@@ -1,0 +1,55 @@
+"""ray_tpu — a TPU-native distributed AI runtime.
+
+A brand-new framework with the capabilities of Ray (reference:
+``/root/reference``, see ``python/ray/__init__.py``) designed idiomatically
+for TPUs: JAX/XLA is the compute substrate, collectives lower to ``jax.lax``
+ops over ICI/DCN meshes instead of NCCL, and the ML libraries (data, train,
+tune, serve, rl) are built over the same task/actor/object primitives that
+make Ray's libraries portable (reference SURVEY: every ML library is pure
+Python over L3).
+"""
+
+from ray_tpu._version import __version__
+from ray_tpu.core.runtime import (
+    init,
+    shutdown,
+    is_initialized,
+    remote,
+    get,
+    put,
+    wait,
+    kill,
+    cancel,
+    get_actor,
+    available_resources,
+    cluster_resources,
+    nodes,
+    method,
+    timeline,
+)
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.actor import ActorClass, ActorHandle
+from ray_tpu.core.runtime_context import get_runtime_context
+
+__all__ = [
+    "__version__",
+    "init",
+    "shutdown",
+    "is_initialized",
+    "remote",
+    "get",
+    "put",
+    "wait",
+    "kill",
+    "cancel",
+    "get_actor",
+    "get_runtime_context",
+    "available_resources",
+    "cluster_resources",
+    "nodes",
+    "method",
+    "timeline",
+    "ObjectRef",
+    "ActorClass",
+    "ActorHandle",
+]

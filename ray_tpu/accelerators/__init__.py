@@ -1,0 +1,3 @@
+from ray_tpu.accelerators.tpu import TPUAcceleratorManager
+
+__all__ = ["TPUAcceleratorManager"]

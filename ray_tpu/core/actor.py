@@ -1,0 +1,142 @@
+"""ActorClass / ActorHandle / ActorMethod.
+
+Role analog: reference ``python/ray/actor.py`` (``ActorClass :566``,
+``ActorHandle :1226``, ``ActorMethod :116``). Each actor is a dedicated
+worker process; method calls are dispatched in submission order by the
+driver (the reference's sequential actor submit queue,
+``src/ray/core_worker/transport/sequential_actor_submit_queue.cc``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ray_tpu.core import task_spec as ts
+from ray_tpu.core.ids import ActorID
+from ray_tpu.core.remote_function import _normalize_resources, _pg_options
+
+
+class ActorMethod:
+    def __init__(self, actor_id: ActorID, method_name: str, options: Optional[Dict] = None):
+        self._actor_id = actor_id
+        self._method_name = method_name
+        self._options = dict(options or {})
+
+    def options(self, **new_options):
+        return ActorMethod(self._actor_id, self._method_name, {**self._options, **new_options})
+
+    def remote(self, *args, **kwargs):
+        from ray_tpu.core.runtime import _get_runtime
+
+        rt = _get_runtime()
+        enc_args, enc_kwargs = ts.encode_args(args, kwargs, rt)
+        num_returns = int(self._options.get("num_returns", 1))
+        spec = ts.make_actor_method_spec(
+            self._actor_id.binary(),
+            self._method_name,
+            enc_args,
+            enc_kwargs,
+            num_returns=num_returns,
+        )
+        refs = rt.submit_actor_task(spec)
+        return refs[0] if num_returns == 1 else refs
+
+    def __call__(self, *a, **kw):
+        raise TypeError(
+            f"actor method {self._method_name} cannot be called directly; use .remote()"
+        )
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID, method_options: Optional[Dict[str, Dict]] = None):
+        object.__setattr__(self, "_actor_id", actor_id)
+        object.__setattr__(self, "_method_options", method_options or {})
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self._actor_id, name, self._method_options.get(name))
+
+    def __repr__(self):
+        return f"ActorHandle({self._actor_id.hex()})"
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._method_options))
+
+    def __hash__(self):
+        return hash(self._actor_id)
+
+    def __eq__(self, other):
+        return isinstance(other, ActorHandle) and other._actor_id == self._actor_id
+
+
+class ActorClass:
+    def __init__(self, cls, options: Dict[str, Any]):
+        self._cls = cls
+        self._options = dict(options or {})
+        self._cls_blob = ts.pickle_fn(cls)
+        self._cls_hash = ts.fn_digest(self._cls_blob)
+        self.__name__ = getattr(cls, "__name__", "Actor")
+        # collect @ray_tpu.method options declared on the class
+        self._method_options = {
+            n: getattr(m, "_rtpu_method_options")
+            for n, m in vars(cls).items()
+            if callable(m) and hasattr(m, "_rtpu_method_options")
+        }
+
+    def __call__(self, *a, **kw):
+        raise TypeError(
+            f"actor class {self.__name__} cannot be instantiated directly; "
+            f"use {self.__name__}.remote()"
+        )
+
+    def options(self, **new_options):
+        ac = ActorClass.__new__(ActorClass)
+        ac._cls = self._cls
+        ac._options = {**self._options, **new_options}
+        ac._cls_blob = self._cls_blob
+        ac._cls_hash = self._cls_hash
+        ac.__name__ = self.__name__
+        ac._method_options = self._method_options
+        return ac
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        from ray_tpu.core.runtime import _get_runtime
+
+        rt = _get_runtime()
+        rt.ensure_fn(self._cls_hash, self._cls_blob)
+        enc_args, enc_kwargs = ts.encode_args(args, kwargs, rt)
+        pg, bundle_index = _pg_options(self._options)
+        spec = ts.make_actor_create_spec(
+            self._cls_hash,
+            enc_args,
+            enc_kwargs,
+            resources=_normalize_resources(self._options, default_cpu=0.0),
+            actor_name=self._options.get("name", ""),
+            max_restarts=int(self._options.get("max_restarts", 0)),
+            max_concurrency=int(self._options.get("max_concurrency", 1)),
+            placement_group_id=pg,
+            bundle_index=bundle_index,
+        )
+        rt.create_actor(spec)
+        return ActorHandle(ActorID(spec["actor_id"]), self._method_options)
+
+    def __reduce__(self):
+        return (_rebuild_actor_class, (self._cls_blob, self._options))
+
+
+def _rebuild_actor_class(cls_blob: bytes, options: Dict[str, Any]) -> ActorClass:
+    import cloudpickle
+
+    ac = ActorClass.__new__(ActorClass)
+    ac._cls = cloudpickle.loads(cls_blob)
+    ac._options = options
+    ac._cls_blob = cls_blob
+    ac._cls_hash = ts.fn_digest(cls_blob)
+    ac.__name__ = getattr(ac._cls, "__name__", "Actor")
+    ac._method_options = {
+        n: getattr(m, "_rtpu_method_options")
+        for n, m in vars(ac._cls).items()
+        if callable(m) and hasattr(m, "_rtpu_method_options")
+    }
+    return ac

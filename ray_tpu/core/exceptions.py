@@ -1,0 +1,67 @@
+"""Exception types surfaced by the runtime.
+
+Role analog: reference ``python/ray/exceptions.py``.
+"""
+
+from __future__ import annotations
+
+import traceback
+
+
+class RayTpuError(Exception):
+    pass
+
+
+class TaskError(RayTpuError):
+    """A task raised; re-raised at ``get`` with the remote traceback."""
+
+    def __init__(self, cause: BaseException, remote_tb: str = "", task_desc: str = ""):
+        self.cause = cause
+        self.remote_tb = remote_tb
+        self.task_desc = task_desc
+        super().__init__(str(cause))
+
+    def __str__(self):
+        return (
+            f"{type(self.cause).__name__}: {self.cause}\n"
+            f"--- remote traceback ({self.task_desc}) ---\n{self.remote_tb}"
+        )
+
+
+def wrap_current_exception(task_desc: str = "") -> TaskError:
+    import sys
+
+    et, ev, tb = sys.exc_info()
+    return TaskError(ev, "".join(traceback.format_exception(et, ev, tb)), task_desc)
+
+
+class WorkerCrashedError(RayTpuError):
+    pass
+
+
+class ActorDiedError(RayTpuError):
+    pass
+
+
+class ActorUnavailableError(RayTpuError):
+    pass
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    pass
+
+
+class ObjectLostError(RayTpuError):
+    pass
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    pass
+
+
+class TaskCancelledError(RayTpuError):
+    pass
+
+
+class PlacementGroupUnavailableError(RayTpuError):
+    pass

@@ -1,0 +1,251 @@
+"""Global control state: object directory, KV store, function/actor tables.
+
+Role analog: reference GCS server (``src/ray/gcs/gcs_server``): InternalKV
+(``gcs_kv_manager.h``), function table (``gcs_function_manager.h``), actor
+table (``gcs_actor_manager.h``), plus the object directory the reference
+keeps per-owner (``ownership_based_object_directory.h``). Single-node
+round 1: in-process state guarded by locks; the narrow method surface is the
+seam where a networked control plane slots in for multi-node.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+from ray_tpu.core.ids import ActorID, ObjectID
+
+PENDING = "PENDING"
+READY = "READY"
+ERROR = "ERROR"
+
+
+class ObjectState:
+    __slots__ = ("status", "inline", "error", "size")
+
+    def __init__(self):
+        self.status = PENDING
+        self.inline: Optional[bytes] = None  # blob if stored inline
+        self.error: Optional[bytes] = None  # serialized TaskError
+        self.size = 0
+
+
+class ActorInfo:
+    __slots__ = (
+        "actor_id", "name", "worker_id", "state", "create_spec",
+        "max_restarts", "restarts", "pending_queue", "running",
+        "death_cause", "max_concurrency", "inflight",
+    )
+
+    def __init__(self, actor_id: ActorID, create_spec: dict):
+        self.actor_id = actor_id
+        self.name = create_spec.get("name") or ""
+        self.worker_id = None
+        self.state = "PENDING"  # PENDING | ALIVE | RESTARTING | DEAD
+        self.create_spec = create_spec
+        self.max_restarts = create_spec.get("max_restarts", 0)
+        self.restarts = 0
+        self.pending_queue: List[dict] = []
+        self.running = False  # a method is currently dispatched
+        self.death_cause = ""
+        self.max_concurrency = create_spec.get("max_concurrency", 1)
+        self.inflight = 0
+
+
+class _Waiter:
+    __slots__ = ("ids", "num_needed", "callback", "fired", "include_errors")
+
+    def __init__(self, ids, num_needed, callback):
+        self.ids: Set[ObjectID] = set(ids)
+        self.num_needed = num_needed
+        self.callback = callback
+        self.fired = False
+
+
+class Gcs:
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.kv: Dict[str, Dict[str, bytes]] = {}  # namespace -> {key: val}
+        self.functions: Dict[str, bytes] = {}
+        self.objects: Dict[ObjectID, ObjectState] = {}
+        self.actors: Dict[ActorID, ActorInfo] = {}
+        self.named_actors: Dict[str, ActorID] = {}
+        self._obj_waiters: Dict[ObjectID, List[_Waiter]] = {}
+        self._cv = threading.Condition(self.lock)
+
+    # -- function table ---------------------------------------------------
+
+    def register_fn(self, h: str, blob: bytes) -> None:
+        with self.lock:
+            self.functions.setdefault(h, blob)
+
+    def get_fn(self, h: str) -> Optional[bytes]:
+        with self.lock:
+            return self.functions.get(h)
+
+    # -- KV ---------------------------------------------------------------
+
+    def kv_put(self, key: str, value: bytes, namespace: str = "default", overwrite: bool = True) -> bool:
+        with self.lock:
+            ns = self.kv.setdefault(namespace, {})
+            if not overwrite and key in ns:
+                return False
+            ns[key] = value
+            return True
+
+    def kv_get(self, key: str, namespace: str = "default") -> Optional[bytes]:
+        with self.lock:
+            return self.kv.get(namespace, {}).get(key)
+
+    def kv_del(self, key: str, namespace: str = "default") -> bool:
+        with self.lock:
+            return self.kv.get(namespace, {}).pop(key, None) is not None
+
+    def kv_keys(self, prefix: str = "", namespace: str = "default") -> List[str]:
+        with self.lock:
+            return [k for k in self.kv.get(namespace, {}) if k.startswith(prefix)]
+
+    # -- object directory -------------------------------------------------
+
+    def ensure_object(self, obj_id: ObjectID) -> ObjectState:
+        with self.lock:
+            st = self.objects.get(obj_id)
+            if st is None:
+                st = ObjectState()
+                self.objects[obj_id] = st
+            return st
+
+    def mark_ready(self, obj_id: ObjectID, inline: Optional[bytes] = None, size: int = 0) -> None:
+        with self.lock:
+            st = self.ensure_object(obj_id)
+            if st.status == ERROR:
+                return  # terminal states never downgrade (e.g. cancellation)
+            st.status = READY
+            st.inline = inline
+            st.size = size or (len(inline) if inline else 0)
+            self._fire_waiters(obj_id)
+            self._cv.notify_all()
+
+    def mark_error(self, obj_id: ObjectID, err_blob: bytes) -> None:
+        with self.lock:
+            st = self.ensure_object(obj_id)
+            st.status = ERROR
+            st.error = err_blob
+            self._fire_waiters(obj_id)
+            self._cv.notify_all()
+
+    def object_state(self, obj_id: ObjectID) -> Optional[ObjectState]:
+        with self.lock:
+            return self.objects.get(obj_id)
+
+    def drop_object(self, obj_id: ObjectID) -> None:
+        with self.lock:
+            self.objects.pop(obj_id, None)
+
+    def _fire_waiters(self, obj_id: ObjectID) -> None:
+        # caller holds lock
+        waiters = self._obj_waiters.pop(obj_id, [])
+        for w in waiters:
+            if w.fired:
+                continue
+            w.ids.discard(obj_id)
+            w.num_needed -= 1
+            if w.num_needed <= 0:
+                w.fired = True
+                for other in w.ids:
+                    lst = self._obj_waiters.get(other)
+                    if lst and w in lst:
+                        lst.remove(w)
+                cb = w.callback
+                threading.Thread(target=cb, daemon=True).start()
+
+    def add_waiter(self, ids: List[ObjectID], num_needed: int, callback: Callable[[], None]):
+        """Invoke ``callback`` (on a fresh thread) once ``num_needed`` of
+        ``ids`` are terminal (READY or ERROR). Fires immediately if already
+        satisfied. Returns the waiter (or None if fired) so callers with a
+        timeout can ``cancel_waiter`` it."""
+        with self.lock:
+            pending = []
+            done = 0
+            for i in ids:
+                st = self.objects.get(i)
+                if st is not None and st.status in (READY, ERROR):
+                    done += 1
+                else:
+                    self.ensure_object(i)
+                    pending.append(i)
+            if done >= num_needed:
+                threading.Thread(target=callback, daemon=True).start()
+                return None
+            w = _Waiter(pending, num_needed - done, callback)
+            for i in pending:
+                self._obj_waiters.setdefault(i, []).append(w)
+            return w
+
+    def cancel_waiter(self, w) -> None:
+        if w is None:
+            return
+        with self.lock:
+            if w.fired:
+                return
+            w.fired = True
+            for i in w.ids:
+                lst = self._obj_waiters.get(i)
+                if lst and w in lst:
+                    lst.remove(w)
+                    if not lst:
+                        del self._obj_waiters[i]
+
+    def wait_objects(
+        self, ids: List[ObjectID], num_returns: int, timeout: Optional[float]
+    ) -> Tuple[List[ObjectID], List[ObjectID]]:
+        """Blocking wait (driver-side fast path)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while True:
+                ready = [
+                    i
+                    for i in ids
+                    if (st := self.objects.get(i)) is not None
+                    and st.status in (READY, ERROR)
+                ]
+                if len(ready) >= num_returns:
+                    ready = ready[:num_returns] if num_returns < len(ready) else ready
+                    rest = [i for i in ids if i not in set(ready)]
+                    return ready, rest
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        rest = [i for i in ids if i not in set(ready)]
+                        return ready, rest
+                    self._cv.wait(remaining)
+                else:
+                    self._cv.wait(5.0)
+
+    # -- actor table ------------------------------------------------------
+
+    def register_actor(self, info: ActorInfo) -> None:
+        with self.lock:
+            self.actors[info.actor_id] = info
+            if info.name:
+                if info.name in self.named_actors:
+                    raise ValueError(f"actor name {info.name!r} already taken")
+                self.named_actors[info.name] = info.actor_id
+
+    def get_actor(self, actor_id: ActorID) -> Optional[ActorInfo]:
+        with self.lock:
+            return self.actors.get(actor_id)
+
+    def lookup_named(self, name: str) -> Optional[ActorID]:
+        with self.lock:
+            return self.named_actors.get(name)
+
+    def mark_actor_dead(self, actor_id: ActorID, cause: str) -> None:
+        with self.lock:
+            info = self.actors.get(actor_id)
+            if info:
+                info.state = "DEAD"
+                info.death_cause = cause
+                if info.name:
+                    self.named_actors.pop(info.name, None)

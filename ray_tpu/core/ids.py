@@ -1,0 +1,89 @@
+"""Binary IDs for tasks, objects, actors, nodes, placement groups.
+
+Role analog: reference ``src/ray/common/id.h`` (28-byte binary IDs). We use
+16 random bytes — uniqueness within a cluster lifetime is all the runtime
+needs, and shorter ids keep message payloads small.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_ID_LEN = 16
+
+_local = threading.local()
+
+
+def _rand_bytes() -> bytes:
+    return os.urandom(_ID_LEN)
+
+
+class BaseID:
+    __slots__ = ("_bytes",)
+
+    def __init__(self, id_bytes: bytes):
+        if not isinstance(id_bytes, bytes) or len(id_bytes) != _ID_LEN:
+            raise ValueError(f"expected {_ID_LEN} raw bytes, got {id_bytes!r}")
+        self._bytes = id_bytes
+
+    @classmethod
+    def from_random(cls):
+        return cls(_rand_bytes())
+
+    @classmethod
+    def from_hex(cls, h: str):
+        return cls(bytes.fromhex(h))
+
+    @classmethod
+    def nil(cls):
+        return cls(b"\x00" * _ID_LEN)
+
+    def is_nil(self) -> bool:
+        return self._bytes == b"\x00" * _ID_LEN
+
+    def binary(self) -> bytes:
+        return self._bytes
+
+    def hex(self) -> str:
+        return self._bytes.hex()
+
+    def __hash__(self):
+        return hash((type(self).__name__, self._bytes))
+
+    def __eq__(self, other):
+        return type(other) is type(self) and other._bytes == self._bytes
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.hex()})"
+
+    def __reduce__(self):
+        return (type(self), (self._bytes,))
+
+
+class ObjectID(BaseID):
+    pass
+
+
+class TaskID(BaseID):
+    pass
+
+
+class ActorID(BaseID):
+    pass
+
+
+class NodeID(BaseID):
+    pass
+
+
+class PlacementGroupID(BaseID):
+    pass
+
+
+class WorkerID(BaseID):
+    pass
+
+
+class JobID(BaseID):
+    pass

@@ -1,0 +1,51 @@
+"""ObjectRef — a future for a value in the object store.
+
+Role analog: reference ``python/ray/includes/object_ref.pxi:36``.
+"""
+
+from __future__ import annotations
+
+from ray_tpu.core.ids import ObjectID
+
+
+class ObjectRef:
+    __slots__ = ("id", "owner", "_task_id")
+
+    def __init__(self, object_id: ObjectID, owner: str = "", task_id=None):
+        self.id = object_id
+        self.owner = owner
+        self._task_id = task_id
+
+    def binary(self) -> bytes:
+        return self.id.binary()
+
+    def hex(self) -> str:
+        return self.id.hex()
+
+    def task_id(self):
+        return self._task_id
+
+    def __hash__(self):
+        return hash(self.id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other.id == self.id
+
+    def __repr__(self):
+        return f"ObjectRef({self.id.hex()})"
+
+    def __reduce__(self):
+        return (ObjectRef, (self.id, self.owner, self._task_id))
+
+    # ``await ref`` support inside async actors / drivers.
+    def __await__(self):
+        import asyncio
+
+        loop = asyncio.get_event_loop()
+
+        def _get():
+            from ray_tpu.core.runtime import get
+
+            return get(self)
+
+        return loop.run_in_executor(None, _get).__await__()

@@ -1,0 +1,165 @@
+"""Shared-memory object store.
+
+Role analog: reference plasma (``src/ray/object_manager/plasma/store.h``) +
+``CoreWorkerPlasmaStoreProvider``. Implementation differs deliberately:
+instead of a store daemon owning one big dlmalloc arena and serving a
+unix-socket protocol, each object is one file in ``/dev/shm`` mmap'd by
+writer and readers. Readiness ("sealing") is coordinated by the object
+directory in the control plane, so readers never attach before the writer
+finished. A C++ arena-backed store can be slotted under the same client API
+later (``ray_tpu/_native``).
+
+Small objects (< INLINE_THRESHOLD) never touch the store: they live inline
+in the object directory (the reference's in-process memory store analog).
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import threading
+from typing import Any, Dict, Optional
+
+from ray_tpu.core import serialization
+from ray_tpu.core.ids import ObjectID
+
+INLINE_THRESHOLD = 8192
+
+_SHM_DIR = "/dev/shm"
+
+
+def _seg_path(session: str, obj_id: ObjectID) -> str:
+    return os.path.join(_SHM_DIR, f"rtpu-{session}-{obj_id.hex()}")
+
+
+class _Pinned:
+    """A mapped segment kept alive while any deserialized view exists."""
+
+    __slots__ = ("mm", "fd", "size")
+
+    def __init__(self, mm: mmap.mmap, fd: int, size: int):
+        self.mm = mm
+        self.fd = fd
+        self.size = size
+
+
+class StoreClient:
+    """Per-process object-store client."""
+
+    def __init__(self, session: str):
+        self.session = session
+        self._pins: Dict[ObjectID, _Pinned] = {}
+        self._lock = threading.Lock()
+
+    # -- write path -------------------------------------------------------
+
+    def put(self, obj_id: ObjectID, value: Any) -> Optional[bytes]:
+        """Serialize ``value``.
+
+        Returns the serialized blob if it is small enough to inline in the
+        directory (caller ships it over the control channel), else writes a
+        shm segment and returns None.
+        """
+        data, buffers = serialization.serialize(value)
+        return self.put_parts(obj_id, data, buffers)
+
+    def put_parts(self, obj_id: ObjectID, data: bytes, buffers) -> Optional[bytes]:
+        """Like ``put`` but takes an already-serialized (data, buffers) pair
+        so callers that must size-check first don't serialize twice."""
+        size = serialization.serialized_size(data, buffers)
+        if size < INLINE_THRESHOLD:
+            out = bytearray(size)
+            serialization.write_into(memoryview(out), data, buffers)
+            return bytes(out)
+        path = _seg_path(self.session, obj_id)
+        fd = os.open(path, os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
+        try:
+            os.ftruncate(fd, size)
+            mm = mmap.mmap(fd, size)
+            serialization.write_into(memoryview(mm), data, buffers)
+        finally:
+            os.close(fd)
+        mm.close()
+        return None
+
+    def put_serialized(self, obj_id: ObjectID, blob: bytes) -> None:
+        """Write an already-serialized blob into a segment (spill-in path)."""
+        path = _seg_path(self.session, obj_id)
+        fd = os.open(path, os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
+        try:
+            os.ftruncate(fd, len(blob))
+            mm = mmap.mmap(fd, len(blob))
+            mm[:] = blob
+            mm.close()
+        finally:
+            os.close(fd)
+
+    # -- read path --------------------------------------------------------
+
+    def get(self, obj_id: ObjectID) -> Any:
+        """Deserialize from shm; zero-copy views pin the mapping."""
+        with self._lock:
+            pinned = self._pins.get(obj_id)
+        if pinned is None:
+            path = _seg_path(self.session, obj_id)
+            fd = os.open(path, os.O_RDONLY)
+            try:
+                size = os.fstat(fd).st_size
+                mm = mmap.mmap(fd, size, prot=mmap.PROT_READ)
+            finally:
+                os.close(fd)
+            pinned = _Pinned(mm, -1, size)
+            with self._lock:
+                self._pins[obj_id] = pinned
+        return serialization.read_from(memoryview(pinned.mm))
+
+    def contains(self, obj_id: ObjectID) -> bool:
+        return obj_id in self._pins or os.path.exists(_seg_path(self.session, obj_id))
+
+    def release(self, obj_id: ObjectID) -> None:
+        """Drop this process's pin (views must no longer be used)."""
+        with self._lock:
+            pinned = self._pins.pop(obj_id, None)
+        if pinned is not None:
+            try:
+                pinned.mm.close()
+            except BufferError:
+                # Live views still reference the mapping; re-pin.
+                with self._lock:
+                    self._pins[obj_id] = pinned
+
+    def delete(self, obj_id: ObjectID) -> None:
+        """Unlink the segment (owner/driver only)."""
+        self.release(obj_id)
+        try:
+            os.unlink(_seg_path(self.session, obj_id))
+        except FileNotFoundError:
+            pass
+
+    def store_bytes(self) -> int:
+        """Total bytes of this session's segments currently in shm."""
+        total = 0
+        prefix = f"rtpu-{self.session}-"
+        try:
+            for name in os.listdir(_SHM_DIR):
+                if name.startswith(prefix):
+                    try:
+                        total += os.stat(os.path.join(_SHM_DIR, name)).st_size
+                    except OSError:
+                        pass
+        except OSError:
+            pass
+        return total
+
+    @staticmethod
+    def cleanup_session(session: str) -> None:
+        prefix = f"rtpu-{session}-"
+        try:
+            for name in os.listdir(_SHM_DIR):
+                if name.startswith(prefix):
+                    try:
+                        os.unlink(os.path.join(_SHM_DIR, name))
+                    except OSError:
+                        pass
+        except OSError:
+            pass

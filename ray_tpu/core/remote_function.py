@@ -1,0 +1,107 @@
+"""RemoteFunction — the object created by ``@ray_tpu.remote`` on a function.
+
+Role analog: reference ``python/ray/remote_function.py`` (``RemoteFunction.
+_remote :266`` → submit). The function body is cloudpickled once and cached
+in the GCS function table keyed by digest; specs carry only the digest.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ray_tpu.core import task_spec as ts
+
+
+def _normalize_resources(opts: Dict[str, Any], default_cpu: float = 1.0) -> Dict[str, float]:
+    res: Dict[str, float] = {}
+    num_cpus = opts.get("num_cpus")
+    res["CPU"] = float(default_cpu if num_cpus is None else num_cpus)
+    if opts.get("num_tpus"):
+        res["TPU"] = float(opts["num_tpus"])
+    if opts.get("num_gpus"):
+        res["GPU"] = float(opts["num_gpus"])
+    for k, v in (opts.get("resources") or {}).items():
+        res[k] = float(v)
+    res = {k: v for k, v in res.items() if v}
+    return res
+
+
+def _pg_options(opts: Dict[str, Any]):
+    pg = opts.get("placement_group")
+    strategy = opts.get("scheduling_strategy")
+    bundle_index = opts.get("placement_group_bundle_index", -1)
+    if strategy is not None and hasattr(strategy, "placement_group"):
+        pg = strategy.placement_group
+        bundle_index = getattr(strategy, "placement_group_bundle_index", -1)
+        if bundle_index is None:
+            bundle_index = -1
+    if pg is not None and not isinstance(pg, (bytes, bytearray)):
+        pg = pg.id.binary()
+    return pg, bundle_index
+
+
+class RemoteFunction:
+    def __init__(self, fn, options: Dict[str, Any]):
+        self._function = fn
+        self._options = dict(options or {})
+        self._fn_blob = ts.pickle_fn(fn)
+        self._fn_hash = ts.fn_digest(self._fn_blob)
+        self.__name__ = getattr(fn, "__name__", "remote_fn")
+        self.__doc__ = getattr(fn, "__doc__", None)
+
+    def __call__(self, *a, **kw):
+        raise TypeError(
+            f"remote function {self.__name__} cannot be called directly; "
+            f"use {self.__name__}.remote()"
+        )
+
+    def options(self, **new_options):
+        merged = {**self._options, **new_options}
+        rf = RemoteFunction.__new__(RemoteFunction)
+        rf._function = self._function
+        rf._options = merged
+        rf._fn_blob = self._fn_blob
+        rf._fn_hash = self._fn_hash
+        rf.__name__ = self.__name__
+        rf.__doc__ = self.__doc__
+        return rf
+
+    def remote(self, *args, **kwargs):
+        from ray_tpu.core.runtime import _get_runtime
+
+        rt = _get_runtime()
+        rt.ensure_fn(self._fn_hash, self._fn_blob)
+        enc_args, enc_kwargs = ts.encode_args(args, kwargs, rt)
+        pg, bundle_index = _pg_options(self._options)
+        num_returns = int(self._options.get("num_returns", 1))
+        spec = ts.make_task_spec(
+            self._fn_hash,
+            enc_args,
+            enc_kwargs,
+            num_returns=num_returns,
+            resources=_normalize_resources(self._options),
+            name=self._options.get("name", self.__name__),
+            max_retries=int(self._options.get("max_retries", 0)),
+            placement_group_id=pg,
+            bundle_index=bundle_index,
+        )
+        refs = rt.submit(spec)
+        if num_returns == 1:
+            return refs[0]
+        return refs
+
+    def __reduce__(self):
+        return (_rebuild_remote_function, (self._fn_blob, self._options))
+
+
+def _rebuild_remote_function(fn_blob: bytes, options: Dict[str, Any]) -> RemoteFunction:
+    import cloudpickle
+
+    rf = RemoteFunction.__new__(RemoteFunction)
+    rf._function = cloudpickle.loads(fn_blob)
+    rf._options = options
+    rf._fn_blob = fn_blob
+    rf._fn_hash = ts.fn_digest(fn_blob)
+    rf.__name__ = getattr(rf._function, "__name__", "remote_fn")
+    rf.__doc__ = getattr(rf._function, "__doc__", None)
+    return rf

@@ -1,0 +1,1152 @@
+"""Driver-side runtime: scheduler, worker pool, public API.
+
+Role analogs in the reference:
+  - scheduler/dispatch: ``src/ray/raylet/local_task_manager.h`` +
+    ``scheduling/cluster_task_manager.h`` (single node, so no spillback)
+  - worker pool: ``src/ray/raylet/worker_pool.h`` (prestart, dedicated
+    actor workers)
+  - public API: ``python/ray/_private/worker.py`` (init/get/put/wait/remote)
+
+Control transport is one duplex pipe per worker; the driver runs one reader
+thread per worker plus an event-driven dispatch loop under a single lock
+(fine for a single node; the multi-node design moves this behind gRPC).
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+from ray_tpu.core import serialization, task_spec as ts
+from ray_tpu.core.exceptions import (
+    ActorDiedError,
+    GetTimeoutError,
+    TaskCancelledError,
+    WorkerCrashedError,
+)
+from ray_tpu.core.gcs import ERROR, Gcs, READY, ActorInfo
+from ray_tpu.core.ids import ActorID, NodeID, ObjectID, TaskID, WorkerID
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.object_store import StoreClient
+
+_runtime = None
+_runtime_lock = threading.Lock()
+
+
+def _set_runtime(rt):
+    global _runtime
+    _runtime = rt
+
+
+def _get_runtime():
+    if _runtime is None:
+        raise RuntimeError("ray_tpu.init() has not been called in this process")
+    return _runtime
+
+
+class _WorkerState:
+    __slots__ = (
+        "worker_id", "proc", "conn", "kind", "status", "current",
+        "held", "actor_id", "reader", "released", "send_lock", "log_path",
+        "pending_spec",
+    )
+
+    def __init__(self, worker_id: WorkerID, proc, kind: str):
+        self.worker_id = worker_id
+        self.proc = proc  # subprocess.Popen
+        self.conn = None  # attached when the worker dials back
+        self.kind = kind  # "pool" | "actor"
+        self.status = "starting"  # starting | idle | busy | dead
+        self.current: Optional[dict] = None
+        self.held: Dict[str, float] = {}
+        self.actor_id: Optional[bytes] = None
+        self.released = False
+        self.send_lock = threading.Lock()
+        self.log_path = ""
+        self.pending_spec: Optional[dict] = None  # dispatch once connected
+
+    def send(self, msg):
+        if self.conn is None:
+            raise OSError("worker not connected yet")
+        with self.send_lock:
+            self.conn.send(msg)
+
+
+class DriverRuntime:
+    is_driver = True
+
+    def __init__(
+        self,
+        num_cpus: Optional[int] = None,
+        num_tpus: Optional[int] = None,
+        resources: Optional[Dict[str, float]] = None,
+        namespace: str = "default",
+        worker_env: Optional[Dict[str, str]] = None,
+        _pool_prestart: int = 2,
+    ):
+        self.session = uuid.uuid4().hex[:12]
+        self.namespace = namespace
+        self.node_id = NodeID.from_random()
+        self.gcs = Gcs()
+        self.store = StoreClient(self.session)
+        self.worker_env = dict(worker_env or {})
+        # Workers must not grab the TPU runtime by default — the driver (or a
+        # designated actor) owns the chip. Opt back in with
+        # @remote(runtime_env={"env_vars": {"JAX_PLATFORMS": ""}}).
+        self.worker_env.setdefault("JAX_PLATFORMS", os.environ.get("JAX_PLATFORMS", "cpu"))
+
+        cpus = num_cpus if num_cpus is not None else (os.cpu_count() or 1)
+        from ray_tpu.accelerators.tpu import detect_num_tpu_chips
+
+        tpus = num_tpus if num_tpus is not None else detect_num_tpu_chips()
+        self.total: Dict[str, float] = {"CPU": float(cpus)}
+        if tpus:
+            self.total["TPU"] = float(tpus)
+        for k, v in (resources or {}).items():
+            self.total[k] = float(v)
+        self.avail = dict(self.total)
+
+        self.lock = threading.RLock()
+        self.workers: Dict[WorkerID, _WorkerState] = {}
+        self.ready_tasks: deque = deque()
+        self.waiting_specs: Dict[bytes, dict] = {}
+        self.cancelled: set = set()
+        self.pgs: Dict[bytes, dict] = {}  # pg_id -> {"bundles": [avail dicts], "totals": [...]}
+        self.timeline_events: List[dict] = []
+        self._task_start_ts: Dict[bytes, float] = {}
+        self.pool_cap = max(4, cpus)
+        self.pool_hard_cap = max(64, cpus * 8)
+        self._spawning = 0  # spawns decided but not yet registered
+        self._shutdown = False
+
+        self.session_dir = f"/tmp/rtpu-{self.session}"
+        os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
+        self._sock_addr = os.path.join(self.session_dir, "driver.sock")
+        from multiprocessing.connection import Listener
+
+        self._listener = Listener(self._sock_addr, family="AF_UNIX", authkey=self.session.encode())
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+        for _ in range(min(_pool_prestart, self.pool_cap)):
+            self._spawn_worker("pool")
+
+    # ------------------------------------------------------------------
+    # worker lifecycle
+    # ------------------------------------------------------------------
+
+    def _accept_loop(self):
+        while not self._shutdown:
+            try:
+                conn = self._listener.accept()
+            except (OSError, EOFError):
+                return
+            try:
+                kind, wid_bytes = conn.recv()
+                assert kind == "hello"
+            except Exception:
+                conn.close()
+                continue
+            wid = WorkerID(wid_bytes)
+            with self.lock:
+                ws = self.workers.get(wid)
+            if ws is None or ws.status == "dead":
+                conn.close()
+                continue
+            ws.conn = conn
+            reader = threading.Thread(target=self._reader_loop, args=(ws,), daemon=True)
+            ws.reader = reader
+            reader.start()
+
+    def _spawn_worker(self, kind: str) -> _WorkerState:
+        import subprocess
+        import sys
+
+        wid = WorkerID.from_random()
+        env = dict(os.environ)
+        env.update(self.worker_env)
+        env["RTPU_WORKER"] = "1"
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        log_path = os.path.join(self.session_dir, "logs", f"worker-{wid.hex()[:8]}.log")
+        log_f = open(log_path, "wb", buffering=0)
+        proc = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "ray_tpu.core.worker",
+                "--addr",
+                self._sock_addr,
+                "--session",
+                self.session,
+                "--worker-id",
+                wid.hex(),
+            ],
+            env=env,
+            stdout=log_f,
+            stderr=subprocess.STDOUT,
+            stdin=subprocess.DEVNULL,
+        )
+        log_f.close()
+        ws = _WorkerState(wid, proc, kind)
+        ws.log_path = log_path
+        with self.lock:
+            self.workers[wid] = ws
+        threading.Thread(target=self._reap, args=(ws,), daemon=True).start()
+        return ws
+
+    def _reap(self, ws: _WorkerState):
+        ws.proc.wait()
+        if not self._shutdown:
+            self._on_worker_death(ws)
+
+    def _reader_loop(self, ws: _WorkerState):
+        while True:
+            try:
+                msg = ws.conn.recv()
+            except (EOFError, OSError):
+                self._on_worker_death(ws)
+                return
+            try:
+                self._handle_msg(ws, msg)
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+
+    def _on_worker_death(self, ws: _WorkerState):
+        with self.lock:
+            if ws.status == "dead":
+                return
+            was = ws.status
+            ws.status = "dead"
+            if not ws.released:
+                self._release(ws.held)
+            spec = ws.current
+            ws.current = None
+        if spec is not None:
+            if spec["type"] == ts.ACTOR_CREATE or ws.actor_id is not None:
+                self._actor_process_died(ws, spec if spec["type"] != ts.ACTOR_CREATE else None)
+            elif spec.get("retries_left", 0) > 0:
+                spec["retries_left"] -= 1
+                self._enqueue_ready(spec)
+            else:
+                err = cloudpickle.dumps(
+                    WorkerCrashedError(f"worker {ws.worker_id.hex()} died running task")
+                )
+                for rid in spec["return_ids"]:
+                    self.gcs.mark_error(ObjectID(rid), err)
+        elif ws.actor_id is not None:
+            self._actor_process_died(ws, None)
+        with self.lock:
+            alive_pool = sum(
+                1 for w in self.workers.values() if w.kind == "pool" and w.status != "dead"
+            )
+            need = (
+                ws.kind == "pool"
+                and (self.ready_tasks or was == "busy")
+                and alive_pool < self.pool_cap
+            )
+            shutdown = self._shutdown
+        if need and not shutdown:
+            self._spawn_worker("pool")
+        self._pump()
+
+    def _actor_process_died(self, ws: _WorkerState, inflight_spec: Optional[dict]):
+        aid = ws.actor_id or (inflight_spec and inflight_spec.get("actor_id"))
+        if aid is None:
+            return
+        info = self.gcs.get_actor(ActorID(aid))
+        if info is None:
+            return
+        err = cloudpickle.dumps(ActorDiedError(f"actor {ActorID(aid).hex()} died"))
+        if inflight_spec is not None:
+            for rid in inflight_spec["return_ids"]:
+                self.gcs.mark_error(ObjectID(rid), err)
+        with self.lock:
+            if info.restarts < info.max_restarts or info.max_restarts == -1:
+                info.restarts += 1
+                info.state = "RESTARTING"
+                restart = True
+            else:
+                restart = False
+        if restart:
+            new_ws = self._spawn_worker("actor")
+            new_ws.actor_id = aid
+            info.worker_id = new_ws.worker_id
+            info.running = True
+            new_ws.pending_spec = dict(info.create_spec)
+        else:
+            self._mark_actor_dead_and_flush(ActorID(aid), "process died", err)
+
+    def _mark_actor_dead_and_flush(self, actor_id: ActorID, cause: str, err_blob: bytes):
+        """Mark an actor DEAD and fail every queued method call — otherwise
+        callers blocked on queued refs would hang forever."""
+        info = self.gcs.get_actor(actor_id)
+        self.gcs.mark_actor_dead(actor_id, cause)
+        if info is None:
+            return
+        with self.lock:
+            queued = list(info.pending_queue)
+            info.pending_queue.clear()
+        for q in queued:
+            for rid in q["return_ids"]:
+                self.gcs.mark_error(ObjectID(rid), err_blob)
+
+    # ------------------------------------------------------------------
+    # message handling (driver side)
+    # ------------------------------------------------------------------
+
+    def _handle_msg(self, ws: _WorkerState, msg):
+        kind = msg[0]
+        if kind == "ready":
+            with self.lock:
+                if ws.status == "starting":
+                    ws.status = "idle"
+                pending = ws.pending_spec
+                ws.pending_spec = None
+            if pending is not None:
+                self._dispatch_to(ws, pending)
+            else:
+                self._pump()
+        elif kind == "done":
+            self._handle_done(ws, msg[1], msg[2])
+        elif kind == "cast":
+            self._handle_cast(ws, msg[1], msg[2])
+        elif kind == "req":
+            self._handle_req(ws, msg[1], msg[2], msg[3])
+
+    def _handle_done(self, ws: _WorkerState, task_id_b: bytes, results):
+        spec = ws.current
+        for rid, rkind, payload in results:
+            oid = ObjectID(rid)
+            if rkind == "i":
+                self.gcs.mark_ready(oid, inline=payload)
+            elif rkind == "s":
+                self.gcs.mark_ready(oid)
+            else:
+                self.gcs.mark_error(oid, payload)
+        start = self._task_start_ts.pop(task_id_b, None)
+        if start is not None and len(self.timeline_events) < 200_000:
+            name = (spec or {}).get("name") or (spec or {}).get("method") or "task"
+            self.timeline_events.append(
+                {
+                    "name": name,
+                    "ph": "X",
+                    "ts": start * 1e6,
+                    "dur": (time.time() - start) * 1e6,
+                    "pid": 1,
+                    "tid": ws.worker_id.hex()[:8],
+                }
+            )
+        failed = bool(results and results[0][1] == "e")
+        with self.lock:
+            ws.current = None
+            if not ws.released:
+                self._release(ws.held)
+            ws.held = {}
+            ws.released = False
+            if spec is not None and spec["type"] == ts.ACTOR_CREATE:
+                info = self.gcs.get_actor(ActorID(spec["actor_id"]))
+                if info is not None:
+                    info.running = False
+                    if failed:
+                        info.state = "DEAD"
+                    else:
+                        info.state = "ALIVE"
+                ws.status = "idle"
+            elif spec is not None and spec["type"] == ts.ACTOR_METHOD:
+                info = self.gcs.get_actor(ActorID(spec["actor_id"]))
+                if info is not None:
+                    info.running = False
+                ws.status = "idle"
+            else:
+                ws.status = "idle"
+        if spec is not None and spec["type"] == ts.ACTOR_CREATE and failed:
+            self._mark_actor_dead_and_flush(
+                ActorID(spec["actor_id"]), "creation task failed", results[0][2]
+            )
+        self._pump()
+
+    def _handle_cast(self, ws: _WorkerState, op: str, args):
+        if op == "put":
+            oid = ObjectID(args[0])
+            self.gcs.mark_ready(oid, inline=args[1])
+        elif op == "submit":
+            self.submit_spec(args[0])
+        elif op == "actor_call":
+            self._submit_actor_spec(args[0])
+        elif op == "fn_put":
+            self.gcs.register_fn(args[0], args[1])
+        elif op == "blocked":
+            with self.lock:
+                if not ws.released and ws.current is not None:
+                    self._release(ws.held)
+                    ws.released = True
+            self._pump()
+        elif op == "unblocked":
+            with self.lock:
+                if ws.released:
+                    self._acquire_forced(ws.held)
+                    ws.released = False
+        elif op == "kill_actor":
+            self.kill_actor(args[0], args[1])
+        elif op == "cancel":
+            self.cancel_task(ObjectID(args[0]))
+        elif op == "free":
+            for b in args[0]:
+                oid = ObjectID(b)
+                self.gcs.drop_object(oid)
+                self.store.delete(oid)
+
+    def _handle_req(self, ws: _WorkerState, req_id: int, op: str, args):
+        def reply(payload, err: Optional[BaseException] = None):
+            try:
+                if err is not None:
+                    ws.send(("reply", req_id, "err", cloudpickle.dumps(err)))
+                else:
+                    ws.send(("reply", req_id, "ok", payload))
+            except (OSError, BrokenPipeError):
+                pass
+
+        try:
+            if op == "get":
+                ids, timeout = args
+                self._async_get(ids, timeout, reply)
+            elif op == "wait":
+                ids, num_returns, timeout = args
+                self._async_wait(ids, num_returns, timeout, reply)
+            elif op == "fn_get":
+                reply(self.gcs.get_fn(args[0]))
+            elif op == "actor_create":
+                self.submit_spec(args[0])
+                reply(None)
+            elif op == "name_lookup":
+                aid = self.gcs.lookup_named(args[0])
+                reply(aid.binary() if aid else None)
+            elif op == "kv":
+                sub, rest = args[0], args[1:]
+                fn = {
+                    "put": self.gcs.kv_put,
+                    "get": self.gcs.kv_get,
+                    "del": self.gcs.kv_del,
+                    "keys": self.gcs.kv_keys,
+                }[sub]
+                reply(fn(*rest))
+            elif op == "resources":
+                with self.lock:
+                    reply(dict(self.avail if args[0] == "avail" else self.total))
+            elif op == "nodes":
+                reply(self.node_info())
+            elif op == "pg_create":
+                reply(self.create_placement_group(args[0], args[1]))
+            elif op == "pg_remove":
+                self.remove_placement_group(args[0])
+                reply(None)
+            else:
+                reply(None, RuntimeError(f"unknown op {op}"))
+        except BaseException as e:  # noqa: BLE001
+            reply(None, e)
+
+    # -- async get/wait used by worker requests ---------------------------
+
+    def _object_payload(self, oid: ObjectID):
+        st = self.gcs.object_state(oid)
+        if st is None or st.status == "PENDING":
+            return None
+        if st.status == ERROR:
+            return ("e", st.error)
+        if st.inline is not None:
+            return ("i", st.inline)
+        return ("s", None)
+
+    def _async_get(self, ids: List[bytes], timeout, reply):
+        oids = [ObjectID(b) for b in ids]
+        fired = threading.Event()
+        timer_box = []
+
+        def on_ready():
+            if fired.is_set():
+                return
+            fired.set()
+            for t in timer_box:
+                t.cancel()
+            reply([self._object_payload(o) for o in oids])
+
+        waiter = self.gcs.add_waiter(oids, len(oids), on_ready)
+        if timeout is not None:
+            def on_timeout():
+                if fired.is_set():
+                    return
+                fired.set()
+                self.gcs.cancel_waiter(waiter)
+                reply(None)
+
+            t = threading.Timer(timeout, on_timeout)
+            t.daemon = True
+            timer_box.append(t)
+            t.start()
+
+    def _async_wait(self, ids: List[bytes], num_returns: int, timeout, reply):
+        oids = [ObjectID(b) for b in ids]
+        fired = threading.Event()
+        timer_box = []
+
+        def snapshot():
+            ready, rest = [], []
+            for o in oids:
+                st = self.gcs.object_state(o)
+                if st is not None and st.status in (READY, ERROR) and len(ready) < num_returns:
+                    ready.append(o.binary())
+                else:
+                    rest.append(o.binary())
+            return ready, rest
+
+        def on_ready():
+            if fired.is_set():
+                return
+            fired.set()
+            for t in timer_box:
+                t.cancel()
+            reply(snapshot())
+
+        waiter = self.gcs.add_waiter(oids, min(num_returns, len(oids)), on_ready)
+        if timeout is not None:
+            def on_timeout():
+                if fired.is_set():
+                    return
+                fired.set()
+                self.gcs.cancel_waiter(waiter)
+                reply(snapshot())
+
+            t = threading.Timer(timeout, on_timeout)
+            t.daemon = True
+            timer_box.append(t)
+            t.start()
+
+    # ------------------------------------------------------------------
+    # resources
+    # ------------------------------------------------------------------
+
+    def _can_acquire(self, res: Dict[str, float], pg: Optional[bytes], bundle: int) -> bool:
+        if pg is not None:
+            pgs = self.pgs.get(pg)
+            if pgs is None:
+                return False
+            if bundle >= 0:
+                pool = pgs["bundles"][bundle]
+                return all(pool.get(k, 0.0) >= v for k, v in res.items())
+            # any-bundle: fits in some single bundle
+            return any(
+                all(b.get(k, 0.0) >= v for k, v in res.items()) for b in pgs["bundles"]
+            )
+        return all(self.avail.get(k, 0.0) >= v for k, v in res.items())
+
+    def _acquire(self, res: Dict[str, float], pg: Optional[bytes], bundle: int) -> Optional[Dict[str, float]]:
+        if not self._can_acquire(res, pg, bundle):
+            return None
+        if pg is not None:
+            pgs = self.pgs[pg]
+            idx = bundle
+            if idx < 0:
+                idx = next(
+                    i
+                    for i, b in enumerate(pgs["bundles"])
+                    if all(b.get(k, 0.0) >= v for k, v in res.items())
+                )
+            pool = pgs["bundles"][idx]
+            for k, v in res.items():
+                pool[k] = pool.get(k, 0.0) - v
+            return {"__pg__": pg, "__bundle__": idx, **res}
+        for k, v in res.items():
+            self.avail[k] = self.avail.get(k, 0.0) - v
+        return dict(res)
+
+    def _release(self, held: Dict[str, float]) -> None:
+        if not held:
+            return
+        pg = held.get("__pg__")
+        if pg is not None:
+            pgs = self.pgs.get(pg)
+            if pgs is None:
+                return
+            pool = pgs["bundles"][held["__bundle__"]]
+            for k, v in held.items():
+                if k.startswith("__"):
+                    continue
+                pool[k] = pool.get(k, 0.0) + v
+            return
+        for k, v in held.items():
+            if k.startswith("__"):
+                continue
+            self.avail[k] = self.avail.get(k, 0.0) + v
+
+    def _acquire_forced(self, held: Dict[str, float]) -> None:
+        pg = held.get("__pg__")
+        if pg is not None:
+            pgs = self.pgs.get(pg)
+            if pgs is None:
+                return
+            pool = pgs["bundles"][held["__bundle__"]]
+            for k, v in held.items():
+                if not k.startswith("__"):
+                    pool[k] = pool.get(k, 0.0) - v
+            return
+        for k, v in held.items():
+            if not k.startswith("__"):
+                self.avail[k] = self.avail.get(k, 0.0) - v
+
+    # ------------------------------------------------------------------
+    # placement groups
+    # ------------------------------------------------------------------
+
+    def create_placement_group(self, bundles: List[Dict[str, float]], strategy: str) -> bytes:
+        from ray_tpu.core.ids import PlacementGroupID
+
+        with self.lock:
+            for b in bundles:
+                for k, v in b.items():
+                    if self.avail.get(k, 0.0) < v:
+                        raise ValueError(
+                            f"cannot reserve bundle {b}: insufficient {k} "
+                            f"(avail {self.avail.get(k, 0.0)})"
+                        )
+            pg_id = PlacementGroupID.from_random().binary()
+            for b in bundles:
+                for k, v in b.items():
+                    self.avail[k] -= v
+            self.pgs[pg_id] = {
+                "bundles": [dict(b) for b in bundles],
+                "totals": [dict(b) for b in bundles],
+                "strategy": strategy,
+            }
+            return pg_id
+
+    def remove_placement_group(self, pg_id: bytes) -> None:
+        with self.lock:
+            pgs = self.pgs.pop(pg_id, None)
+            if pgs is None:
+                return
+            for b in pgs["totals"]:
+                for k, v in b.items():
+                    self.avail[k] = self.avail.get(k, 0.0) + v
+
+    # ------------------------------------------------------------------
+    # submission + dispatch
+    # ------------------------------------------------------------------
+
+    def register_fn(self, h: str, blob: bytes):
+        self.gcs.register_fn(h, blob)
+
+    def submit_spec(self, spec: dict) -> List[ObjectRef]:
+        if spec["type"] == ts.ACTOR_CREATE:
+            info = ActorInfo(ActorID(spec["actor_id"]), spec)
+            self.gcs.register_actor(info)
+        for rid in spec["return_ids"]:
+            self.gcs.ensure_object(ObjectID(rid))
+        deps = ts.arg_refs(spec["args"], spec["kwargs"])
+        unresolved = [
+            d for d in deps
+            if (st := self.gcs.object_state(d)) is None or st.status == "PENDING"
+        ]
+        if unresolved:
+            self.gcs.add_waiter(unresolved, len(unresolved), lambda: self._enqueue_ready(spec))
+        else:
+            self._enqueue_ready(spec)
+        tid = TaskID(spec["task_id"])
+        return [ObjectRef(ObjectID(b), task_id=tid) for b in spec["return_ids"]]
+
+    def _submit_actor_spec(self, spec: dict) -> List[ObjectRef]:
+        for rid in spec["return_ids"]:
+            self.gcs.ensure_object(ObjectID(rid))
+        deps = ts.arg_refs(spec["args"], spec["kwargs"])
+        unresolved = [
+            d for d in deps
+            if (st := self.gcs.object_state(d)) is None or st.status == "PENDING"
+        ]
+        if unresolved:
+            self.gcs.add_waiter(
+                unresolved, len(unresolved), lambda: self._enqueue_actor_call(spec)
+            )
+        else:
+            self._enqueue_actor_call(spec)
+        return [ObjectRef(ObjectID(b)) for b in spec["return_ids"]]
+
+    def _enqueue_actor_call(self, spec: dict):
+        info = self.gcs.get_actor(ActorID(spec["actor_id"]))
+        if info is None or info.state == "DEAD":
+            err = cloudpickle.dumps(ActorDiedError("actor is dead"))
+            for rid in spec["return_ids"]:
+                self.gcs.mark_error(ObjectID(rid), err)
+            return
+        with self.lock:
+            info.pending_queue.append(spec)
+        self._pump()
+
+    def _enqueue_ready(self, spec: dict):
+        if spec["task_id"] in self.cancelled:
+            err = cloudpickle.dumps(TaskCancelledError("task was cancelled"))
+            for rid in spec["return_ids"]:
+                self.gcs.mark_error(ObjectID(rid), err)
+            return
+        st0 = self.gcs.object_state(ObjectID(spec["return_ids"][0]))
+        if st0 is not None and st0.status == ERROR:
+            return  # cancelled while waiting for dependencies
+        # propagate dependency errors without running the task
+        err_blob = None
+        for e in list(spec["args"]) + list(spec["kwargs"].values()):
+            if e[0] == "r":
+                st = self.gcs.object_state(ObjectID(e[1]))
+                if st is not None and st.status == ERROR:
+                    err_blob = st.error
+                    break
+        if err_blob is not None:
+            for rid in spec["return_ids"]:
+                self.gcs.mark_error(ObjectID(rid), err_blob)
+            if spec["type"] == ts.ACTOR_CREATE:
+                self._mark_actor_dead_and_flush(
+                    ActorID(spec["actor_id"]), "creation args errored", err_blob
+                )
+            return
+        with self.lock:
+            self.ready_tasks.append(spec)
+        self._pump()
+
+    def _attach_inline_args(self, spec: dict):
+        def conv(e):
+            if e[0] == "r":
+                st = self.gcs.object_state(ObjectID(e[1]))
+                if st is not None and st.inline is not None:
+                    return ("ri", e[1], st.inline)
+            return e
+
+        spec["args"] = [conv(e) for e in spec["args"]]
+        spec["kwargs"] = {k: conv(v) for k, v in spec["kwargs"].items()}
+
+    def _dispatch_to(self, ws: _WorkerState, spec: dict):
+        self._attach_inline_args(spec)
+        with self.lock:
+            ws.status = "busy"
+            ws.current = spec
+            ws.released = False
+        self._task_start_ts[spec["task_id"]] = time.time()
+        try:
+            ws.send(("exec", spec))
+        except (OSError, BrokenPipeError):
+            self._on_worker_death(ws)
+
+    def _pump(self):
+        while True:
+            dispatched = False
+            with self.lock:
+                if self._shutdown:
+                    return
+                # 1. ordinary tasks + actor creations from the ready queue
+                for _ in range(len(self.ready_tasks)):
+                    spec = self.ready_tasks.popleft()
+                    if spec["task_id"] in self.cancelled:
+                        err = cloudpickle.dumps(TaskCancelledError("task was cancelled"))
+                        for rid in spec["return_ids"]:
+                            self.gcs.mark_error(ObjectID(rid), err)
+                        continue
+                    res = spec.get("resources") or {}
+                    held = self._acquire(res, spec.get("pg"), spec.get("bundle_index", -1))
+                    if held is None:
+                        self.ready_tasks.append(spec)
+                        continue
+                    if spec["type"] == ts.ACTOR_CREATE:
+                        ws = self._spawn_worker_locked("actor")
+                        ws.actor_id = spec["actor_id"]
+                        info = self.gcs.get_actor(ActorID(spec["actor_id"]))
+                        if info is not None:
+                            info.worker_id = ws.worker_id
+                            info.running = True
+                        ws.held = held
+                        # worker hasn't dialed back yet; dispatch on "ready"
+                        ws.pending_spec = spec
+                        continue
+                    ws = self._find_idle_pool_worker_locked()
+                    if ws is None:
+                        self._release(held)
+                        self.ready_tasks.append(spec)
+                        continue
+                    ws.held = held
+                    target = (ws, spec)
+                    dispatched = True
+                    break
+                else:
+                    # 2. actor method calls
+                    target = None
+                    for info in list(self.gcs.actors.values()):
+                        if not info.pending_queue or info.running:
+                            continue
+                        if info.state not in ("ALIVE",):
+                            continue
+                        ws = self.workers.get(info.worker_id)
+                        if ws is None or ws.status != "idle":
+                            continue
+                        spec = info.pending_queue.pop(0)
+                        info.running = True
+                        ws.held = {}
+                        target = (ws, spec)
+                        dispatched = True
+                        break
+            if not dispatched:
+                return
+            self._dispatch_to(*target)
+
+    def _find_idle_pool_worker_locked(self) -> Optional[_WorkerState]:
+        for w in self.workers.values():
+            if w.kind == "pool" and w.status == "idle":
+                return w
+        n_pool = (
+            sum(1 for w in self.workers.values() if w.kind == "pool" and w.status != "dead")
+            + self._spawning
+        )
+        n_starting = (
+            sum(1 for w in self.workers.values() if w.kind == "pool" and w.status == "starting")
+            + self._spawning
+        )
+        # Spawn enough workers to drain the ready backlog (bounded by caps).
+        want = len(self.ready_tasks) + 1 - n_starting
+        want = min(want, self.pool_cap - n_pool, self.pool_hard_cap - n_pool)
+        for _ in range(max(0, want)):
+            self._spawning += 1
+            threading.Thread(target=self._spawn_pool_async, daemon=True).start()
+        return None
+
+    def _spawn_pool_async(self):
+        try:
+            self._spawn_worker("pool")
+        finally:
+            with self.lock:
+                self._spawning -= 1
+
+    def _spawn_worker_locked(self, kind: str) -> _WorkerState:
+        # like _spawn_worker but callable with self.lock held (RLock)
+        return self._spawn_worker(kind)
+
+    # ------------------------------------------------------------------
+    # public API surface (driver)
+    # ------------------------------------------------------------------
+
+    def put(self, value: Any) -> ObjectRef:
+        oid = ObjectID.from_random()
+        inline = self.store.put(oid, value)
+        self.gcs.mark_ready(oid, inline=inline)
+        return ObjectRef(oid)
+
+    def put_parts(self, data: bytes, buffers) -> ObjectRef:
+        oid = ObjectID.from_random()
+        inline = self.store.put_parts(oid, data, buffers)
+        self.gcs.mark_ready(oid, inline=inline)
+        return ObjectRef(oid)
+
+    def get(self, refs: List[ObjectRef], timeout: Optional[float] = None):
+        ids = [r.id for r in refs]
+        ready, rest = self.gcs.wait_objects(ids, len(ids), timeout)
+        if rest:
+            raise GetTimeoutError(f"get timed out after {timeout}s; {len(rest)} pending")
+        out = []
+        for oid in ids:
+            st = self.gcs.object_state(oid)
+            if st.status == ERROR:
+                raise cloudpickle.loads(st.error)
+            if st.inline is not None:
+                out.append(serialization.loads_oob(st.inline))
+            else:
+                out.append(self.store.get(oid))
+        return out
+
+    def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
+        ids = [r.id for r in refs]
+        ready, rest = self.gcs.wait_objects(ids, num_returns, timeout)
+        ready_set = set(ready)
+        return (
+            [r for r in refs if r.id in ready_set],
+            [r for r in refs if r.id not in ready_set],
+        )
+
+    def submit(self, spec: dict) -> List[ObjectRef]:
+        return self.submit_spec(spec)
+
+    def create_actor(self, spec: dict):
+        self.submit_spec(spec)
+
+    def submit_actor_task(self, spec: dict) -> List[ObjectRef]:
+        return self._submit_actor_spec(spec)
+
+    def ensure_fn(self, h: str, blob: bytes):
+        self.gcs.register_fn(h, blob)
+
+    def kill_actor(self, actor_id: bytes, no_restart: bool = True):
+        info = self.gcs.get_actor(ActorID(actor_id))
+        if info is None:
+            return
+        with self.lock:
+            if no_restart:
+                info.max_restarts = info.restarts  # exhaust restarts
+            ws = self.workers.get(info.worker_id)
+        if ws is not None and ws.status != "dead":
+            try:
+                ws.proc.terminate()
+            except Exception:
+                pass
+
+    def cancel(self, ref: ObjectRef, force: bool = False):
+        self.cancel_task(ref.id)
+
+    def cancel_task(self, obj_id: ObjectID):
+        with self.lock:
+            for spec in list(self.ready_tasks):
+                if obj_id.binary() in spec["return_ids"]:
+                    self.cancelled.add(spec["task_id"])
+                    return
+            # mark for when deps resolve
+            for ws in self.workers.values():
+                if ws.current and obj_id.binary() in ws.current["return_ids"]:
+                    return  # running: cooperative cancel unsupported in round 1
+        err = cloudpickle.dumps(TaskCancelledError("task was cancelled"))
+        st = self.gcs.object_state(obj_id)
+        if st is not None and st.status == "PENDING":
+            self.gcs.mark_error(obj_id, err)
+
+    def lookup_named_actor(self, name: str):
+        aid = self.gcs.lookup_named(name)
+        return aid.binary() if aid else None
+
+    def kv_op(self, op: str, *args):
+        fn = {
+            "put": self.gcs.kv_put,
+            "get": self.gcs.kv_get,
+            "del": self.gcs.kv_del,
+            "keys": self.gcs.kv_keys,
+        }[op]
+        return fn(*args)
+
+    def resources(self, which: str) -> Dict[str, float]:
+        with self.lock:
+            return dict(self.avail if which == "avail" else self.total)
+
+    def free(self, ids: List[bytes]):
+        for b in ids:
+            oid = ObjectID(b)
+            self.gcs.drop_object(oid)
+            self.store.delete(oid)
+
+    def node_info(self):
+        return [
+            {
+                "NodeID": self.node_id.hex(),
+                "Alive": True,
+                "Resources": dict(self.total),
+                "alive": True,
+            }
+        ]
+
+    def timeline(self):
+        return list(self.timeline_events)
+
+    def shutdown(self):
+        with self.lock:
+            self._shutdown = True
+            workers = list(self.workers.values())
+        for ws in workers:
+            try:
+                ws.send(("shutdown",))
+            except Exception:
+                pass
+        deadline = time.monotonic() + 2.0
+        for ws in workers:
+            t = max(0.05, deadline - time.monotonic())
+            try:
+                ws.proc.wait(t)
+            except Exception:
+                ws.proc.terminate()
+        for ws in workers:
+            if ws.proc.poll() is None:
+                try:
+                    ws.proc.wait(0.5)
+                except Exception:
+                    ws.proc.kill()
+        try:
+            self._listener.close()
+        except Exception:
+            pass
+        try:
+            os.unlink(self._sock_addr)
+        except OSError:
+            pass
+        StoreClient.cleanup_session(self.session)
+
+
+# ----------------------------------------------------------------------
+# module-level public API
+# ----------------------------------------------------------------------
+
+
+def init(
+    address: Optional[str] = None,
+    *,
+    num_cpus: Optional[int] = None,
+    num_tpus: Optional[int] = None,
+    resources: Optional[Dict[str, float]] = None,
+    namespace: str = "default",
+    ignore_reinit_error: bool = False,
+    runtime_env: Optional[dict] = None,
+    log_to_driver: bool = True,
+    **kwargs,
+):
+    """Start the runtime in this process (reference: ``ray.init``,
+    ``python/ray/_private/worker.py:1214``). Single-node; ``address`` is
+    accepted for API compatibility."""
+    global _runtime
+    with _runtime_lock:
+        if _runtime is not None:
+            if ignore_reinit_error:
+                return _runtime
+            raise RuntimeError("ray_tpu.init() already called (use ignore_reinit_error=True)")
+        worker_env = {}
+        if runtime_env and "env_vars" in runtime_env:
+            worker_env.update(runtime_env["env_vars"])
+        rt = DriverRuntime(
+            num_cpus=num_cpus,
+            num_tpus=num_tpus,
+            resources=resources,
+            namespace=namespace,
+            worker_env=worker_env,
+        )
+        _runtime = rt
+        atexit.register(_atexit_shutdown)
+        return rt
+
+
+def _atexit_shutdown():
+    global _runtime
+    rt = _runtime
+    if rt is not None and rt.is_driver:
+        try:
+            rt.shutdown()
+        except Exception:
+            pass
+        _runtime = None
+
+
+def shutdown():
+    global _runtime
+    with _runtime_lock:
+        rt = _runtime
+        if rt is None:
+            return
+        if rt.is_driver:
+            rt.shutdown()
+        _runtime = None
+
+
+def is_initialized() -> bool:
+    return _runtime is not None
+
+
+def put(value: Any) -> ObjectRef:
+    return _get_runtime().put(value)
+
+
+def get(refs, timeout: Optional[float] = None):
+    rt = _get_runtime()
+    if isinstance(refs, ObjectRef):
+        return rt.get([refs], timeout)[0]
+    if not isinstance(refs, list):
+        raise TypeError("get() takes an ObjectRef or list of ObjectRefs")
+    if not refs:
+        return []
+    return rt.get(refs, timeout)
+
+
+def wait(refs, *, num_returns: int = 1, timeout: Optional[float] = None, fetch_local: bool = True):
+    if not isinstance(refs, list):
+        raise TypeError("wait() takes a list of ObjectRefs")
+    if num_returns > len(refs):
+        raise ValueError("num_returns > len(refs)")
+    return _get_runtime().wait(refs, num_returns, timeout, fetch_local)
+
+
+def kill(actor, *, no_restart: bool = True):
+    from ray_tpu.core.actor import ActorHandle
+
+    if not isinstance(actor, ActorHandle):
+        raise TypeError("kill() expects an ActorHandle")
+    _get_runtime().kill_actor(actor._actor_id.binary(), no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
+    _get_runtime().cancel(ref, force)
+
+
+def get_actor(name: str, namespace: Optional[str] = None):
+    from ray_tpu.core.actor import ActorHandle
+
+    aid = _get_runtime().lookup_named_actor(name)
+    if aid is None:
+        raise ValueError(f"no actor named {name!r}")
+    return ActorHandle(ActorID(aid))
+
+
+def available_resources() -> Dict[str, float]:
+    return _get_runtime().resources("avail")
+
+
+def cluster_resources() -> Dict[str, float]:
+    return _get_runtime().resources("total")
+
+
+def nodes():
+    return _get_runtime().node_info()
+
+
+def timeline(filename: Optional[str] = None):
+    events = _get_runtime().timeline()
+    if filename:
+        import json
+
+        with open(filename, "w") as f:
+            json.dump(events, f)
+    return events
+
+
+def remote(*args, **options):
+    """``@remote`` decorator for functions and classes (reference:
+    ``python/ray/_private/worker.py:3212``)."""
+    from ray_tpu.core.actor import ActorClass
+    from ray_tpu.core.remote_function import RemoteFunction
+    import inspect
+
+    def make(target, opts):
+        if inspect.isclass(target):
+            return ActorClass(target, opts)
+        return RemoteFunction(target, opts)
+
+    if len(args) == 1 and callable(args[0]) and not options:
+        return make(args[0], {})
+    if args:
+        raise TypeError("@remote options must be keyword arguments")
+
+    def deco(target):
+        return make(target, options)
+
+    return deco
+
+
+def method(**options):
+    """``@ray.method`` analog: annotate actor methods (num_returns...)."""
+
+    def deco(fn):
+        fn._rtpu_method_options = options
+        return fn
+
+    return deco

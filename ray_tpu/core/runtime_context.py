@@ -1,0 +1,54 @@
+"""Runtime context: who am I, where am I running.
+
+Role analog: reference ``python/ray/runtime_context.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class RuntimeContext:
+    def __init__(self, rt):
+        self._rt = rt
+
+    @property
+    def was_current_actor_reconstructed(self) -> bool:
+        return False
+
+    def get_node_id(self) -> str:
+        if self._rt.is_driver:
+            return self._rt.node_id.hex()
+        return "node"
+
+    def get_job_id(self) -> str:
+        return "job"
+
+    def get_worker_id(self) -> str:
+        if self._rt.is_driver:
+            return "driver"
+        return self._rt.worker_id.hex()
+
+    def get_task_id(self) -> Optional[str]:
+        if self._rt.is_driver:
+            return None
+        tid = self._rt.current_task_id
+        return tid.hex() if tid else None
+
+    def get_actor_id(self) -> Optional[str]:
+        if self._rt.is_driver:
+            return None
+        aid = self._rt.current_actor_id
+        return aid.hex() if aid else None
+
+    def get_actor_name(self) -> Optional[str]:
+        return None
+
+    def get_assigned_resources(self):
+        return {}
+
+
+def get_runtime_context() -> RuntimeContext:
+    from ray_tpu.core.runtime import _get_runtime
+
+    return RuntimeContext(_get_runtime())

@@ -1,0 +1,132 @@
+"""Object serialization: pickle protocol 5 with out-of-band buffers.
+
+Role analog: reference ``python/ray/_private/serialization.py``
+(``SerializationContext``, msgpack + cloudpickle + zero-copy numpy readers).
+
+Layout written into an object-store buffer::
+
+    u64 magic | u64 n_buffers | u64 pickle_len | [u64 buf_len]*n  |
+    pickle bytes | padding-to-64 | buf0 | padding-to-64 | buf1 | ...
+
+Large contiguous payloads (numpy arrays, bytes) travel out-of-band so that
+``get`` can reconstruct them as zero-copy views over shared memory. JAX
+arrays are device-resident; they are converted to numpy on ``put`` (host
+round-trip) — device-to-device transfer without a host hop is the job of the
+device channel layer (``ray_tpu.channel``), not the object store.
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Any, List, Tuple
+
+import cloudpickle
+
+MAGIC = 0x52415954505500  # "RAYTPU"
+_ALIGN = 64
+_HDR = struct.Struct("<QQQ")
+
+
+def _pad(n: int) -> int:
+    return (n + _ALIGN - 1) // _ALIGN * _ALIGN
+
+
+def _to_host(value: Any) -> Any:
+    # jax.Array → numpy before pickling; imported lazily so the core runtime
+    # does not depend on jax.
+    t = type(value)
+    mod = t.__module__
+    if mod.startswith("jaxlib") or mod.startswith("jax"):
+        import numpy as np
+
+        try:
+            return np.asarray(value)
+        except Exception:
+            return value
+    return value
+
+
+def serialize(value: Any) -> Tuple[bytes, List[pickle.PickleBuffer]]:
+    """Returns (pickle_bytes, out_of_band_buffers)."""
+    buffers: List[pickle.PickleBuffer] = []
+    value = _to_host(value)
+
+    def cb(buf: pickle.PickleBuffer):
+        # Only send large buffers out-of-band; small ones inline pickle.
+        if buf.raw().nbytes >= 512:
+            buffers.append(buf)
+            return False  # out-of-band
+        return True  # serialize in-band
+
+    try:
+        data = pickle.dumps(value, protocol=5, buffer_callback=cb)
+    except Exception:
+        buffers.clear()
+        data = cloudpickle.dumps(value, protocol=5, buffer_callback=cb)
+    return data, buffers
+
+
+def serialized_size(data: bytes, buffers: List[pickle.PickleBuffer]) -> int:
+    n = len(buffers)
+    off = _HDR.size + 8 * n
+    off = _pad(off + len(data))
+    for b in buffers:
+        off = _pad(off + b.raw().nbytes)
+    return off
+
+
+def write_into(mv: memoryview, data: bytes, buffers: List[pickle.PickleBuffer]) -> int:
+    """Writes the serialized object into ``mv``; returns bytes written."""
+    n = len(buffers)
+    _HDR.pack_into(mv, 0, MAGIC, n, len(data))
+    off = _HDR.size
+    for b in buffers:
+        struct.pack_into("<Q", mv, off, b.raw().nbytes)
+        off += 8
+    mv[off : off + len(data)] = data
+    off = _pad(off + len(data))
+    for b in buffers:
+        raw = b.raw()
+        nb = raw.nbytes
+        mv[off : off + nb] = raw.cast("B") if raw.format != "B" or raw.ndim != 1 else raw
+        off = _pad(off + nb)
+    return off
+
+
+def read_from(mv: memoryview) -> Any:
+    """Reconstructs an object from a store buffer.
+
+    Out-of-band buffers are zero-copy views into ``mv`` — the caller must
+    keep the backing segment alive as long as the value (the object store
+    client pins segments per ref).
+    """
+    magic, n, plen = _HDR.unpack_from(mv, 0)
+    if magic != MAGIC:
+        raise ValueError("corrupt object buffer (bad magic)")
+    off = _HDR.size
+    sizes = []
+    for _ in range(n):
+        (sz,) = struct.unpack_from("<Q", mv, off)
+        sizes.append(sz)
+        off += 8
+    data = bytes(mv[off : off + plen])
+    off = _pad(off + plen)
+    bufs = []
+    for sz in sizes:
+        bufs.append(mv[off : off + sz])
+        off = _pad(off + sz)
+    return pickle.loads(data, buffers=bufs)
+
+
+def dumps_oob(value: Any) -> bytes:
+    """One-shot serialize to a contiguous bytes blob (for pipe transport)."""
+    data, buffers = serialize(value)
+    size = serialized_size(data, buffers)
+    out = bytearray(size)
+    write_into(memoryview(out), data, buffers)
+    return bytes(out)
+
+
+def loads_oob(blob: bytes) -> Any:
+    return read_from(memoryview(blob))

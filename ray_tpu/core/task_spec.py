@@ -1,0 +1,141 @@
+"""Task/actor specs and argument encoding.
+
+Role analog: reference ``src/ray/common/task/task_spec.h`` +
+``python/ray/remote_function.py`` arg handling. Specs are plain dicts so
+they pickle fast over control-channel pipes.
+
+Argument encodings:
+  ("v", blob)            — inline serialized value
+  ("r", id_bytes)        — ObjectRef; resolved via the store at exec time
+  ("ri", id_bytes, blob) — ObjectRef whose value was inline in the directory;
+                           the scheduler attaches the blob at dispatch
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+from ray_tpu.core import serialization
+from ray_tpu.core.ids import ObjectID, TaskID, ActorID
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.object_store import INLINE_THRESHOLD
+
+TASK = "task"
+ACTOR_CREATE = "actor_create"
+ACTOR_METHOD = "actor_method"
+
+
+def fn_digest(blob: bytes) -> str:
+    return hashlib.sha256(blob).hexdigest()[:24]
+
+
+def pickle_fn(fn) -> bytes:
+    return cloudpickle.dumps(fn)
+
+
+def encode_args(args, kwargs, runtime) -> Tuple[list, dict]:
+    """Encode call args. Oversized inline values are promoted to store
+    objects (mirrors the reference: large args are implicitly ``ray.put``).
+    Each value is serialized exactly once."""
+
+    def enc(a: Any):
+        if isinstance(a, ObjectRef):
+            return ("r", a.id.binary())
+        data, buffers = serialization.serialize(a)
+        size = serialization.serialized_size(data, buffers)
+        if size >= INLINE_THRESHOLD:
+            ref = runtime.put_parts(data, buffers)
+            return ("r", ref.id.binary())
+        out = bytearray(size)
+        serialization.write_into(memoryview(out), data, buffers)
+        return ("v", bytes(out))
+
+    return [enc(a) for a in args], {k: enc(v) for k, v in (kwargs or {}).items()}
+
+
+def arg_refs(enc_args: list, enc_kwargs: dict) -> List[ObjectID]:
+    out = []
+    for e in list(enc_args) + list(enc_kwargs.values()):
+        if e[0] == "r":
+            out.append(ObjectID(e[1]))
+    return out
+
+
+def make_task_spec(
+    fn_hash: str,
+    enc_args: list,
+    enc_kwargs: dict,
+    num_returns: int,
+    resources: Dict[str, float],
+    name: str = "",
+    max_retries: int = 0,
+    placement_group_id: Optional[bytes] = None,
+    bundle_index: int = -1,
+    scheduling_strategy: Any = None,
+) -> dict:
+    task_id = TaskID.from_random()
+    return {
+        "type": TASK,
+        "task_id": task_id.binary(),
+        "fn_hash": fn_hash,
+        "name": name,
+        "args": enc_args,
+        "kwargs": enc_kwargs,
+        "return_ids": [ObjectID.from_random().binary() for _ in range(num_returns)],
+        "resources": resources,
+        "max_retries": max_retries,
+        "pg": placement_group_id,
+        "bundle_index": bundle_index,
+        "retries_left": max_retries,
+    }
+
+
+def make_actor_create_spec(
+    cls_hash: str,
+    enc_args: list,
+    enc_kwargs: dict,
+    resources: Dict[str, float],
+    actor_name: str = "",
+    max_restarts: int = 0,
+    max_concurrency: int = 1,
+    placement_group_id: Optional[bytes] = None,
+    bundle_index: int = -1,
+) -> dict:
+    actor_id = ActorID.from_random()
+    return {
+        "type": ACTOR_CREATE,
+        "task_id": TaskID.from_random().binary(),
+        "actor_id": actor_id.binary(),
+        "fn_hash": cls_hash,
+        "name": actor_name,
+        "args": enc_args,
+        "kwargs": enc_kwargs,
+        "return_ids": [ObjectID.from_random().binary()],
+        "resources": resources,
+        "max_restarts": max_restarts,
+        "max_concurrency": max_concurrency,
+        "pg": placement_group_id,
+        "bundle_index": bundle_index,
+    }
+
+
+def make_actor_method_spec(
+    actor_id: bytes,
+    method_name: str,
+    enc_args: list,
+    enc_kwargs: dict,
+    num_returns: int = 1,
+) -> dict:
+    return {
+        "type": ACTOR_METHOD,
+        "task_id": TaskID.from_random().binary(),
+        "actor_id": actor_id,
+        "method": method_name,
+        "args": enc_args,
+        "kwargs": enc_kwargs,
+        "return_ids": [ObjectID.from_random().binary() for _ in range(num_returns)],
+        "resources": {},
+    }

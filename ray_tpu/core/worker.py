@@ -1,0 +1,315 @@
+"""Worker process: task execution loop + worker-side runtime client.
+
+Role analog: reference worker main loop (``python/ray/_private/workers/
+default_worker.py`` + ``_raylet.pyx:2251 task_execution_handler``). One
+worker executes one task at a time; while executing, nested API calls
+(``get``/``put``/``remote``/actor calls) flow over the same control pipe to
+the driver as request/reply or one-way casts.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import traceback
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+from ray_tpu.core import serialization, task_spec as ts
+from ray_tpu.core.exceptions import (
+    ActorDiedError,
+    GetTimeoutError,
+    TaskError,
+)
+from ray_tpu.core.ids import ActorID, ObjectID, TaskID, WorkerID
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.object_store import INLINE_THRESHOLD, StoreClient
+
+
+class WorkerRuntime:
+    """Runtime interface bound inside a worker process (see runtime.py for
+    the driver-side twin; both expose the same narrow surface)."""
+
+    is_driver = False
+
+    def __init__(self, conn, session: str, worker_id: bytes):
+        self.conn = conn
+        self.session = session
+        self.worker_id = WorkerID(worker_id)
+        self.store = StoreClient(session)
+        self.fn_cache: Dict[str, Any] = {}
+        self.registered_fns: set = set()
+        self.actors: Dict[bytes, Any] = {}
+        self._req_counter = itertools.count()
+        self._deferred_exec: deque = deque()
+        self._send_lock = threading.Lock()
+        # context of the currently running task
+        self.current_task_id: Optional[TaskID] = None
+        self.current_actor_id: Optional[ActorID] = None
+
+    # -- transport --------------------------------------------------------
+
+    def _send(self, msg):
+        with self._send_lock:
+            self.conn.send(msg)
+
+    def cast(self, op: str, *args):
+        self._send(("cast", op, args))
+
+    def request(self, op: str, *args):
+        req_id = next(self._req_counter)
+        self._send(("req", req_id, op, args))
+        while True:
+            msg = self.conn.recv()
+            kind = msg[0]
+            if kind == "reply" and msg[1] == req_id:
+                if msg[2] == "err":
+                    raise cloudpickle.loads(msg[3])
+                return msg[3]
+            elif kind == "exec":
+                # concurrent dispatch (actor max_concurrency>1 future work):
+                # defer until the current task finishes.
+                self._deferred_exec.append(msg[1])
+            elif kind == "shutdown":
+                os._exit(0)
+            # stray replies for timed-out requests are dropped
+
+    # -- object API -------------------------------------------------------
+
+    def put(self, value: Any) -> ObjectRef:
+        obj_id = ObjectID.from_random()
+        inline = self.store.put(obj_id, value)
+        self.cast("put", obj_id.binary(), inline)
+        return ObjectRef(obj_id)
+
+    def put_parts(self, data: bytes, buffers) -> ObjectRef:
+        obj_id = ObjectID.from_random()
+        inline = self.store.put_parts(obj_id, data, buffers)
+        self.cast("put", obj_id.binary(), inline)
+        return ObjectRef(obj_id)
+
+    def get(self, refs: List[ObjectRef], timeout: Optional[float] = None):
+        ids = [r.id.binary() for r in refs]
+        self.cast("blocked")
+        try:
+            results = self.request("get", ids, timeout)
+        finally:
+            self.cast("unblocked")
+        if results is None:
+            raise GetTimeoutError(f"get timed out after {timeout}s on {refs}")
+        out = []
+        for (kind, payload), r in zip(results, refs):
+            if kind == "i":
+                out.append(serialization.loads_oob(payload))
+            elif kind == "s":
+                out.append(self.store.get(r.id))
+            else:
+                raise cloudpickle.loads(payload)
+        return out
+
+    def wait(self, refs, num_returns, timeout, fetch_local=True):
+        ids = [r.id.binary() for r in refs]
+        self.cast("blocked")
+        try:
+            ready, rest = self.request("wait", ids, num_returns, timeout)
+        finally:
+            self.cast("unblocked")
+        by_id = {r.id.binary(): r for r in refs}
+        return [by_id[i] for i in ready], [by_id[i] for i in rest]
+
+    # -- task/actor submission -------------------------------------------
+
+    def ensure_fn(self, h: str, blob: bytes):
+        if h not in self.registered_fns:
+            self.cast("fn_put", h, blob)
+            self.registered_fns.add(h)
+
+    def submit(self, spec: dict) -> List[ObjectRef]:
+        self.cast("submit", spec)
+        tid = TaskID(spec["task_id"])
+        return [ObjectRef(ObjectID(b), task_id=tid) for b in spec["return_ids"]]
+
+    def create_actor(self, spec: dict):
+        self.request("actor_create", spec)
+
+    def submit_actor_task(self, spec: dict) -> List[ObjectRef]:
+        self.cast("actor_call", spec)
+        return [ObjectRef(ObjectID(b)) for b in spec["return_ids"]]
+
+    def kill_actor(self, actor_id: bytes, no_restart: bool = True):
+        self.cast("kill_actor", actor_id, no_restart)
+
+    def cancel(self, ref: ObjectRef, force: bool = False):
+        self.cast("cancel", ref.id.binary(), force)
+
+    def lookup_named_actor(self, name: str):
+        return self.request("name_lookup", name)
+
+    def create_placement_group(self, bundles, strategy: str) -> bytes:
+        return self.request("pg_create", bundles, strategy)
+
+    def remove_placement_group(self, pg_id: bytes):
+        self.request("pg_remove", pg_id)
+
+    def kv_op(self, op: str, *args):
+        return self.request("kv", op, *args)
+
+    def resources(self, which: str) -> Dict[str, float]:
+        return self.request("resources", which)
+
+    def node_info(self):
+        return self.request("nodes")
+
+    def free(self, ids: List[bytes]):
+        self.cast("free", ids)
+
+    # -- execution --------------------------------------------------------
+
+    def _resolve_fn(self, h: str):
+        fn = self.fn_cache.get(h)
+        if fn is None:
+            blob = self.request("fn_get", h)
+            if blob is None:
+                raise RuntimeError(f"function {h} not found in GCS")
+            fn = cloudpickle.loads(blob)
+            self.fn_cache[h] = fn
+            self.registered_fns.add(h)
+        return fn
+
+    def _decode_arg(self, e):
+        kind = e[0]
+        if kind == "v":
+            return serialization.loads_oob(e[1])
+        if kind == "ri":
+            return serialization.loads_oob(e[2])
+        if kind == "r":
+            oid = ObjectID(e[1])
+            return self.store.get(oid)
+        if kind == "re":
+            raise cloudpickle.loads(e[1])
+        raise ValueError(f"bad arg encoding {kind}")
+
+    def _encode_results(self, spec: dict, value: Any):
+        rids = spec["return_ids"]
+        if len(rids) == 1:
+            values = [value]
+        else:
+            values = list(value)
+            if len(values) != len(rids):
+                raise ValueError(
+                    f"task declared num_returns={len(rids)} but returned {len(values)}"
+                )
+        results = []
+        for rid_b, v in zip(rids, values):
+            oid = ObjectID(rid_b)
+            inline = self.store.put(oid, v)
+            if inline is not None:
+                results.append((rid_b, "i", inline))
+            else:
+                results.append((rid_b, "s", None))
+        return results
+
+    def execute(self, spec: dict):
+        ttype = spec["type"]
+        self.current_task_id = TaskID(spec["task_id"])
+        try:
+            args = [self._decode_arg(a) for a in spec["args"]]
+            kwargs = {k: self._decode_arg(v) for k, v in spec["kwargs"].items()}
+            if ttype == ts.TASK:
+                fn = self._resolve_fn(spec["fn_hash"])
+                value = fn(*args, **kwargs)
+                results = self._encode_results(spec, value)
+            elif ttype == ts.ACTOR_CREATE:
+                cls = self._resolve_fn(spec["fn_hash"])
+                self.current_actor_id = ActorID(spec["actor_id"])
+                instance = cls(*args, **kwargs)
+                self.actors[spec["actor_id"]] = instance
+                results = self._encode_results(spec, None)
+            elif ttype == ts.ACTOR_METHOD:
+                instance = self.actors.get(spec["actor_id"])
+                if instance is None:
+                    raise ActorDiedError("actor instance not found in this worker")
+                self.current_actor_id = ActorID(spec["actor_id"])
+                method = getattr(instance, spec["method"])
+                value = method(*args, **kwargs)
+                results = self._encode_results(spec, value)
+            else:
+                raise ValueError(f"unknown task type {ttype}")
+            self._send(("done", spec["task_id"], results))
+        except BaseException as e:  # noqa: BLE001 — remote errors must not kill the worker
+            desc = f"{ttype} {spec.get('name') or spec.get('method', '')}"
+            if isinstance(e, TaskError):
+                err = e
+            else:
+                import sys
+
+                et, ev, tb = sys.exc_info()
+                err = TaskError(ev, "".join(traceback.format_exception(et, ev, tb)), desc)
+            blob = cloudpickle.dumps(err)
+            results = [(rid, "e", blob) for rid in spec["return_ids"]]
+            self._send(("done", spec["task_id"], results))
+        finally:
+            self.current_task_id = None
+
+    def main_loop(self):
+        self._send(("ready",))
+        while True:
+            if self._deferred_exec:
+                spec = self._deferred_exec.popleft()
+            else:
+                try:
+                    msg = self.conn.recv()
+                except (EOFError, OSError):
+                    os._exit(0)
+                kind = msg[0]
+                if kind == "shutdown":
+                    os._exit(0)
+                elif kind == "exec":
+                    spec = msg[1]
+                elif kind == "reply":
+                    continue  # late reply for a timed-out request
+                else:
+                    continue
+            self.execute(spec)
+
+
+def worker_entry(conn, session: str, worker_id: bytes):
+    os.environ["RTPU_WORKER"] = "1"
+    import ray_tpu.core.runtime as rt
+
+    w = WorkerRuntime(conn, session, worker_id)
+    rt._set_runtime(w)
+    try:
+        w.main_loop()
+    except KeyboardInterrupt:
+        os._exit(0)
+
+
+def _main():
+    """Worker executable: ``python -m ray_tpu.core.worker --addr ...``.
+
+    Workers are separate executables that dial back to the driver over a
+    unix socket (reference: raylet execs ``default_worker.py``) — NOT
+    multiprocessing children, so a driver script without an
+    ``if __name__ == "__main__"`` guard can never fork-bomb.
+    """
+    import argparse
+    from multiprocessing.connection import Client
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--addr", required=True)
+    ap.add_argument("--session", required=True)
+    ap.add_argument("--worker-id", required=True)
+    args = ap.parse_args()
+
+    conn = Client(args.addr, family="AF_UNIX", authkey=args.session.encode())
+    wid = bytes.fromhex(args.worker_id)
+    conn.send(("hello", wid))
+    worker_entry(conn, args.session, wid)
+
+
+if __name__ == "__main__":
+    _main()

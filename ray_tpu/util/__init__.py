@@ -1,0 +1,7 @@
+from ray_tpu.util.placement_group import (
+    placement_group,
+    remove_placement_group,
+    PlacementGroup,
+)
+
+__all__ = ["placement_group", "remove_placement_group", "PlacementGroup"]

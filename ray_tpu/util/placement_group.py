@@ -1,0 +1,70 @@
+"""Placement groups: gang-reserve resource bundles.
+
+Role analog: reference ``python/ray/util/placement_group.py`` (PACK/SPREAD/
+STRICT_PACK/STRICT_SPREAD strategies; on a single node every strategy
+reduces to reserving the bundles). On a TPU cluster a bundle maps naturally
+to one slice host; SLICE_PACK reserves one bundle per host of a pod slice.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ray_tpu.core.ids import PlacementGroupID
+
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD", "SLICE_PACK")
+
+
+class PlacementGroup:
+    def __init__(self, pg_id: PlacementGroupID, bundles: List[Dict[str, float]], strategy: str):
+        self.id = pg_id
+        self.bundles = bundles
+        self.strategy = strategy
+
+    @property
+    def bundle_specs(self) -> List[Dict[str, float]]:
+        return self.bundles
+
+    @property
+    def bundle_count(self) -> int:
+        return len(self.bundles)
+
+    def ready(self):
+        """Returns an ObjectRef resolving once the group is reserved.
+        Reservation is synchronous single-node, so this is immediate."""
+        from ray_tpu.core.runtime import _get_runtime
+
+        return _get_runtime().put(True)
+
+    def wait(self, timeout_seconds: Optional[float] = None) -> bool:
+        return True
+
+    def __reduce__(self):
+        return (PlacementGroup, (self.id, self.bundles, self.strategy))
+
+
+def placement_group(
+    bundles: List[Dict[str, float]],
+    strategy: str = "PACK",
+    name: str = "",
+    lifetime: Optional[str] = None,
+) -> PlacementGroup:
+    if strategy not in VALID_STRATEGIES:
+        raise ValueError(f"invalid strategy {strategy!r}; one of {VALID_STRATEGIES}")
+    if not bundles or any(not b for b in bundles):
+        raise ValueError("bundles must be a non-empty list of non-empty dicts")
+    from ray_tpu.core.runtime import _get_runtime
+
+    rt = _get_runtime()
+    pg_id = rt.create_placement_group([{k: float(v) for k, v in b.items()} for b in bundles], strategy)
+    return PlacementGroup(PlacementGroupID(pg_id), bundles, strategy)
+
+
+def remove_placement_group(pg: PlacementGroup) -> None:
+    from ray_tpu.core.runtime import _get_runtime
+
+    _get_runtime().remove_placement_group(pg.id.binary())
+
+
+def get_current_placement_group() -> Optional[PlacementGroup]:
+    return None

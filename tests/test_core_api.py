@@ -1,0 +1,267 @@
+"""Core API tests: tasks, objects, actors, wait, errors.
+
+Modeled on the reference's ``python/ray/tests/test_basic.py`` coverage.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core.exceptions import ActorDiedError, GetTimeoutError, TaskError
+
+
+def test_put_get(rt):
+    ref = ray_tpu.put(42)
+    assert ray_tpu.get(ref) == 42
+
+
+def test_put_get_large_numpy(rt):
+    x = np.arange(1_000_000, dtype=np.float32)
+    ref = ray_tpu.put(x)
+    y = ray_tpu.get(ref)
+    np.testing.assert_array_equal(x, y)
+
+
+def test_simple_task(rt):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(1, 2)) == 3
+
+
+def test_task_with_ref_arg(rt):
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    r1 = double.remote(10)
+    r2 = double.remote(r1)
+    assert ray_tpu.get(r2) == 40
+
+
+def test_task_large_arg_and_result(rt):
+    @ray_tpu.remote
+    def f(x):
+        return x + 1.0
+
+    x = np.ones((512, 512), dtype=np.float32)
+    out = ray_tpu.get(f.remote(x))
+    assert out.shape == (512, 512)
+    assert float(out[0, 0]) == 2.0
+
+
+def test_multiple_returns(rt):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+
+def test_task_error_propagates(rt):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("boom!")
+
+    with pytest.raises(TaskError) as ei:
+        ray_tpu.get(boom.remote())
+    assert "boom!" in str(ei.value)
+
+
+def test_dependency_error_propagates(rt):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("boom!")
+
+    @ray_tpu.remote
+    def use(x):
+        return x
+
+    with pytest.raises(TaskError):
+        ray_tpu.get(use.remote(boom.remote()))
+
+
+def test_wait(rt):
+    @ray_tpu.remote
+    def fast():
+        return "fast"
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(20)
+        return "slow"
+
+    f, s = fast.remote(), slow.remote()
+    ready, rest = ray_tpu.wait([f, s], num_returns=1, timeout=15)
+    assert ready == [f]
+    assert rest == [s]
+
+
+def test_get_timeout(rt):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(10)
+
+    with pytest.raises(GetTimeoutError):
+        ray_tpu.get(slow.remote(), timeout=0.5)
+
+
+def test_nested_tasks(rt):
+    @ray_tpu.remote
+    def inner(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(inner.remote(x)) + 1
+
+    assert ray_tpu.get(outer.remote(5)) == 11
+
+
+def test_actor_basic(rt):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.n = start
+
+        def inc(self, k=1):
+            self.n += k
+            return self.n
+
+        def value(self):
+            return self.n
+
+    c = Counter.remote(10)
+    assert ray_tpu.get(c.inc.remote()) == 11
+    assert ray_tpu.get(c.inc.remote(5)) == 16
+    assert ray_tpu.get(c.value.remote()) == 16
+
+
+def test_actor_ordering(rt):
+    @ray_tpu.remote
+    class Appender:
+        def __init__(self):
+            self.items = []
+
+        def add(self, x):
+            self.items.append(x)
+            return list(self.items)
+
+    a = Appender.remote()
+    refs = [a.add.remote(i) for i in range(20)]
+    final = ray_tpu.get(refs[-1])
+    assert final == list(range(20))
+
+
+def test_named_actor(rt):
+    @ray_tpu.remote
+    class Store:
+        def __init__(self):
+            self.d = {}
+
+        def set(self, k, v):
+            self.d[k] = v
+            return True
+
+        def get(self, k):
+            return self.d.get(k)
+
+    s = Store.options(name="kv_store").remote()
+    ray_tpu.get(s.set.remote("a", 1))
+    s2 = ray_tpu.get_actor("kv_store")
+    assert ray_tpu.get(s2.get.remote("a")) == 1
+
+
+def test_actor_error(rt):
+    @ray_tpu.remote
+    class Bad:
+        def fail(self):
+            raise RuntimeError("actor method failed")
+
+        def ok(self):
+            return "ok"
+
+    b = Bad.remote()
+    with pytest.raises(TaskError):
+        ray_tpu.get(b.fail.remote())
+    # actor survives method errors
+    assert ray_tpu.get(b.ok.remote()) == "ok"
+
+
+def test_kill_actor(rt):
+    @ray_tpu.remote
+    class Sleeper:
+        def ping(self):
+            return "pong"
+
+    s = Sleeper.remote()
+    assert ray_tpu.get(s.ping.remote()) == "pong"
+    ray_tpu.kill(s)
+    with pytest.raises((ActorDiedError, TaskError)):
+        ray_tpu.get(s.ping.remote(), timeout=10)
+
+
+def test_actor_passing_handles(rt):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self):
+            self.n += 1
+            return self.n
+
+    @ray_tpu.remote
+    def bump(counter):
+        return ray_tpu.get(counter.inc.remote())
+
+    c = Counter.remote()
+    assert ray_tpu.get(bump.remote(c)) == 1
+    assert ray_tpu.get(bump.remote(c)) == 2
+
+
+def test_parallelism(rt):
+    @ray_tpu.remote
+    def sleepy(t):
+        time.sleep(t)
+        return t
+
+    # warm the pool first so worker spawn latency doesn't skew the timing
+    ray_tpu.get([sleepy.remote(0.01) for _ in range(4)])
+    start = time.time()
+    refs = [sleepy.remote(1.0) for _ in range(4)]
+    ray_tpu.get(refs)
+    elapsed = time.time() - start
+    assert elapsed < 3.5, f"4x1s tasks took {elapsed:.1f}s — not parallel"
+
+
+def test_cluster_resources(rt):
+    total = ray_tpu.cluster_resources()
+    assert total["CPU"] == 4.0
+
+
+def test_placement_group(rt):
+    from ray_tpu.util.placement_group import placement_group, remove_placement_group
+
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    assert ray_tpu.get(pg.ready.remote() if hasattr(pg.ready, "remote") else pg.ready()) is True
+
+    @ray_tpu.remote
+    def where():
+        return 1
+
+    from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+    r = where.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=0
+        )
+    ).remote()
+    assert ray_tpu.get(r) == 1
+    remove_placement_group(pg)
+    avail = ray_tpu.available_resources()
+    assert avail["CPU"] == 4.0
