@@ -1,0 +1,336 @@
+"""Host-level collective API — parity with ``ray.util.collective``.
+
+Reference surface: ``python/ray/util/collective/collective.py``
+(``init_collective_group :120``, ``create_collective_group :151``,
+``allreduce :258``, ``barrier :298``, ``reduce :311``, ``broadcast :373``,
+``allgather :423``, ``reducescatter :472``, ``send :531``, ``recv :594``).
+
+Two backends (see :mod:`ray_tpu.collective.types`):
+
+- STORE — works between any processes/actors; reductions run through a
+  named coordinator actor + the shared-memory object store. This is the
+  gloo-analog control path.
+- XLA — for jax arrays on the devices a single process owns; verbs execute
+  as jitted ``shard_map`` programs over a local 1-D mesh, i.e. real ICI
+  collectives. Cross-host device collectives belong inside your pjit
+  program (annotate shardings; see ray_tpu.parallel) — that is the
+  TPU-idiomatic path, not host-initiated verbs.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.collective.types import Backend, ReduceOp
+
+_groups: Dict[str, "BaseGroup"] = {}
+_lock = threading.Lock()
+
+_COORD_PREFIX = "rtpu_collective_coord:"
+
+
+def _get_or_create_coordinator(group_name: str, world_size: int):
+    """Get or create the named coordinator actor. Returns (handle, created)."""
+    import ray_tpu
+    from ray_tpu.collective.coordinator import CollectiveCoordinator
+
+    name = _COORD_PREFIX + group_name
+    try:
+        return ray_tpu.get_actor(name), False
+    except ValueError:
+        try:
+            handle = (
+                ray_tpu.remote(CollectiveCoordinator)
+                .options(name=name, max_concurrency=max(4, world_size))
+                .remote(world_size)
+            )
+            return handle, True
+        except Exception:
+            return ray_tpu.get_actor(name), False
+
+
+class BaseGroup:
+    def __init__(self, world_size: int, rank: int, group_name: str):
+        self.world_size = world_size
+        self.rank = rank
+        self.group_name = group_name
+
+    def destroy(self):
+        pass
+
+
+class StoreGroup(BaseGroup):
+    """Collectives through the coordinator actor (CPU / control plane)."""
+
+    def __init__(self, world_size: int, rank: int, group_name: str):
+        super().__init__(world_size, rank, group_name)
+        self._coord, self._created_coord = _get_or_create_coordinator(
+            group_name, world_size)
+        self._seq: Dict[str, int] = {}
+        self._p2p_seq: Dict[tuple, int] = {}
+
+    def destroy(self):
+        if self._created_coord:
+            import ray_tpu
+            try:
+                ray_tpu.kill(self._coord)
+            except Exception:
+                pass
+
+    def _run(self, kind: str, part: Any, op: str = "sum", root: int = 0,
+             timeout_s: float = 60.0):
+        import ray_tpu
+
+        # Commit the sequence number only on success so a timed-out op can be
+        # retried with the same seq (the late contribution still pairs up).
+        seq = self._seq.get(kind, 0)
+        out = ray_tpu.get(
+            self._coord.contribute.remote(kind, seq, self.rank, part, op, root)
+        )
+        if out is not None:
+            self._seq[kind] = seq + 1
+            return out
+        deadline = time.monotonic() + timeout_s
+        delay = 0.0005
+        while time.monotonic() < deadline:
+            done, res = ray_tpu.get(self._coord.fetch.remote(kind, seq, self.rank))
+            if done:
+                self._seq[kind] = seq + 1
+                return res
+            time.sleep(delay)
+            delay = min(delay * 2, 0.05)
+        raise TimeoutError(
+            f"collective {kind}#{seq} timed out in group {self.group_name} "
+            f"(rank {self.rank}/{self.world_size})"
+        )
+
+    def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM):
+        return self._run("allreduce", np.asarray(tensor), op=op.value)
+
+    def barrier(self):
+        self._run("barrier", None)
+
+    def reduce(self, tensor, root_rank: int = 0, op: ReduceOp = ReduceOp.SUM):
+        out = self._run("reduce", np.asarray(tensor), op=op.value, root=root_rank)
+        return out if self.rank == root_rank else np.asarray(tensor)
+
+    def broadcast(self, tensor, root_rank: int = 0):
+        return self._run("broadcast", np.asarray(tensor), root=root_rank)
+
+    def allgather(self, tensor):
+        return self._run("allgather", np.asarray(tensor))
+
+    def reducescatter(self, tensor, op: ReduceOp = ReduceOp.SUM):
+        return self._run("reducescatter", np.asarray(tensor), op=op.value)
+
+    def alltoall(self, chunks: List[Any]):
+        if len(chunks) != self.world_size:
+            raise ValueError("alltoall needs world_size chunks")
+        return self._run("alltoall", [np.asarray(c) for c in chunks])
+
+    def send(self, tensor, dst_rank: int):
+        import ray_tpu
+
+        key = (self.rank, dst_rank)
+        seq = self._p2p_seq.get(key, 0)
+        ray_tpu.get(self._coord.send.remote(self.rank, dst_rank, seq, np.asarray(tensor)))
+        self._p2p_seq[key] = seq + 1
+
+    def recv(self, src_rank: int, timeout_s: float = 60.0):
+        import ray_tpu
+
+        key = (src_rank, self.rank)
+        seq = self._p2p_seq.get(key, 0)
+        deadline = time.monotonic() + timeout_s
+        delay = 0.0005
+        while time.monotonic() < deadline:
+            done, val = ray_tpu.get(self._coord.recv.remote(src_rank, self.rank, seq))
+            if done:
+                self._p2p_seq[key] = seq + 1
+                return val
+            time.sleep(delay)
+            delay = min(delay * 2, 0.05)
+        raise TimeoutError(f"recv from rank {src_rank} timed out")
+
+
+class XlaGroup(BaseGroup):
+    """Device collectives over this process's local chips (1-D mesh).
+
+    world_size here is the local device count; ``tensors`` arguments are
+    per-device lists (the reference's ``*_multigpu`` variants,
+    ``collective.py:340`` etc.) or a single sharded jax.Array.
+    """
+
+    def __init__(self, world_size: int, rank: int, group_name: str):
+        super().__init__(world_size, rank, group_name)
+        import jax
+        from jax.sharding import Mesh
+
+        devs = jax.local_devices()
+        if world_size > len(devs):
+            raise ValueError(
+                f"XLA group world_size {world_size} > local devices {len(devs)}"
+            )
+        arr = np.asarray(devs[:world_size], dtype=object)
+        self.mesh = Mesh(arr, axis_names=("x",))
+        self._cache: Dict[tuple, Any] = {}
+
+    def _sharded(self, tensors: List[Any]):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        arrs = [np.asarray(t) for t in tensors]
+        stacked = np.stack(arrs, axis=0)
+        sharding = NamedSharding(self.mesh, P("x"))
+        return jax.device_put(stacked, sharding)
+
+    def _collective(self, kind: str, op: str = "sum"):
+        key = (kind, op)
+        if key in self._cache:
+            return self._cache[key]
+        import jax
+        from jax.sharding import PartitionSpec as P
+        from jax import lax, shard_map
+
+        def body(x):
+            x = x[0]  # drop the leading per-device dim of this shard
+            if kind == "allreduce":
+                if op == "product":
+                    import jax.numpy as jnp
+                    g = lax.all_gather(x, "x", axis=0)
+                    return jnp.prod(g, axis=0)[None]
+                red = {"sum": lax.psum, "mean": lax.pmean, "max": lax.pmax,
+                       "min": lax.pmin}[op]
+                return red(x, "x")[None]
+            if kind == "allgather":
+                return lax.all_gather(x, "x", axis=0, tiled=True)[None]
+            if kind == "reducescatter":
+                return lax.psum_scatter(x, "x", scatter_dimension=0, tiled=True)[None]
+            raise ValueError(kind)
+
+        fn = jax.jit(shard_map(body, mesh=self.mesh, in_specs=P("x"),
+                               out_specs=P("x"), check_vma=False))
+        self._cache[key] = fn
+        return fn
+
+    def allreduce(self, tensors: List[Any], op: ReduceOp = ReduceOp.SUM):
+        out = self._collective("allreduce", op.value)(self._sharded(tensors))
+        return [np.asarray(s.data)[0] for s in out.addressable_shards]
+
+    def allgather(self, tensors: List[Any]):
+        out = self._collective("allgather")(self._sharded(tensors))
+        return [np.asarray(s.data)[0] for s in out.addressable_shards]
+
+    def reducescatter(self, tensors: List[Any], op: ReduceOp = ReduceOp.SUM):
+        out = self._collective("reducescatter", op.value)(self._sharded(tensors))
+        return [np.asarray(s.data)[0] for s in out.addressable_shards]
+
+    def barrier(self):
+        self.allreduce([np.zeros((8, 128), np.float32)
+                        for _ in range(len(self.mesh.devices.flat))])
+
+
+def init_collective_group(world_size: int, rank: int,
+                          backend=Backend.STORE,
+                          group_name: str = "default") -> BaseGroup:
+    """Declare membership of this process/actor in a named group."""
+    backend = Backend.parse(backend)
+    with _lock:
+        if group_name in _groups:
+            raise RuntimeError(f"collective group {group_name!r} already initialized")
+        if backend == Backend.STORE:
+            g = StoreGroup(world_size, rank, group_name)
+        else:
+            g = XlaGroup(world_size, rank, group_name)
+        _groups[group_name] = g
+        return g
+
+
+def create_collective_group(actors, world_size: int, ranks: List[int],
+                            backend=Backend.STORE,
+                            group_name: str = "default"):
+    """Driver-side declarative setup (reference ``collective.py:151``).
+
+    Pre-creates the named coordinator so member actors can lazily
+    ``init_collective_group`` on first verb without racing on actor
+    creation (the reference spawns a named ``Info`` store actor the same
+    way). ``actors``/``ranks`` are accepted for API parity; membership is
+    claimed by each actor's own init call.
+    """
+    import ray_tpu
+
+    Backend.parse(backend)
+    coord, created = _get_or_create_coordinator(group_name, world_size)
+    if created:
+        ray_tpu.get(coord.world.remote())  # barrier on creation
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    return group_name in _groups
+
+
+def get_collective_group(group_name: str = "default") -> BaseGroup:
+    if group_name not in _groups:
+        raise RuntimeError(
+            f"collective group {group_name!r} is not initialized in this process"
+        )
+    return _groups[group_name]
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    with _lock:
+        g = _groups.pop(group_name, None)
+        if g:
+            g.destroy()
+
+
+def get_rank(group_name: str = "default") -> int:
+    return get_collective_group(group_name).rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return get_collective_group(group_name).world_size
+
+
+# module-level verbs (reference API shape)
+
+def allreduce(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
+    return get_collective_group(group_name).allreduce(tensor, op)
+
+
+def barrier(group_name: str = "default"):
+    get_collective_group(group_name).barrier()
+
+
+def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
+           op: ReduceOp = ReduceOp.SUM):
+    return get_collective_group(group_name).reduce(tensor, dst_rank, op)
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    return get_collective_group(group_name).broadcast(tensor, src_rank)
+
+
+def allgather(tensor, group_name: str = "default"):
+    return get_collective_group(group_name).allgather(tensor)
+
+
+def reducescatter(tensor, group_name: str = "default",
+                  op: ReduceOp = ReduceOp.SUM):
+    return get_collective_group(group_name).reducescatter(tensor, op)
+
+
+def alltoall(chunks, group_name: str = "default"):
+    return get_collective_group(group_name).alltoall(chunks)
+
+
+def send(tensor, dst_rank: int, group_name: str = "default"):
+    get_collective_group(group_name).send(tensor, dst_rank)
+
+
+def recv(src_rank: int, group_name: str = "default"):
+    return get_collective_group(group_name).recv(src_rank)

@@ -1,0 +1,90 @@
+"""Collective types — parity with ``python/ray/util/collective/types.py``.
+
+Backends: the reference offers NCCL (GPU) and GLOO (CPU). Here the device
+backend is XLA (collectives lower to ``jax.lax`` ops over ICI inside jitted
+programs, see :mod:`ray_tpu.parallel.ops`) and the CPU/control backend is
+STORE (reductions through the shared-memory object store via a coordinator
+actor — the gloo-analog that works anywhere, used for rendezvous, metrics,
+and small-tensor sync).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+
+
+class Backend(str, enum.Enum):
+    XLA = "xla"
+    STORE = "store"
+
+    @classmethod
+    def parse(cls, value) -> "Backend":
+        if isinstance(value, Backend):
+            return value
+        v = str(value).lower()
+        if v in ("xla", "tpu", "ici"):
+            return cls.XLA
+        if v in ("store", "cpu", "gloo"):
+            return cls.STORE
+        if v in ("nccl", "mpi"):
+            raise ValueError(
+                f"backend {value!r} is GPU/MPI-specific; use 'xla' (device) "
+                f"or 'store' (cpu) in ray_tpu"
+            )
+        raise ValueError(f"unknown collective backend: {value!r}")
+
+
+class ReduceOp(str, enum.Enum):
+    SUM = "sum"
+    PRODUCT = "product"
+    MAX = "max"
+    MIN = "min"
+    MEAN = "mean"
+
+
+@dataclass
+class AllReduceOptions:
+    reduceOp: ReduceOp = ReduceOp.SUM
+    timeout_ms: int = 30000
+
+
+@dataclass
+class BarrierOptions:
+    timeout_ms: int = 30000
+
+
+@dataclass
+class ReduceOptions:
+    reduceOp: ReduceOp = ReduceOp.SUM
+    root_rank: int = 0
+    timeout_ms: int = 30000
+
+
+@dataclass
+class BroadcastOptions:
+    root_rank: int = 0
+    timeout_ms: int = 30000
+
+
+@dataclass
+class AllGatherOptions:
+    timeout_ms: int = 30000
+
+
+@dataclass
+class ReduceScatterOptions:
+    reduceOp: ReduceOp = ReduceOp.SUM
+    timeout_ms: int = 30000
+
+
+@dataclass
+class SendOptions:
+    dst_rank: int = 0
+    timeout_ms: int = 30000
+
+
+@dataclass
+class RecvOptions:
+    src_rank: int = 0
+    timeout_ms: int = 30000

@@ -1,0 +1,72 @@
+"""Mesh-aware collective primitives for use inside jit/shard_map.
+
+These are thin, named wrappers over ``jax.lax`` collectives — the TPU
+dataplane that replaces NCCL calls in the reference
+(``python/ray/util/collective/collective.py:258`` allreduce etc.). They only
+make sense inside a ``shard_map``/``pjit`` program where the axis names are
+bound; :mod:`ray_tpu.collective` provides the host-level API with the same
+verbs for actor-to-actor use.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+AxisName = Union[str, Sequence[str]]
+
+
+def allreduce_sum(x, axis: AxisName):
+    return lax.psum(x, axis)
+
+
+def allreduce_mean(x, axis: AxisName):
+    return lax.pmean(x, axis)
+
+
+def allreduce_max(x, axis: AxisName):
+    return lax.pmax(x, axis)
+
+
+def allreduce_min(x, axis: AxisName):
+    return lax.pmin(x, axis)
+
+
+def allgather(x, axis: AxisName, *, tiled: bool = True, gather_axis: int = 0):
+    return lax.all_gather(x, axis, axis=gather_axis, tiled=tiled)
+
+
+def reducescatter_sum(x, axis: AxisName, *, scatter_axis: int = 0,
+                      tiled: bool = True):
+    return lax.psum_scatter(x, axis, scatter_dimension=scatter_axis,
+                            tiled=tiled)
+
+
+def alltoall(x, axis: AxisName, *, split_axis: int, concat_axis: int):
+    return lax.all_to_all(x, axis, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+def broadcast(x, axis: AxisName, *, root: int = 0):
+    """Every shard receives root's value (select + psum keeps it one pass)."""
+    idx = lax.axis_index(axis)
+    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return lax.psum(masked, axis)
+
+
+def ring_permute(x, axis: AxisName, *, shift: int = 1):
+    """Send each shard to its ring neighbor (the ring-attention step)."""
+    n = lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis, perm)
+
+
+def axis_index(axis: AxisName):
+    return lax.axis_index(axis)
+
+
+def axis_size(axis: AxisName):
+    return lax.axis_size(axis)

@@ -4,10 +4,17 @@ import sys
 # Must happen before any jax import anywhere in the test session: run tests
 # on a virtual 8-device CPU mesh so multi-chip sharding logic is exercised
 # without TPU hardware (the driver separately dry-runs the multichip path).
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The image's sitecustomize may have already imported jax and registered a
+# TPU backend before this conftest runs; jax.config.update still wins as
+# long as no device query has happened yet.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

@@ -1,0 +1,72 @@
+"""STORE-backend collectives between actors, XLA group on local devices."""
+
+import numpy as np
+import pytest
+
+
+def test_store_collective_between_actors(rt_module):
+    rt = rt_module
+    from ray_tpu.collective import create_collective_group
+
+    class Member:
+        def __init__(self, rank, world):
+            self.rank, self.world = rank, world
+
+        def setup(self):
+            import ray_tpu.collective as col
+            col.init_collective_group(self.world, self.rank, "store", "g1")
+            return True
+
+        def do_allreduce(self):
+            import ray_tpu.collective as col
+            out = col.allreduce(np.full((4,), float(self.rank + 1)), "g1")
+            return out
+
+        def do_bcast_gather(self):
+            import ray_tpu.collective as col
+            b = col.broadcast(np.full((2,), float(self.rank)), 1, "g1")
+            g = col.allgather(np.array([self.rank]), "g1")
+            return b, [np.asarray(x) for x in g]
+
+        def do_p2p(self):
+            import ray_tpu.collective as col
+            if self.rank == 0:
+                col.send(np.array([42.0]), 1, "g1")
+                return None
+            if self.rank == 1:
+                return col.recv(0, "g1")
+            return None
+
+    world = 3
+    create_collective_group([], world, list(range(world)), "store", "g1")
+    members = [rt.remote(Member).remote(r, world) for r in range(world)]
+    assert all(rt.get([m.setup.remote() for m in members]))
+
+    outs = rt.get([m.do_allreduce.remote() for m in members])
+    for o in outs:
+        np.testing.assert_allclose(o, np.full((4,), 6.0))
+
+    outs = rt.get([m.do_bcast_gather.remote() for m in members])
+    for b, g in outs:
+        np.testing.assert_allclose(b, np.full((2,), 1.0))
+        np.testing.assert_allclose(np.concatenate(g), [0, 1, 2])
+
+    outs = rt.get([m.do_p2p.remote() for m in members])
+    np.testing.assert_allclose(outs[1], [42.0])
+
+
+def test_xla_group_local_devices():
+    import jax
+    from ray_tpu.collective.collective import XlaGroup
+    from ray_tpu.collective.types import ReduceOp
+
+    n = len(jax.local_devices())
+    g = XlaGroup(n, 0, "local")
+    tensors = [np.full((8, 128), float(i)) for i in range(n)]
+    out = g.allreduce(tensors)
+    expect = sum(range(n))
+    for o in out:
+        np.testing.assert_allclose(o, np.full((8, 128), float(expect)))
+
+    gathered = g.allgather([np.full((1, 128), float(i)) for i in range(n)])
+    assert np.asarray(gathered[0]).shape == (n, 128)

@@ -1,0 +1,95 @@
+"""Mesh, sharding rules, and in-jit collective ops on the 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ray_tpu.parallel import (
+    MeshConfig, make_mesh, mesh_shape_for, DEFAULT_RULES, logical_to_spec,
+    shard_params, constrain,
+)
+from ray_tpu.parallel import ops as pops
+
+
+def test_mesh_resolve_fills_unknown_axis():
+    cfg = MeshConfig(dp=2, fsdp=-1, tp=2)
+    sizes = cfg.resolve(8)
+    assert sizes["fsdp"] == 2 and sizes["dp"] == 2 and sizes["tp"] == 2
+
+
+def test_mesh_resolve_rejects_bad_product():
+    with pytest.raises(ValueError):
+        MeshConfig(dp=3, fsdp=1).resolve(8)
+    with pytest.raises(ValueError):
+        MeshConfig(dp=-1, fsdp=-1).resolve(8)
+
+
+def test_make_mesh_axes():
+    mesh = make_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
+    assert mesh.shape["dp"] == 2 and mesh.shape["fsdp"] == 2
+    assert mesh.shape["tp"] == 2 and mesh.shape["sp"] == 1
+    assert mesh.devices.size == 8
+
+
+def test_logical_to_spec():
+    assert logical_to_spec(["batch", "seq", "embed"]) == P(("dp", "fsdp"), "sp", "fsdp")
+    assert logical_to_spec(["embed", "heads", None]) == P("fsdp", "tp", None)
+
+
+def test_shard_params_places_on_mesh():
+    mesh = make_mesh(MeshConfig(dp=1, fsdp=4, tp=2))
+    params = {"w": np.ones((16, 8), np.float32)}
+    axes = {"w": ("embed", "heads")}
+    sharded = shard_params(params, axes, mesh)
+    s = sharded["w"].sharding
+    assert isinstance(s, NamedSharding)
+    assert s.spec == P("fsdp", "tp")
+
+
+def test_collective_ops_inside_shard_map():
+    from jax import shard_map
+
+    mesh = make_mesh(MeshConfig(dp=8, fsdp=1))
+    x = np.arange(8, dtype=np.float32).reshape(8, 1)
+
+    def body(xs):
+        v = xs[0, 0]
+        total = pops.allreduce_sum(v, "dp")
+        mx = pops.allreduce_max(v, "dp")
+        return jnp.stack([total, mx]).reshape(1, 2)
+
+    fn = jax.jit(shard_map(body, mesh=mesh,
+                           in_specs=P(("dp",)), out_specs=P("dp"),
+                           check_vma=False))
+    out = np.asarray(fn(x))
+    assert np.all(out[:, 0] == 28.0)
+    assert np.all(out[:, 1] == 7.0)
+
+
+def test_ring_permute_rolls_shards():
+    from jax import shard_map
+
+    mesh = make_mesh(MeshConfig(dp=8, fsdp=1))
+    x = np.arange(8, dtype=np.float32).reshape(8, 1)
+
+    def body(xs):
+        return pops.ring_permute(xs, "dp", shift=1)
+
+    fn = jax.jit(shard_map(body, mesh=mesh, in_specs=P("dp"),
+                           out_specs=P("dp"), check_vma=False))
+    out = np.asarray(fn(x)).ravel()
+    assert list(out) == [7.0, 0, 1, 2, 3, 4, 5, 6]
+
+
+def test_constrain_inside_jit():
+    mesh = make_mesh(MeshConfig(dp=2, fsdp=4))
+
+    @jax.jit
+    def f(x):
+        return constrain(x * 2, ["batch", None])
+
+    with mesh:
+        out = f(np.ones((8, 4), np.float32))
+    assert np.all(np.asarray(out) == 2.0)
