@@ -1,0 +1,176 @@
+"""Attention: naive reference + blockwise (flash-style) XLA implementation.
+
+Shapes follow the JAX convention ``[batch, seq, heads, head_dim]``. Grouped
+query attention (GQA) is supported: ``k``/``v`` may have fewer heads than
+``q`` as long as ``q_heads % kv_heads == 0``.
+
+The blockwise implementation is the online-softmax algorithm (running max /
+running denominator) expressed with ``lax.scan`` so XLA keeps static shapes
+and can pipeline HBM→VMEM streaming; the Pallas kernel in
+:mod:`ray_tpu.ops.flash_pallas` is the hand-tiled version of the same loop.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _repeat_kv(k: jax.Array, num_q_heads: int) -> jax.Array:
+    """Expand kv heads to match q heads for GQA."""
+    kv_heads = k.shape[2]
+    if kv_heads == num_q_heads:
+        return k
+    if num_q_heads % kv_heads:
+        raise ValueError(f"q heads {num_q_heads} not divisible by kv heads {kv_heads}")
+    return jnp.repeat(k, num_q_heads // kv_heads, axis=2)
+
+
+def naive_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    q_offset: int = 0,
+) -> jax.Array:
+    """Materialized-scores attention; numerical reference for tests.
+
+    ``q_offset`` shifts q's global positions (used for decode where q is a
+    suffix of the kv sequence).
+    """
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    k = _repeat_kv(k, q.shape[2])
+    v = _repeat_kv(v, q.shape[2])
+    # [B, H, Lq, Lk]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
+    scores = scores * scale
+    if causal:
+        lq, lk = q.shape[1], k.shape[1]
+        q_pos = jnp.arange(lq) + q_offset
+        k_pos = jnp.arange(lk)
+        mask = q_pos[:, None] >= k_pos[None, :]
+        scores = jnp.where(mask[None, None], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def _attend_block(q, k, v, m, l, o, mask, scale):
+    """One online-softmax update: q block vs one kv block.
+
+    q: [B, qb, H, D]; k/v: [B, kb, H, D]; m,l: [B, H, qb]; o: [B, qb, H, D];
+    mask: [qb, kb] bool or None.
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale  # fp32
+    if mask is not None:
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new[..., None])
+    l_new = l * alpha + p.sum(axis=-1)
+    o_new = o * alpha.transpose(0, 2, 1)[..., None] + jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v
+    )
+    return m_new, l_new, o_new
+
+
+def blockwise_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    q_block: int = 512,
+    kv_block: int = 512,
+    q_offset: int = 0,
+) -> jax.Array:
+    """Flash-style attention with online softmax, pure XLA.
+
+    Memory is O(q_block * kv_block) per head rather than O(Lq * Lk). Blocks
+    are static so XLA tiles cleanly onto the MXU.
+    """
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    k = _repeat_kv(k, h)
+    v = _repeat_kv(v, h)
+    q_block = min(q_block, lq)
+    kv_block = min(kv_block, lk)
+    if lq % q_block or lk % kv_block:
+        # Fall back for ragged lengths; decode paths use naive anyway.
+        return naive_attention(q, k, v, causal=causal, scale=scale, q_offset=q_offset)
+    nq, nk = lq // q_block, lk // kv_block
+
+    qf = q.astype(jnp.float32).reshape(b, nq, q_block, h, d)
+    kf = k.astype(jnp.float32).reshape(b, nk, kv_block, h, d)
+    vf = v.astype(jnp.float32).reshape(b, nk, kv_block, h, d)
+
+    q_ids = jnp.arange(q_block)
+    k_ids = jnp.arange(kv_block)
+
+    def per_q_block(qi, qb):
+        # qb: [B, qb, H, D]
+        m0 = jnp.full((b, h, q_block), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((b, h, q_block), jnp.float32)
+        o0 = jnp.zeros((b, q_block, h, d), jnp.float32)
+
+        def kv_step(carry, inp):
+            m, l, o = carry
+            ki, kb, vb = inp
+            if causal:
+                qpos = qi * q_block + q_ids[:, None] + q_offset
+                kpos = ki * kv_block + k_ids[None, :]
+                mask = qpos >= kpos
+            else:
+                mask = None
+            m, l, o = _attend_block(qb, kb, vb, m, l, o, mask, scale)
+            return (m, l, o), None
+
+        (m, l, o), _ = lax.scan(
+            kv_step, (m0, l0, o0), (jnp.arange(nk), kf.swapaxes(0, 1), vf.swapaxes(0, 1))
+        )
+        return o / l.transpose(0, 2, 1)[..., None]
+
+    out = lax.map(lambda args: per_q_block(*args), (jnp.arange(nq), qf.swapaxes(0, 1)))
+    # out: [nq, B, qb, H, D] -> [B, Lq, H, D]
+    out = out.swapaxes(0, 1).reshape(b, lq, h, d)
+    return out.astype(q.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "impl", "q_block", "kv_block"))
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    impl: str = "auto",
+    q_block: int = 512,
+    kv_block: int = 512,
+) -> jax.Array:
+    """Dispatching entry point: Pallas kernel on TPU, blockwise XLA elsewhere.
+
+    ``impl``: ``auto`` | ``pallas`` | ``xla`` | ``naive``.
+    """
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if impl == "pallas":
+        from ray_tpu.ops.flash_pallas import flash_attention_pallas
+
+        return flash_attention_pallas(
+            q, k, v, causal=causal, block_q=q_block, block_k=kv_block
+        )
+    if impl == "xla":
+        return blockwise_attention(
+            q, k, v, causal=causal, q_block=q_block, kv_block=kv_block
+        )
+    return naive_attention(q, k, v, causal=causal)

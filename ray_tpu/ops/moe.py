@@ -1,0 +1,97 @@
+"""Mixture-of-experts: top-k routing + GShard-style dense dispatch.
+
+Absent from the reference (SURVEY §2.4: expert parallel = "absent"). The
+TPU-native formulation keeps everything as static-shape einsums so the MXU
+does the dispatch: tokens are routed into a [experts, capacity] buffer with
+one-hot dispatch/combine tensors (Switch/GShard style) rather than gather/
+scatter, and expert parallelism is one ``all_to_all`` over the ``ep`` mesh
+axis when the expert dim is sharded.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class RouterOutput(NamedTuple):
+    dispatch: jax.Array      # [tokens, experts, capacity] one-hot-ish f32
+    combine: jax.Array       # [tokens, experts, capacity] weights
+    aux_loss: jax.Array      # load-balancing loss (scalar)
+
+
+def top_k_router(
+    logits: jax.Array,
+    *,
+    num_experts: int,
+    k: int = 2,
+    capacity_factor: float = 1.25,
+) -> RouterOutput:
+    """Route tokens to top-k experts with a fixed per-expert capacity.
+
+    ``logits``: [tokens, experts]. Tokens over capacity are dropped (their
+    combine weight is zero) — standard Switch behavior, keeps shapes static.
+    """
+    tokens = logits.shape[0]
+    capacity = max(1, int(capacity_factor * tokens * k / num_experts))
+
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    # load-balancing aux loss (Switch eq. 4): E * sum_e f_e * p_e
+    _, top_idx = jax.lax.top_k(probs, k)  # [tokens, k]
+
+    dispatch = jnp.zeros((tokens, num_experts, capacity), jnp.float32)
+    combine = jnp.zeros((tokens, num_experts, capacity), jnp.float32)
+    # Fill choices sequentially so earlier-choice tokens win capacity slots.
+    position_in_expert = jnp.zeros((num_experts,), jnp.int32)
+    for choice in range(k):
+        idx = top_idx[:, choice]                       # [tokens]
+        onehot = jax.nn.one_hot(idx, num_experts)      # [tokens, experts]
+        # position of each token within its expert's queue for this choice
+        pos = jnp.cumsum(onehot, axis=0) - 1 + position_in_expert[None, :]
+        position_in_expert = position_in_expert + jnp.sum(
+            onehot, axis=0
+        ).astype(jnp.int32)
+        pos_tok = jnp.sum(pos * onehot, axis=1).astype(jnp.int32)  # [tokens]
+        in_cap = pos_tok < capacity
+        gate = jnp.sum(probs * onehot, axis=1) * in_cap            # [tokens]
+        slot = jax.nn.one_hot(pos_tok, capacity) * in_cap[:, None]
+        dispatch = dispatch + onehot[:, :, None] * slot[:, None, :]
+        combine = combine + gate[:, None, None] * onehot[:, :, None] * slot[:, None, :]
+
+    frac_routed = jnp.mean(
+        jax.nn.one_hot(top_idx[:, 0], num_experts), axis=0
+    )
+    mean_prob = jnp.mean(probs, axis=0)
+    aux_loss = num_experts * jnp.sum(frac_routed * mean_prob)
+    return RouterOutput(dispatch, combine, aux_loss)
+
+
+def moe_layer_dense(
+    x: jax.Array,
+    router_w: jax.Array,
+    w_gate: jax.Array,
+    w_up: jax.Array,
+    w_down: jax.Array,
+    *,
+    k: int = 2,
+    capacity_factor: float = 1.25,
+) -> Tuple[jax.Array, jax.Array]:
+    """MoE SwiGLU block. x: [B, L, D]; expert weights: [E, D, F] / [E, F, D].
+
+    Returns (output [B, L, D], aux_loss). Einsum-only dispatch — with the E
+    dim sharded on the ``ep`` mesh axis, XLA inserts the all_to_all pair.
+    """
+    b, l, d = x.shape
+    e = w_gate.shape[0]
+    xt = x.reshape(b * l, d)
+    logits = xt.astype(jnp.float32) @ router_w.astype(jnp.float32)  # [T, E]
+    route = top_k_router(logits, num_experts=e, k=k, capacity_factor=capacity_factor)
+    # [T, E, C] x [T, D] -> [E, C, D]
+    expert_in = jnp.einsum("tec,td->ecd", route.dispatch, xt.astype(jnp.float32))
+    gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, w_gate.astype(jnp.float32)))
+    up = jnp.einsum("ecd,edf->ecf", expert_in, w_up.astype(jnp.float32))
+    expert_out = jnp.einsum("ecf,efd->ecd", gate * up, w_down.astype(jnp.float32))
+    out = jnp.einsum("tec,ecd->td", route.combine, expert_out)
+    return out.reshape(b, l, d).astype(x.dtype), route.aux_loss

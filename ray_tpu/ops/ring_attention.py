@@ -1,0 +1,86 @@
+"""Ring attention: sequence-parallel attention over an ICI ring.
+
+Absent from the reference (SURVEY §5: no ring attention / context parallel
+anywhere in Ray) — this is the TPU-native design for long context: shard the
+sequence dim over the ``sp`` mesh axis, keep Q local, and rotate K/V blocks
+around the ring with ``lax.ppermute`` (ICI neighbor transfers), accumulating
+attention with the online-softmax update so each step is a flash-attention
+block step. Communication overlaps compute: XLA schedules the permute of
+step i+1 concurrently with the attention of step i.
+
+Used inside ``shard_map`` (or a pjit program with manual axes). Inputs are
+the *local* shards ``[B, L/sp, H, D]``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array, lax
+
+from ray_tpu.ops.attention import NEG_INF, _attend_block, _repeat_kv
+from ray_tpu.parallel.ops import ring_permute
+
+
+def ring_attention(
+    q: Array,
+    k: Array,
+    v: Array,
+    *,
+    axis: str = "sp",
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> Array:
+    """Sequence-parallel attention; call inside shard_map over ``axis``.
+
+    q/k/v: local shards [B, Lloc, H(k), D] where global L = Lloc * sp.
+    Returns the local output shard [B, Lloc, H, D].
+    """
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    k = _repeat_kv(k, q.shape[2])
+    v = _repeat_kv(v, q.shape[2])
+
+    sp = lax.axis_size(axis)
+    my = lax.axis_index(axis)
+    b, lloc, h, d = q.shape
+
+    qf = q.astype(jnp.float32)
+    q_pos = my * lloc + jnp.arange(lloc)  # global positions of local queries
+
+    def attend(kb, vb, i, m, l, o):
+        # After i forward shifts we hold the block that originated on device
+        # (my - i) mod sp; mask by global positions.
+        mask = None
+        if causal:
+            src = (my - i) % sp
+            k_pos = src * lloc + jnp.arange(lloc)
+            mask = q_pos[:, None] >= k_pos[None, :]
+        return _attend_block(
+            qf, kb.astype(jnp.float32), vb.astype(jnp.float32), m, l, o, mask, scale
+        )
+
+    def step(carry, i):
+        kb, vb, m, l, o = carry
+        m, l, o = attend(kb, vb, i, m, l, o)
+        kb = ring_permute(kb, axis)
+        vb = ring_permute(vb, axis)
+        return (kb, vb, m, l, o), None
+
+    m0 = jnp.full((b, h, lloc), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, lloc), jnp.float32)
+    o0 = jnp.zeros((b, lloc, h, d), jnp.float32)
+    # First ring step runs outside the scan so the carry enters already
+    # sp-varying (the accumulators depend on axis_index); the last step runs
+    # outside too, so the scan body's trailing permute is never wasted.
+    m, l, o = attend(k, v, 0, m0, l0, o0)
+    if sp > 1:
+        kb = ring_permute(k, axis)
+        vb = ring_permute(v, axis)
+        (kb, vb, m, l, o), _ = lax.scan(
+            step, (kb, vb, m, l, o), jnp.arange(1, sp - 1)
+        )
+        m, l, o = attend(kb, vb, sp - 1, m, l, o)
+    l = jnp.where(l == 0.0, 1.0, l)  # rows with no visible keys
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
