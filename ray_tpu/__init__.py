@@ -9,7 +9,20 @@ make Ray's libraries portable (reference SURVEY: every ML library is pure
 Python over L3).
 """
 
+import importlib
+
 from ray_tpu._version import __version__
+
+_SUBPACKAGES = ("core", "parallel", "collective", "ops", "models", "train",
+                "tune", "data", "serve", "rllib", "util", "accelerators")
+
+
+def __getattr__(name: str):
+    """Lazy subpackage access: ``import ray_tpu; ray_tpu.data.range(...)``
+    (mirrors ``ray.data`` etc. being importable off the top-level package)."""
+    if name in _SUBPACKAGES:
+        return importlib.import_module(f"ray_tpu.{name}")
+    raise AttributeError(f"module 'ray_tpu' has no attribute {name!r}")
 from ray_tpu.core.runtime import (
     init,
     shutdown,

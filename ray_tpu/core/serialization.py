@@ -59,11 +59,17 @@ def serialize(value: Any) -> Tuple[bytes, List[pickle.PickleBuffer]]:
             return False  # out-of-band
         return True  # serialize in-band
 
+    # cloudpickle, not plain pickle: plain pickle serializes __main__-defined
+    # functions/classes BY REFERENCE (succeeds locally, AttributeError in the
+    # worker whose __main__ is the worker entrypoint). cloudpickle serializes
+    # those by value and delegates everything else to the C pickler, so the
+    # data path cost is unchanged (reference: Ray's SerializationContext is
+    # cloudpickle-based too, python/ray/_private/serialization.py:111).
     try:
-        data = pickle.dumps(value, protocol=5, buffer_callback=cb)
+        data = cloudpickle.dumps(value, protocol=5, buffer_callback=cb)
     except Exception:
         buffers.clear()
-        data = cloudpickle.dumps(value, protocol=5, buffer_callback=cb)
+        data = pickle.dumps(value, protocol=5, buffer_callback=cb)
     return data, buffers
 
 

@@ -278,6 +278,9 @@ class WorkerRuntime:
 
 def worker_entry(conn, session: str, worker_id: bytes):
     os.environ["RTPU_WORKER"] = "1"
+    from ray_tpu.util.tpu_info import honor_jax_platform_env
+
+    honor_jax_platform_env(only_if_imported=True)
     import ray_tpu.core.runtime as rt
 
     w = WorkerRuntime(conn, session, worker_id)

@@ -162,7 +162,9 @@ def flash_attention(
     ``impl``: ``auto`` | ``pallas`` | ``xla`` | ``naive``.
     """
     if impl == "auto":
-        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+        from ray_tpu.util.tpu_info import is_tpu_backend
+
+        impl = "pallas" if is_tpu_backend() else "xla"
     if impl == "pallas":
         from ray_tpu.ops.flash_pallas import flash_attention_pallas
 

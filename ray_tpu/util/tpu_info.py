@@ -1,0 +1,82 @@
+"""TPU backend detection + per-chip peak-FLOPs table.
+
+The TPU may be attached under platform name "tpu" (direct PJRT) or "axon"
+(tunneled PJRT plugin) — anything that dispatches to Pallas kernels or
+computes MFU must use these helpers instead of comparing
+``jax.default_backend()`` to the literal "tpu".
+"""
+
+from __future__ import annotations
+
+TPU_PLATFORMS = ("tpu", "axon")
+
+# Public spec-sheet peak bf16 matmul FLOP/s per chip.
+PEAK_FLOPS_BY_KIND = {
+    "v2": 45e12,
+    "v3": 123e12,
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5litepod": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+    "trillium": 918e12,
+}
+
+
+def is_tpu_backend() -> bool:
+    import jax
+
+    return jax.default_backend() in TPU_PLATFORMS
+
+
+def device_kind() -> str:
+    import jax
+
+    return getattr(jax.devices()[0], "device_kind", "unknown")
+
+
+def peak_flops_per_chip(default: float = 197e12) -> float:
+    """Best-effort peak bf16 FLOP/s for the attached chip."""
+    kind = device_kind().lower().replace(" ", "").replace("-", "")
+    for key, val in sorted(PEAK_FLOPS_BY_KIND.items(),
+                           key=lambda kv: -len(kv[0])):
+        if key in kind:
+            return val
+    return default
+
+
+def honor_jax_platform_env(*, only_if_imported: bool = False) -> None:
+    """Make jax respect the JAX_PLATFORMS env var in this process.
+
+    A site-installed TPU plugin (axon sitecustomize) may pin
+    ``jax_platforms`` by config at interpreter start, silently overriding
+    the env var — a CPU-pinned process would then hang trying to claim the
+    TPU tunnel on its first device query. Call this before any device query
+    whenever the env var is authoritative (workers, driver entry points,
+    bench). With ``only_if_imported`` the no-op case skips the jax import
+    (worker fast path: if sitecustomize didn't import jax, nothing pinned
+    the config either).
+    """
+    import os
+    import sys
+
+    platforms = os.environ.get("JAX_PLATFORMS", "")
+    if not platforms:
+        return
+    if only_if_imported and "jax" not in sys.modules:
+        return
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", platforms)
+    except Exception:
+        pass
+
+
+def force_cpu() -> None:
+    """Pin jax to CPU before any device query (tests/dev boxes where the
+    TPU tunnel may be registered but unavailable)."""
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    honor_jax_platform_env()
