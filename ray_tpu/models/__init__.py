@@ -1,0 +1,54 @@
+"""Built-in TPU-tuned model family.
+
+The reference has no first-party model zoo (Train wraps user torch models;
+RLlib builds small encoders via ``rllib/core/models/``). Here the flagship
+LLM family is part of the framework because the headline benchmark is LLM
+training on TPU (BASELINE.json north star): decoder-only transformers
+covering Llama-3 shapes (RoPE/SwiGLU/RMSNorm/GQA), GPT-2 shapes
+(learned-pos/GELU/LayerNorm), and MoE variants, all as pure functions over
+param pytrees with logical-axis sharding annotations.
+"""
+
+from ray_tpu.models.config import (
+    TransformerConfig,
+    PRESETS,
+    get_config,
+    llama3_8b,
+    llama3_70b,
+    llama_1b,
+    llama_250m,
+    llama_debug,
+    gpt2_small,
+    gpt2_debug,
+    moe_debug,
+)
+from ray_tpu.models.transformer import (
+    init_params,
+    param_axes,
+    forward,
+    loss_and_metrics,
+    init_cache,
+    decode_step,
+    generate,
+)
+
+__all__ = [
+    "TransformerConfig",
+    "PRESETS",
+    "get_config",
+    "llama3_8b",
+    "llama3_70b",
+    "llama_1b",
+    "llama_250m",
+    "llama_debug",
+    "gpt2_small",
+    "gpt2_debug",
+    "moe_debug",
+    "init_params",
+    "param_axes",
+    "forward",
+    "loss_and_metrics",
+    "init_cache",
+    "decode_step",
+    "generate",
+]

@@ -1,0 +1,184 @@
+"""Model configs + presets for the built-in transformer family.
+
+The reference ships no model zoo of its own (RLlib's catalogs build
+encoders per-framework, ``rllib/core/models/``; Train wraps user torch
+models). Here the model family is first-class because the flagship
+benchmark is LLM training (BASELINE.json north star: Llama-3-8B ≥45% MFU),
+so the framework owns a TPU-tuned transformer the way the reference's
+release benchmarks own ``torch_benchmark.py`` workloads
+(``release/air_tests/air_benchmarks/workloads/``).
+
+Everything is static at trace time: a config is hashable and is passed as a
+static argument to jitted functions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    """Hashable, trace-static description of a decoder-only transformer."""
+
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: Optional[int] = None   # None => MHA (= n_heads); < n_heads => GQA
+    head_dim: Optional[int] = None     # None => d_model // n_heads
+    d_ff: Optional[int] = None         # None => 4*d_model (gelu) / ~8/3*d_model (swiglu)
+    max_seq_len: int = 2048
+
+    # architecture family knobs
+    mlp: str = "swiglu"                # "swiglu" (llama) | "gelu" (gpt2)
+    norm: str = "rms"                  # "rms" (llama) | "layer" (gpt2)
+    positions: str = "rope"            # "rope" (llama) | "learned" (gpt2)
+    rope_theta: float = 500000.0
+    tie_embeddings: bool = False
+
+    # mixture of experts (0 => dense)
+    num_experts: int = 0
+    expert_top_k: int = 2
+    expert_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
+
+    # numerics / memory
+    dtype: str = "bfloat16"            # activation/param compute dtype
+    param_dtype: str = "float32"       # master param dtype
+    remat: bool = True                 # jax.checkpoint each layer (HBM <-> FLOPs)
+    logits_softcap: float = 0.0        # tanh soft-capping (0 = off)
+    z_loss: float = 0.0                # output z-loss weight
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
+
+    @property
+    def hdim(self) -> int:
+        return self.head_dim or self.d_model // self.n_heads
+
+    @property
+    def ff(self) -> int:
+        if self.d_ff is not None:
+            return self.d_ff
+        if self.mlp == "swiglu":
+            # llama-style: 2/3 * 4d rounded up to a multiple of 256 (MXU tiles)
+            raw = int(8 * self.d_model / 3)
+            return (raw + 255) // 256 * 256
+        return 4 * self.d_model
+
+    def replace(self, **kw) -> "TransformerConfig":
+        return dataclasses.replace(self, **kw)
+
+    def num_params(self) -> int:
+        """Parameter count (embeddings included once if tied)."""
+        d, f, hd = self.d_model, self.ff, self.hdim
+        attn = d * hd * self.n_heads + 2 * d * hd * self.kv_heads + hd * self.n_heads * d
+        if self.mlp == "swiglu":
+            mlp = 3 * d * f
+        else:
+            mlp = 2 * d * f
+        if self.num_experts:
+            mlp = mlp * self.num_experts + d * self.num_experts  # + router
+        norms = 2 * d
+        per_layer = attn + mlp + norms
+        emb = self.vocab_size * d
+        head = 0 if self.tie_embeddings else self.vocab_size * d
+        pos = self.max_seq_len * d if self.positions == "learned" else 0
+        return self.n_layers * per_layer + emb + head + pos + d  # + final norm
+
+    def flops_per_token(self) -> int:
+        """Approx training FLOPs/token (fwd+bwd ≈ 6N + attention quadratic)."""
+        n = self.num_params()
+        emb = self.vocab_size * self.d_model * (1 if self.tie_embeddings else 2)
+        return 6 * (n - emb)
+
+
+# ---------------------------------------------------------------------------
+# Presets. llama3_* mirror public Llama-3 shapes; *_debug are CI-sized.
+# ---------------------------------------------------------------------------
+
+def llama3_8b() -> TransformerConfig:
+    return TransformerConfig(
+        vocab_size=128256, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+        d_ff=14336, max_seq_len=8192, mlp="swiglu", norm="rms",
+        positions="rope", rope_theta=500000.0,
+    )
+
+
+def llama3_70b() -> TransformerConfig:
+    return TransformerConfig(
+        vocab_size=128256, d_model=8192, n_layers=80, n_heads=64, n_kv_heads=8,
+        d_ff=28672, max_seq_len=8192, mlp="swiglu", norm="rms",
+        positions="rope", rope_theta=500000.0,
+    )
+
+
+def llama_1b() -> TransformerConfig:
+    """~1.2B params — fits one v5e chip in bf16 with optimizer state sharded."""
+    return TransformerConfig(
+        vocab_size=32000, d_model=2048, n_layers=16, n_heads=16, n_kv_heads=8,
+        d_ff=5632, max_seq_len=4096,
+    )
+
+
+def llama_250m() -> TransformerConfig:
+    """~250M-param bench model: large enough that the MXU dominates, small
+    enough to init fast on one chip (bench.py's default workload)."""
+    return TransformerConfig(
+        vocab_size=32000, d_model=1024, n_layers=12, n_heads=16, n_kv_heads=8,
+        d_ff=2816, max_seq_len=2048,
+    )
+
+
+def llama_debug() -> TransformerConfig:
+    """Tiny config for tests and the multichip dryrun."""
+    return TransformerConfig(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=128, remat=False,
+    )
+
+
+def gpt2_small() -> TransformerConfig:
+    return TransformerConfig(
+        vocab_size=50257, d_model=768, n_layers=12, n_heads=12,
+        d_ff=3072, max_seq_len=1024, mlp="gelu", norm="layer",
+        positions="learned", tie_embeddings=True,
+    )
+
+
+def gpt2_debug() -> TransformerConfig:
+    return TransformerConfig(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+        d_ff=256, max_seq_len=128, mlp="gelu", norm="layer",
+        positions="learned", tie_embeddings=True, remat=False,
+    )
+
+
+def moe_debug() -> TransformerConfig:
+    return TransformerConfig(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=4,
+        d_ff=128, max_seq_len=128, num_experts=4, expert_top_k=2, remat=False,
+    )
+
+
+PRESETS = {
+    "llama3-8b": llama3_8b,
+    "llama3-70b": llama3_70b,
+    "llama-1b": llama_1b,
+    "llama-250m": llama_250m,
+    "llama-debug": llama_debug,
+    "gpt2-small": gpt2_small,
+    "gpt2-debug": gpt2_debug,
+    "moe-debug": moe_debug,
+}
+
+
+def get_config(name: str) -> TransformerConfig:
+    try:
+        return PRESETS[name]()
+    except KeyError:
+        raise ValueError(f"unknown preset {name!r}; have {sorted(PRESETS)}")

@@ -1,0 +1,432 @@
+"""Decoder-only transformer: pure-function forward over a param pytree.
+
+TPU-native design notes:
+
+- Parameters are plain pytrees (nested dicts of arrays) with a parallel
+  *logical-axes* pytree (:func:`param_axes`); sharding is applied by mapping
+  logical names through :mod:`ray_tpu.parallel.sharding` rules — no module
+  wrappers (contrast the reference's DDP/FSDP wrapping at
+  ``python/ray/train/torch/train_loop_utils.py:158``).
+- Layers are **stacked** on a leading dim and the forward runs ``lax.scan``
+  over them: one layer gets traced/compiled once regardless of depth, and
+  XLA pipelines the weight prefetch of layer i+1 against layer i's compute.
+- ``jax.checkpoint`` around the scanned body trades FLOPs for HBM (standard
+  remat policy for LLM training).
+- Attention dispatches to the Pallas flash kernel on TPU, the blockwise XLA
+  kernel elsewhere, and ring attention (``lax.ppermute`` over the ``sp``
+  mesh axis) when the ambient mesh has a nontrivial sequence-parallel axis.
+- All matmuls run in ``config.dtype`` (bf16 by default) on the MXU; norms,
+  softmax, and the loss accumulate in f32.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.models.config import TransformerConfig
+from ray_tpu.ops.attention import blockwise_attention, naive_attention
+from ray_tpu.ops.layers import apply_rotary, rms_norm, rotary_embedding
+from ray_tpu.ops.moe import moe_layer_dense
+from ray_tpu.parallel.sharding import constrain
+
+Params = Dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# Init
+# ---------------------------------------------------------------------------
+
+def init_params(rng: jax.Array, config: TransformerConfig) -> Params:
+    """Initialize a parameter pytree (layers stacked on a leading dim)."""
+    c = config
+    pdt = jnp.dtype(c.param_dtype)
+    d, hd, f, L = c.d_model, c.hdim, c.ff, c.n_layers
+    h, kv, v = c.n_heads, c.kv_heads, c.vocab_size
+
+    keys = iter(jax.random.split(rng, 16))
+
+    def normal(key, shape, std):
+        return (jax.random.normal(key, shape, jnp.float32) * std).astype(pdt)
+
+    proj_std = d ** -0.5
+    out_std = proj_std / (2 * L) ** 0.5  # GPT-2-style depth scaling
+
+    layers: Params = {
+        "attn_norm": jnp.ones((L, d), pdt),
+        "wq": normal(next(keys), (L, d, h, hd), proj_std),
+        "wk": normal(next(keys), (L, d, kv, hd), proj_std),
+        "wv": normal(next(keys), (L, d, kv, hd), proj_std),
+        "wo": normal(next(keys), (L, h, hd, d), out_std),
+        "mlp_norm": jnp.ones((L, d), pdt),
+    }
+    if c.norm == "layer":
+        layers["attn_norm_b"] = jnp.zeros((L, d), pdt)
+        layers["mlp_norm_b"] = jnp.zeros((L, d), pdt)
+
+    if c.num_experts:
+        e = c.num_experts
+        layers["router"] = normal(next(keys), (L, d, e), proj_std)
+        layers["w_gate"] = normal(next(keys), (L, e, d, f), proj_std)
+        layers["w_up"] = normal(next(keys), (L, e, d, f), proj_std)
+        layers["w_down"] = normal(next(keys), (L, e, f, d), out_std)
+    elif c.mlp == "swiglu":
+        layers["w_gate"] = normal(next(keys), (L, d, f), proj_std)
+        layers["w_up"] = normal(next(keys), (L, d, f), proj_std)
+        layers["w_down"] = normal(next(keys), (L, f, d), out_std)
+    else:  # gelu
+        layers["w_in"] = normal(next(keys), (L, d, f), proj_std)
+        layers["b_in"] = jnp.zeros((L, f), pdt)
+        layers["w_out"] = normal(next(keys), (L, f, d), out_std)
+        layers["b_out"] = jnp.zeros((L, d), pdt)
+
+    params: Params = {
+        "embed": normal(next(keys), (v, d), 0.02),
+        "layers": layers,
+        "final_norm": jnp.ones((d,), pdt),
+    }
+    if c.norm == "layer":
+        params["final_norm_b"] = jnp.zeros((d,), pdt)
+    if c.positions == "learned":
+        params["pos_embed"] = normal(next(keys), (c.max_seq_len, d), 0.02)
+    if not c.tie_embeddings:
+        params["lm_head"] = normal(next(keys), (d, v), proj_std)
+    return params
+
+
+def param_axes(config: TransformerConfig) -> Params:
+    """Logical-axes pytree matching :func:`init_params` leaf-for-leaf."""
+    c = config
+    lay = {
+        "attn_norm": ("layers", "norm"),
+        "wq": ("layers", "embed", "heads", "head_dim"),
+        "wk": ("layers", "embed", "kv_heads", "head_dim"),
+        "wv": ("layers", "embed", "kv_heads", "head_dim"),
+        "wo": ("layers", "heads", "head_dim", "embed"),
+        "mlp_norm": ("layers", "norm"),
+    }
+    if c.norm == "layer":
+        lay["attn_norm_b"] = ("layers", "norm")
+        lay["mlp_norm_b"] = ("layers", "norm")
+    if c.num_experts:
+        lay["router"] = ("layers", "embed", "expert")
+        lay["w_gate"] = ("layers", "expert", "embed", "mlp")
+        lay["w_up"] = ("layers", "expert", "embed", "mlp")
+        lay["w_down"] = ("layers", "expert", "mlp", "embed")
+    elif c.mlp == "swiglu":
+        lay["w_gate"] = ("layers", "embed", "mlp")
+        lay["w_up"] = ("layers", "embed", "mlp")
+        lay["w_down"] = ("layers", "mlp", "embed")
+    else:
+        lay["w_in"] = ("layers", "embed", "mlp")
+        lay["b_in"] = ("layers", "mlp")
+        lay["w_out"] = ("layers", "mlp", "embed")
+        lay["b_out"] = ("layers", "norm")
+    axes: Params = {
+        "embed": ("vocab", "embed"),
+        "layers": lay,
+        "final_norm": ("norm",),
+    }
+    if c.norm == "layer":
+        axes["final_norm_b"] = ("norm",)
+    if c.positions == "learned":
+        axes["pos_embed"] = (None, "embed")
+    if not c.tie_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _norm(x, w, b, kind):
+    if kind == "rms":
+        return rms_norm(x, w)
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(axis=-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(axis=-1, keepdims=True)
+    out = (xf - mu) * lax.rsqrt(var + 1e-5) * w.astype(jnp.float32)
+    if b is not None:
+        out = out + b.astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+def _sp_axis_size() -> int:
+    """Size of the ambient mesh's sequence-parallel axis (1 if absent)."""
+    from jax.sharding import get_abstract_mesh
+
+    mesh = get_abstract_mesh()
+    if mesh is None or mesh.empty or "sp" not in mesh.axis_names:
+        return 1
+    return mesh.shape["sp"]
+
+
+def _attention(q, k, v, config: TransformerConfig):
+    """Training attention: ring over sp when sequence-parallel, else flash."""
+    sp = _sp_axis_size()
+    if sp > 1 and q.shape[1] % sp == 0 and k.shape[1] % sp == 0:
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+        from jax.sharding import get_abstract_mesh
+
+        from ray_tpu.ops.ring_attention import ring_attention
+
+        mesh = get_abstract_mesh()
+        batch = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
+        qspec = P(batch or None, "sp", "tp" if "tp" in mesh.axis_names else None, None)
+        fn = shard_map(
+            functools.partial(ring_attention, axis="sp", causal=True),
+            mesh=mesh, in_specs=(qspec, qspec, qspec), out_specs=qspec,
+            check_vma=False,
+        )
+        return fn(q, k, v)
+    from ray_tpu.util.tpu_info import is_tpu_backend
+
+    if is_tpu_backend():
+        from ray_tpu.ops.flash_pallas import flash_attention_pallas
+
+        return flash_attention_pallas(q, k, v, causal=True)
+    return blockwise_attention(q, k, v, causal=True)
+
+
+def forward(
+    params: Params,
+    tokens: jax.Array,
+    config: TransformerConfig,
+    *,
+    positions: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Full-sequence forward. tokens: [B, L] int32 → (logits [B,L,V] f32, moe_aux)."""
+    c = config
+    dt = jnp.dtype(c.dtype)
+    b, l = tokens.shape
+    if positions is None:
+        positions = jnp.arange(l)[None, :]
+
+    x = params["embed"].astype(dt)[tokens]
+    if c.positions == "learned":
+        x = x + params["pos_embed"].astype(dt)[positions[0]][None]
+    x = constrain(x, ("batch", "seq", None))
+
+    if c.positions == "rope":
+        cos, sin = rotary_embedding(positions[0], c.hdim, theta=c.rope_theta)
+    else:
+        cos = sin = None
+
+    def layer(x, lp):
+        h = _norm(x, lp["attn_norm"], lp.get("attn_norm_b"), c.norm)
+        q = jnp.einsum("bld,dhk->blhk", h, lp["wq"].astype(dt))
+        k = jnp.einsum("bld,dhk->blhk", h, lp["wk"].astype(dt))
+        v = jnp.einsum("bld,dhk->blhk", h, lp["wv"].astype(dt))
+        if cos is not None:
+            q = apply_rotary(q, cos, sin)
+            k = apply_rotary(k, cos, sin)
+        q = constrain(q, ("batch", "seq", "heads", None))
+        k = constrain(k, ("batch", "seq", "kv_heads", None))
+        o = _attention(q, k, v, c)
+        o = jnp.einsum("blhk,hkd->bld", o, lp["wo"].astype(dt))
+        x = constrain(x + o, ("batch", "seq", None))
+
+        h = _norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"), c.norm)
+        aux = jnp.zeros((), jnp.float32)
+        if c.num_experts:
+            m, aux = moe_layer_dense(
+                h, lp["router"].astype(dt), lp["w_gate"].astype(dt),
+                lp["w_up"].astype(dt), lp["w_down"].astype(dt),
+                k=c.expert_top_k, capacity_factor=c.expert_capacity_factor,
+            )
+        elif c.mlp == "swiglu":
+            g = jax.nn.silu(jnp.einsum("bld,df->blf", h, lp["w_gate"].astype(dt)))
+            u = jnp.einsum("bld,df->blf", h, lp["w_up"].astype(dt))
+            gu = constrain(g * u, ("batch", "seq", "mlp"))
+            m = jnp.einsum("blf,fd->bld", gu, lp["w_down"].astype(dt))
+        else:
+            hmid = jnp.einsum("bld,df->blf", h, lp["w_in"].astype(dt))
+            hmid = jax.nn.gelu(hmid + lp["b_in"].astype(dt))
+            hmid = constrain(hmid, ("batch", "seq", "mlp"))
+            m = jnp.einsum("blf,fd->bld", hmid, lp["w_out"].astype(dt))
+            m = m + lp["b_out"].astype(dt)
+        x = constrain(x + m, ("batch", "seq", None))
+        return x, aux
+
+    body = jax.checkpoint(layer) if c.remat else layer
+
+    def scan_step(carry, lp):
+        x, aux_sum = carry
+        x, aux = body(x, lp)
+        return (x, aux_sum + aux), None
+
+    (x, moe_aux), _ = lax.scan(scan_step, (x, jnp.zeros((), jnp.float32)),
+                               params["layers"])
+
+    x = _norm(x, params["final_norm"], params.get("final_norm_b"), c.norm)
+    head = (params["embed"].T if c.tie_embeddings else params["lm_head"]).astype(dt)
+    logits = jnp.einsum("bld,dv->blv", x, head).astype(jnp.float32)
+    if c.logits_softcap:
+        logits = jnp.tanh(logits / c.logits_softcap) * c.logits_softcap
+    return logits, moe_aux
+
+
+# ---------------------------------------------------------------------------
+# Loss
+# ---------------------------------------------------------------------------
+
+def loss_and_metrics(
+    params: Params,
+    batch: Dict[str, jax.Array],
+    config: TransformerConfig,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Next-token cross entropy. batch: {"tokens": [B,L]} or explicit
+    {"inputs", "targets", "mask"}."""
+    if "inputs" in batch:
+        inputs, targets = batch["inputs"], batch["targets"]
+        mask = batch.get("mask")
+    else:
+        toks = batch["tokens"]
+        inputs, targets = toks[:, :-1], toks[:, 1:]
+        mask = batch.get("mask")
+        if mask is not None:
+            mask = mask[:, 1:]
+    if mask is None:
+        mask = jnp.ones(targets.shape, jnp.float32)
+    mask = mask.astype(jnp.float32)
+
+    logits, moe_aux = forward(params, inputs, config)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    tgt_logit = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    nll = (logz - tgt_logit) * mask
+    denom = jnp.maximum(mask.sum(), 1.0)
+    loss = nll.sum() / denom
+    metrics = {"loss": loss, "ntokens": mask.sum()}
+    if config.z_loss:
+        zl = config.z_loss * ((logz ** 2) * mask).sum() / denom
+        loss = loss + zl
+        metrics["z_loss"] = zl
+    if config.num_experts:
+        loss = loss + config.moe_aux_weight * moe_aux
+        metrics["moe_aux"] = moe_aux
+    metrics["perplexity"] = jnp.exp(jnp.minimum(metrics["loss"], 20.0))
+    return loss, metrics
+
+
+# ---------------------------------------------------------------------------
+# KV-cache decode (serve / RL inference path)
+# ---------------------------------------------------------------------------
+
+def init_cache(config: TransformerConfig, batch: int, max_len: int,
+               dtype=None) -> Params:
+    c = config
+    dt = jnp.dtype(dtype or c.dtype)
+    shape = (c.n_layers, batch, max_len, c.kv_heads, c.hdim)
+    return {
+        "k": jnp.zeros(shape, dt),
+        "v": jnp.zeros(shape, dt),
+        "pos": jnp.zeros((), jnp.int32),
+    }
+
+
+def decode_step(
+    params: Params,
+    cache: Params,
+    tokens: jax.Array,
+    config: TransformerConfig,
+) -> Tuple[jax.Array, Params]:
+    """Append ``tokens`` [B, T] (prompt chunk or single step) to the cache and
+    return (logits [B, T, V], new cache). Static T → one compiled program per
+    chunk length (prefill vs decode=1)."""
+    c = config
+    dt = jnp.dtype(c.dtype)
+    b, t = tokens.shape
+    pos0 = cache["pos"]
+    positions = pos0 + jnp.arange(t)
+
+    x = params["embed"].astype(dt)[tokens]
+    if c.positions == "learned":
+        x = x + jnp.take(params["pos_embed"].astype(dt), positions, axis=0)[None]
+    if c.positions == "rope":
+        cos, sin = rotary_embedding(positions, c.hdim, theta=c.rope_theta)
+    else:
+        cos = sin = None
+
+    def layer(carry, inp):
+        x = carry
+        lp, kc, vc = inp
+        h = _norm(x, lp["attn_norm"], lp.get("attn_norm_b"), c.norm)
+        q = jnp.einsum("bld,dhk->blhk", h, lp["wq"].astype(dt))
+        k = jnp.einsum("bld,dhk->blhk", h, lp["wk"].astype(dt))
+        v = jnp.einsum("bld,dhk->blhk", h, lp["wv"].astype(dt))
+        if cos is not None:
+            q = apply_rotary(q, cos, sin)
+            k = apply_rotary(k, cos, sin)
+        kc = lax.dynamic_update_slice(kc, k.astype(kc.dtype), (0, pos0, 0, 0))
+        vc = lax.dynamic_update_slice(vc, v.astype(vc.dtype), (0, pos0, 0, 0))
+        o = naive_attention(q, kc, vc, causal=True, q_offset=pos0)
+        o = jnp.einsum("blhk,hkd->bld", o, lp["wo"].astype(dt))
+        x = x + o
+        h = _norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"), c.norm)
+        if c.num_experts:
+            m, _ = moe_layer_dense(
+                h, lp["router"].astype(dt), lp["w_gate"].astype(dt),
+                lp["w_up"].astype(dt), lp["w_down"].astype(dt),
+                k=c.expert_top_k, capacity_factor=c.expert_capacity_factor,
+            )
+        elif c.mlp == "swiglu":
+            g = jax.nn.silu(jnp.einsum("bld,df->blf", h, lp["w_gate"].astype(dt)))
+            m = jnp.einsum("blf,fd->bld", g * jnp.einsum(
+                "bld,df->blf", h, lp["w_up"].astype(dt)), lp["w_down"].astype(dt))
+        else:
+            hmid = jax.nn.gelu(jnp.einsum(
+                "bld,df->blf", h, lp["w_in"].astype(dt)) + lp["b_in"].astype(dt))
+            m = jnp.einsum("blf,fd->bld", hmid, lp["w_out"].astype(dt))
+            m = m + lp["b_out"].astype(dt)
+        return x + m, (kc, vc)
+
+    x, (new_k, new_v) = lax.scan(
+        layer, x, (params["layers"], cache["k"], cache["v"])
+    )
+    x = _norm(x, params["final_norm"], params.get("final_norm_b"), c.norm)
+    head = (params["embed"].T if c.tie_embeddings else params["lm_head"]).astype(dt)
+    logits = jnp.einsum("bld,dv->blv", x, head).astype(jnp.float32)
+    new_cache = {"k": new_k, "v": new_v, "pos": pos0 + t}
+    return logits, new_cache
+
+
+def generate(
+    params: Params,
+    prompt: jax.Array,
+    config: TransformerConfig,
+    *,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+    max_len: Optional[int] = None,
+) -> jax.Array:
+    """Greedy/temperature sampling. prompt: [B, P] → [B, P+max_new_tokens]."""
+    b, p = prompt.shape
+    total = max_len or min(config.max_seq_len, p + max_new_tokens)
+    cache = init_cache(config, b, total)
+    logits, cache = decode_step(params, cache, prompt, config)
+    last = logits[:, -1]
+
+    def sample(logits, key):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(key, logits / temperature).astype(jnp.int32)
+
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    def step(carry, key):
+        cache, last_logits = carry
+        tok = sample(last_logits, key)
+        logits, cache = decode_step(params, cache, tok[:, None], config)
+        return (cache, logits[:, -1]), tok
+
+    keys = jax.random.split(rng, max_new_tokens)
+    (_, _), toks = lax.scan(step, (cache, last), keys)
+    return jnp.concatenate([prompt, toks.T], axis=1)

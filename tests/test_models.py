@@ -1,0 +1,153 @@
+"""Model family: shapes, loss, decode==forward consistency, sharded training."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu import models
+from ray_tpu.models import (
+    TransformerConfig, init_params, param_axes, forward, loss_and_metrics,
+    init_cache, decode_step, generate,
+)
+from ray_tpu.parallel import MeshConfig, make_mesh, shard_params
+
+
+CONFIGS = {
+    "llama": models.llama_debug(),
+    "gpt2": models.gpt2_debug(),
+    "moe": models.moe_debug(),
+}
+
+
+@pytest.mark.parametrize("name", list(CONFIGS))
+def test_forward_shapes(name):
+    c = CONFIGS[name]
+    params = init_params(jax.random.PRNGKey(0), c)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, c.vocab_size)
+    logits, aux = forward(params, toks, c)
+    assert logits.shape == (2, 16, c.vocab_size)
+    assert logits.dtype == jnp.float32
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+@pytest.mark.parametrize("name", list(CONFIGS))
+def test_param_axes_match_params(name):
+    c = CONFIGS[name]
+    params = init_params(jax.random.PRNGKey(0), c)
+    axes = param_axes(c)
+    flat_p = jax.tree.leaves(params)
+    flat_a = jax.tree.leaves(axes, is_leaf=lambda x: isinstance(x, tuple))
+    assert len(flat_p) == len(flat_a)
+    # Every axes tuple must have one entry per array dim.
+    def check(p, a):
+        assert len(a) == p.ndim, f"{a} vs {p.shape}"
+    jax.tree.map(check, params, axes,
+                 is_leaf=lambda x: isinstance(x, tuple) and all(
+                     e is None or isinstance(e, str) for e in x))
+
+
+def test_num_params_formula_matches():
+    c = CONFIGS["llama"]
+    params = init_params(jax.random.PRNGKey(0), c)
+    actual = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+    assert actual == c.num_params()
+
+
+def test_loss_decreases_under_sgd():
+    c = models.llama_debug()
+    params = init_params(jax.random.PRNGKey(0), c)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, c.vocab_size)
+    batch = {"tokens": toks}
+
+    @jax.jit
+    def step(params):
+        (loss, m), grads = jax.value_and_grad(
+            lambda p: loss_and_metrics(p, batch, c), has_aux=True)(params)
+        params = jax.tree.map(lambda p, g: p - 0.1 * g.astype(p.dtype),
+                              params, grads)
+        return params, loss
+
+    params, l0 = step(params)
+    for _ in range(5):
+        params, loss = step(params)
+    assert float(loss) < float(l0)
+
+
+def test_decode_matches_forward():
+    c = models.llama_debug()
+    params = init_params(jax.random.PRNGKey(0), c)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0, c.vocab_size)
+    full, _ = forward(params, toks, c)
+
+    # prefill 8, then decode 4 one at a time
+    cache = init_cache(c, 2, 16)
+    lp, cache = decode_step(params, cache, toks[:, :8], c)
+    np.testing.assert_allclose(np.asarray(lp), np.asarray(full[:, :8]),
+                               atol=2e-2, rtol=2e-2)
+    outs = [lp[:, -1:]]
+    for i in range(8, 12):
+        li, cache = decode_step(params, cache, toks[:, i:i + 1], c)
+        outs.append(li)
+    dec = jnp.concatenate(outs[1:], axis=1)
+    np.testing.assert_allclose(np.asarray(dec), np.asarray(full[:, 8:12]),
+                               atol=2e-2, rtol=2e-2)
+
+
+def test_generate_greedy_deterministic():
+    c = models.gpt2_debug()
+    params = init_params(jax.random.PRNGKey(0), c)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 4), 0, c.vocab_size)
+    out1 = generate(params, prompt, c, max_new_tokens=6)
+    out2 = generate(params, prompt, c, max_new_tokens=6)
+    assert out1.shape == (1, 10)
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+    np.testing.assert_array_equal(np.asarray(out1[:, :4]), np.asarray(prompt))
+
+
+def test_sharded_train_step_tp_fsdp():
+    """Full train step jitted over a dp×fsdp×tp mesh with sharded params."""
+    c = models.llama_debug()
+    mesh = make_mesh(MeshConfig(dp=2, fsdp=2, tp=2, sp=1))
+    params = init_params(jax.random.PRNGKey(0), c)
+    axes = param_axes(c)
+    params = shard_params(params, axes, mesh)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, c.vocab_size)
+    batch = {"tokens": toks}
+
+    with jax.set_mesh(mesh):
+        @jax.jit
+        def step(params):
+            (loss, m), grads = jax.value_and_grad(
+                lambda p: loss_and_metrics(p, batch, c), has_aux=True)(params)
+            return jax.tree.map(
+                lambda p, g: p - 0.1 * g.astype(p.dtype), params, grads), loss
+
+        new_params, loss = step(params)
+    assert np.isfinite(float(loss))
+    # Param shardings preserved through the step (trailing-None spec forms
+    # compare unequal, so check equivalence).
+    wq_new, wq_old = new_params["layers"]["wq"], params["layers"]["wq"]
+    assert wq_new.sharding.is_equivalent_to(wq_old.sharding, wq_old.ndim)
+
+
+def test_sharded_train_step_ring_attention_sp():
+    """sp>1 routes attention through ring attention inside the jitted step."""
+    c = models.llama_debug()
+    mesh = make_mesh(MeshConfig(dp=1, fsdp=2, tp=1, sp=4))
+    params = init_params(jax.random.PRNGKey(0), c)
+    params_sharded = shard_params(params, param_axes(c), mesh)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 65), 0, c.vocab_size)
+    # Explicit inputs/targets keep the model seq len at 64 (divisible by sp).
+    batch = {"inputs": toks[:, :-1], "targets": toks[:, 1:]}
+
+    ref_loss, _ = loss_and_metrics(params, batch, c)  # no mesh: flash path
+
+    with jax.set_mesh(mesh):
+        @jax.jit
+        def step(params):
+            loss, m = loss_and_metrics(params, batch, c)
+            return loss
+
+        sp_loss = step(params_sharded)
+    np.testing.assert_allclose(float(sp_loss), float(ref_loss), atol=2e-2, rtol=2e-2)
