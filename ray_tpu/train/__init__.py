@@ -1,0 +1,59 @@
+"""ray_tpu.train — distributed training over host actors + pjit.
+
+Role analog: ``python/ray/train`` (SURVEY §2.5, §3.4). Public surface
+mirrors the reference — ``JaxTrainer`` stands where ``TorchTrainer`` does,
+``report``/``get_context``/``get_checkpoint`` match ``ray.train.*`` — but the
+data plane is pjit over a device mesh: gradient sync is XLA collectives over
+ICI (no process groups), parallelism is declared as a MeshConfig, and
+checkpoints save sharded param pytrees host-side.
+"""
+
+from ray_tpu.train.checkpoint import Checkpoint, save_pytree, load_pytree
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    Result,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.backend import Backend, BackendConfig, JaxConfig
+from ray_tpu.train.session import (
+    TrainContext,
+    get_checkpoint,
+    get_context,
+    report,
+)
+from ray_tpu.train.trainer import (
+    BaseTrainer,
+    DataParallelTrainer,
+    JaxTrainer,
+    TrainingFailedError,
+)
+from ray_tpu.train.train_state import (
+    TrainLoopHelper,
+    create_train_state,
+    make_train_step,
+    state_shardings,
+)
+
+__all__ = [
+    "Checkpoint",
+    "save_pytree",
+    "load_pytree",
+    "CheckpointConfig",
+    "FailureConfig",
+    "Result",
+    "RunConfig",
+    "ScalingConfig",
+    "Backend",
+    "BackendConfig",
+    "JaxConfig",
+    "TrainContext",
+    "get_checkpoint",
+    "get_context",
+    "report",
+    "BaseTrainer",
+    "DataParallelTrainer",
+    "JaxTrainer",
+    "TrainingFailedError",
+]

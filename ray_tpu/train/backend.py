@@ -1,0 +1,108 @@
+"""Backend plugins: per-framework worker-group setup hooks.
+
+Role analog: ``Backend``/``BackendConfig`` (``python/ray/train/backend.py``)
+with the Neuron-XLA backend (``train/torch/xla/config.py:20,120``) as the
+shape blueprint: on_start does rendezvous env vars, on_training_start does
+framework init, on_shutdown cleans up. The TPU-native backend wires the JAX
+coordination service instead of ``dist.init_process_group``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from ray_tpu.parallel.mesh import MeshConfig
+
+
+@dataclass
+class BackendConfig:
+    @property
+    def backend_cls(self):
+        return Backend
+
+
+class Backend:
+    """Hooks called by the BackendExecutor around the worker group."""
+
+    def on_start(self, worker_group, backend_config: BackendConfig):
+        pass
+
+    def on_training_start(self, worker_group, backend_config: BackendConfig):
+        pass
+
+    def on_shutdown(self, worker_group, backend_config: BackendConfig):
+        pass
+
+
+@dataclass
+class JaxConfig(BackendConfig):
+    """JAX/TPU backend config.
+
+    ``distributed=None`` (auto): initialize ``jax.distributed`` only when the
+    group has more than one worker — single-host groups (one v5e-8 host, CPU
+    tests) just use the local runtime. The coordinator is worker 0's IP
+    (reference rendezvous analog: ``_setup_torch_process_group``'s
+    MASTER_ADDR, ``train/torch/config.py:65``).
+    """
+
+    distributed: Optional[bool] = None
+    coordinator_port: int = 8476
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    extra_env: Dict[str, str] = field(default_factory=dict)
+
+    @property
+    def backend_cls(self):
+        return JaxBackend
+
+
+def _init_jax_distributed(coordinator: str, num_processes: int,
+                          process_id: int) -> Dict[str, Any]:
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return {
+        "process_index": jax.process_index(),
+        "device_count": jax.device_count(),
+    }
+
+
+def _shutdown_jax_distributed() -> None:
+    import jax
+
+    try:
+        jax.distributed.shutdown()
+    except Exception:
+        pass
+
+
+class JaxBackend(Backend):
+    def on_start(self, worker_group, backend_config: JaxConfig):
+        n = len(worker_group)
+        distributed = backend_config.distributed
+        if distributed is None:
+            distributed = n > 1
+        env = dict(backend_config.extra_env)
+        if env:
+            worker_group.execute(lambda e=env: __import__("os").environ.update(e))
+        if distributed:
+            meta = worker_group.execute_single(0, lambda: __import__(
+                "socket").gethostbyname(__import__("socket").gethostname()))
+            coordinator = f"{meta}:{backend_config.coordinator_port}"
+            import ray_tpu
+
+            refs = [
+                w.execute.remote(_init_jax_distributed, coordinator, n, i)
+                for i, w in enumerate(worker_group.workers)
+            ]
+            ray_tpu.get(refs)
+
+    def on_shutdown(self, worker_group, backend_config: JaxConfig):
+        try:
+            worker_group.execute(_shutdown_jax_distributed)
+        except Exception:
+            pass

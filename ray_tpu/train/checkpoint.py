@@ -1,0 +1,116 @@
+"""Checkpoint: a directory handle, plus pytree (de)serialization helpers.
+
+Role analog: ``ray.train.Checkpoint`` (``python/ray/train/_checkpoint.py:56``)
+— a checkpoint IS a directory on a filesystem; frameworks decide what's
+inside. The pytree helpers save/restore JAX param/opt-state trees; sharded
+``jax.Array`` leaves are fetched host-side per shard so each host writes
+only what it owns (orbax-style process-local saving) and restore re-places
+shards onto the target mesh sharding.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import pickle
+import shutil
+import tempfile
+from typing import Any, Dict, Iterator, Optional
+
+import numpy as np
+
+_METADATA_FILE = ".metadata.json"
+_TREE_FILE = "pytree.npz"
+_STRUCT_FILE = "pytree_struct.pkl"
+
+
+class Checkpoint:
+    """A handle to a checkpoint directory."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "Checkpoint":
+        d = tempfile.mkdtemp(prefix="ray_tpu_ckpt_")
+        with open(os.path.join(d, "dict_checkpoint.pkl"), "wb") as f:
+            pickle.dump(data, f)
+        return cls(d)
+
+    def to_dict(self) -> Dict[str, Any]:
+        with open(os.path.join(self.path, "dict_checkpoint.pkl"), "rb") as f:
+            return pickle.load(f)
+
+    def to_directory(self, path: Optional[str] = None) -> str:
+        if path is None:
+            path = tempfile.mkdtemp(prefix="ray_tpu_ckpt_")
+        if os.path.abspath(path) != self.path:
+            shutil.copytree(self.path, path, dirs_exist_ok=True)
+        return path
+
+    @contextlib.contextmanager
+    def as_directory(self) -> Iterator[str]:
+        yield self.path
+
+    def get_metadata(self) -> Dict[str, Any]:
+        p = os.path.join(self.path, _METADATA_FILE)
+        if os.path.exists(p):
+            with open(p) as f:
+                return json.load(f)
+        return {}
+
+    def set_metadata(self, meta: Dict[str, Any]) -> None:
+        with open(os.path.join(self.path, _METADATA_FILE), "w") as f:
+            json.dump(meta, f)
+
+    def update_metadata(self, meta: Dict[str, Any]) -> None:
+        m = self.get_metadata()
+        m.update(meta)
+        self.set_metadata(m)
+
+    def __repr__(self):
+        return f"Checkpoint(path={self.path!r})"
+
+
+# ---------------------------------------------------------------------------
+# Pytree save/restore
+# ---------------------------------------------------------------------------
+
+def save_pytree(tree: Any, path: str, *, name: str = "state") -> None:
+    """Save a pytree of arrays under ``path``. Device arrays are pulled to
+    host as numpy; structure goes to a pickle next to the flat arrays."""
+    import jax
+
+    os.makedirs(path, exist_ok=True)
+    leaves, treedef = jax.tree.flatten(tree)
+    host = []
+    for leaf in leaves:
+        if hasattr(leaf, "addressable_data"):   # jax.Array (maybe sharded)
+            leaf = jax.device_get(leaf)
+        host.append(np.asarray(leaf))
+    np.savez(os.path.join(path, f"{name}_{_TREE_FILE}"),
+             **{str(i): a for i, a in enumerate(host)})
+    with open(os.path.join(path, f"{name}_{_STRUCT_FILE}"), "wb") as f:
+        pickle.dump(treedef, f)
+
+
+def load_pytree(path: str, *, name: str = "state", shardings: Any = None) -> Any:
+    """Load a pytree saved by :func:`save_pytree`; optionally re-place leaves
+    onto ``shardings`` (a matching pytree of ``NamedSharding``)."""
+    import jax
+
+    with open(os.path.join(path, f"{name}_{_STRUCT_FILE}"), "rb") as f:
+        treedef = pickle.load(f)
+    z = np.load(os.path.join(path, f"{name}_{_TREE_FILE}.npz")
+                if not os.path.exists(os.path.join(path, f"{name}_{_TREE_FILE}"))
+                else os.path.join(path, f"{name}_{_TREE_FILE}"))
+    leaves = [z[str(i)] for i in range(len(z.files))]
+    tree = jax.tree.unflatten(treedef, leaves)
+    if shardings is not None:
+        tree = jax.tree.map(lambda x, s: jax.device_put(x, s), tree, shardings)
+    return tree

@@ -1,0 +1,92 @@
+"""Train/AIR-style run configuration dataclasses.
+
+Role analogs in the reference: ``ScalingConfig``/``RunConfig``/
+``FailureConfig``/``CheckpointConfig`` in ``python/ray/air/config.py`` and
+``Result`` in ``python/ray/air/result.py``. TPU-native addition: a
+:class:`ray_tpu.parallel.mesh.MeshConfig` rides inside ``ScalingConfig`` so
+the *parallelism layout* (dp/fsdp/tp/sp/ep/pp) is declared where the
+reference only declares a worker count — the mesh is the TPU equivalent of
+"how many DDP ranks".
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from ray_tpu.parallel.mesh import MeshConfig
+
+
+@dataclass
+class ScalingConfig:
+    """How many workers (host processes) and what each one owns.
+
+    One worker = one host actor owning all that host's TPU chips through a
+    single jax runtime (SURVEY §7 design stance: process per host, not per
+    chip). ``num_workers=1`` covers single-host slices (v5e-8 and below) and
+    every CPU test; multi-host slices get one worker per host plus a
+    jax.distributed rendezvous run by the backend.
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    resources_per_worker: Dict[str, float] = field(default_factory=dict)
+    placement_strategy: str = "PACK"
+    topology: Optional[str] = None        # e.g. "v5e-256" (informational)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+
+    def worker_resources(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker)
+        if "CPU" not in res:
+            res["CPU"] = 1.0
+        if self.use_tpu and "TPU" not in res:
+            res["TPU"] = 1.0
+        return res
+
+
+@dataclass
+class FailureConfig:
+    """Restart-the-whole-group semantics (reference
+    ``backend_executor.py:708 _restart``): on a TPU slice one lost host
+    kills the ICI collective, so recovery is group restart from the last
+    checkpoint, not per-task lineage."""
+
+    max_failures: int = 0
+
+
+@dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+    checkpoint_frequency: int = 0
+
+
+@dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    verbose: int = 1
+
+    def resolved_storage_path(self) -> str:
+        return self.storage_path or os.path.join(
+            os.path.expanduser("~"), "ray_tpu_results")
+
+
+@dataclass
+class Result:
+    """What ``Trainer.fit`` returns (reference ``air/result.py``)."""
+
+    metrics: Dict[str, Any]
+    checkpoint: Optional[Any]          # ray_tpu.train.Checkpoint
+    path: Optional[str] = None
+    error: Optional[BaseException] = None
+    metrics_dataframe: Optional[Any] = None
+    config: Optional[Dict[str, Any]] = None
+
+    @property
+    def best_checkpoints(self):
+        return getattr(self, "_best_checkpoints", [])

@@ -1,0 +1,169 @@
+"""Worker-side training session: ``report``/``get_context`` API.
+
+Role analog: ``_TrainSession`` (``python/ray/train/_internal/session.py:110``)
+— the user's ``train_loop_per_worker`` runs on a daemon thread inside the
+worker actor; ``ray_tpu.train.report(metrics, checkpoint=)`` enqueues results
+that the driver drains via actor calls. ``report`` is also a **barrier** in
+spirit: training on a slice is SPMD, so every worker reports the same step
+count in lockstep.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+
+@dataclass
+class TrainContext:
+    """What the user can ask about their worker (reference
+    ``ray.train.get_context()``)."""
+
+    world_rank: int = 0
+    world_size: int = 1
+    local_rank: int = 0
+    local_world_size: int = 1
+    node_rank: int = 0
+    experiment_name: str = "default"
+    trial_name: str = "trial"
+    trial_dir: str = "."
+    trial_id: str = "0"
+    loop_config: Dict[str, Any] = field(default_factory=dict)
+
+    def get_world_rank(self) -> int:
+        return self.world_rank
+
+    def get_world_size(self) -> int:
+        return self.world_size
+
+    def get_local_rank(self) -> int:
+        return self.local_rank
+
+    def get_local_world_size(self) -> int:
+        return self.local_world_size
+
+    def get_node_rank(self) -> int:
+        return self.node_rank
+
+    def get_trial_dir(self) -> str:
+        return self.trial_dir
+
+    def get_experiment_name(self) -> str:
+        return self.experiment_name
+
+    def get_trial_name(self) -> str:
+        return self.trial_name
+
+    def get_trial_id(self) -> str:
+        return self.trial_id
+
+
+class _Session:
+    """Per-process singleton holding the running train thread."""
+
+    def __init__(
+        self,
+        train_fn: Callable[[], Any],
+        context: TrainContext,
+        starting_checkpoint: Optional[Checkpoint] = None,
+    ):
+        self.context = context
+        self.starting_checkpoint = starting_checkpoint
+        self.result_queue: "queue.Queue" = queue.Queue()
+        self.continue_event = threading.Event()
+        self.error: Optional[BaseException] = None
+        self.finished = False
+        self._checkpoint_seq = 0
+
+        def runner():
+            try:
+                train_fn()
+                self.result_queue.put(("done", None, None))
+            except BaseException as e:  # noqa: BLE001 — propagated to driver
+                self.error = e
+                self.result_queue.put(("error", e, None))
+
+        self.thread = threading.Thread(target=runner, daemon=True,
+                                       name="train_loop")
+
+    def start(self):
+        self.thread.start()
+
+    def report(self, metrics: Dict[str, Any],
+               checkpoint: Optional[Checkpoint] = None) -> None:
+        ckpt_path = None
+        if checkpoint is not None:
+            # Persist the worker's checkpoint into the trial dir so it
+            # outlives the worker process (StorageContext analog,
+            # reference train/_internal/storage.py:349).
+            seq = self._checkpoint_seq
+            self._checkpoint_seq += 1
+            dest = os.path.join(
+                self.context.trial_dir,
+                f"checkpoint_{seq:06d}",
+                f"rank_{self.context.world_rank}",
+            )
+            if os.path.abspath(checkpoint.path) != os.path.abspath(dest):
+                checkpoint.to_directory(dest)
+            ckpt_path = os.path.dirname(dest)
+        self.result_queue.put(("result", dict(metrics), ckpt_path))
+        # Block until the driver consumed the result — keeps workers in
+        # lockstep at report granularity and bounds queue memory.
+        self.continue_event.wait()
+        self.continue_event.clear()
+
+    def next_result(self, timeout: Optional[float] = None):
+        try:
+            kind, payload, ckpt = self.result_queue.get(timeout=timeout)
+        except queue.Empty:
+            return ("pending", None, None)
+        if kind == "result":
+            self.continue_event.set()
+        return (kind, payload, ckpt)
+
+    def get_checkpoint(self) -> Optional[Checkpoint]:
+        return self.starting_checkpoint
+
+
+_session: Optional[_Session] = None
+
+
+def _init_session(session: _Session) -> None:
+    global _session
+    _session = session
+
+
+def _shutdown_session() -> None:
+    global _session
+    _session = None
+
+
+def get_session() -> Optional[_Session]:
+    return _session
+
+
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Checkpoint] = None) -> None:
+    """Report metrics (and optionally a checkpoint) from the train loop."""
+    if _session is None:
+        raise RuntimeError(
+            "ray_tpu.train.report() called outside a training session")
+    _session.report(metrics, checkpoint)
+
+
+def get_context() -> TrainContext:
+    if _session is None:
+        return TrainContext()
+    return _session.context
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    """The checkpoint to resume from, if the run was restored."""
+    if _session is None:
+        return None
+    return _session.get_checkpoint()

@@ -1,0 +1,170 @@
+"""TrainState + pjit train-step factory: the TPU training inner loop.
+
+Green-field relative to the reference (its inner loop is the user's torch
+code; Ray only sees epoch-granularity reports, SURVEY §3.4). Here the
+framework owns a canonical pjit training step because the sharding layout
+(params on fsdp/tp, batch on dp×fsdp, sequence on sp) is framework policy:
+
+- params/opt-state are placed by logical-axis rules (ZeRO-3 ≡ fsdp axis);
+- the step is jitted once with donated state (buffers reused in HBM);
+- gradients come out of ``jax.grad`` already averaged across the data axes
+  by XLA (the loss is a global mean — no explicit allreduce anywhere).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.parallel.mesh import MeshConfig, make_mesh
+from ray_tpu.parallel.sharding import DEFAULT_RULES, ShardingRules
+
+TrainState = Dict[str, Any]   # {"step", "params", "opt_state"}
+
+
+def create_train_state(
+    params: Any,
+    optimizer: optax.GradientTransformation,
+) -> TrainState:
+    return {
+        "step": jnp.zeros((), jnp.int32),
+        "params": params,
+        "opt_state": optimizer.init(params),
+    }
+
+
+def state_shardings(
+    state: TrainState,
+    param_axes: Any,
+    mesh: Mesh,
+    rules: Optional[ShardingRules] = None,
+) -> TrainState:
+    """NamedSharding pytree for a TrainState: opt-state moments inherit the
+    param sharding they correspond to (ZeRO: optimizer state sharded like
+    params); scalars replicate."""
+    rules = rules or DEFAULT_RULES
+    param_shardings = jax.tree.map(
+        lambda axes: NamedSharding(mesh, rules.spec(axes)),
+        param_axes,
+        is_leaf=lambda x: isinstance(x, tuple) and all(
+            a is None or isinstance(a, str) for a in x),
+    )
+    replicated = NamedSharding(mesh, P())
+
+    params_struct = jax.tree.structure(state["params"])
+
+    def opt_leaf_sharding(leaf):
+        # optax states are pytrees whose array leaves either mirror the param
+        # tree (moments) or are scalars (counts).
+        if jax.tree.structure(leaf) == params_struct:
+            return param_shardings
+        return jax.tree.map(lambda _: replicated, leaf)
+
+    opt_shardings = jax.tree.map(
+        opt_leaf_sharding, state["opt_state"],
+        is_leaf=lambda x: jax.tree.structure(x) == params_struct or not isinstance(x, (tuple, list, dict)),
+    )
+    return {
+        "step": replicated,
+        "params": param_shardings,
+        "opt_state": opt_shardings,
+    }
+
+
+def make_train_step(
+    loss_fn: Callable[[Any, Dict[str, jax.Array]], Tuple[jax.Array, Dict]],
+    optimizer: optax.GradientTransformation,
+    *,
+    donate: bool = True,
+) -> Callable[[TrainState, Dict[str, jax.Array]], Tuple[TrainState, Dict]]:
+    """Build a jittable ``(state, batch) -> (state, metrics)`` step.
+
+    Call it under ``jax.set_mesh(mesh)`` with sharded state — XLA inserts
+    all collectives (grad psum over dp/fsdp, all-gathers for fsdp params,
+    ring permutes for sp attention).
+    """
+
+    def step(state: TrainState, batch: Dict[str, jax.Array]):
+        (loss, metrics), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state["params"], batch)
+        updates, opt_state = optimizer.update(
+            grads, state["opt_state"], state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        metrics = dict(metrics)
+        metrics["grad_norm"] = optax.global_norm(grads)
+        new_state = {
+            "step": state["step"] + 1,
+            "params": params,
+            "opt_state": opt_state,
+        }
+        return new_state, metrics
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+@dataclass
+class TrainLoopHelper:
+    """Convenience bundle most train loops need: mesh + sharded state + step.
+
+    Used by the built-in LLM workloads (bench.py, examples) and by users who
+    don't want to hand-roll the pjit plumbing. One call builds the mesh from
+    the ScalingConfig's MeshConfig, places params, and compiles the step.
+    """
+
+    mesh: Mesh
+    state: TrainState
+    step_fn: Callable
+    rules: ShardingRules
+
+    @classmethod
+    def create(
+        cls,
+        init_params_fn: Callable[[], Any],
+        param_axes: Any,
+        loss_fn: Callable,
+        optimizer: optax.GradientTransformation,
+        *,
+        mesh_config: Optional[MeshConfig] = None,
+        mesh: Optional[Mesh] = None,
+        rules: Optional[ShardingRules] = None,
+        donate: bool = True,
+    ) -> "TrainLoopHelper":
+        rules = rules or DEFAULT_RULES
+        if mesh is None:
+            mesh = make_mesh(mesh_config or MeshConfig())
+        with jax.set_mesh(mesh):
+            # Init params already sharded: jit the initializer with sharded
+            # outputs so big models never materialize replicated.
+            abstract = jax.eval_shape(init_params_fn)
+            p_sh = jax.tree.map(
+                lambda axes: NamedSharding(mesh, rules.spec(axes)),
+                param_axes,
+                is_leaf=lambda x: isinstance(x, tuple) and all(
+                    a is None or isinstance(a, str) for a in x),
+            )
+            params = jax.jit(init_params_fn, out_shardings=p_sh)()
+            state = create_train_state(params, optimizer)
+            st_sh = state_shardings(state, param_axes, mesh, rules)
+            state = jax.tree.map(
+                lambda x, s: jax.device_put(x, s) if hasattr(x, "shape") else x,
+                state, st_sh)
+            step_fn = make_train_step(loss_fn, optimizer, donate=donate)
+        return cls(mesh=mesh, state=state, step_fn=step_fn, rules=rules)
+
+    def batch_sharding(self) -> NamedSharding:
+        batch_axes = tuple(a for a in ("dp", "fsdp")
+                           if a in self.mesh.axis_names)
+        return NamedSharding(self.mesh, P(batch_axes or None))
+
+    def run_step(self, batch: Dict[str, jax.Array]):
+        bs = self.batch_sharding()
+        batch = jax.tree.map(lambda x: jax.device_put(x, bs), batch)
+        with jax.set_mesh(self.mesh):
+            self.state, metrics = self.step_fn(self.state, batch)
+        return metrics

@@ -1,0 +1,232 @@
+"""Trainers: BaseTrainer → DataParallelTrainer → JaxTrainer.
+
+Role analog: ``python/ray/train/base_trainer.py:111`` (``fit :567``) and
+``data_parallel_trainer.py:25``. The reference routes every ``fit`` through
+a 1-trial Tune run; here the training loop drives the BackendExecutor
+directly and the Tune integration wraps the same ``_run`` body via
+``as_trainable`` (so ``Tuner(JaxTrainer(...))`` works identically).
+
+TPU-native difference: ``JaxTrainer`` is the flagship (the reference's
+``TorchTrainer`` analog) — workers are host actors; inside the loop the user
+builds a mesh (``scaling_config.mesh``) and runs a pjit-compiled step;
+gradient sync is XLA collectives over ICI, invisible to the framework, while
+the reference wires torch DDP explicitly (``train/torch/config.py:150``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ray_tpu.train.backend import BackendConfig, JaxConfig
+from ray_tpu.train.backend_executor import BackendExecutor
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    Result,
+    RunConfig,
+    ScalingConfig,
+)
+
+
+class TrainingFailedError(RuntimeError):
+    pass
+
+
+class BaseTrainer:
+    def __init__(
+        self,
+        *,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+    ):
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.resume_from_checkpoint = resume_from_checkpoint
+
+    def fit(self) -> Result:
+        raise NotImplementedError
+
+    def as_trainable(self):
+        """Wrap as a Tune trainable class (reference
+        ``base_trainer.py:693 _generate_trainable_cls``)."""
+        from ray_tpu.tune.trainable import wrap_function
+
+        trainer = self
+
+        def _trainable(config: Dict[str, Any]):
+            import ray_tpu.tune as tune
+
+            merged = trainer._merged_loop_config()
+            merged.update(config.get("train_loop_config", config))
+            for metrics, ckpt in trainer._iter_results(merged):
+                tune.report(metrics, checkpoint=ckpt)
+
+        return wrap_function(_trainable)
+
+    def _merged_loop_config(self) -> Dict[str, Any]:
+        return {}
+
+
+class DataParallelTrainer(BaseTrainer):
+    """Runs ``train_loop_per_worker`` on every worker of the group."""
+
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: Optional[Dict[str, Any]] = None,
+        backend_config: Optional[BackendConfig] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+    ):
+        super().__init__(scaling_config=scaling_config, run_config=run_config,
+                         resume_from_checkpoint=resume_from_checkpoint)
+        self.train_loop_per_worker = train_loop_per_worker
+        self.train_loop_config = train_loop_config or {}
+        self.backend_config = backend_config or BackendConfig()
+        self.datasets = datasets or {}
+
+    def _merged_loop_config(self) -> Dict[str, Any]:
+        return dict(self.train_loop_config)
+
+    # -- experiment dirs --------------------------------------------------
+
+    def _trial_dir(self) -> Tuple[str, str]:
+        name = self.run_config.name or f"JaxTrainer_{uuid.uuid4().hex[:8]}"
+        exp_dir = os.path.join(self.run_config.resolved_storage_path(), name)
+        trial_dir = os.path.join(exp_dir, f"trial_{uuid.uuid4().hex[:8]}")
+        os.makedirs(trial_dir, exist_ok=True)
+        return name, trial_dir
+
+    # -- fit --------------------------------------------------------------
+
+    def fit(self) -> Result:
+        name, trial_dir = self._trial_dir()
+        failure_cfg = self.run_config.failure_config
+        attempts = failure_cfg.max_failures + 1
+        last_error: Optional[BaseException] = None
+        start_ckpt = (self.resume_from_checkpoint.path
+                      if self.resume_from_checkpoint else None)
+
+        for attempt in range(max(attempts, 1)):
+            executor = BackendExecutor(self.backend_config, self.scaling_config)
+            try:
+                executor.start()
+                result = self._training_run(executor, name, trial_dir,
+                                            start_ckpt)
+                executor.shutdown()
+                return result
+            except BaseException as e:  # noqa: BLE001
+                last_error = e
+                executor.shutdown()
+                # resume the retry from the latest persisted checkpoint
+                latest = _latest_checkpoint(trial_dir)
+                if latest:
+                    start_ckpt = latest
+        raise TrainingFailedError(
+            f"training failed after {attempts} attempt(s)") from last_error
+
+    def _training_run(self, executor: BackendExecutor, name: str,
+                      trial_dir: str,
+                      start_ckpt: Optional[str]) -> Result:
+        executor.start_training(
+            self.train_loop_per_worker,
+            loop_config=self._merged_loop_config(),
+            trial_dir=trial_dir,
+            experiment_name=name,
+            checkpoint_path=start_ckpt,
+        )
+        progress_path = os.path.join(trial_dir, "progress.jsonl")
+        last_metrics: Dict[str, Any] = {}
+        checkpoints: List[Tuple[Dict[str, Any], str]] = []
+        with open(progress_path, "a") as progress:
+            while True:
+                results = executor.get_next_results()
+                if results is None:
+                    break
+                rank0_metrics, _ = results[0]
+                ckpt_dir = next((c for _, c in results if c), None)
+                last_metrics = dict(rank0_metrics)
+                last_metrics.setdefault("_timestamp", time.time())
+                progress.write(json.dumps(last_metrics, default=str) + "\n")
+                progress.flush()
+                if ckpt_dir:
+                    checkpoints.append((last_metrics, ckpt_dir))
+                    self._prune_checkpoints(checkpoints)
+        executor.finish_training()
+        best = checkpoints[-1][1] if checkpoints else None
+        return Result(
+            metrics=last_metrics,
+            checkpoint=Checkpoint(best) if best else None,
+            path=trial_dir,
+        )
+
+    def _iter_results(self, loop_config: Dict[str, Any]):
+        """Generator used by the Tune trainable wrapper."""
+        name, trial_dir = self._trial_dir()
+        executor = BackendExecutor(self.backend_config, self.scaling_config)
+        executor.start()
+        try:
+            executor.start_training(
+                self.train_loop_per_worker, loop_config=loop_config,
+                trial_dir=trial_dir, experiment_name=name,
+                checkpoint_path=(self.resume_from_checkpoint.path
+                                 if self.resume_from_checkpoint else None),
+            )
+            while True:
+                results = executor.get_next_results()
+                if results is None:
+                    break
+                metrics, _ = results[0]
+                ckpt_dir = next((c for _, c in results if c), None)
+                yield metrics, (Checkpoint(ckpt_dir) if ckpt_dir else None)
+            executor.finish_training()
+        finally:
+            executor.shutdown()
+
+    def _prune_checkpoints(
+            self, checkpoints: List[Tuple[Dict[str, Any], str]]) -> None:
+        cfg: CheckpointConfig = self.run_config.checkpoint_config
+        if not cfg.num_to_keep or len(checkpoints) <= cfg.num_to_keep:
+            return
+        if cfg.checkpoint_score_attribute:
+            sign = 1 if cfg.checkpoint_score_order == "max" else -1
+            checkpoints.sort(
+                key=lambda mc: sign * float(
+                    mc[0].get(cfg.checkpoint_score_attribute, float("-inf"))))
+            doomed = checkpoints[:-cfg.num_to_keep]
+            keep = checkpoints[-cfg.num_to_keep:]
+        else:
+            doomed = checkpoints[:-cfg.num_to_keep]
+            keep = checkpoints[-cfg.num_to_keep:]
+        for _, path in doomed:
+            shutil.rmtree(path, ignore_errors=True)
+        checkpoints[:] = keep
+
+
+class JaxTrainer(DataParallelTrainer):
+    """The flagship TPU trainer (TorchTrainer analog)."""
+
+    def __init__(self, train_loop_per_worker: Callable, *,
+                 jax_config: Optional[JaxConfig] = None, **kwargs):
+        scaling = kwargs.get("scaling_config") or ScalingConfig()
+        backend = jax_config or JaxConfig(mesh=scaling.mesh)
+        super().__init__(train_loop_per_worker,
+                         backend_config=backend, **kwargs)
+
+
+def _latest_checkpoint(trial_dir: str) -> Optional[str]:
+    if not os.path.isdir(trial_dir):
+        return None
+    cands = sorted(d for d in os.listdir(trial_dir)
+                   if d.startswith("checkpoint_"))
+    return os.path.join(trial_dir, cands[-1]) if cands else None

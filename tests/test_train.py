@@ -1,0 +1,151 @@
+"""Train library: session/report, JaxTrainer fit, restart, checkpoints,
+pjit train-step helper."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import ray_tpu
+from ray_tpu import models
+from ray_tpu.parallel import MeshConfig, make_mesh
+from ray_tpu.train import (
+    Checkpoint, FailureConfig, JaxTrainer, RunConfig, ScalingConfig,
+    TrainLoopHelper, load_pytree, save_pytree,
+)
+from ray_tpu.train.train_state import create_train_state, state_shardings
+
+
+@pytest.fixture
+def rt_train(tmp_path):
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    yield str(tmp_path)
+    ray_tpu.shutdown()
+
+
+def test_jax_trainer_reports_and_checkpoints(rt_train):
+    storage = rt_train
+
+    def loop(config):
+        import ray_tpu.train as train
+
+        for step in range(3):
+            ckpt = None
+            if step == 2:
+                import tempfile, pickle
+
+                d = tempfile.mkdtemp()
+                with open(os.path.join(d, "model.pkl"), "wb") as f:
+                    pickle.dump({"w": step * config["lr"]}, f)
+                ckpt = Checkpoint(d)
+            train.report({"step": step, "loss": 1.0 / (step + 1)},
+                         checkpoint=ckpt)
+
+    trainer = JaxTrainer(
+        loop,
+        train_loop_config={"lr": 0.5},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="t1", storage_path=storage),
+    )
+    result = trainer.fit()
+    assert result.metrics["step"] == 2
+    assert result.metrics["loss"] == pytest.approx(1 / 3)
+    assert result.checkpoint is not None
+    # rank dirs inside the checkpoint
+    ranks = sorted(os.listdir(result.checkpoint.path))
+    assert "rank_0" in ranks and "rank_1" in ranks
+
+
+def test_jax_trainer_worker_error_raises(rt_train):
+    def loop(config):
+        raise RuntimeError("boom")
+
+    trainer = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(storage_path=rt_train),
+    )
+    from ray_tpu.train import TrainingFailedError
+
+    with pytest.raises(TrainingFailedError):
+        trainer.fit()
+
+
+def test_jax_trainer_restart_resumes_from_checkpoint(rt_train):
+    marker = os.path.join(rt_train, "fail_once")
+
+    def loop(config):
+        import ray_tpu.train as train
+
+        start = 0
+        ckpt = train.get_checkpoint()
+        if ckpt is not None:
+            import pickle
+
+            rank_dir = os.path.join(ckpt.path, "rank_0")
+            with open(os.path.join(rank_dir, "state.pkl"), "rb") as f:
+                start = pickle.load(f)["step"] + 1
+        for step in range(start, 4):
+            import pickle, tempfile
+
+            d = tempfile.mkdtemp()
+            with open(os.path.join(d, "state.pkl"), "wb") as f:
+                pickle.dump({"step": step}, f)
+            train.report({"step": step, "resumed_from": start},
+                         checkpoint=Checkpoint(d))
+            if step == 1 and not os.path.exists(config["marker"]):
+                open(config["marker"], "w").close()
+                raise RuntimeError("injected failure after step 1")
+
+    trainer = JaxTrainer(
+        loop,
+        train_loop_config={"marker": marker},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(storage_path=rt_train,
+                             failure_config=FailureConfig(max_failures=1)),
+    )
+    result = trainer.fit()
+    assert result.metrics["step"] == 3
+    assert result.metrics["resumed_from"] == 2  # resumed after step-1 ckpt
+
+
+def test_save_load_pytree_roundtrip(tmp_path):
+    tree = {"a": np.arange(6, dtype=np.float32).reshape(2, 3),
+            "b": {"c": np.ones((4,), np.int32)}}
+    save_pytree(tree, str(tmp_path))
+    back = load_pytree(str(tmp_path))
+    np.testing.assert_array_equal(back["a"], tree["a"])
+    np.testing.assert_array_equal(back["b"]["c"], tree["b"]["c"])
+
+
+def test_train_loop_helper_llama_loss_decreases():
+    c = models.llama_debug()
+    toks = jax.random.randint(jax.random.PRNGKey(1), (8, 33), 0, c.vocab_size)
+    batch = {"tokens": np.asarray(toks)}
+
+    helper = TrainLoopHelper.create(
+        lambda: models.init_params(jax.random.PRNGKey(0), c),
+        models.param_axes(c),
+        lambda p, b: models.loss_and_metrics(p, b, c),
+        optax.adamw(3e-3),
+        mesh_config=MeshConfig(dp=2, fsdp=2, tp=2),
+    )
+    losses = [float(helper.run_step(batch)["loss"]) for _ in range(6)]
+    assert losses[-1] < losses[0]
+    assert int(jax.device_get(helper.state["step"])) == 6
+
+
+def test_state_shardings_cover_opt_state():
+    c = models.llama_debug()
+    mesh = make_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
+    params = models.init_params(jax.random.PRNGKey(0), c)
+    opt = optax.adam(1e-3)
+    state = create_train_state(params, opt)
+    sh = state_shardings(state, models.param_axes(c), mesh)
+    # moments follow params; counts replicate
+    flat_state = jax.tree.leaves(state)
+    flat_sh = jax.tree.leaves(sh)
+    assert len(flat_state) == len(flat_sh)
