@@ -1,0 +1,68 @@
+"""ray_tpu.tune — hyperparameter search over trial actors.
+
+Role analog: ``python/ray/tune`` (SURVEY §2.5). Same shape as the
+reference: Trainable (class + function APIs), Tuner/tune.run, trial
+schedulers (ASHA/Median/PBT), search spaces and samplers, ResultGrid.
+``tune.report`` is the same session primitive as ``train.report`` (the
+reference shares it too — function trainables run in a ``_TrainSession``).
+"""
+
+from ray_tpu.train.session import get_checkpoint, get_context, report
+from ray_tpu.tune.schedulers import (
+    ASHAScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from ray_tpu.tune.search import (
+    BasicVariantGenerator,
+    Searcher,
+    SimpleBayesSearch,
+    choice,
+    grid_search,
+    loguniform,
+    quniform,
+    randint,
+    sample_from,
+    uniform,
+)
+from ray_tpu.tune.trainable import (
+    FunctionTrainable,
+    Trainable,
+    with_parameters,
+    wrap_function,
+)
+from ray_tpu.tune.tune_controller import ResultGrid, TuneController, Trial
+from ray_tpu.tune.tuner import TuneConfig, Tuner, run
+
+__all__ = [
+    "report",
+    "get_checkpoint",
+    "get_context",
+    "ASHAScheduler",
+    "FIFOScheduler",
+    "MedianStoppingRule",
+    "PopulationBasedTraining",
+    "TrialScheduler",
+    "BasicVariantGenerator",
+    "Searcher",
+    "SimpleBayesSearch",
+    "choice",
+    "grid_search",
+    "loguniform",
+    "quniform",
+    "randint",
+    "sample_from",
+    "uniform",
+    "Trainable",
+    "FunctionTrainable",
+    "with_parameters",
+    "wrap_function",
+    "ResultGrid",
+    "TuneController",
+    "Trial",
+    "TuneConfig",
+    "Tuner",
+    "run",
+]

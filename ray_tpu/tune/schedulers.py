@@ -1,0 +1,190 @@
+"""Trial schedulers: FIFO, ASHA, HyperBand-style rungs, Median stopping, PBT.
+
+Role analog: ``python/ray/tune/schedulers/`` (ASHA =
+``async_hyperband.py``, PBT = ``pbt.py``). The controller calls
+``on_trial_result`` after every report and acts on the returned decision.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Any, Callable, Dict, List, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+PAUSE = "PAUSE"
+
+
+class TrialScheduler:
+    def on_trial_add(self, trial) -> None:
+        pass
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, trial, result: Optional[Dict[str, Any]]) -> None:
+        pass
+
+    def choose_trial_to_run(self, trials) -> Optional[Any]:
+        for t in trials:
+            if t.status == "PENDING":
+                return t
+        return None
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+class ASHAScheduler(TrialScheduler):
+    """Asynchronous Successive Halving (stopping rule form).
+
+    At each rung (grace_period * reduction_factor**k iterations), a trial
+    stops unless its metric is in the top 1/reduction_factor of completed
+    rung entries — the asynchronous formulation (no waiting for a full
+    bracket), matching the reference's ``AsyncHyperBandScheduler``.
+    """
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: float = 4,
+                 time_attr: str = "training_iteration"):
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.grace = grace_period
+        self.rf = reduction_factor
+        self.time_attr = time_attr
+        # rung level -> list of metric values recorded at that rung
+        self.rungs: Dict[int, List[float]] = {}
+        levels = []
+        t = grace_period
+        while t < max_t:
+            levels.append(int(t))
+            t *= reduction_factor
+        self.levels = levels
+
+    def _better(self, a: float, b: float) -> bool:
+        return a < b if self.mode == "min" else a > b
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> str:
+        t = result.get(self.time_attr, 0)
+        val = result.get(self.metric)
+        if val is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP
+        for level in self.levels:
+            if t == level:
+                recorded = self.rungs.setdefault(level, [])
+                recorded.append(float(val))
+                k = max(1, int(len(recorded) / self.rf))
+                top = sorted(recorded, reverse=(self.mode == "max"))[:k]
+                worst_top = top[-1]
+                if not self._better(float(val), worst_top) and \
+                        float(val) != worst_top:
+                    return STOP
+        return CONTINUE
+
+
+class MedianStoppingRule(TrialScheduler):
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 grace_period: int = 1,
+                 time_attr: str = "training_iteration"):
+        self.metric = metric
+        self.mode = mode
+        self.grace = grace_period
+        self.time_attr = time_attr
+        self.history: Dict[str, List[float]] = {}
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> str:
+        val = result.get(self.metric)
+        t = result.get(self.time_attr, 0)
+        if val is None:
+            return CONTINUE
+        self.history.setdefault(trial.trial_id, []).append(float(val))
+        if t < self.grace or len(self.history) < 3:
+            return CONTINUE
+        bests = []
+        for tid, vals in self.history.items():
+            if tid != trial.trial_id:
+                bests.append(min(vals) if self.mode == "min" else max(vals))
+        if not bests:
+            return CONTINUE
+        bests.sort()
+        median = bests[len(bests) // 2]
+        mine = (min(self.history[trial.trial_id]) if self.mode == "min"
+                else max(self.history[trial.trial_id]))
+        if self.mode == "min" and mine > median:
+            return STOP
+        if self.mode == "max" and mine < median:
+            return STOP
+        return CONTINUE
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT: exploit (copy weights+config of a top trial) + explore (perturb).
+
+    Reference: ``tune/schedulers/pbt.py``. The controller implements the
+    mechanics (checkpoint copy + actor restart); the scheduler decides when
+    and what. ``hyperparam_mutations`` maps keys to either a list of choices
+    or a (low, high) continuous resample range.
+    """
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 perturbation_interval: int = 4,
+                 hyperparam_mutations: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25,
+                 seed: Optional[int] = None):
+        self.metric = metric
+        self.mode = mode
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.resample_p = resample_probability
+        self.rng = random.Random(seed)
+        self.last_perturb: Dict[str, int] = {}
+        self.latest: Dict[str, float] = {}
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> str:
+        val = result.get(self.metric)
+        t = result.get("training_iteration", 0)
+        if val is None:
+            return CONTINUE
+        self.latest[trial.trial_id] = float(val)
+        last = self.last_perturb.get(trial.trial_id, 0)
+        if t - last < self.interval or len(self.latest) < 2:
+            return CONTINUE
+        self.last_perturb[trial.trial_id] = t
+        ranked = sorted(self.latest.items(), key=lambda kv: kv[1],
+                        reverse=(self.mode == "max"))
+        n = len(ranked)
+        k = max(1, int(n * self.quantile))
+        bottom_ids = {tid for tid, _ in ranked[n - k:]}
+        top_ids = [tid for tid, _ in ranked[:k]]
+        if trial.trial_id in bottom_ids and top_ids:
+            trial.pbt_exploit_from = self.rng.choice(top_ids)
+            return PAUSE  # controller performs exploit+explore, then resumes
+        return CONTINUE
+
+    def explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        new = dict(config)
+        for key, spec in self.mutations.items():
+            if self.rng.random() < self.resample_p or key not in new:
+                if isinstance(spec, list):
+                    new[key] = self.rng.choice(spec)
+                elif isinstance(spec, tuple) and len(spec) == 2:
+                    new[key] = self.rng.uniform(*spec)
+                elif callable(spec):
+                    new[key] = spec()
+            else:
+                cur = new[key]
+                if isinstance(cur, (int, float)):
+                    factor = self.rng.choice([0.8, 1.2])
+                    new[key] = type(cur)(cur * factor) if isinstance(cur, float) \
+                        else max(1, int(cur * factor))
+                elif isinstance(spec, list):
+                    new[key] = self.rng.choice(spec)
+        return new

@@ -1,0 +1,258 @@
+"""Search spaces + basic variant generation (grid/random), plus a simple
+model-based searcher.
+
+Role analog: ``python/ray/tune/search/`` — the sample domains
+(``tune.uniform/loguniform/choice/randint/...``), grid_search markers, and
+``BasicVariantGenerator``. The external-library searchers (hyperopt/optuna/
+ax) are out of scope (not installable); a small TPE-flavored searcher covers
+the "smarter than random" niche natively.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+@dataclass
+class Uniform(Domain):
+    low: float
+    high: float
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+@dataclass
+class LogUniform(Domain):
+    low: float
+    high: float
+
+    def sample(self, rng):
+        return math.exp(rng.uniform(math.log(self.low), math.log(self.high)))
+
+
+@dataclass
+class QUniform(Domain):
+    low: float
+    high: float
+    q: float
+
+    def sample(self, rng):
+        return round(rng.uniform(self.low, self.high) / self.q) * self.q
+
+
+@dataclass
+class RandInt(Domain):
+    low: int
+    high: int
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+
+@dataclass
+class Choice(Domain):
+    categories: List[Any]
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+@dataclass
+class GridSearch:
+    values: List[Any]
+
+
+def uniform(low: float, high: float) -> Uniform:
+    return Uniform(low, high)
+
+
+def loguniform(low: float, high: float) -> LogUniform:
+    return LogUniform(low, high)
+
+
+def quniform(low: float, high: float, q: float) -> QUniform:
+    return QUniform(low, high, q)
+
+
+def randint(low: int, high: int) -> RandInt:
+    return RandInt(low, high)
+
+
+def choice(categories: List[Any]) -> Choice:
+    return Choice(list(categories))
+
+
+def grid_search(values: List[Any]) -> Dict[str, Any]:
+    return {"grid_search": list(values)}
+
+
+def sample_from(fn: Callable[[Dict[str, Any]], Any]):
+    return _SampleFrom(fn)
+
+
+@dataclass
+class _SampleFrom:
+    fn: Callable
+
+
+# ---------------------------------------------------------------------------
+# Variant generation
+# ---------------------------------------------------------------------------
+
+def _split_grid(space: Dict[str, Any], prefix=()) -> List[Tuple[Tuple, List]]:
+    grids = []
+    for k, v in space.items():
+        path = prefix + (k,)
+        if isinstance(v, dict) and "grid_search" in v and len(v) == 1:
+            grids.append((path, v["grid_search"]))
+        elif isinstance(v, GridSearch):
+            grids.append((path, v.values))
+        elif isinstance(v, dict):
+            grids.extend(_split_grid(v, path))
+    return grids
+
+
+def _set_path(d: Dict, path: Tuple, value: Any) -> None:
+    for k in path[:-1]:
+        d = d.setdefault(k, {})
+    d[path[-1]] = value
+
+
+def _resolve(space: Any, rng: random.Random, resolved: Dict) -> Any:
+    if isinstance(space, dict):
+        if "grid_search" in space and len(space) == 1:
+            raise AssertionError("grid entries must be expanded before resolve")
+        return {k: _resolve(v, rng, resolved) for k, v in space.items()}
+    if isinstance(space, Domain):
+        return space.sample(rng)
+    if isinstance(space, _SampleFrom):
+        return space.fn(resolved)
+    return space
+
+
+def generate_variants(
+    param_space: Dict[str, Any],
+    num_samples: int = 1,
+    seed: Optional[int] = None,
+) -> Iterator[Dict[str, Any]]:
+    """Cross-product of grid axes × num_samples random draws of domains
+    (reference BasicVariantGenerator semantics)."""
+    rng = random.Random(seed)
+    grids = _split_grid(param_space)
+
+    def grid_combos(i=0) -> Iterator[List[Tuple[Tuple, Any]]]:
+        if i == len(grids):
+            yield []
+            return
+        path, values = grids[i]
+        for v in values:
+            for rest in grid_combos(i + 1):
+                yield [(path, v)] + rest
+
+    for _ in range(num_samples):
+        for combo in grid_combos():
+            cfg = _resolve(
+                {k: v for k, v in param_space.items()
+                 if not (isinstance(v, (GridSearch,)) or
+                         (isinstance(v, dict) and "grid_search" in v))},
+                rng, {})
+            for path, v in combo:
+                _set_path(cfg, path, v)
+            yield cfg
+
+
+class Searcher:
+    """Minimal searcher interface (reference ``tune/search/searcher.py``)."""
+
+    def __init__(self, metric: str = "loss", mode: str = "min"):
+        self.metric = metric
+        self.mode = mode
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]] = None) -> None:
+        pass
+
+
+class BasicVariantGenerator(Searcher):
+    def __init__(self, param_space: Dict[str, Any], num_samples: int = 1,
+                 seed: Optional[int] = None, **kw):
+        super().__init__(**kw)
+        self._it = generate_variants(param_space, num_samples, seed)
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        try:
+            return next(self._it)
+        except StopIteration:
+            return None
+
+
+class SimpleBayesSearch(Searcher):
+    """Native "smarter than random" searcher: after ``n_initial`` random
+    trials, sample candidates and pick the one nearest (in normalized space)
+    to the best-seen configs (a cheap local-search/TPE stand-in)."""
+
+    def __init__(self, param_space: Dict[str, Any], metric: str = "loss",
+                 mode: str = "min", n_initial: int = 5,
+                 n_candidates: int = 16, seed: Optional[int] = None):
+        super().__init__(metric=metric, mode=mode)
+        self.space = param_space
+        self.rng = random.Random(seed)
+        self.n_initial = n_initial
+        self.n_candidates = n_candidates
+        self.observations: List[Tuple[Dict[str, Any], float]] = []
+
+    def _sample(self) -> Dict[str, Any]:
+        return _resolve(self.space, self.rng, {})
+
+    def _numeric_keys(self) -> List[str]:
+        return [k for k, v in self.space.items()
+                if isinstance(v, (Uniform, LogUniform, QUniform, RandInt))]
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if len(self.observations) < self.n_initial:
+            return self._sample()
+        sign = 1 if self.mode == "min" else -1
+        best = sorted(self.observations, key=lambda o: sign * o[1])
+        top = [c for c, _ in best[:max(1, len(best) // 4)]]
+        keys = self._numeric_keys()
+        if not keys:
+            return self._sample()
+
+        def dist(cfg):
+            return min(
+                sum((_norm(self.space[k], cfg[k]) -
+                     _norm(self.space[k], t[k])) ** 2 for k in keys)
+                for t in top)
+
+        cands = [self._sample() for _ in range(self.n_candidates)]
+        cands.sort(key=dist)
+        return cands[0]
+
+    def on_trial_complete(self, trial_id, result=None):
+        if result and self.metric in result:
+            # config is attached by the controller before calling
+            cfg = result.get("config", {})
+            self.observations.append((cfg, float(result[self.metric])))
+
+
+def _norm(domain: Domain, value: float) -> float:
+    if isinstance(domain, LogUniform):
+        lo, hi = math.log(domain.low), math.log(domain.high)
+        return (math.log(max(value, 1e-30)) - lo) / (hi - lo)
+    if isinstance(domain, (Uniform, QUniform)):
+        return (value - domain.low) / (domain.high - domain.low)
+    if isinstance(domain, RandInt):
+        return (value - domain.low) / max(domain.high - domain.low, 1)
+    return 0.0

@@ -1,0 +1,188 @@
+"""Trainable API: class trainables + function trainables.
+
+Role analog: ``python/ray/tune/trainable/trainable.py`` (class API) and the
+function-trainable wrapper (reference wraps function trainables in a
+``_TrainSession`` too — SURVEY §2.5 Ray Tune row). A Trainable runs inside a
+trial actor; the controller drives it via ``train_step``/``save``/``restore``
+actor calls.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tempfile
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.session import TrainContext, _Session, _init_session
+
+
+class Trainable:
+    """Class API: subclass and override setup/step/save/load."""
+
+    def __init__(self, config: Optional[Dict[str, Any]] = None,
+                 trial_dir: str = "."):
+        self.config = dict(config or {})
+        self.trial_dir = trial_dir
+        self.iteration = 0
+        self._setup_done = False
+
+    # -- user overrides ---------------------------------------------------
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        pass
+
+    def step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def save_checkpoint(self, checkpoint_dir: str) -> Optional[Dict[str, Any]]:
+        return None
+
+    def load_checkpoint(self, checkpoint: Optional[Dict[str, Any]],
+                        checkpoint_dir: str) -> None:
+        pass
+
+    def cleanup(self) -> None:
+        pass
+
+    # -- controller-facing ------------------------------------------------
+
+    def train_step(self) -> Dict[str, Any]:
+        if not self._setup_done:
+            self.setup(self.config)
+            self._setup_done = True
+        result = self.step() or {}
+        self.iteration += 1
+        result.setdefault("training_iteration", self.iteration)
+        result.setdefault("done", False)
+        return result
+
+    def save(self) -> str:
+        if not self._setup_done:
+            self.setup(self.config)
+            self._setup_done = True
+        d = os.path.join(self.trial_dir,
+                         f"checkpoint_{self.iteration:06d}")
+        os.makedirs(d, exist_ok=True)
+        data = self.save_checkpoint(d)
+        if data is not None:
+            with open(os.path.join(d, "trainable_state.pkl"), "wb") as f:
+                pickle.dump(data, f)
+        with open(os.path.join(d, ".tune_meta.pkl"), "wb") as f:
+            pickle.dump({"iteration": self.iteration}, f)
+        return d
+
+    def restore(self, checkpoint_dir: str) -> None:
+        if not self._setup_done:
+            self.setup(self.config)
+            self._setup_done = True
+        meta_p = os.path.join(checkpoint_dir, ".tune_meta.pkl")
+        if os.path.exists(meta_p):
+            with open(meta_p, "rb") as f:
+                self.iteration = pickle.load(f)["iteration"]
+        data = None
+        data_p = os.path.join(checkpoint_dir, "trainable_state.pkl")
+        if os.path.exists(data_p):
+            with open(data_p, "rb") as f:
+                data = pickle.load(f)
+        self.load_checkpoint(data, checkpoint_dir)
+
+    def reset_config(self, new_config: Dict[str, Any]) -> bool:
+        """Return True if the trainable can hot-swap configs (PBT reuse)."""
+        return False
+
+    def stop(self) -> None:
+        self.cleanup()
+
+
+class FunctionTrainable(Trainable):
+    """Wraps ``def train_fn(config)`` using the train session machinery: the
+    function runs on a thread, ``tune.report`` enqueues results, and each
+    ``step()`` drains one."""
+
+    _train_fn: Callable = None  # bound by wrap_function subclass
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        self._restore_dir: Optional[str] = None
+        self._session: Optional[_Session] = None
+
+    def _ensure_session(self):
+        if self._session is not None:
+            return
+        ctx = TrainContext(
+            world_rank=0, world_size=1,
+            trial_dir=self.trial_dir,
+            trial_name=os.path.basename(self.trial_dir),
+            loop_config=dict(self.config),
+        )
+        ckpt = Checkpoint(self._restore_dir) if self._restore_dir else None
+        fn = type(self)._train_fn
+        import inspect
+
+        try:
+            nparams = len(inspect.signature(fn).parameters)
+        except (TypeError, ValueError):
+            nparams = 1
+        runner = (lambda: fn(dict(self.config))) if nparams >= 1 else fn
+        self._session = _Session(runner, ctx, ckpt)
+        _init_session(self._session)
+        self._session.start()
+
+    def step(self) -> Dict[str, Any]:
+        self._ensure_session()
+        kind, payload, ckpt_path = self._session.next_result(timeout=600.0)
+        if kind == "error":
+            raise payload
+        if kind == "done":
+            return {"done": True}
+        if kind == "pending":
+            raise TimeoutError("function trainable produced no result in 600s")
+        result = dict(payload)
+        result["done"] = False
+        if ckpt_path:
+            result["_checkpoint_dir"] = ckpt_path
+        return result
+
+    def save(self) -> str:
+        # Function trainables checkpoint via tune.report(checkpoint=...);
+        # save() returns the latest reported checkpoint dir.
+        cands = sorted(d for d in os.listdir(self.trial_dir)
+                       if d.startswith("checkpoint_"))
+        if not cands:
+            d = os.path.join(self.trial_dir, "checkpoint_empty")
+            os.makedirs(d, exist_ok=True)
+            return d
+        return os.path.join(self.trial_dir, cands[-1])
+
+    def restore(self, checkpoint_dir: str) -> None:
+        self._restore_dir = checkpoint_dir
+
+
+def wrap_function(train_fn: Callable) -> type:
+    """Create a FunctionTrainable subclass bound to ``train_fn``."""
+
+    class _WrappedTrainable(FunctionTrainable):
+        _train_fn = staticmethod(train_fn)
+
+    _WrappedTrainable.__name__ = getattr(train_fn, "__name__", "fn") + "_trainable"
+    return _WrappedTrainable
+
+
+def with_parameters(fn_or_cls, **kwargs):
+    """Partially bind large objects into a trainable (reference
+    ``tune.with_parameters``)."""
+    if isinstance(fn_or_cls, type) and issubclass(fn_or_cls, Trainable):
+        class _Bound(fn_or_cls):
+            def setup(self, config):
+                super().setup({**config, **kwargs})
+        _Bound.__name__ = fn_or_cls.__name__
+        return _Bound
+
+    import functools
+
+    @functools.wraps(fn_or_cls)
+    def wrapped(config):
+        return fn_or_cls(config, **kwargs)
+
+    return wrapped

@@ -1,0 +1,296 @@
+"""TuneController: the trial-driving event loop.
+
+Role analog: ``python/ray/tune/execution/tune_controller.py:68`` (``step``
+loop :666, actor scheduling :964, save :1691, restore :1791). Each trial is
+one actor built from the trainable class; the controller keeps one in-flight
+``train_step`` call per running trial and reacts to results with scheduler
+decisions (CONTINUE/STOP/PAUSE-for-PBT).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import traceback
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import CheckpointConfig, Result, RunConfig
+from ray_tpu.tune.schedulers import CONTINUE, PAUSE, STOP, FIFOScheduler, \
+    PopulationBasedTraining, TrialScheduler
+
+
+class Trial:
+    def __init__(self, trial_id: str, config: Dict[str, Any], trial_dir: str):
+        self.trial_id = trial_id
+        self.config = config
+        self.trial_dir = trial_dir
+        self.status = "PENDING"
+        self.last_result: Dict[str, Any] = {}
+        self.history: List[Dict[str, Any]] = []
+        self.checkpoint_dir: Optional[str] = None
+        self.actor = None
+        self.pending_ref = None
+        self.error: Optional[BaseException] = None
+        self.pbt_exploit_from: Optional[str] = None
+        self.iteration = 0
+
+    def metric_history(self, key: str) -> List[float]:
+        return [r[key] for r in self.history if key in r]
+
+
+class TuneController:
+    def __init__(
+        self,
+        trainable_cls: type,
+        param_configs: List[Dict[str, Any]],
+        *,
+        run_config: Optional[RunConfig] = None,
+        scheduler: Optional[TrialScheduler] = None,
+        stopper: Optional[Callable[[str, Dict[str, Any]], bool]] = None,
+        max_concurrent: int = 0,
+        resources_per_trial: Optional[Dict[str, float]] = None,
+        max_failures_per_trial: int = 0,
+        checkpoint_at_end: bool = False,
+    ):
+        self.trainable_cls = trainable_cls
+        self.run_config = run_config or RunConfig()
+        self.scheduler = scheduler or FIFOScheduler()
+        self.stopper = stopper
+        self.max_concurrent = max_concurrent
+        self.resources = resources_per_trial or {"CPU": 1}
+        self.max_failures = max_failures_per_trial
+        self.checkpoint_at_end = checkpoint_at_end
+        self._failures: Dict[str, int] = {}
+
+        name = self.run_config.name or f"tune_{uuid.uuid4().hex[:8]}"
+        self.exp_dir = os.path.join(
+            self.run_config.resolved_storage_path(), name)
+        os.makedirs(self.exp_dir, exist_ok=True)
+
+        self.trials: List[Trial] = []
+        for i, cfg in enumerate(param_configs):
+            tid = f"{i:05d}"
+            tdir = os.path.join(self.exp_dir, f"trial_{tid}")
+            os.makedirs(tdir, exist_ok=True)
+            t = Trial(tid, cfg, tdir)
+            self.trials.append(t)
+            self.scheduler.on_trial_add(t)
+
+    # -- actor management -------------------------------------------------
+
+    def _make_actor(self, trial: Trial):
+        cls = ray_tpu.remote(self.trainable_cls)
+        opts = {"num_cpus": self.resources.get("CPU", 1),
+                "resources": {k: v for k, v in self.resources.items()
+                              if k != "CPU"}}
+        return cls.options(**opts).remote(trial.config, trial.trial_dir)
+
+    def _start_trial(self, trial: Trial, restore_from: Optional[str] = None):
+        trial.actor = self._make_actor(trial)
+        if restore_from:
+            ray_tpu.get(trial.actor.restore.remote(restore_from))
+        trial.status = "RUNNING"
+        trial.pending_ref = trial.actor.train_step.remote()
+
+    def _stop_trial(self, trial: Trial, status: str = "TERMINATED"):
+        trial.status = status
+        trial.pending_ref = None
+        if trial.actor is not None:
+            try:
+                ray_tpu.kill(trial.actor)
+            except Exception:
+                pass
+            trial.actor = None
+
+    # -- main loop --------------------------------------------------------
+
+    def run(self) -> List[Trial]:
+        while True:
+            self._launch_pending()
+            running = [t for t in self.trials if t.status == "RUNNING"
+                       and t.pending_ref is not None]
+            if not running:
+                if any(t.status == "PENDING" for t in self.trials):
+                    continue
+                break
+            refs = [t.pending_ref for t in running]
+            ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=60.0)
+            if not ready:
+                continue
+            for ref in ready:
+                trial = next(t for t in running if t.pending_ref == ref)
+                self._process_result(trial, ref)
+        self._write_experiment_state()
+        return self.trials
+
+    def _launch_pending(self):
+        running = sum(1 for t in self.trials if t.status == "RUNNING")
+        limit = self.max_concurrent or len(self.trials)
+        for t in self.trials:
+            if running >= limit:
+                break
+            if t.status == "PENDING":
+                try:
+                    self._start_trial(t, restore_from=t.checkpoint_dir)
+                    running += 1
+                except Exception as e:  # resource exhaustion etc.
+                    t.error = e
+                    t.status = "ERROR"
+
+    def _process_result(self, trial: Trial, ref):
+        try:
+            result = ray_tpu.get([ref])[0]
+        except Exception as e:  # noqa: BLE001
+            self._failures[trial.trial_id] = \
+                self._failures.get(trial.trial_id, 0) + 1
+            trial.error = e
+            if self._failures[trial.trial_id] <= self.max_failures:
+                self._stop_trial(trial, "PENDING")
+                trial.status = "PENDING"  # retry from last checkpoint
+            else:
+                self._stop_trial(trial, "ERROR")
+                self.scheduler.on_trial_complete(trial, None)
+            return
+
+        trial.pending_ref = None
+        if result.get("done"):
+            self._complete_trial(trial, trial.last_result)
+            return
+
+        result["config"] = trial.config
+        trial.last_result = result
+        trial.history.append(result)
+        trial.iteration = result.get("training_iteration", trial.iteration + 1)
+        if "_checkpoint_dir" in result:
+            trial.checkpoint_dir = result["_checkpoint_dir"]
+        self._append_progress(trial, result)
+
+        # periodic class-trainable checkpointing
+        freq = self.run_config.checkpoint_config.checkpoint_frequency
+        if freq and trial.iteration % freq == 0:
+            trial.checkpoint_dir = ray_tpu.get([trial.actor.save.remote()])[0]
+
+        if self.stopper and self.stopper(trial.trial_id, result):
+            self._finalize_and_stop(trial)
+            return
+
+        decision = self.scheduler.on_trial_result(trial, result)
+        if decision == STOP:
+            self._finalize_and_stop(trial)
+        elif decision == PAUSE and trial.pbt_exploit_from:
+            self._pbt_exploit(trial)
+        else:
+            trial.pending_ref = trial.actor.train_step.remote()
+
+    def _finalize_and_stop(self, trial: Trial):
+        if self.checkpoint_at_end and trial.actor is not None and \
+                not isinstance(trial.checkpoint_dir, str):
+            try:
+                trial.checkpoint_dir = ray_tpu.get(
+                    [trial.actor.save.remote()])[0]
+            except Exception:
+                pass
+        self._stop_trial(trial, "TERMINATED")
+        self.scheduler.on_trial_complete(trial, trial.last_result)
+
+    def _complete_trial(self, trial: Trial, result: Dict[str, Any]):
+        self._stop_trial(trial, "TERMINATED")
+        self.scheduler.on_trial_complete(trial, result)
+
+    def _pbt_exploit(self, trial: Trial):
+        donor = next((t for t in self.trials
+                      if t.trial_id == trial.pbt_exploit_from), None)
+        trial.pbt_exploit_from = None
+        if donor is None:
+            trial.pending_ref = trial.actor.train_step.remote()
+            return
+        # snapshot the donor (queued behind its in-flight step)
+        donor_ckpt = donor.checkpoint_dir
+        if donor.actor is not None:
+            try:
+                donor_ckpt = ray_tpu.get([donor.actor.save.remote()])[0]
+                donor.checkpoint_dir = donor_ckpt
+            except Exception:
+                pass
+        assert isinstance(self.scheduler, PopulationBasedTraining)
+        trial.config = self.scheduler.explore(donor.config)
+        self._stop_trial(trial, "PAUSED")
+        self._start_trial(trial, restore_from=donor_ckpt)
+
+    # -- bookkeeping ------------------------------------------------------
+
+    def _append_progress(self, trial: Trial, result: Dict[str, Any]):
+        path = os.path.join(trial.trial_dir, "progress.jsonl")
+        rec = {k: v for k, v in result.items() if not k.startswith("_")}
+        rec["_timestamp"] = time.time()
+        with open(path, "a") as f:
+            f.write(json.dumps(rec, default=str) + "\n")
+
+    def _write_experiment_state(self):
+        state = {
+            "trials": [
+                {
+                    "trial_id": t.trial_id,
+                    "config": t.config,
+                    "status": t.status,
+                    "last_result": {k: v for k, v in t.last_result.items()
+                                    if not k.startswith("_")},
+                    "checkpoint_dir": t.checkpoint_dir,
+                    "error": (traceback.format_exception_only(
+                        type(t.error), t.error)[0].strip()
+                        if t.error else None),
+                }
+                for t in self.trials
+            ]
+        }
+        with open(os.path.join(self.exp_dir, "experiment_state.json"),
+                  "w") as f:
+            json.dump(state, f, indent=1, default=str)
+
+
+class ResultGrid:
+    def __init__(self, trials: List[Trial], exp_dir: str):
+        self._trials = trials
+        self.experiment_path = exp_dir
+
+    def __len__(self):
+        return len(self._trials)
+
+    def __getitem__(self, i) -> Result:
+        t = self._trials[i]
+        return Result(
+            metrics={k: v for k, v in t.last_result.items()
+                     if not k.startswith("_")},
+            checkpoint=Checkpoint(t.checkpoint_dir) if t.checkpoint_dir else None,
+            path=t.trial_dir,
+            error=t.error,
+            config=t.config,
+        )
+
+    @property
+    def errors(self) -> List[BaseException]:
+        return [t.error for t in self._trials if t.error]
+
+    def get_best_result(self, metric: str, mode: str = "min") -> Result:
+        scored = [(i, t.last_result.get(metric)) for i, t in
+                  enumerate(self._trials) if metric in t.last_result]
+        if not scored:
+            raise ValueError(f"no trial reported metric {metric!r}")
+        best_i = (min if mode == "min" else max)(scored, key=lambda s: s[1])[0]
+        return self[best_i]
+
+    def get_dataframe(self):
+        import pandas as pd
+
+        return pd.DataFrame([
+            {**{k: v for k, v in t.last_result.items()
+                if not k.startswith("_")},
+             "trial_id": t.trial_id, "status": t.status,
+             **{f"config/{k}": v for k, v in t.config.items()
+                if isinstance(v, (int, float, str, bool))}}
+            for t in self._trials
+        ])

@@ -1,0 +1,119 @@
+"""Tuner + tune.run: the public entry points.
+
+Role analog: ``python/ray/tune/tuner.py`` and ``tune/tune.py``. A Tuner
+expands the param space into trials, builds the controller, runs it, and
+returns a ResultGrid.
+"""
+
+from __future__ import annotations
+
+import inspect
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Union
+
+from ray_tpu.train.config import RunConfig
+from ray_tpu.tune.schedulers import TrialScheduler
+from ray_tpu.tune.search import BasicVariantGenerator, Searcher, \
+    generate_variants
+from ray_tpu.tune.trainable import Trainable, wrap_function
+from ray_tpu.tune.tune_controller import ResultGrid, TuneController
+
+
+@dataclass
+class TuneConfig:
+    metric: Optional[str] = None
+    mode: str = "min"
+    num_samples: int = 1
+    max_concurrent_trials: int = 0
+    scheduler: Optional[TrialScheduler] = None
+    search_alg: Optional[Searcher] = None
+    seed: Optional[int] = None
+    resources_per_trial: Dict[str, float] = field(
+        default_factory=lambda: {"CPU": 1})
+    checkpoint_at_end: bool = False
+
+
+class Tuner:
+    def __init__(
+        self,
+        trainable: Union[Callable, type],
+        *,
+        param_space: Optional[Dict[str, Any]] = None,
+        tune_config: Optional[TuneConfig] = None,
+        run_config: Optional[RunConfig] = None,
+    ):
+        # Trainer instances (ray_tpu.train.BaseTrainer) wrap to a trainable.
+        from ray_tpu.train.trainer import BaseTrainer
+
+        if isinstance(trainable, BaseTrainer):
+            trainable = trainable.as_trainable()
+        if isinstance(trainable, type) and issubclass(trainable, Trainable):
+            self.trainable_cls = trainable
+        elif callable(trainable):
+            self.trainable_cls = wrap_function(trainable)
+        else:
+            raise TypeError(f"cannot interpret trainable: {trainable!r}")
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+
+    def fit(self) -> ResultGrid:
+        tc = self.tune_config
+        if tc.search_alg is not None:
+            configs = []
+            for i in range(tc.num_samples):
+                cfg = tc.search_alg.suggest(f"{i:05d}")
+                if cfg is None:
+                    break
+                configs.append(cfg)
+        else:
+            configs = list(generate_variants(
+                self.param_space, tc.num_samples, tc.seed))
+        if not configs:
+            configs = [{}]
+
+        controller = TuneController(
+            self.trainable_cls,
+            configs,
+            run_config=self.run_config,
+            scheduler=tc.scheduler,
+            max_concurrent=tc.max_concurrent_trials,
+            resources_per_trial=tc.resources_per_trial,
+            max_failures_per_trial=self.run_config.failure_config.max_failures,
+            checkpoint_at_end=tc.checkpoint_at_end,
+        )
+        # let model-based searchers observe completions
+        if tc.search_alg is not None:
+            orig = controller.scheduler.on_trial_complete
+
+            def observe(trial, result, _orig=orig):
+                _orig(trial, result)
+                if result:
+                    tc.search_alg.on_trial_complete(trial.trial_id, result)
+
+            controller.scheduler.on_trial_complete = observe
+        trials = controller.run()
+        return ResultGrid(trials, controller.exp_dir)
+
+
+def run(
+    trainable: Union[Callable, type],
+    *,
+    config: Optional[Dict[str, Any]] = None,
+    num_samples: int = 1,
+    metric: Optional[str] = None,
+    mode: str = "min",
+    scheduler: Optional[TrialScheduler] = None,
+    storage_path: Optional[str] = None,
+    name: Optional[str] = None,
+    **kwargs,
+) -> ResultGrid:
+    """Legacy-style ``tune.run`` facade over Tuner."""
+    tuner = Tuner(
+        trainable,
+        param_space=config,
+        tune_config=TuneConfig(metric=metric, mode=mode,
+                               num_samples=num_samples, scheduler=scheduler),
+        run_config=RunConfig(name=name, storage_path=storage_path),
+    )
+    return tuner.fit()

@@ -1,0 +1,173 @@
+"""Tune: search spaces, function/class trainables, schedulers, PBT, Tuner."""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.train import Checkpoint, RunConfig
+from ray_tpu.tune import (
+    ASHAScheduler, PopulationBasedTraining, Trainable, TuneConfig, Tuner,
+)
+from ray_tpu.tune.search import generate_variants
+
+
+@pytest.fixture
+def rt_tune(tmp_path):
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    yield str(tmp_path)
+    ray_tpu.shutdown()
+
+
+def test_generate_variants_grid_and_samples():
+    space = {
+        "lr": tune.grid_search([0.1, 0.01]),
+        "wd": tune.uniform(0.0, 1.0),
+        "opt": "adam",
+    }
+    variants = list(generate_variants(space, num_samples=3, seed=0))
+    assert len(variants) == 6
+    assert {v["lr"] for v in variants} == {0.1, 0.01}
+    assert all(0.0 <= v["wd"] <= 1.0 for v in variants)
+    assert all(v["opt"] == "adam" for v in variants)
+
+
+def test_function_trainable_tuner(rt_tune):
+    def objective(config):
+        for i in range(3):
+            tune.report({"score": config["x"] ** 2 + i})
+
+    tuner = Tuner(
+        objective,
+        param_space={"x": tune.grid_search([-2, 0, 3])},
+        tune_config=TuneConfig(metric="score", mode="min"),
+        run_config=RunConfig(storage_path=rt_tune),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 3
+    best = grid.get_best_result("score", mode="min")
+    assert best.config["x"] == 0
+    assert best.metrics["score"] == 2  # final report: 0 + 2
+
+
+def test_class_trainable_with_checkpointing(rt_tune):
+    class Quad(Trainable):
+        def setup(self, config):
+            self.x = config["start"]
+
+        def step(self):
+            self.x *= 0.5
+            return {"val": self.x}
+
+        def save_checkpoint(self, d):
+            return {"x": self.x}
+
+        def load_checkpoint(self, data, d):
+            self.x = data["x"]
+
+    tuner = Tuner(
+        Quad,
+        param_space={"start": 8.0},
+        tune_config=TuneConfig(
+            scheduler=tune.ASHAScheduler(metric="val", mode="min", max_t=4),
+            checkpoint_at_end=True),
+        run_config=RunConfig(storage_path=rt_tune),
+    )
+    grid = tuner.fit()
+    res = grid[0]
+    assert res.metrics["val"] == pytest.approx(8.0 * 0.5 ** 4)
+    assert res.checkpoint is not None
+    assert os.path.exists(os.path.join(res.checkpoint.path,
+                                       "trainable_state.pkl"))
+
+
+def test_asha_stops_bad_trials(rt_tune):
+    def objective(config):
+        for i in range(16):
+            # trial quality fixed by config: lower "quality" = higher loss
+            tune.report({"loss": 10.0 - config["quality"] + 0.01 * i})
+
+    # Best trial first + sequential execution makes rung decisions
+    # deterministic: later (worse) trials get cut at the first rung.
+    tuner = Tuner(
+        objective,
+        param_space={"quality": tune.grid_search([8, 5, 3, 1])},
+        tune_config=TuneConfig(
+            scheduler=ASHAScheduler(metric="loss", mode="min", max_t=16,
+                                    grace_period=2, reduction_factor=2),
+            max_concurrent_trials=1),
+        run_config=RunConfig(storage_path=rt_tune),
+    )
+    grid = tuner.fit()
+    df_iters = {r.config["quality"]: r.metrics.get("training_iteration", 0)
+                for r in (grid[i] for i in range(len(grid)))}
+    # the best trial survives to max_t; the worst should be cut early
+    assert df_iters[8] == 16
+    assert df_iters[1] < 16
+
+
+def test_pbt_exploits_and_mutates(rt_tune):
+    class Learner(Trainable):
+        def setup(self, config):
+            self.score = 0.0
+
+        def step(self):
+            self.score += self.config["rate"]
+            return {"score": self.score}
+
+        def save_checkpoint(self, d):
+            return {"score": self.score}
+
+        def load_checkpoint(self, data, d):
+            self.score = data["score"]
+
+        def reset_config(self, c):
+            self.config = c
+            return True
+
+    stopper = lambda tid, res: res.get("training_iteration", 0) >= 12
+
+    pbt = PopulationBasedTraining(
+        metric="score", mode="max", perturbation_interval=3,
+        hyperparam_mutations={"rate": (0.1, 2.0)}, seed=0)
+    tuner = Tuner(
+        Learner,
+        param_space={"rate": tune.uniform(0.1, 2.0)},
+        tune_config=TuneConfig(num_samples=4, scheduler=pbt),
+        run_config=RunConfig(storage_path=rt_tune),
+    )
+    # install stopper through controller: use run() path instead
+    from ray_tpu.tune.tune_controller import TuneController
+    from ray_tpu.tune.search import generate_variants as gv
+
+    controller = TuneController(
+        tuner.trainable_cls,
+        list(gv({"rate": tune.uniform(0.1, 2.0)}, 4, seed=1)),
+        run_config=RunConfig(storage_path=rt_tune),
+        scheduler=pbt,
+        stopper=stopper,
+    )
+    trials = controller.run()
+    assert all(t.status == "TERMINATED" for t in trials)
+    scores = [t.last_result.get("score", 0) for t in trials]
+    assert max(scores) > 0
+
+
+def test_searcher_simple_bayes(rt_tune):
+    def objective(config):
+        tune.report({"loss": (config["x"] - 0.7) ** 2})
+
+    search = tune.SimpleBayesSearch(
+        {"x": tune.uniform(0.0, 1.0)}, metric="loss", mode="min",
+        n_initial=3, seed=0)
+    tuner = Tuner(
+        objective,
+        tune_config=TuneConfig(num_samples=8, search_alg=search,
+                               metric="loss", mode="min"),
+        run_config=RunConfig(storage_path=rt_tune),
+    )
+    grid = tuner.fit()
+    best = grid.get_best_result("loss", mode="min")
+    assert best.metrics["loss"] < 0.2
