@@ -1,0 +1,49 @@
+"""ray_tpu.data — streaming distributed datasets feeding pjit programs.
+
+Role analog: ``python/ray/data`` (SURVEY §2.5, §3.7). Same architecture in
+compact form: lazy logical plan → fused map stages → streaming execution
+over the task runtime with bounded in-flight backpressure; all-to-all ops
+are barriers. TPU-native addition: ``DataIterator.iter_jax_batches`` yields
+mesh-sharded ``jax.Array`` batches (the ingest path of JaxTrainer).
+"""
+
+from ray_tpu.data.block import Block, BlockMetadata
+from ray_tpu.data.dataset import Dataset
+from ray_tpu.data.execution import ExecutionOptions
+from ray_tpu.data.grouped import GroupedData
+from ray_tpu.data.iterator import DataIterator
+from ray_tpu.data.read_api import (
+    from_arrow,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+    range_tensor,
+    read_binary_files,
+    read_csv,
+    read_json,
+    read_numpy,
+    read_parquet,
+    read_text,
+)
+
+__all__ = [
+    "Block",
+    "BlockMetadata",
+    "Dataset",
+    "DataIterator",
+    "ExecutionOptions",
+    "GroupedData",
+    "from_arrow",
+    "from_items",
+    "from_numpy",
+    "from_pandas",
+    "range",
+    "range_tensor",
+    "read_binary_files",
+    "read_csv",
+    "read_json",
+    "read_numpy",
+    "read_parquet",
+    "read_text",
+]

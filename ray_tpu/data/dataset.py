@@ -1,0 +1,306 @@
+"""Dataset: lazy logical plan over blocks, streaming-executed.
+
+Role analog: ``python/ray/data/dataset.py`` + the logical-operator layer
+(``data/_internal/logical/``). A Dataset is (source refs, list of logical
+ops); every transform appends an op and returns a new Dataset; execution
+happens on iteration/consumption through the streaming executor.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Dict, Iterator, List, Optional, Union
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import (
+    Block,
+    batch_to_block,
+    block_from_rows,
+    block_metadata,
+    block_num_rows,
+    block_slice,
+    block_to_batch,
+    block_to_rows,
+    concat_blocks,
+)
+from ray_tpu.data.execution import (
+    AllToAllOp,
+    ExecutionOptions,
+    LimitOp,
+    MapOp,
+    execute_streaming,
+    repartition_fn,
+    shuffle_fn,
+    sort_fn,
+)
+
+
+class Dataset:
+    def __init__(self, source_refs: List[Any], ops: Optional[List[Any]] = None,
+                 options: Optional[ExecutionOptions] = None):
+        self._source = list(source_refs)
+        self._ops = list(ops or [])
+        self._options = options or ExecutionOptions()
+
+    # -- plan building ----------------------------------------------------
+
+    def _with_op(self, op) -> "Dataset":
+        return Dataset(self._source, self._ops + [op], self._options)
+
+    def map_batches(
+        self,
+        fn: Callable,
+        *,
+        batch_size: Optional[int] = None,
+        batch_format: str = "numpy",
+        fn_kwargs: Optional[Dict[str, Any]] = None,
+        **_ignored,
+    ) -> "Dataset":
+        kwargs = fn_kwargs or {}
+
+        def _map(block: Block) -> List[Block]:
+            out: List[Block] = []
+            n = block_num_rows(block)
+            size = batch_size or n or 1
+            for i in range(0, max(n, 1), size):
+                piece = block_slice(block, i, min(i + size, n))
+                if block_num_rows(piece) == 0 and n > 0:
+                    continue
+                res = fn(block_to_batch(piece, batch_format), **kwargs)
+                out.append(batch_to_block(res))
+            return out
+
+        return self._with_op(MapOp(name="map_batches", fn=_map))
+
+    def map(self, fn: Callable[[Dict[str, Any]], Dict[str, Any]]) -> "Dataset":
+        def _map(block: Block) -> List[Block]:
+            return [block_from_rows([fn(r) for r in block_to_rows(block)])]
+
+        return self._with_op(MapOp(name="map", fn=_map))
+
+    def flat_map(self, fn: Callable[[Dict[str, Any]], List[Dict[str, Any]]]
+                 ) -> "Dataset":
+        def _map(block: Block) -> List[Block]:
+            rows: List[Dict[str, Any]] = []
+            for r in block_to_rows(block):
+                rows.extend(fn(r))
+            return [block_from_rows(rows)] if rows else []
+
+        return self._with_op(MapOp(name="flat_map", fn=_map))
+
+    def filter(self, fn: Callable[[Dict[str, Any]], bool]) -> "Dataset":
+        def _map(block: Block) -> List[Block]:
+            keep = [r for r in block_to_rows(block) if fn(r)]
+            return [block_from_rows(keep)] if keep else []
+
+        return self._with_op(MapOp(name="filter", fn=_map))
+
+    def add_column(self, name: str, fn: Callable[[Block], np.ndarray]
+                   ) -> "Dataset":
+        def _map(block: Block) -> List[Block]:
+            out = dict(block)
+            out[name] = np.asarray(fn(block))
+            return [out]
+
+        return self._with_op(MapOp(name="add_column", fn=_map))
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        def _map(block: Block) -> List[Block]:
+            return [{k: v for k, v in block.items() if k not in cols}]
+
+        return self._with_op(MapOp(name="drop_columns", fn=_map))
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        def _map(block: Block) -> List[Block]:
+            return [{k: block[k] for k in cols}]
+
+        return self._with_op(MapOp(name="select_columns", fn=_map))
+
+    def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
+        def _map(block: Block) -> List[Block]:
+            return [{mapping.get(k, k): v for k, v in block.items()}]
+
+        return self._with_op(MapOp(name="rename_columns", fn=_map))
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        return self._with_op(AllToAllOp("random_shuffle", shuffle_fn(seed)))
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        return self._with_op(AllToAllOp("repartition",
+                                        repartition_fn(num_blocks)))
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        return self._with_op(AllToAllOp("sort", sort_fn(key, descending)))
+
+    def limit(self, n: int) -> "Dataset":
+        return self._with_op(LimitOp("limit", n))
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        # materialize each side's plan into refs, then concatenate sources
+        refs = list(self.iter_block_refs())
+        for o in others:
+            refs.extend(o.iter_block_refs())
+        return Dataset(refs)
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        left = concat_blocks([ray_tpu.get(r) for r in self.iter_block_refs()])
+        right = concat_blocks([ray_tpu.get(r) for r in other.iter_block_refs()])
+        if block_num_rows(left) != block_num_rows(right):
+            raise ValueError("zip requires equal row counts")
+        merged = dict(left)
+        for k, v in right.items():
+            merged[k if k not in merged else f"{k}_1"] = v
+        return Dataset([ray_tpu.put(merged)])
+
+    def groupby(self, key: str) -> "GroupedData":
+        from ray_tpu.data.grouped import GroupedData
+
+        return GroupedData(self, key)
+
+    # -- execution --------------------------------------------------------
+
+    def iter_block_refs(self) -> Iterator[Any]:
+        return execute_streaming(iter(self._source), self._ops, self._options)
+
+    def iter_blocks(self) -> Iterator[Block]:
+        for ref in self.iter_block_refs():
+            yield ray_tpu.get(ref)
+
+    def materialize(self) -> "Dataset":
+        return Dataset(list(self.iter_block_refs()))
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        for block in self.iter_blocks():
+            yield from block_to_rows(block)
+
+    def iter_batches(
+        self,
+        *,
+        batch_size: int = 256,
+        batch_format: str = "numpy",
+        drop_last: bool = False,
+        prefetch_batches: int = 1,
+    ) -> Iterator[Any]:
+        from ray_tpu.data.iterator import iter_batches_from_blocks
+
+        return iter_batches_from_blocks(
+            self.iter_blocks(), batch_size=batch_size,
+            batch_format=batch_format, drop_last=drop_last,
+            prefetch_batches=prefetch_batches)
+
+    def iterator(self) -> "DataIterator":
+        from ray_tpu.data.iterator import DataIterator
+
+        return DataIterator(self)
+
+    def streaming_split(self, n: int, *, equal: bool = True
+                        ) -> List["DataIterator"]:
+        """Split into n iterators for n training workers (reference
+        ``Dataset.streaming_split`` used by Train's DataConfig)."""
+        from ray_tpu.data.iterator import DataIterator
+
+        return [DataIterator(self, split_index=i, num_splits=n)
+                for i in range(n)]
+
+    def split(self, n: int) -> List["Dataset"]:
+        blocks = list(self.iter_block_refs())
+        whole = concat_blocks([ray_tpu.get(r) for r in blocks])
+        total = block_num_rows(whole)
+        size = (total + n - 1) // n
+        return [Dataset([ray_tpu.put(block_slice(whole, i * size,
+                                                 min((i + 1) * size, total)))])
+                for i in range(n)]
+
+    # -- consumption ------------------------------------------------------
+
+    def take(self, n: int = 20) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        for row in self.iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def take_all(self) -> List[Dict[str, Any]]:
+        return list(self.iter_rows())
+
+    def count(self) -> int:
+        return sum(block_num_rows(b) for b in self.iter_blocks())
+
+    def schema(self) -> Optional[Dict[str, str]]:
+        for block in self.iter_blocks():
+            if block:
+                return {k: str(v.dtype) for k, v in block.items()}
+        return None
+
+    def columns(self) -> List[str]:
+        s = self.schema()
+        return list(s) if s else []
+
+    def num_blocks(self) -> int:
+        return sum(1 for _ in self.iter_block_refs())
+
+    def size_bytes(self) -> int:
+        return sum(block_metadata(b).size_bytes for b in self.iter_blocks())
+
+    def to_pandas(self):
+        from ray_tpu.data.block import block_to_pandas
+
+        return block_to_pandas(concat_blocks(list(self.iter_blocks())))
+
+    def sum(self, col: str) -> float:
+        return float(sum(b[col].sum() for b in self.iter_blocks() if col in b))
+
+    def min(self, col: str) -> float:
+        return float(min(b[col].min() for b in self.iter_blocks() if col in b))
+
+    def max(self, col: str) -> float:
+        return float(max(b[col].max() for b in self.iter_blocks() if col in b))
+
+    def mean(self, col: str) -> float:
+        total, count = 0.0, 0
+        for b in self.iter_blocks():
+            if col in b:
+                total += float(b[col].sum())
+                count += len(b[col])
+        return total / max(count, 1)
+
+    # -- writes -----------------------------------------------------------
+
+    def write_parquet(self, path: str) -> None:
+        import os
+
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        os.makedirs(path, exist_ok=True)
+        for i, block in enumerate(self.iter_blocks()):
+            table = pa.table({k: list(v) if v.ndim > 1 else v
+                              for k, v in block.items()})
+            pq.write_table(table, f"{path}/part-{i:05d}.parquet")
+
+    def write_csv(self, path: str) -> None:
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        from ray_tpu.data.block import block_to_pandas
+
+        for i, block in enumerate(self.iter_blocks()):
+            block_to_pandas(block).to_csv(f"{path}/part-{i:05d}.csv",
+                                          index=False)
+
+    def write_json(self, path: str) -> None:
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        from ray_tpu.data.block import block_to_pandas
+
+        for i, block in enumerate(self.iter_blocks()):
+            block_to_pandas(block).to_json(f"{path}/part-{i:05d}.json",
+                                           orient="records", lines=True)
+
+    def __repr__(self):
+        ops = " -> ".join(op.name for op in self._ops) or "source"
+        return f"Dataset({len(self._source)} source blocks, plan: {ops})"

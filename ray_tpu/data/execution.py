@@ -1,0 +1,218 @@
+"""Streaming execution of logical plans over the task runtime.
+
+Role analog: ``python/ray/data/_internal/execution/streaming_executor.py:48``
+and its Topology loop (``streaming_executor_state.py``). Same ideas, compact
+form: logical map-ish operators are **fused** into one task per block
+(reference optimizer's fusion rule), blocks flow through the fused pipeline
+as object refs with a bounded number of in-flight tasks (backpressure), and
+all-to-all ops (shuffle/sort/repartition/groupby) are barriers that
+materialize their input refs.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import (
+    Block,
+    block_num_rows,
+    block_slice,
+    block_take,
+    concat_blocks,
+)
+
+
+# ---------------------------------------------------------------------------
+# Logical operators
+# ---------------------------------------------------------------------------
+
+@dataclass
+class MapOp:
+    """Block -> List[Block] transform; fusible with neighbors."""
+
+    name: str
+    fn: Callable[[Block], List[Block]]
+
+
+@dataclass
+class AllToAllOp:
+    """Barrier op consuming all blocks at once."""
+
+    name: str
+    fn: Callable[[List[Block]], List[Block]]
+
+
+@dataclass
+class LimitOp:
+    name: str
+    limit: int
+
+
+LogicalOp = Any  # MapOp | AllToAllOp | LimitOp
+
+
+def fuse_ops(ops: List[LogicalOp]) -> List[LogicalOp]:
+    """Merge consecutive MapOps into single fused stages (the reference's
+    OperatorFusionRule): one task per block runs the whole chain."""
+    fused: List[LogicalOp] = []
+    for op in ops:
+        if isinstance(op, MapOp) and fused and isinstance(fused[-1], MapOp):
+            prev = fused[-1]
+
+            def chained(block: Block, _prev=prev.fn, _next=op.fn) -> List[Block]:
+                out: List[Block] = []
+                for b in _prev(block):
+                    out.extend(_next(b))
+                return out
+
+            fused[-1] = MapOp(name=f"{prev.name}->{op.name}", fn=chained)
+        else:
+            fused.append(op)
+    return fused
+
+
+# ---------------------------------------------------------------------------
+# Streaming executor
+# ---------------------------------------------------------------------------
+
+def _apply_map(fn_blob_fn, block: Block) -> List[Block]:
+    return fn_blob_fn(block)
+
+
+@dataclass
+class ExecutionOptions:
+    max_in_flight: int = 8       # per map stage (backpressure window)
+    preserve_order: bool = True
+
+
+def execute_streaming(
+    source: Iterator[Any],         # iterator of ObjectRef[Block] or Blocks
+    ops: List[LogicalOp],
+    options: Optional[ExecutionOptions] = None,
+) -> Iterator[Any]:
+    """Run the plan, yielding ObjectRefs of output blocks as they're ready."""
+    options = options or ExecutionOptions()
+    ops = fuse_ops(ops)
+    stream: Iterator[Any] = (_ensure_ref(x) for x in source)
+    for op in ops:
+        if isinstance(op, MapOp):
+            stream = _run_map_stage(stream, op, options)
+        elif isinstance(op, AllToAllOp):
+            stream = _run_all_to_all(stream, op)
+        elif isinstance(op, LimitOp):
+            stream = _run_limit(stream, op.limit)
+        else:
+            raise TypeError(f"unknown op {op!r}")
+    return stream
+
+
+def _ensure_ref(x):
+    from ray_tpu.core.object_ref import ObjectRef
+
+    if isinstance(x, ObjectRef):
+        return x
+    return ray_tpu.put(x)
+
+
+def _run_map_stage(stream: Iterator[Any], op: MapOp,
+                   options: ExecutionOptions) -> Iterator[Any]:
+    """Bounded-in-flight task pool over input refs (streaming backpressure:
+    reference ``select_operator_to_run``'s resource gating, reduced to a
+    window of ``max_in_flight`` concurrent tasks)."""
+    remote_fn = ray_tpu.remote(lambda block, _fn=op.fn: _fn(block))
+    in_flight: List[Any] = []
+
+    def results_of(ref) -> List[Any]:
+        # the task returns List[Block]; flatten to per-block refs by
+        # fetching the list (cheap: refs to blocks stay in store)
+        out_blocks = ray_tpu.get(ref)
+        return [ray_tpu.put(b) for b in out_blocks]
+
+    for ref in stream:
+        in_flight.append(remote_fn.remote(ref))
+        while len(in_flight) >= options.max_in_flight:
+            first = in_flight.pop(0)
+            for r in results_of(first):
+                yield r
+    for ref in in_flight:
+        for r in results_of(ref):
+            yield r
+
+
+def _run_all_to_all(stream: Iterator[Any], op: AllToAllOp) -> Iterator[Any]:
+    blocks = [ray_tpu.get(r) for r in stream]
+    for out in op.fn(blocks):
+        yield ray_tpu.put(out)
+
+
+def _run_limit(stream: Iterator[Any], limit: int) -> Iterator[Any]:
+    remaining = limit
+    for ref in stream:
+        if remaining <= 0:
+            return
+        block = ray_tpu.get(ref)
+        n = block_num_rows(block)
+        if n <= remaining:
+            remaining -= n
+            yield ref
+        else:
+            yield ray_tpu.put(block_slice(block, 0, remaining))
+            remaining = 0
+            return
+
+
+# ---------------------------------------------------------------------------
+# All-to-all implementations
+# ---------------------------------------------------------------------------
+
+def shuffle_fn(seed: Optional[int]) -> Callable[[List[Block]], List[Block]]:
+    def _shuffle(blocks: List[Block]) -> List[Block]:
+        whole = concat_blocks(blocks)
+        n = block_num_rows(whole)
+        rng = np.random.default_rng(seed)
+        perm = rng.permutation(n)
+        shuffled = block_take(whole, perm)
+        # keep roughly the original partitioning
+        k = max(len(blocks), 1)
+        size = max(1, (n + k - 1) // k)
+        return [block_slice(shuffled, i, min(i + size, n))
+                for i in range(0, n, size)]
+
+    return _shuffle
+
+
+def repartition_fn(num_blocks: int) -> Callable[[List[Block]], List[Block]]:
+    def _repartition(blocks: List[Block]) -> List[Block]:
+        whole = concat_blocks(blocks)
+        n = block_num_rows(whole)
+        if n == 0:
+            return []
+        size = max(1, (n + num_blocks - 1) // num_blocks)
+        return [block_slice(whole, i, min(i + size, n))
+                for i in range(0, n, size)]
+
+    return _repartition
+
+
+def sort_fn(key: str, descending: bool = False
+            ) -> Callable[[List[Block]], List[Block]]:
+    def _sort(blocks: List[Block]) -> List[Block]:
+        whole = concat_blocks(blocks)
+        if not whole:
+            return []
+        order = np.argsort(whole[key], kind="stable")
+        if descending:
+            order = order[::-1]
+        out = block_take(whole, order)
+        k = max(len(blocks), 1)
+        n = block_num_rows(out)
+        size = max(1, (n + k - 1) // k)
+        return [block_slice(out, i, min(i + size, n))
+                for i in range(0, n, size)]
+
+    return _sort

@@ -1,0 +1,80 @@
+"""GroupedData: groupby + aggregations.
+
+Role analog: ``python/ray/data/grouped_data.py``. Aggregation is an
+all-to-all (hash-group on the materialized stream), matching the
+reference's shuffle-based groupby semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import Block, block_take, concat_blocks
+
+
+class GroupedData:
+    def __init__(self, dataset, key: str):
+        self._dataset = dataset
+        self._key = key
+
+    def _grouped(self) -> Dict[Any, Block]:
+        whole = concat_blocks(list(self._dataset.iter_blocks()))
+        if not whole:
+            return {}
+        keys = whole[self._key]
+        order = np.argsort(keys, kind="stable")
+        sorted_block = block_take(whole, order)
+        sorted_keys = sorted_block[self._key]
+        groups: Dict[Any, Block] = {}
+        boundaries = np.flatnonzero(
+            np.concatenate([[True], sorted_keys[1:] != sorted_keys[:-1]]))
+        ends = np.concatenate([boundaries[1:], [len(sorted_keys)]])
+        for start, end in zip(boundaries, ends):
+            groups[sorted_keys[start].item()
+                   if hasattr(sorted_keys[start], "item")
+                   else sorted_keys[start]] = {
+                k: v[start:end] for k, v in sorted_block.items()}
+        return groups
+
+    def _agg(self, cols_fn: Callable[[Any, Block], Dict[str, Any]]):
+        from ray_tpu.data.block import block_from_rows
+        from ray_tpu.data.dataset import Dataset
+
+        rows: List[Dict[str, Any]] = []
+        for key, block in self._grouped().items():
+            rows.append({self._key: key, **cols_fn(key, block)})
+        return Dataset([ray_tpu.put(block_from_rows(rows))])
+
+    def count(self):
+        from ray_tpu.data.block import block_num_rows
+
+        return self._agg(lambda k, b: {"count()": block_num_rows(b)})
+
+    def sum(self, col: str):
+        return self._agg(lambda k, b: {f"sum({col})": float(b[col].sum())})
+
+    def mean(self, col: str):
+        return self._agg(lambda k, b: {f"mean({col})": float(b[col].mean())})
+
+    def min(self, col: str):
+        return self._agg(lambda k, b: {f"min({col})": float(b[col].min())})
+
+    def max(self, col: str):
+        return self._agg(lambda k, b: {f"max({col})": float(b[col].max())})
+
+    def std(self, col: str):
+        return self._agg(lambda k, b: {f"std({col})": float(b[col].std())})
+
+    def aggregate(self, name: str, fn: Callable[[Block], Any]):
+        return self._agg(lambda k, b: {name: fn(b)})
+
+    def map_groups(self, fn: Callable[[Block], Block]):
+        from ray_tpu.data.dataset import Dataset
+
+        refs = [ray_tpu.put(fn(b)) for b in self._grouped().values()]
+        from ray_tpu.data.block import block_num_rows
+
+        return Dataset([r for r in refs])

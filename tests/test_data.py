@@ -1,0 +1,137 @@
+"""Data library: transforms, execution, fusion, groupby, iterators, IO."""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rdata
+
+
+@pytest.fixture(scope="module")
+def rt_data():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_range_count_take(rt_data):
+    ds = rdata.range(100, parallelism=4)
+    assert ds.count() == 100
+    rows = ds.take(5)
+    assert [r["id"] for r in rows] == [0, 1, 2, 3, 4]
+
+
+def test_map_batches_and_fusion(rt_data):
+    ds = (rdata.range(64, parallelism=4)
+          .map_batches(lambda b: {"id": b["id"] * 2})
+          .map_batches(lambda b: {"id": b["id"] + 1}))
+    vals = [r["id"] for r in ds.take_all()]
+    assert vals == [2 * i + 1 for i in range(64)]
+    # the two map stages fuse into one
+    from ray_tpu.data.execution import fuse_ops
+
+    assert len(fuse_ops(ds._ops)) == 1
+
+
+def test_map_filter_flat_map(rt_data):
+    ds = rdata.range(10, parallelism=2).map(lambda r: {"x": int(r["id"]) * 10})
+    ds = ds.filter(lambda r: r["x"] >= 50)
+    ds = ds.flat_map(lambda r: [{"x": r["x"]}, {"x": r["x"] + 1}])
+    vals = [r["x"] for r in ds.take_all()]
+    assert vals == [50, 51, 60, 61, 70, 71, 80, 81, 90, 91]
+
+
+def test_shuffle_sort_repartition(rt_data):
+    ds = rdata.range(50, parallelism=5).random_shuffle(seed=7)
+    shuffled = [r["id"] for r in ds.take_all()]
+    assert sorted(shuffled) == list(range(50))
+    assert shuffled != list(range(50))
+
+    ds2 = ds.sort("id", descending=True)
+    assert [r["id"] for r in ds2.take(3)] == [49, 48, 47]
+
+    ds3 = ds.repartition(3)
+    assert ds3.num_blocks() == 3
+
+
+def test_limit_and_union_zip(rt_data):
+    a = rdata.range(10, parallelism=2).limit(4)
+    assert a.count() == 4
+    b = rdata.from_items([{"y": i} for i in range(4)])
+    z = a.zip(b)
+    rows = z.take_all()
+    assert set(rows[0]) == {"id", "y"}
+    u = a.union(a)
+    assert u.count() == 8
+
+
+def test_groupby_aggregates(rt_data):
+    items = [{"k": i % 3, "v": float(i)} for i in range(12)]
+    ds = rdata.from_items(items, parallelism=3)
+    counts = {r["k"]: r["count()"] for r in ds.groupby("k").count().take_all()}
+    assert counts == {0: 4, 1: 4, 2: 4}
+    means = {r["k"]: r["mean(v)"] for r in ds.groupby("k").mean("v").take_all()}
+    assert means[0] == pytest.approx(np.mean([0, 3, 6, 9]))
+
+
+def test_iter_batches_exact_sizes(rt_data):
+    ds = rdata.range(100, parallelism=7)
+    sizes = [len(b["id"]) for b in ds.iter_batches(batch_size=32)]
+    assert sizes == [32, 32, 32, 4]
+    sizes = [len(b["id"]) for b in ds.iter_batches(batch_size=32,
+                                                   drop_last=True)]
+    assert sizes == [32, 32, 32]
+
+
+def test_streaming_split_covers_all(rt_data):
+    ds = rdata.range(40, parallelism=4).materialize()
+    its = ds.streaming_split(2)
+    seen = []
+    for it in its:
+        for r in it.iter_rows():
+            seen.append(r["id"])
+    assert sorted(seen) == list(range(40))
+
+
+def test_iter_jax_batches_sharded(rt_data):
+    import jax
+
+    from ray_tpu.parallel import MeshConfig, make_mesh
+
+    mesh = make_mesh(MeshConfig(dp=4, fsdp=2))
+    ds = rdata.range(64, parallelism=4)
+    it = ds.iterator()
+    batches = list(it.iter_jax_batches(batch_size=16, mesh=mesh))
+    assert len(batches) == 4
+    arr = batches[0]["id"]
+    assert isinstance(arr, jax.Array)
+    assert arr.shape == (16,)
+    assert len(arr.sharding.device_set) == 8
+
+
+def test_write_read_roundtrip(rt_data, tmp_path):
+    ds = rdata.from_items([{"a": i, "b": float(i) / 2} for i in range(20)],
+                          parallelism=2)
+    pq_dir = str(tmp_path / "pq")
+    ds.write_parquet(pq_dir)
+    back = rdata.read_parquet(pq_dir)
+    assert back.count() == 20
+    assert back.sum("a") == sum(range(20))
+
+    csv_dir = str(tmp_path / "csv")
+    ds.write_csv(csv_dir)
+    back_csv = rdata.read_csv(csv_dir)
+    assert back_csv.count() == 20
+
+    js_dir = str(tmp_path / "js")
+    ds.write_json(js_dir)
+    assert rdata.read_json(js_dir).count() == 20
+
+
+def test_columns_schema_stats(rt_data):
+    ds = rdata.from_items([{"a": 1, "b": 2.0}])
+    assert set(ds.columns()) == {"a", "b"}
+    assert ds.mean("b") == 2.0
+    assert ds.min("a") == 1
