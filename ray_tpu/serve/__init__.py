@@ -1,0 +1,48 @@
+"""ray_tpu.serve — online serving over replica actors.
+
+Role analog: ``python/ray/serve`` (SURVEY §2.5, §3.6). Control plane =
+named controller actor reconciling replica actors; data plane = handle →
+power-of-two-choices routing → replica actor call; plus dynamic batching,
+model composition, multiplexing, autoscaling, and an HTTP proxy. TPU
+angle: a replica owns chips and serves a jitted model; ``@serve.batch``
+aggregates requests into MXU-sized batches.
+"""
+
+from ray_tpu.serve.api import (
+    delete,
+    get_deployment_handle,
+    get_multiplexed_model_id,
+    multiplexed,
+    run,
+    shutdown,
+    status,
+)
+from ray_tpu.serve.batching import batch
+from ray_tpu.serve.deployment import (
+    Application,
+    AutoscalingConfig,
+    Deployment,
+    DeploymentConfig,
+    deployment,
+)
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.proxy import HTTPProxy
+
+__all__ = [
+    "run",
+    "shutdown",
+    "delete",
+    "status",
+    "deployment",
+    "Deployment",
+    "DeploymentConfig",
+    "AutoscalingConfig",
+    "Application",
+    "DeploymentHandle",
+    "DeploymentResponse",
+    "HTTPProxy",
+    "batch",
+    "multiplexed",
+    "get_multiplexed_model_id",
+    "get_deployment_handle",
+]

@@ -1,0 +1,140 @@
+"""serve.run / serve.shutdown / handles / multiplexing.
+
+Role analog: ``python/ray/serve/api.py`` (``serve.run :545``). The client
+side: package the bound application into specs, hand them to the named
+controller actor, return the entry deployment's handle.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import functools
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Optional
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.serve.controller import CONTROLLER_NAME, ServeController
+from ray_tpu.serve.deployment import Application, Deployment
+from ray_tpu.serve.handle import DeploymentHandle, _AppRefSentinel
+
+
+def _get_or_create_controller():
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:
+        cls = ray_tpu.remote(ServeController)
+        return cls.options(name=CONTROLLER_NAME, num_cpus=0).remote()
+
+
+def _spec_for(app: Application) -> Dict[str, Any]:
+    dep = app.deployment
+    composed = []
+
+    def encode(x):
+        if isinstance(x, Application):
+            composed.append(x.deployment.name)
+            return _AppRefSentinel(x.deployment.name)
+        return x
+
+    init_args = tuple(encode(a) for a in app.init_args)
+    init_kwargs = {k: encode(v) for k, v in app.init_kwargs.items()}
+    cfg = dep.config
+    return {
+        "name": dep.name,
+        "cls_blob": cloudpickle.dumps(dep.func_or_class),
+        "init_args": cloudpickle.dumps(init_args),
+        "init_kwargs": cloudpickle.dumps(init_kwargs),
+        "composed": composed,
+        "config": {
+            "num_replicas": cfg.num_replicas,
+            "max_ongoing_requests": cfg.max_ongoing_requests,
+            "autoscaling_config": (vars(cfg.autoscaling_config)
+                                   if cfg.autoscaling_config else None),
+            "ray_actor_options": cfg.ray_actor_options,
+            "user_config": cfg.user_config,
+        },
+    }
+
+
+def run(app: Application, *, name: str = "default",
+        route_prefix: Optional[str] = None) -> DeploymentHandle:
+    """Deploy the application; returns a handle to its entry deployment."""
+    if isinstance(app, Deployment):
+        app = app.bind()
+    controller = _get_or_create_controller()
+    specs = [_spec_for(a) for a in app.flatten().values()]
+    ray_tpu.get(controller.deploy_application.remote(specs))
+    handle = DeploymentHandle(app.deployment.name, controller)
+    handle._refresh(force=True)
+    return handle
+
+
+def get_deployment_handle(deployment_name: str,
+                          app_name: str = "default") -> DeploymentHandle:
+    return DeploymentHandle(deployment_name, _get_or_create_controller())
+
+
+def delete(name: str) -> None:
+    controller = _get_or_create_controller()
+    ray_tpu.get(controller.delete_deployment.remote(name))
+
+
+def status() -> Dict[str, Any]:
+    controller = _get_or_create_controller()
+    return ray_tpu.get(controller.list_deployments.remote())
+
+
+def shutdown() -> None:
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:
+        return
+    try:
+        ray_tpu.get(controller.shutdown.remote())
+        ray_tpu.kill(controller)
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Model multiplexing (reference serve/multiplex.py)
+# ---------------------------------------------------------------------------
+
+_multiplexed_model_id: contextvars.ContextVar = contextvars.ContextVar(
+    "multiplexed_model_id", default="")
+
+
+def get_multiplexed_model_id() -> str:
+    return _multiplexed_model_id.get()
+
+
+def multiplexed(fn=None, *, max_num_models_per_replica: int = 3):
+    """Decorate an async model-loader method; an LRU of loaded models is
+    kept per replica (reference ``serve/multiplex.py``)."""
+
+    def wrap(load_fn):
+        caches: Dict[int, OrderedDict] = {}
+
+        @functools.wraps(load_fn)
+        async def wrapper(self, model_id: str):
+            cache = caches.setdefault(id(self), OrderedDict())
+            if model_id in cache:
+                cache.move_to_end(model_id)
+                return cache[model_id]
+            model = load_fn(self, model_id)
+            import inspect
+
+            if inspect.iscoroutine(model):
+                model = await model
+            cache[model_id] = model
+            if len(cache) > max_num_models_per_replica:
+                cache.popitem(last=False)
+            return model
+
+        return wrapper
+
+    if fn is None:
+        return wrap
+    return wrap(fn)

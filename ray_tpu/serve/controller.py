@@ -1,0 +1,183 @@
+"""ServeController: deployment state reconciliation + autoscaling.
+
+Role analog: ``python/ray/serve/_private/controller.py:86`` with the
+``DeploymentStateManager`` reconciler (``deployment_state.py:1226``) and
+autoscaling (``autoscaling_state.py``). The controller is a named actor;
+``deploy``/``delete`` reconcile replica actors synchronously (create the
+missing, kill the surplus), and ``autoscale_tick`` applies the queue-based
+policy from metrics the handles report. Config updates broadcast by bumping
+a routing-table version handles poll (the LongPollHost analog, pull-based).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+class ServeController:
+    def __init__(self):
+        # name -> {"app": Application-ish dict, "replicas": [handles], ...}
+        self._deployments: Dict[str, Dict[str, Any]] = {}
+        self._version = 0
+        # deployment -> list of (timestamp, ongoing) samples from handles
+        self._metrics: Dict[str, List[Any]] = {}
+
+    # -- deploy / delete --------------------------------------------------
+
+    def deploy_application(self, specs: List[Dict[str, Any]]) -> int:
+        """specs: one dict per deployment: {name, cls_blob, init_args,
+        init_kwargs, config(dict), composed(list of dep names)}."""
+        import cloudpickle
+
+        for spec in specs:
+            name = spec["name"]
+            entry = self._deployments.get(name)
+            if entry is None:
+                entry = {"replicas": [], "spec": spec}
+                self._deployments[name] = entry
+            else:
+                entry["spec"] = spec
+            entry["target"] = spec["config"]["num_replicas"]
+        # resolve composition: build handles for dependencies first
+        order = self._topo_order(specs)
+        for name in order:
+            self._reconcile(name)
+        self._version += 1
+        return self._version
+
+    def delete_deployment(self, name: str) -> None:
+        entry = self._deployments.pop(name, None)
+        if entry:
+            for r in entry["replicas"]:
+                self._kill(r)
+        self._version += 1
+
+    def shutdown(self) -> None:
+        for name in list(self._deployments):
+            self.delete_deployment(name)
+
+    def _topo_order(self, specs) -> List[str]:
+        by_name = {s["name"]: s for s in specs}
+        seen: List[str] = []
+
+        def visit(n):
+            if n in seen or n not in by_name:
+                return
+            for dep in by_name[n].get("composed", []):
+                visit(dep)
+            seen.append(n)
+
+        for s in specs:
+            visit(s["name"])
+        return seen
+
+    # -- reconciliation ---------------------------------------------------
+
+    def _make_replica(self, spec: Dict[str, Any]):
+        import cloudpickle
+
+        import ray_tpu
+        from ray_tpu.serve.replica import ReplicaActor
+
+        cls_or_fn = cloudpickle.loads(spec["cls_blob"])
+        init_args = cloudpickle.loads(spec["init_args"])
+        init_kwargs = cloudpickle.loads(spec["init_kwargs"])
+        # composed deps: replace sentinels with live handles
+        from ray_tpu.serve.handle import DeploymentHandle, _AppRefSentinel
+
+        def resolve(x):
+            if isinstance(x, _AppRefSentinel):
+                return DeploymentHandle(x.name, controller=None)
+            return x
+
+        init_args = tuple(resolve(a) for a in init_args)
+        init_kwargs = {k: resolve(v) for k, v in init_kwargs.items()}
+        opts = dict(spec["config"].get("ray_actor_options") or {})
+        actor_cls = ray_tpu.remote(ReplicaActor)
+        return actor_cls.options(**opts).remote(
+            cls_or_fn, init_args, init_kwargs,
+            spec["config"].get("user_config"))
+
+    def _reconcile(self, name: str) -> None:
+        entry = self._deployments.get(name)
+        if not entry:
+            return
+        target = entry.get("target", 1)
+        replicas = entry["replicas"]
+        while len(replicas) < target:
+            replicas.append(self._make_replica(entry["spec"]))
+        while len(replicas) > target:
+            self._kill(replicas.pop())
+
+    def _kill(self, replica) -> None:
+        import ray_tpu
+
+        try:
+            ray_tpu.kill(replica)
+        except Exception:
+            pass
+
+    # -- routing table ----------------------------------------------------
+
+    def get_routing_info(self, name: str):
+        entry = self._deployments.get(name)
+        if entry is None:
+            return None
+        return {
+            "version": self._version,
+            "replicas": list(entry["replicas"]),
+            "max_ongoing_requests":
+                entry["spec"]["config"].get("max_ongoing_requests", 8),
+        }
+
+    def get_version(self) -> int:
+        return self._version
+
+    def list_deployments(self) -> Dict[str, Dict[str, Any]]:
+        return {
+            name: {
+                "num_replicas": len(e["replicas"]),
+                "target": e.get("target"),
+            }
+            for name, e in self._deployments.items()
+        }
+
+    # -- autoscaling ------------------------------------------------------
+
+    def record_request_metrics(self, name: str, ongoing: float) -> None:
+        self._metrics.setdefault(name, []).append((time.time(), ongoing))
+        # keep the last minute
+        cutoff = time.time() - 60.0
+        self._metrics[name] = [(t, o) for t, o in self._metrics[name]
+                               if t >= cutoff]
+
+    def autoscale_tick(self) -> Dict[str, int]:
+        """Apply the autoscaling policy (reference
+        ``autoscaling_policy.py``: scale to ongoing/target ratio, clamped)."""
+        decisions = {}
+        for name, entry in self._deployments.items():
+            cfg = entry["spec"]["config"].get("autoscaling_config")
+            if not cfg:
+                continue
+            samples = [o for _, o in self._metrics.get(name, [])]
+            if not samples:
+                continue
+            avg_ongoing = sum(samples) / len(samples)
+            cur = max(len(entry["replicas"]), 1)
+            desired = avg_ongoing / max(cfg["target_ongoing_requests"], 1e-9)
+            import math
+
+            new = cur
+            if desired > cur:
+                new = min(int(math.ceil(desired)), cfg["max_replicas"])
+            elif desired < cur * cfg["downscale_factor"]:
+                new = max(int(math.ceil(desired)), cfg["min_replicas"])
+            if new != cur:
+                entry["target"] = new
+                self._reconcile(name)
+                self._version += 1
+                decisions[name] = new
+        return decisions

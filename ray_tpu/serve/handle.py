@@ -1,0 +1,137 @@
+"""DeploymentHandle + Router: the request path.
+
+Role analog: ``python/ray/serve/handle.py:711`` → ``Router``
+(``router.py:312``) → ``PowerOfTwoChoicesReplicaScheduler``
+(``replica_scheduler/pow_2_scheduler.py:49``). The handle keeps a local
+in-flight count per replica (the reference's client-side queue-length cache,
+``common.py:218``) and picks the less-loaded of two random replicas; the
+routing table refreshes from the controller when its version bumps.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class _AppRefSentinel:
+    """Placeholder for a composed sub-application in init args."""
+
+    name: str
+
+
+class DeploymentResponse:
+    """Future-like wrapper over the underlying ObjectRef (reference
+    ``DeploymentResponse``)."""
+
+    def __init__(self, ref, on_done=None):
+        self._ref = ref
+        self._on_done = on_done
+        self._result = None
+        self._done = False
+
+    def result(self, timeout_s: Optional[float] = None):
+        import ray_tpu
+
+        if not self._done:
+            self._result = ray_tpu.get(self._ref, timeout=timeout_s)
+            self._done = True
+            if self._on_done:
+                self._on_done()
+        return self._result
+
+    @property
+    def ref(self):
+        return self._ref
+
+    def __await__(self):
+        return self._ref.__await__()
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, controller=None,
+                 method_name: str = "__call__"):
+        self.deployment_name = deployment_name
+        self._controller = controller
+        self._method = method_name
+        self._replicas: List[Any] = []
+        self._version = -1
+        self._max_ongoing = 8
+        self._inflight: Dict[int, int] = {}
+        self._rng = random.Random()
+
+    # -- controller sync --------------------------------------------------
+
+    def _get_controller(self):
+        if self._controller is None:
+            import ray_tpu
+
+            self._controller = ray_tpu.get_actor("SERVE_CONTROLLER")
+        return self._controller
+
+    def _refresh(self, force: bool = False):
+        import ray_tpu
+
+        ctrl = self._get_controller()
+        version = ray_tpu.get(ctrl.get_version.remote())
+        if force or version != self._version or not self._replicas:
+            info = ray_tpu.get(
+                ctrl.get_routing_info.remote(self.deployment_name))
+            if info is None:
+                raise KeyError(
+                    f"deployment {self.deployment_name!r} not found")
+            self._replicas = info["replicas"]
+            self._max_ongoing = info["max_ongoing_requests"]
+            self._version = info["version"]
+            self._inflight = {i: 0 for i in range(len(self._replicas))}
+
+    # -- routing ----------------------------------------------------------
+
+    def _pick_replica(self) -> int:
+        n = len(self._replicas)
+        if n == 1:
+            return 0
+        i, j = self._rng.sample(range(n), 2)
+        return i if self._inflight.get(i, 0) <= self._inflight.get(j, 0) else j
+
+    def options(self, *, method_name: Optional[str] = None
+                ) -> "DeploymentHandle":
+        h = DeploymentHandle(self.deployment_name, self._controller,
+                             method_name or self._method)
+        h._replicas = self._replicas
+        h._version = self._version
+        h._max_ongoing = self._max_ongoing
+        h._inflight = self._inflight   # share the load view
+        return h
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        self._refresh()
+        idx = self._pick_replica()
+        replica = self._replicas[idx]
+        self._inflight[idx] = self._inflight.get(idx, 0) + 1
+        ref = replica.handle_request.remote(self._method, args, kwargs)
+
+        def _done(i=idx):
+            self._inflight[i] = max(0, self._inflight.get(i, 0) - 1)
+            self._report_metrics()
+
+        return DeploymentResponse(ref, on_done=_done)
+
+    def _report_metrics(self):
+        try:
+            ctrl = self._get_controller()
+            total = float(sum(self._inflight.values()))
+            ctrl.record_request_metrics.remote(self.deployment_name, total)
+        except Exception:
+            pass
+
+    def __getattr__(self, name: str) -> "DeploymentHandle":
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return self.options(method_name=name)
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self.deployment_name, None, self._method))

@@ -1,0 +1,115 @@
+"""HTTP proxy: minimal asyncio HTTP/1.1 server routing to deployments.
+
+Role analog: ``python/ray/serve/_private/proxy.py:1112`` (``HTTPProxy``
+:748). The reference runs uvicorn/ASGI per node; here a stdlib asyncio
+server (no external deps) parses requests, routes ``/<deployment>`` to the
+deployment's handle, and returns JSON. Runs on a daemon thread in the
+driver process (single-node data plane).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Any, Dict, Optional
+
+from ray_tpu.serve.handle import DeploymentHandle
+
+
+class HTTPProxy:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+        self.host = host
+        self.port = port
+        self._handles: Dict[str, DeploymentHandle] = {}
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+
+    def register(self, route: str, handle: DeploymentHandle) -> None:
+        self._handles[route.strip("/")] = handle
+
+    # -- server -----------------------------------------------------------
+
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter):
+        try:
+            request_line = await reader.readline()
+            if not request_line:
+                return
+            method, path, _ = request_line.decode().split(" ", 2)
+            headers: Dict[str, str] = {}
+            while True:
+                line = await reader.readline()
+                if line in (b"\r\n", b"\n", b""):
+                    break
+                k, _, v = line.decode().partition(":")
+                headers[k.strip().lower()] = v.strip()
+            body = b""
+            n = int(headers.get("content-length", 0))
+            if n:
+                body = await reader.readexactly(n)
+            status, payload = await self._route(method, path, body)
+            data = json.dumps(payload).encode()
+            writer.write(
+                f"HTTP/1.1 {status}\r\nContent-Type: application/json\r\n"
+                f"Content-Length: {len(data)}\r\nConnection: close"
+                f"\r\n\r\n".encode() + data)
+            await writer.drain()
+        except Exception:
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _route(self, method: str, path: str, body: bytes):
+        name = path.strip("/").split("/")[0]
+        if name == "-" or name == "":
+            return "200 OK", {"status": "ok",
+                              "routes": sorted(self._handles)}
+        handle = self._handles.get(name)
+        if handle is None:
+            return "404 Not Found", {"error": f"no deployment {name!r}"}
+        arg: Any = None
+        if body:
+            try:
+                arg = json.loads(body)
+            except json.JSONDecodeError:
+                arg = body.decode()
+        loop = asyncio.get_event_loop()
+        try:
+            resp = handle.remote(arg) if arg is not None else handle.remote()
+            result = await loop.run_in_executor(None, resp.result)
+            return "200 OK", {"result": result}
+        except Exception as e:  # noqa: BLE001
+            return "500 Internal Server Error", {"error": str(e)}
+
+    def _run(self):
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+
+        async def main():
+            server = await asyncio.start_server(
+                self._handle_conn, self.host, self.port)
+            if self.port == 0:
+                self.port = server.sockets[0].getsockname()[1]
+            self._started.set()
+            async with server:
+                await server.serve_forever()
+
+        try:
+            self._loop.run_until_complete(main())
+        except asyncio.CancelledError:
+            pass
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="serve_http_proxy")
+        self._thread.start()
+        self._started.wait(timeout=10)
+
+    def stop(self) -> None:
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)

@@ -1,0 +1,61 @@
+"""ReplicaActor: wraps the user's deployment callable.
+
+Role analog: ``python/ray/serve/_private/replica.py:231`` (``ReplicaActor``
++ ``UserCallableWrapper :737``). A replica is an actor; requests arrive as
+ordinary actor calls. TPU angle: a replica that owns TPU chips loads a
+jitted model once in ``__init__`` and every request hits the compiled
+function — batched inference composes with ``@serve.batch``.
+"""
+
+from __future__ import annotations
+
+import inspect
+import time
+from typing import Any, Dict, Optional
+
+
+class ReplicaActor:
+    def __init__(self, cls_or_fn, init_args, init_kwargs,
+                 user_config: Optional[Dict[str, Any]] = None,
+                 handle_args: Optional[Dict[str, Any]] = None):
+        # handle_args: deployment-name -> handle for composed models
+        self._is_function = inspect.isfunction(cls_or_fn) or \
+            inspect.isbuiltin(cls_or_fn)
+        if self._is_function:
+            self._callable = cls_or_fn
+        else:
+            self._callable = cls_or_fn(*init_args, **init_kwargs)
+        self._user_config = user_config
+        if user_config is not None and hasattr(self._callable, "reconfigure"):
+            self._callable.reconfigure(user_config)
+        self._num_requests = 0
+        self._start_time = time.time()
+
+    def handle_request(self, method_name: str, args, kwargs):
+        self._num_requests += 1
+        if self._is_function:
+            fn = self._callable
+        else:
+            fn = getattr(self._callable, method_name or "__call__")
+        out = fn(*args, **kwargs)
+        if inspect.iscoroutine(out):
+            import asyncio
+
+            out = asyncio.get_event_loop().run_until_complete(out)
+        return out
+
+    def reconfigure(self, user_config: Dict[str, Any]):
+        self._user_config = user_config
+        if hasattr(self._callable, "reconfigure"):
+            self._callable.reconfigure(user_config)
+
+    def check_health(self) -> bool:
+        if hasattr(self._callable, "check_health"):
+            self._callable.check_health()
+        return True
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "num_requests": self._num_requests,
+            "uptime_s": time.time() - self._start_time,
+        }

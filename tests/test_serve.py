@@ -1,0 +1,165 @@
+"""Serve: deploy, route, compose, batch, multiplex, autoscale, HTTP proxy."""
+
+import asyncio
+import json
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def rt_serve():
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def test_function_deployment(rt_serve):
+    @serve.deployment
+    def doubler(x):
+        return x * 2
+
+    handle = serve.run(doubler.bind())
+    assert handle.remote(21).result() == 42
+
+
+def test_class_deployment_with_state_and_methods(rt_serve):
+    @serve.deployment(num_replicas=2)
+    class Counter:
+        def __init__(self, start):
+            self.start = start
+
+        def __call__(self, x):
+            return self.start + x
+
+        def describe(self):
+            return f"counter from {self.start}"
+
+    handle = serve.run(Counter.bind(100))
+    assert handle.remote(5).result() == 105
+    assert handle.describe.remote().result() == "counter from 100"
+    # both replicas registered
+    assert serve.status()["Counter"]["num_replicas"] == 2
+
+
+def test_model_composition(rt_serve):
+    @serve.deployment
+    class Preprocess:
+        def __call__(self, x):
+            return x + 1
+
+    @serve.deployment
+    class Combined:
+        def __init__(self, pre):
+            self.pre = pre
+
+        def __call__(self, x):
+            y = self.pre.remote(x).result()
+            return y * 10
+
+    handle = serve.run(Combined.bind(Preprocess.bind()))
+    assert handle.remote(4).result() == 50
+
+
+def test_load_balancing_across_replicas(rt_serve):
+    import os
+
+    @serve.deployment(num_replicas=3)
+    class WhoAmI:
+        def __call__(self):
+            return os.getpid()
+
+    handle = serve.run(WhoAmI.bind())
+    pids = {handle.remote().result() for _ in range(20)}
+    assert len(pids) >= 2  # requests spread over replicas
+
+
+def test_serve_batch_decorator():
+    calls = []
+
+    @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.05)
+    async def process(items):
+        calls.append(len(items))
+        return [i * 2 for i in items]
+
+    async def main():
+        outs = await asyncio.gather(*[process(i) for i in range(10)])
+        return outs
+
+    outs = asyncio.new_event_loop().run_until_complete(main())
+    assert outs == [i * 2 for i in range(10)]
+    assert max(calls) > 1  # batching actually happened
+
+
+def test_multiplexed_lru():
+    loaded = []
+
+    class Replica:
+        @serve.multiplexed(max_num_models_per_replica=2)
+        async def get_model(self, model_id):
+            loaded.append(model_id)
+            return f"model-{model_id}"
+
+    r = Replica()
+
+    async def main():
+        a = await r.get_model("a")
+        b = await r.get_model("b")
+        a2 = await r.get_model("a")   # cache hit
+        c = await r.get_model("c")    # evicts b
+        b2 = await r.get_model("b")   # reload
+        return a, b, a2, c, b2
+
+    out = asyncio.new_event_loop().run_until_complete(main())
+    assert out == ("model-a", "model-b", "model-a", "model-c", "model-b")
+    assert loaded == ["a", "b", "c", "b"]
+
+
+def test_autoscaling_scales_up(rt_serve):
+    @serve.deployment(autoscaling_config={
+        "min_replicas": 1, "max_replicas": 4,
+        "target_ongoing_requests": 1.0})
+    def work(x=0):
+        return x
+
+    handle = serve.run(work.bind())
+    ctrl = ray_tpu.get_actor("SERVE_CONTROLLER")
+    # report high sustained load, then tick
+    for _ in range(5):
+        ray_tpu.get(ctrl.record_request_metrics.remote("work", 6.0))
+    decisions = ray_tpu.get(ctrl.autoscale_tick.remote())
+    assert decisions.get("work", 0) >= 2
+    assert serve.status()["work"]["num_replicas"] >= 2
+
+
+def test_http_proxy(rt_serve):
+    import http.client
+
+    @serve.deployment
+    def echo(payload=None):
+        return {"got": payload}
+
+    handle = serve.run(echo.bind())
+    proxy = serve.HTTPProxy(port=0)
+    proxy.register("echo", handle)
+    proxy.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", proxy.port, timeout=30)
+        body = json.dumps({"a": 1})
+        conn.request("POST", "/echo", body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        data = json.loads(resp.read())
+        assert data["result"]["got"] == {"a": 1}
+
+        conn = http.client.HTTPConnection("127.0.0.1", proxy.port, timeout=30)
+        conn.request("GET", "/")
+        resp = conn.getresponse()
+        assert json.loads(resp.read())["routes"] == ["echo"]
+    finally:
+        proxy.stop()
