@@ -1,0 +1,39 @@
+"""ray_tpu.rllib — reinforcement learning: env-runner actors + JAX learners.
+
+Role analog: ``rllib/`` new API stack (SURVEY §2.7): AlgorithmConfig →
+Algorithm (a Tune Trainable) → EnvRunnerGroup (CPU actors, fault-tolerant
+manager) + LearnerGroup (JAX learners; on TPU one learner owns a mesh and
+gradient sync is XLA psum, not DDP). PPO (sync, GAE) and IMPALA (async,
+V-trace) ship first; replay buffers cover the off-policy family.
+"""
+
+from ray_tpu.rllib.actor_manager import FaultTolerantActorManager
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.env_runner import SingleAgentEnvRunner
+from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, ImpalaLearner, \
+    compute_vtrace
+from ray_tpu.rllib.learner import JaxLearner, LearnerGroup
+from ray_tpu.rllib.ppo import PPO, PPOConfig, PPOLearner, compute_gae
+from ray_tpu.rllib.replay import PrioritizedReplayBuffer, ReplayBuffer
+from ray_tpu.rllib.rl_module import JaxRLModule, RLModuleSpec
+
+__all__ = [
+    "Algorithm",
+    "AlgorithmConfig",
+    "SingleAgentEnvRunner",
+    "FaultTolerantActorManager",
+    "JaxLearner",
+    "LearnerGroup",
+    "JaxRLModule",
+    "RLModuleSpec",
+    "PPO",
+    "PPOConfig",
+    "PPOLearner",
+    "compute_gae",
+    "IMPALA",
+    "IMPALAConfig",
+    "ImpalaLearner",
+    "compute_vtrace",
+    "ReplayBuffer",
+    "PrioritizedReplayBuffer",
+]

@@ -1,0 +1,67 @@
+"""FaultTolerantActorManager: health-checked fan-out over actor pools.
+
+Role analog: ``rllib/utils/actor_manager.py:196`` — EnvRunnerGroup's
+resilience layer: issue calls to many actors, harvest what succeeds, mark
+and restart the dead.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import ray_tpu
+
+
+class FaultTolerantActorManager:
+    def __init__(self, make_actor: Callable[[int], Any], num_actors: int):
+        self._make_actor = make_actor
+        self._actors: Dict[int, Any] = {
+            i: make_actor(i) for i in range(num_actors)}
+        self._healthy: Dict[int, bool] = {i: True for i in self._actors}
+        self.num_restarts = 0
+
+    def __len__(self):
+        return len(self._actors)
+
+    def healthy_ids(self) -> List[int]:
+        return [i for i, h in self._healthy.items() if h]
+
+    def foreach_actor(self, fn_name: str, *args,
+                      timeout: Optional[float] = None,
+                      **kwargs) -> List[Tuple[int, Any]]:
+        """Call ``fn_name`` on every healthy actor; returns (id, result)
+        for the ones that succeeded, marking failures unhealthy."""
+        refs = {}
+        for i in self.healthy_ids():
+            method = getattr(self._actors[i], fn_name)
+            refs[i] = method.remote(*args, **kwargs)
+        out: List[Tuple[int, Any]] = []
+        for i, ref in refs.items():
+            try:
+                out.append((i, ray_tpu.get(ref, timeout=timeout)))
+            except Exception:
+                self._healthy[i] = False
+        return out
+
+    def probe_and_restore(self) -> int:
+        """Health-check unhealthy actors; recreate the dead ones."""
+        restored = 0
+        for i, healthy in list(self._healthy.items()):
+            if healthy:
+                continue
+            try:
+                ray_tpu.get(self._actors[i].ping.remote(), timeout=5)
+                self._healthy[i] = True
+            except Exception:
+                try:
+                    ray_tpu.kill(self._actors[i])
+                except Exception:
+                    pass
+                self._actors[i] = self._make_actor(i)
+                self._healthy[i] = True
+                self.num_restarts += 1
+                restored += 1
+        return restored
+
+    def actors(self) -> List[Any]:
+        return [self._actors[i] for i in self.healthy_ids()]

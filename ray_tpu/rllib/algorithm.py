@@ -1,0 +1,222 @@
+"""AlgorithmConfig + Algorithm: the RL training driver.
+
+Role analog: ``rllib/algorithms/algorithm.py:213`` (a Tune Trainable whose
+``step`` runs ``training_step``) and the fluent ``AlgorithmConfig``
+(``algorithm_config.py``). EnvRunnerGroup fans out sampling to CPU actors
+via the fault-tolerant manager; the learner group updates on device.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, List, Optional, Type
+
+import numpy as np
+
+from ray_tpu.rllib.actor_manager import FaultTolerantActorManager
+from ray_tpu.rllib.env_runner import SingleAgentEnvRunner
+from ray_tpu.tune.trainable import Trainable
+
+
+class AlgorithmConfig:
+    """Fluent builder (reference ``AlgorithmConfig``): ``.environment()``,
+    ``.env_runners()``, ``.training()``, ``.build()``."""
+
+    def __init__(self, algo_class: Optional[Type["Algorithm"]] = None):
+        self.algo_class = algo_class
+        self.env: Optional[str] = None
+        self.env_config: Dict[str, Any] = {}
+        self.num_env_runners = 0           # 0 => local runner in-process
+        self.num_envs_per_env_runner = 1
+        self.rollout_fragment_length = 200
+        self.num_learners = 0              # 0 => local learner
+        self.lr = 3e-4
+        self.gamma = 0.99
+        self.train_batch_size = 4000
+        self.minibatch_size = 128
+        self.num_epochs = 4
+        self.grad_clip = 0.5
+        self.seed = 0
+        self.extra: Dict[str, Any] = {}
+
+    # -- fluent setters ---------------------------------------------------
+
+    def environment(self, env: str, *, env_config: Optional[Dict] = None
+                    ) -> "AlgorithmConfig":
+        self.env = env
+        if env_config:
+            self.env_config = env_config
+        return self
+
+    def env_runners(self, *, num_env_runners: Optional[int] = None,
+                    num_envs_per_env_runner: Optional[int] = None,
+                    rollout_fragment_length: Optional[int] = None
+                    ) -> "AlgorithmConfig":
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_env_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def learners(self, *, num_learners: Optional[int] = None
+                 ) -> "AlgorithmConfig":
+        if num_learners is not None:
+            self.num_learners = num_learners
+        return self
+
+    def training(self, **kwargs) -> "AlgorithmConfig":
+        for k, v in kwargs.items():
+            if hasattr(self, k):
+                setattr(self, k, v)
+            else:
+                self.extra[k] = v
+        return self
+
+    def debugging(self, *, seed: Optional[int] = None) -> "AlgorithmConfig":
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def copy(self) -> "AlgorithmConfig":
+        return copy.deepcopy(self)
+
+    def update_from_dict(self, d: Dict[str, Any]) -> "AlgorithmConfig":
+        for k, v in d.items():
+            if hasattr(self, k):
+                setattr(self, k, v)
+            else:
+                self.extra[k] = v
+        return self
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = {k: v for k, v in vars(self).items()
+             if k not in ("algo_class",) and not k.startswith("_")}
+        return d
+
+    def build(self) -> "Algorithm":
+        assert self.algo_class is not None, "config has no algorithm class"
+        return self.algo_class(self)
+
+
+class Algorithm(Trainable):
+    """Base RL algorithm; subclasses override ``training_step``."""
+
+    config_cls = AlgorithmConfig
+
+    @classmethod
+    def get_default_config(cls) -> AlgorithmConfig:
+        return cls.config_cls(cls)
+
+    def __init__(self, config, trial_dir: str = "."):
+        # Tune passes a plain dict (trial actor construction); standalone
+        # use passes an AlgorithmConfig.
+        if isinstance(config, dict):
+            config = self.get_default_config().update_from_dict(config)
+        self.algo_config = config
+        super().__init__(config.to_dict(), trial_dir)
+        self._setup_algo()
+        self._setup_done = True
+
+    # Trainable.setup is a no-op; Algorithm wires itself in __init__ so it
+    # can also be used standalone (algo = config.build(); algo.train()).
+    def setup(self, config):
+        pass
+
+    def _setup_algo(self):
+        cfg = self.algo_config
+        # Probe the env once to derive the module spec.
+        probe = SingleAgentEnvRunner(cfg.env, 1, None, cfg.seed,
+                                     cfg.env_config)
+        self.module_spec = probe.get_spec()
+        probe.stop()
+
+        if cfg.num_env_runners > 0:
+            import ray_tpu
+
+            runner_cls = ray_tpu.remote(SingleAgentEnvRunner)
+
+            def make_runner(i: int):
+                return runner_cls.options(num_cpus=1).remote(
+                    cfg.env, cfg.num_envs_per_env_runner, self.module_spec,
+                    cfg.seed + i * 1000 + 1, cfg.env_config)
+
+            self.env_runner_group = FaultTolerantActorManager(
+                make_runner, cfg.num_env_runners)
+            self.local_runner = None
+        else:
+            self.env_runner_group = None
+            self.local_runner = SingleAgentEnvRunner(
+                cfg.env, cfg.num_envs_per_env_runner, self.module_spec,
+                cfg.seed + 1, cfg.env_config)
+
+        self.learner_group = self._make_learner_group()
+        self._iteration = 0
+
+    def _make_learner_group(self):
+        raise NotImplementedError
+
+    # -- sampling ---------------------------------------------------------
+
+    def _sample(self, num_steps: int) -> List[Dict[str, np.ndarray]]:
+        if self.env_runner_group is None:
+            return [self.local_runner.sample(num_steps)]
+        out = self.env_runner_group.foreach_actor("sample", num_steps)
+        self.env_runner_group.probe_and_restore()
+        return [b for _, b in out]
+
+    def _sync_runner_weights(self):
+        weights = self.learner_group.get_weights()
+        if self.env_runner_group is None:
+            self.local_runner.set_weights(weights)
+        else:
+            self.env_runner_group.foreach_actor("set_weights", weights)
+
+    def _runner_metrics(self) -> Dict[str, Any]:
+        if self.env_runner_group is None:
+            return self.local_runner.get_metrics()
+        ms = [m for _, m in self.env_runner_group.foreach_actor("get_metrics")]
+        if not ms:
+            return {}
+        out: Dict[str, Any] = {}
+        for k in ms[0]:
+            vals = [m[k] for m in ms]
+            out[k] = (float(np.mean(vals)) if isinstance(vals[0], float)
+                      else int(np.sum(vals)))
+        return out
+
+    # -- Trainable interface ---------------------------------------------
+
+    def training_step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def step(self) -> Dict[str, Any]:
+        result = self.training_step()
+        result.update(self._runner_metrics())
+        return result
+
+    def train(self) -> Dict[str, Any]:
+        return self.train_step()   # Trainable.train_step adds bookkeeping
+
+    def save_checkpoint(self, checkpoint_dir: str) -> Dict[str, Any]:
+        return {"learner_state": self.learner_group.get_state(),
+                "iteration": self._iteration}
+
+    def load_checkpoint(self, data, checkpoint_dir: str) -> None:
+        if data:
+            self.learner_group.set_state(data["learner_state"])
+            self._iteration = data.get("iteration", 0)
+            self._sync_runner_weights()
+
+    def cleanup(self) -> None:
+        if self.env_runner_group is not None:
+            for a in self.env_runner_group.actors():
+                try:
+                    import ray_tpu
+
+                    ray_tpu.kill(a)
+                except Exception:
+                    pass
+        elif self.local_runner is not None:
+            self.local_runner.stop()

@@ -1,0 +1,120 @@
+"""EnvRunner: CPU actors stepping vectorized gymnasium envs.
+
+Role analog: ``rllib/env/single_agent_env_runner.py`` over gymnasium vector
+envs, managed by ``EnvRunnerGroup`` (``env_runner_group.py:66``) through a
+fault-tolerant actor manager. Env runners are CPU-only; the sampled batch
+ships to the (TPU) learner as numpy, so the host/device split matches the
+reference's sampler/learner split.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class SingleAgentEnvRunner:
+    """Steps N vectorized env copies with the current module weights."""
+
+    def __init__(self, env_name: str, num_envs: int = 1,
+                 module_spec: Optional[Dict[str, Any]] = None,
+                 seed: int = 0, env_config: Optional[Dict[str, Any]] = None):
+        import gymnasium as gym
+
+        import jax
+
+        from ray_tpu.rllib.rl_module import RLModuleSpec
+
+        self.env = gym.make_vec(env_name, num_envs=num_envs,
+                                vectorization_mode="sync",
+                                **(env_config or {}))
+        self.num_envs = num_envs
+        if module_spec is None:
+            from ray_tpu.rllib.rl_module import spec_for_env
+
+            self.spec = spec_for_env(self.env)
+        else:
+            self.spec = RLModuleSpec(**module_spec)
+        self.module = self.spec.build()
+        self.params = self.module.init(jax.random.PRNGKey(seed))
+        self._rng = jax.random.PRNGKey(seed + 1)
+        self._explore_fn = jax.jit(self.module.forward_exploration)
+        self._obs, _ = self.env.reset(seed=seed)
+        self._episode_returns = np.zeros(num_envs)
+        self._episode_lens = np.zeros(num_envs, dtype=np.int64)
+        self._completed_returns: List[float] = []
+        self._completed_lens: List[int] = []
+
+    def set_weights(self, params) -> None:
+        self.params = params
+
+    def get_spec(self) -> Dict[str, Any]:
+        from dataclasses import asdict
+
+        return asdict(self.spec)
+
+    def sample(self, num_steps: int = 200) -> Dict[str, np.ndarray]:
+        """Collect a rollout of ``num_steps`` vector steps.
+
+        Returns a flat batch dict with [T*N, ...] arrays plus episode
+        metrics; bootstrap values handled learner-side via ``next_obs``.
+        """
+        import jax
+
+        obs_buf, act_buf, logp_buf, vf_buf = [], [], [], []
+        rew_buf, done_buf, trunc_buf = [], [], []
+        obs = self._obs
+        for _ in range(num_steps):
+            self._rng, key = jax.random.split(self._rng)
+            out = self._explore_fn(self.params,
+                                   obs.astype(np.float32).reshape(
+                                       self.num_envs, -1), key)
+            action = np.asarray(out["actions"])
+            env_action = action if self.spec.discrete else action.reshape(
+                self.env.action_space.shape)
+            next_obs, reward, term, trunc, _ = self.env.step(env_action)
+            obs_buf.append(obs.reshape(self.num_envs, -1))
+            act_buf.append(action)
+            logp_buf.append(np.asarray(out["action_logp"]))
+            vf_buf.append(np.asarray(out["vf_preds"]))
+            rew_buf.append(reward)
+            done_buf.append(term)
+            trunc_buf.append(trunc)
+            self._episode_returns += reward
+            self._episode_lens += 1
+            finished = np.logical_or(term, trunc)
+            for i in np.flatnonzero(finished):
+                self._completed_returns.append(float(self._episode_returns[i]))
+                self._completed_lens.append(int(self._episode_lens[i]))
+                self._episode_returns[i] = 0.0
+                self._episode_lens[i] = 0
+            obs = next_obs
+        self._obs = obs
+        batch = {
+            "obs": np.stack(obs_buf).astype(np.float32),          # [T, N, D]
+            "actions": np.stack(act_buf),
+            "action_logp": np.stack(logp_buf).astype(np.float32),
+            "vf_preds": np.stack(vf_buf).astype(np.float32),
+            "rewards": np.stack(rew_buf).astype(np.float32),
+            "terminateds": np.stack(done_buf),
+            "truncateds": np.stack(trunc_buf),
+            "next_obs": obs.reshape(self.num_envs, -1).astype(np.float32),
+        }
+        return batch
+
+    def get_metrics(self) -> Dict[str, Any]:
+        m = {
+            "episode_return_mean": (float(np.mean(self._completed_returns[-100:]))
+                                    if self._completed_returns else 0.0),
+            "episode_len_mean": (float(np.mean(self._completed_lens[-100:]))
+                                 if self._completed_lens else 0.0),
+            "num_episodes": len(self._completed_returns),
+        }
+        return m
+
+    def ping(self) -> bool:
+        return True
+
+    def stop(self) -> None:
+        self.env.close()

@@ -1,0 +1,161 @@
+"""IMPALA: asynchronous sampling + V-trace off-policy correction.
+
+Role analog: ``rllib/algorithms/impala/impala.py`` (async sample fan-out,
+learner decoupled from sampling; aggregation tree :676-696 is subsumed by
+the object store — batches ship as refs and concat on the learner side).
+V-trace follows the published recursion (Espeholt et al. 2018), computed
+host-side like PPO's GAE.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.learner import JaxLearner, LearnerGroup
+
+
+def compute_vtrace(behavior_logp, target_logp, rewards, values, dones,
+                   last_values, gamma: float,
+                   clip_rho: float = 1.0, clip_c: float = 1.0):
+    """V-trace targets over [T, N] arrays (host-side numpy)."""
+    t_len, n = rewards.shape
+    rhos = np.exp(target_logp - behavior_logp)
+    clipped_rho = np.minimum(rhos, clip_rho)
+    cs = np.minimum(rhos, clip_c)
+    nonterminal = 1.0 - dones.astype(np.float32)
+
+    next_values = np.concatenate([values[1:], last_values[None]], axis=0)
+    deltas = clipped_rho * (rewards + gamma * next_values * nonterminal
+                            - values)
+    vs_minus_v = np.zeros((t_len + 1, n), np.float32)
+    for t in range(t_len - 1, -1, -1):
+        vs_minus_v[t] = deltas[t] + gamma * cs[t] * nonterminal[t] * \
+            vs_minus_v[t + 1]
+    vs = vs_minus_v[:-1] + values
+    next_vs = np.concatenate([vs[1:], last_values[None]], axis=0)
+    pg_advantages = clipped_rho * (
+        rewards + gamma * next_vs * nonterminal - values)
+    return vs, pg_advantages
+
+
+class ImpalaLearner(JaxLearner):
+    def compute_loss(self, params, batch):
+        import jax.numpy as jnp
+
+        cfg = self.config
+        vf_coeff = cfg.get("vf_loss_coeff", 0.5)
+        ent_coeff = cfg.get("entropy_coeff", 0.01)
+
+        out = self.module.forward_train(params, batch["obs"])
+        logp, entropy = self.module.logp_entropy(out, batch["actions"])
+        pg_loss = -(logp * batch["pg_advantages"]).mean()
+        vf_loss = jnp.square(out["vf_preds"] - batch["vs"]).mean()
+        ent = entropy.mean()
+        loss = pg_loss + vf_coeff * vf_loss - ent_coeff * ent
+        return loss, {"policy_loss": pg_loss, "vf_loss": vf_loss,
+                      "entropy": ent}
+
+
+class IMPALAConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or IMPALA)
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.clip_rho = 1.0
+        self.clip_c = 1.0
+        self.lr = 5e-4
+        self.num_epochs = 1          # off-policy: single pass
+
+    def copy(self):
+        import copy as _copy
+
+        return _copy.deepcopy(self)
+
+
+class IMPALA(Algorithm):
+    config_cls = IMPALAConfig
+
+    def _make_learner_group(self):
+        cfg = self.algo_config
+        learner_cfg = {
+            "lr": cfg.lr, "grad_clip": cfg.grad_clip,
+            "vf_loss_coeff": getattr(cfg, "vf_loss_coeff", 0.5),
+            "entropy_coeff": getattr(cfg, "entropy_coeff", 0.01),
+        }
+        return LearnerGroup(ImpalaLearner, self.module_spec, learner_cfg,
+                            num_learners=cfg.num_learners, seed=cfg.seed)
+
+    def _setup_algo(self):
+        super()._setup_algo()
+        self._inflight: Dict[Any, int] = {}
+
+    def training_step(self) -> Dict[str, Any]:
+        """Async: keep one sample() in flight per runner; update on what
+        arrives this tick (the learner never waits for stragglers)."""
+        import ray_tpu
+
+        cfg = self.algo_config
+        if self.env_runner_group is None:
+            batches = [self.local_runner.sample(cfg.rollout_fragment_length)]
+        else:
+            # launch/refresh in-flight sampling on every healthy runner
+            for i in self.env_runner_group.healthy_ids():
+                actor = self.env_runner_group._actors[i]
+                if i not in self._inflight:
+                    self._inflight[i] = actor.sample.remote(
+                        cfg.rollout_fragment_length)
+            ready, _ = ray_tpu.wait(list(self._inflight.values()),
+                                    num_returns=1, timeout=60)
+            batches = []
+            done_ids = [i for i, r in self._inflight.items() if r in ready]
+            for i in done_ids:
+                try:
+                    batches.append(ray_tpu.get(self._inflight.pop(i)))
+                except Exception:
+                    self.env_runner_group._healthy[i] = False
+            self.env_runner_group.probe_and_restore()
+            if not batches:
+                return {"num_env_steps_sampled": 0}
+
+        train_batch = self._postprocess(batches)
+        metrics = self.learner_group.update(train_batch, num_epochs=1)
+        self._sync_runner_weights()
+        self._iteration += 1
+        metrics["num_env_steps_sampled"] = len(train_batch["obs"])
+        return metrics
+
+    def _postprocess(self, batches: List[Dict[str, np.ndarray]]
+                     ) -> Dict[str, np.ndarray]:
+        cfg = self.algo_config
+        weights = self.learner_group.get_weights()
+        from ray_tpu.rllib.rl_module import RLModuleSpec
+
+        module = RLModuleSpec(**self.module_spec).build()
+        outs = []
+        for b in batches:
+            t_len, n = b["rewards"].shape
+            flat_obs = b["obs"].reshape(t_len * n, -1)
+            out = module.forward_train(weights, flat_obs)
+            target_logp, _ = module.logp_entropy(
+                out, b["actions"].reshape(t_len * n,
+                                          *b["actions"].shape[2:]))
+            target_logp = np.asarray(target_logp).reshape(t_len, n)
+            values = np.asarray(out["vf_preds"]).reshape(t_len, n)
+            last_out = module.forward_train(weights, b["next_obs"])
+            last_values = np.asarray(last_out["vf_preds"])
+            vs, pg_adv = compute_vtrace(
+                b["action_logp"], target_logp, b["rewards"], values,
+                np.logical_or(b["terminateds"], b["truncateds"]),
+                last_values, cfg.gamma,
+                getattr(cfg, "clip_rho", 1.0), getattr(cfg, "clip_c", 1.0))
+            outs.append({
+                "obs": flat_obs,
+                "actions": b["actions"].reshape(t_len * n,
+                                                *b["actions"].shape[2:]),
+                "pg_advantages": pg_adv.reshape(-1).astype(np.float32),
+                "vs": vs.reshape(-1).astype(np.float32),
+            })
+        return {k: np.concatenate([o[k] for o in outs]) for k in outs[0]}

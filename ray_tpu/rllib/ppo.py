@@ -1,0 +1,167 @@
+"""PPO: clipped-surrogate policy optimization with GAE.
+
+Role analog: ``rllib/algorithms/ppo/ppo.py:421`` (new-API-stack
+``_training_step_new_api_stack :430``: synchronous sampling → learner
+update → weight sync). The loss matches the reference PPO learner: clipped
+surrogate + value loss (clipped) + entropy bonus; advantages via GAE
+computed on-host (numpy) before the batch ships to the device.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.learner import JaxLearner, LearnerGroup
+
+
+def compute_gae(rewards, values, dones, truncateds, last_values,
+                gamma: float, lam: float):
+    """GAE over [T, N] arrays; episode boundaries cut the recursion.
+
+    Truncated (time-limit) ends bootstrap from the value estimate; true
+    terminations zero the bootstrap.
+    """
+    t_len, n = rewards.shape
+    adv = np.zeros((t_len, n), np.float32)
+    last_gae = np.zeros((n,), np.float32)
+    next_value = last_values
+    for t in range(t_len - 1, -1, -1):
+        # bootstrap unless a true termination happened at step t
+        nonterminal = 1.0 - dones[t].astype(np.float32)
+        delta = rewards[t] + gamma * next_value * nonterminal - values[t]
+        episode_end = np.logical_or(dones[t], truncateds[t])
+        last_gae = delta + gamma * lam * nonterminal * last_gae * (
+            1.0 - truncateds[t].astype(np.float32))
+        adv[t] = last_gae
+        # reset the recursion across episode boundaries
+        last_gae = last_gae * (1.0 - episode_end.astype(np.float32))
+        next_value = values[t]
+    returns = adv + values
+    return adv, returns
+
+
+class PPOLearner(JaxLearner):
+    def compute_loss(self, params, batch):
+        import jax.numpy as jnp
+
+        cfg = self.config
+        clip = cfg.get("clip_param", 0.2)
+        vf_clip = cfg.get("vf_clip_param", 10.0)
+        vf_coeff = cfg.get("vf_loss_coeff", 0.5)
+        ent_coeff = cfg.get("entropy_coeff", 0.0)
+
+        out = self.module.forward_train(params, batch["obs"])
+        logp, entropy = self.module.logp_entropy(out, batch["actions"])
+        ratio = jnp.exp(logp - batch["action_logp"])
+        adv = batch["advantages"]
+        surr = jnp.minimum(
+            ratio * adv,
+            jnp.clip(ratio, 1 - clip, 1 + clip) * adv)
+        policy_loss = -surr.mean()
+
+        vf = out["vf_preds"]
+        vf_err = jnp.square(vf - batch["value_targets"])
+        vf_clipped = batch["vf_preds"] + jnp.clip(
+            vf - batch["vf_preds"], -vf_clip, vf_clip)
+        vf_err_clipped = jnp.square(vf_clipped - batch["value_targets"])
+        vf_loss = jnp.maximum(vf_err, vf_err_clipped).mean()
+
+        ent = entropy.mean()
+        loss = policy_loss + vf_coeff * vf_loss - ent_coeff * ent
+        kl = (batch["action_logp"] - logp).mean()
+        return loss, {
+            "policy_loss": policy_loss,
+            "vf_loss": vf_loss,
+            "entropy": ent,
+            "kl": kl,
+        }
+
+
+class PPOConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or PPO)
+        self.lam = 0.95
+        self.clip_param = 0.2
+        self.vf_clip_param = 10.0
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.0
+        self.lr = 5e-5
+
+
+class PPO(Algorithm):
+    config_cls = PPOConfig
+
+    def _make_learner_group(self):
+        cfg = self.algo_config
+        learner_cfg = {
+            "lr": cfg.lr, "grad_clip": cfg.grad_clip,
+            "clip_param": getattr(cfg, "clip_param", 0.2),
+            "vf_clip_param": getattr(cfg, "vf_clip_param", 10.0),
+            "vf_loss_coeff": getattr(cfg, "vf_loss_coeff", 0.5),
+            "entropy_coeff": getattr(cfg, "entropy_coeff", 0.0),
+        }
+        return LearnerGroup(PPOLearner, self.module_spec, learner_cfg,
+                            num_learners=cfg.num_learners, seed=cfg.seed)
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax
+
+        cfg = self.algo_config
+        # 1. synchronous parallel sampling (reference ppo.py:435)
+        batches = self._sample(cfg.rollout_fragment_length)
+        train_batch = self._postprocess(batches)
+        # 2. learner update (reference ppo.py:478)
+        metrics = self.learner_group.update(
+            train_batch,
+            minibatch_size=cfg.minibatch_size,
+            num_epochs=cfg.num_epochs,
+        )
+        # 3. broadcast new weights to env runners (reference ppo.py:501)
+        self._sync_runner_weights()
+        self._iteration += 1
+        metrics["num_env_steps_sampled"] = int(
+            len(train_batch["obs"]))
+        return metrics
+
+    def _postprocess(self, batches: List[Dict[str, np.ndarray]]
+                     ) -> Dict[str, np.ndarray]:
+        import jax
+
+        cfg = self.algo_config
+        outs = []
+        weights = None
+        for b in batches:
+            # bootstrap value for the last observation of each env
+            if weights is None:
+                weights = self.learner_group.get_weights()
+            module = (self.local_runner.module if self.local_runner
+                      else None)
+            if module is None:
+                from ray_tpu.rllib.rl_module import RLModuleSpec
+
+                module = RLModuleSpec(**self.module_spec).build()
+            last_out = module.forward_train(weights, b["next_obs"])
+            last_values = np.asarray(last_out["vf_preds"])
+            adv, ret = compute_gae(
+                b["rewards"], b["vf_preds"], b["terminateds"],
+                b["truncateds"], last_values, cfg.gamma,
+                getattr(cfg, "lam", 0.95))
+            t_len, n = b["rewards"].shape
+            flat = {
+                "obs": b["obs"].reshape(t_len * n, -1),
+                "actions": b["actions"].reshape(t_len * n, *b["actions"].shape[2:]),
+                "action_logp": b["action_logp"].reshape(-1),
+                "vf_preds": b["vf_preds"].reshape(-1),
+                "advantages": adv.reshape(-1),
+                "value_targets": ret.reshape(-1),
+            }
+            outs.append(flat)
+        merged = {k: np.concatenate([o[k] for o in outs]) for k in outs[0]}
+        # advantage normalization (reference PPO default)
+        a = merged["advantages"]
+        merged["advantages"] = ((a - a.mean()) / max(a.std(), 1e-6)
+                                ).astype(np.float32)
+        return merged

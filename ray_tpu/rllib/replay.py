@@ -1,0 +1,79 @@
+"""Replay buffers: uniform + prioritized.
+
+Role analog: ``rllib/utils/replay_buffers/`` (the episode/prioritized
+variants used by DQN/SAC). Numpy ring buffers; sampling returns column
+batches ready for the jitted learner step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+class ReplayBuffer:
+    """Uniform-sampling ring buffer of transition dicts."""
+
+    def __init__(self, capacity: int = 100_000, seed: int = 0):
+        self.capacity = capacity
+        self._storage: Dict[str, np.ndarray] = {}
+        self._idx = 0
+        self._size = 0
+        self._rng = np.random.default_rng(seed)
+
+    def __len__(self):
+        return self._size
+
+    def add(self, batch: Dict[str, np.ndarray]) -> None:
+        n = len(next(iter(batch.values())))
+        if not self._storage:
+            for k, v in batch.items():
+                self._storage[k] = np.zeros((self.capacity,) + v.shape[1:],
+                                            v.dtype)
+        for i in range(n):
+            for k, v in batch.items():
+                self._storage[k][self._idx] = v[i]
+            self._idx = (self._idx + 1) % self.capacity
+            self._size = min(self._size + 1, self.capacity)
+
+    def sample(self, batch_size: int) -> Dict[str, np.ndarray]:
+        idx = self._rng.integers(0, self._size, batch_size)
+        return {k: v[idx] for k, v in self._storage.items()}
+
+
+class PrioritizedReplayBuffer(ReplayBuffer):
+    """Proportional prioritized replay (Schaul et al. 2015)."""
+
+    def __init__(self, capacity: int = 100_000, alpha: float = 0.6,
+                 beta: float = 0.4, seed: int = 0):
+        super().__init__(capacity, seed)
+        self.alpha = alpha
+        self.beta = beta
+        self._priorities = np.zeros((capacity,), np.float64)
+        self._max_priority = 1.0
+
+    def add(self, batch: Dict[str, np.ndarray]) -> None:
+        n = len(next(iter(batch.values())))
+        start = self._idx
+        super().add(batch)
+        for off in range(n):
+            self._priorities[(start + off) % self.capacity] = \
+                self._max_priority
+
+    def sample(self, batch_size: int) -> Dict[str, np.ndarray]:
+        prios = self._priorities[:self._size] ** self.alpha
+        probs = prios / prios.sum()
+        idx = self._rng.choice(self._size, batch_size, p=probs)
+        weights = (self._size * probs[idx]) ** (-self.beta)
+        weights = weights / weights.max()
+        out = {k: v[idx] for k, v in self._storage.items()}
+        out["weights"] = weights.astype(np.float32)
+        out["batch_indexes"] = idx.astype(np.int64)
+        return out
+
+    def update_priorities(self, indexes: np.ndarray,
+                          priorities: np.ndarray) -> None:
+        priorities = np.abs(priorities) + 1e-6
+        self._priorities[indexes] = priorities
+        self._max_priority = max(self._max_priority, priorities.max())

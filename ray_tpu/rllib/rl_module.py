@@ -1,0 +1,150 @@
+"""RLModule: framework-native model abstraction for RL.
+
+Role analog: ``rllib/core/rl_module/rl_module.py`` (the new-API-stack
+replacement for ModelV2). A JaxRLModule is a pure-function bundle over a
+param pytree: ``init`` builds params, ``forward_exploration`` /
+``forward_inference`` / ``forward_train`` mirror the reference's three
+forward modes. The default module is an MLP actor-critic (discrete or
+continuous); everything jits and shards like any other param pytree.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class RLModuleSpec:
+    """Construction-from-config (reference ``SingleAgentRLModuleSpec``)."""
+
+    observation_dim: int
+    action_dim: int
+    discrete: bool = True
+    hidden: Tuple[int, ...] = (64, 64)
+    activation: str = "tanh"
+
+    def build(self) -> "JaxRLModule":
+        return JaxRLModule(self)
+
+
+def _act(name: str):
+    return {"tanh": jnp.tanh, "relu": jax.nn.relu,
+            "gelu": jax.nn.gelu}[name]
+
+
+def _mlp_init(key, sizes: Sequence[int]) -> Dict[str, Any]:
+    layers = []
+    keys = jax.random.split(key, len(sizes) - 1)
+    for k, (fan_in, fan_out) in zip(keys, zip(sizes[:-1], sizes[1:])):
+        w = jax.random.normal(k, (fan_in, fan_out), jnp.float32)
+        w = w * np.sqrt(2.0 / fan_in)
+        layers.append({"w": w, "b": jnp.zeros((fan_out,), jnp.float32)})
+    return {"layers": layers}
+
+
+def _mlp_apply(params, x, activation):
+    act = _act(activation)
+    layers = params["layers"]
+    for i, lyr in enumerate(layers):
+        x = x @ lyr["w"] + lyr["b"]
+        if i < len(layers) - 1:
+            x = act(x)
+    return x
+
+
+class JaxRLModule:
+    """Actor-critic module: pi (policy head) + vf (value head)."""
+
+    def __init__(self, spec: RLModuleSpec):
+        self.spec = spec
+
+    def init(self, rng: jax.Array) -> Dict[str, Any]:
+        k_pi, k_vf, k_logstd = jax.random.split(rng, 3)
+        out_dim = self.spec.action_dim
+        params = {
+            "pi": _mlp_init(k_pi, (self.spec.observation_dim,
+                                   *self.spec.hidden, out_dim)),
+            "vf": _mlp_init(k_vf, (self.spec.observation_dim,
+                                   *self.spec.hidden, 1)),
+        }
+        if not self.spec.discrete:
+            params["log_std"] = jnp.zeros((out_dim,), jnp.float32)
+        return params
+
+    # -- forward modes ----------------------------------------------------
+
+    def forward_train(self, params, obs) -> Dict[str, jax.Array]:
+        logits = _mlp_apply(params["pi"], obs, self.spec.activation)
+        vf = _mlp_apply(params["vf"], obs, self.spec.activation)[..., 0]
+        out = {"action_dist_inputs": logits, "vf_preds": vf}
+        if not self.spec.discrete:
+            out["log_std"] = params["log_std"]
+        return out
+
+    def forward_exploration(self, params, obs, rng) -> Dict[str, jax.Array]:
+        out = self.forward_train(params, obs)
+        logits = out["action_dist_inputs"]
+        if self.spec.discrete:
+            action = jax.random.categorical(rng, logits)
+            logp = jax.nn.log_softmax(logits)[
+                jnp.arange(logits.shape[0]), action]
+        else:
+            std = jnp.exp(out["log_std"])
+            noise = jax.random.normal(rng, logits.shape)
+            action = logits + std * noise
+            logp = _diag_gaussian_logp(action, logits, out["log_std"])
+        out["actions"] = action
+        out["action_logp"] = logp
+        return out
+
+    def forward_inference(self, params, obs) -> Dict[str, jax.Array]:
+        out = self.forward_train(params, obs)
+        logits = out["action_dist_inputs"]
+        out["actions"] = (jnp.argmax(logits, axis=-1) if self.spec.discrete
+                          else logits)
+        return out
+
+    # -- distribution helpers --------------------------------------------
+
+    def logp_entropy(self, params_out: Dict[str, jax.Array],
+                     actions) -> Tuple[jax.Array, jax.Array]:
+        logits = params_out["action_dist_inputs"]
+        if self.spec.discrete:
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, actions[..., None].astype(jnp.int32), axis=-1)[..., 0]
+            p = jnp.exp(logp_all)
+            entropy = -(p * logp_all).sum(-1)
+        else:
+            log_std = params_out["log_std"]
+            logp = _diag_gaussian_logp(actions, logits, log_std)
+            entropy = (0.5 * (1.0 + np.log(2 * np.pi)) + log_std).sum(-1)
+            entropy = jnp.broadcast_to(entropy, logp.shape)
+        return logp, entropy
+
+
+def _diag_gaussian_logp(x, mean, log_std):
+    var = jnp.exp(2 * log_std)
+    return (-0.5 * ((x - mean) ** 2 / var + 2 * log_std +
+                    np.log(2 * np.pi))).sum(-1)
+
+
+def spec_for_env(env) -> RLModuleSpec:
+    import gymnasium as gym
+
+    obs_space = env.single_observation_space if hasattr(
+        env, "single_observation_space") else env.observation_space
+    act_space = env.single_action_space if hasattr(
+        env, "single_action_space") else env.action_space
+    obs_dim = int(np.prod(obs_space.shape))
+    if isinstance(act_space, gym.spaces.Discrete):
+        return RLModuleSpec(observation_dim=obs_dim,
+                            action_dim=int(act_space.n), discrete=True)
+    return RLModuleSpec(observation_dim=obs_dim,
+                        action_dim=int(np.prod(act_space.shape)),
+                        discrete=False)

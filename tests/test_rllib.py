@@ -1,0 +1,182 @@
+"""RLlib: GAE/vtrace math, modules, PPO learning CartPole, IMPALA, replay."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def rt_rl():
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_compute_gae_simple():
+    from ray_tpu.rllib import compute_gae
+
+    # single env, no dones: GAE with lam=1 == discounted MC - values
+    t_len = 5
+    rewards = np.ones((t_len, 1), np.float32)
+    values = np.zeros((t_len, 1), np.float32)
+    dones = np.zeros((t_len, 1), bool)
+    truncs = np.zeros((t_len, 1), bool)
+    last_v = np.zeros((1,), np.float32)
+    adv, ret = compute_gae(rewards, values, dones, truncs, last_v,
+                           gamma=0.9, lam=1.0)
+    expect_t0 = sum(0.9 ** i for i in range(t_len))
+    assert adv[0, 0] == pytest.approx(expect_t0)
+    assert ret[0, 0] == pytest.approx(expect_t0)
+
+
+def test_compute_gae_respects_done():
+    from ray_tpu.rllib import compute_gae
+
+    rewards = np.array([[1.0], [1.0]], np.float32)
+    values = np.zeros((2, 1), np.float32)
+    dones = np.array([[True], [False]])
+    truncs = np.zeros((2, 1), bool)
+    last_v = np.ones((1,), np.float32) * 100
+    adv, _ = compute_gae(rewards, values, dones, truncs, last_v,
+                         gamma=0.9, lam=1.0)
+    # step0 ends an episode: no bootstrap across it
+    assert adv[0, 0] == pytest.approx(1.0)
+
+
+def test_vtrace_on_policy_reduces_to_returns():
+    from ray_tpu.rllib import compute_vtrace
+
+    t_len = 4
+    logp = np.zeros((t_len, 1), np.float32)
+    rewards = np.ones((t_len, 1), np.float32)
+    values = np.zeros((t_len, 1), np.float32)
+    dones = np.zeros((t_len, 1), bool)
+    last_v = np.zeros((1,), np.float32)
+    vs, pg_adv = compute_vtrace(logp, logp, rewards, values, dones,
+                                last_v, gamma=0.9)
+    expect = sum(0.9 ** i for i in range(t_len))
+    assert vs[0, 0] == pytest.approx(expect)
+
+
+def test_rl_module_forward_shapes():
+    import jax
+
+    from ray_tpu.rllib import RLModuleSpec
+
+    spec = RLModuleSpec(observation_dim=4, action_dim=2)
+    mod = spec.build()
+    params = mod.init(jax.random.PRNGKey(0))
+    obs = np.zeros((7, 4), np.float32)
+    out = mod.forward_train(params, obs)
+    assert out["action_dist_inputs"].shape == (7, 2)
+    assert out["vf_preds"].shape == (7,)
+    exp = mod.forward_exploration(params, obs, jax.random.PRNGKey(1))
+    assert exp["actions"].shape == (7,)
+    logp, ent = mod.logp_entropy(out, np.zeros((7,), np.int64))
+    assert logp.shape == (7,) and ent.shape == (7,)
+    assert np.all(np.asarray(ent) > 0)
+
+
+def test_env_runner_samples(rt_rl):
+    from ray_tpu.rllib import SingleAgentEnvRunner
+
+    runner = SingleAgentEnvRunner("CartPole-v1", num_envs=2, seed=0)
+    batch = runner.sample(num_steps=10)
+    assert batch["obs"].shape == (10, 2, 4)
+    assert batch["actions"].shape == (10, 2)
+    assert batch["next_obs"].shape == (2, 4)
+    runner.stop()
+
+
+def test_replay_buffers():
+    from ray_tpu.rllib import PrioritizedReplayBuffer, ReplayBuffer
+
+    buf = ReplayBuffer(capacity=100, seed=0)
+    buf.add({"x": np.arange(150, dtype=np.float32)})
+    assert len(buf) == 100
+    s = buf.sample(32)
+    assert s["x"].shape == (32,)
+
+    pbuf = PrioritizedReplayBuffer(capacity=50, seed=0)
+    pbuf.add({"x": np.arange(50, dtype=np.float32)})
+    s = pbuf.sample(16)
+    assert "weights" in s and "batch_indexes" in s
+    pbuf.update_priorities(s["batch_indexes"], np.full(16, 10.0))
+    s2 = pbuf.sample(1000)
+    # heavily prioritized indexes dominate the resample
+    frac = np.isin(s2["batch_indexes"], s["batch_indexes"]).mean()
+    assert frac > 0.5
+
+
+def test_ppo_learns_cartpole_local(rt_rl):
+    from ray_tpu.rllib import PPOConfig
+
+    config = (PPOConfig()
+              .environment("CartPole-v1")
+              .env_runners(num_envs_per_env_runner=8,
+                           rollout_fragment_length=256)
+              .training(lr=3e-4, minibatch_size=256, num_epochs=8,
+                        entropy_coeff=0.01)
+              .debugging(seed=0))
+    algo = config.build()
+    returns = []
+    for _ in range(10):
+        result = algo.train()
+        returns.append(result.get("episode_return_mean", 0.0))
+    algo.cleanup()
+    # CartPole starts ~20; PPO should clearly improve within ~20k steps
+    assert max(returns[-4:]) > 60, f"PPO failed to learn: {returns}"
+
+
+def test_ppo_remote_env_runners(rt_rl):
+    from ray_tpu.rllib import PPOConfig
+
+    config = (PPOConfig()
+              .environment("CartPole-v1")
+              .env_runners(num_env_runners=2, num_envs_per_env_runner=2,
+                           rollout_fragment_length=64)
+              .training(minibatch_size=64, num_epochs=2)
+              .debugging(seed=0))
+    algo = config.build()
+    result = algo.train()
+    assert result["num_env_steps_sampled"] == 64 * 2 * 2
+    assert "policy_loss" in result
+    algo.cleanup()
+
+
+def test_impala_single_step(rt_rl):
+    from ray_tpu.rllib import IMPALAConfig
+
+    config = (IMPALAConfig()
+              .environment("CartPole-v1")
+              .env_runners(num_envs_per_env_runner=2,
+                           rollout_fragment_length=32)
+              .debugging(seed=0))
+    algo = config.build()
+    result = algo.train()
+    assert "policy_loss" in result
+    assert result["num_env_steps_sampled"] == 64
+    algo.cleanup()
+
+
+def test_algorithm_checkpoint_roundtrip(rt_rl, tmp_path):
+    from ray_tpu.rllib import PPOConfig
+
+    config = (PPOConfig().environment("CartPole-v1")
+              .env_runners(rollout_fragment_length=32)
+              .training(minibatch_size=32, num_epochs=1))
+    algo = config.build()
+    algo.train()
+    data = algo.save_checkpoint(str(tmp_path))
+    w0 = algo.learner_group.get_weights()
+
+    algo2 = config.copy().build()
+    algo2.load_checkpoint(data, str(tmp_path))
+    w1 = algo2.learner_group.get_weights()
+    import jax
+
+    for a, b in zip(jax.tree.leaves(w0), jax.tree.leaves(w1)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    algo.cleanup()
+    algo2.cleanup()
