@@ -1,0 +1,156 @@
+"""ctypes bindings for the C++ native runtime components.
+
+Role analog: the reference's Cython bridge (``python/ray/_raylet.pyx``) in
+miniature — the native pieces are C++ (``native/``), and Python talks to
+them through a flat C API (ctypes; pybind11 isn't in the image). The .so is
+built on first use with g++ and cached; every consumer must handle
+``load_store_lib() is None`` and fall back to the pure-Python path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "build", "librtpu_store.so")
+
+_lib_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_lib_failed = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["make", "-C", _NATIVE_DIR, "-s"],
+            check=True, capture_output=True, timeout=120)
+        return os.path.exists(_SO_PATH)
+    except Exception:
+        return False
+
+
+def load_store_lib() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native store library, or None."""
+    global _lib, _lib_failed
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if _lib_failed:
+            return None
+        if not os.path.exists(_SO_PATH) and not _build():
+            _lib_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError:
+            _lib_failed = True
+            return None
+        lib.rtpu_store_open.restype = ctypes.c_void_p
+        lib.rtpu_store_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.rtpu_store_close.argtypes = [ctypes.c_void_p]
+        lib.rtpu_store_destroy.argtypes = [ctypes.c_char_p]
+        lib.rtpu_create.restype = ctypes.c_uint64
+        lib.rtpu_create.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_uint64]
+        lib.rtpu_seal.restype = ctypes.c_int
+        lib.rtpu_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rtpu_get.restype = ctypes.c_uint64
+        lib.rtpu_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                 ctypes.POINTER(ctypes.c_uint64)]
+        lib.rtpu_contains.restype = ctypes.c_int
+        lib.rtpu_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rtpu_release.restype = ctypes.c_int
+        lib.rtpu_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rtpu_delete.restype = ctypes.c_int
+        lib.rtpu_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rtpu_evict.restype = ctypes.c_uint64
+        lib.rtpu_evict.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.rtpu_stats.argtypes = [ctypes.c_void_p] + \
+            [ctypes.POINTER(ctypes.c_uint64)] * 3
+        lib.rtpu_base.restype = ctypes.c_void_p
+        lib.rtpu_base.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+_ID_BYTES = 20  # kIdBytes in native/store.cc
+
+
+def _pad_id(obj_id: bytes) -> bytes:
+    """Normalize an id to exactly the native id width (the C side reads a
+    fixed 20 bytes; shorter ids would make ctypes read past the buffer)."""
+    return obj_id[:_ID_BYTES].ljust(_ID_BYTES, b"\x00")
+
+
+class NativeArena:
+    """Python handle over one native store arena."""
+
+    def __init__(self, session: str, capacity: int = 1 << 30):
+        lib = load_store_lib()
+        if lib is None:
+            raise RuntimeError("native store library unavailable")
+        self._lib = lib
+        self.name = f"/rtpu-arena-{session}".encode()
+        self._store = lib.rtpu_store_open(self.name, capacity)
+        if not self._store:
+            raise RuntimeError("failed to open native arena")
+        self._base = lib.rtpu_base(self._store)
+
+    def create(self, obj_id: bytes, size: int) -> Optional[memoryview]:
+        off = self._lib.rtpu_create(self._store, _pad_id(obj_id), size)
+        if off == 0:
+            return None
+        buf = (ctypes.c_char * size).from_address(self._base + off)
+        return memoryview(buf).cast("B")
+
+    def seal(self, obj_id: bytes) -> None:
+        self._lib.rtpu_seal(self._store, _pad_id(obj_id))
+
+    def get(self, obj_id: bytes) -> Optional[memoryview]:
+        size = ctypes.c_uint64()
+        off = self._lib.rtpu_get(self._store, _pad_id(obj_id), ctypes.byref(size))
+        if off == 0:
+            return None
+        buf = (ctypes.c_char * size.value).from_address(self._base + off)
+        # Readonly: sealed objects are immutable shared memory; a writable
+        # view would let `get` callers silently corrupt every other reader
+        # (the mmap fallback maps PROT_READ for the same reason).
+        return memoryview(buf).cast("B").toreadonly()
+
+    def contains(self, obj_id: bytes) -> bool:
+        return bool(self._lib.rtpu_contains(self._store, _pad_id(obj_id)))
+
+    def release(self, obj_id: bytes) -> None:
+        self._lib.rtpu_release(self._store, _pad_id(obj_id))
+
+    def delete(self, obj_id: bytes) -> None:
+        self._lib.rtpu_delete(self._store, _pad_id(obj_id))
+
+    def evict(self, nbytes: int) -> int:
+        return int(self._lib.rtpu_evict(self._store, nbytes))
+
+    def stats(self) -> dict:
+        cap = ctypes.c_uint64()
+        used = ctypes.c_uint64()
+        num = ctypes.c_uint64()
+        self._lib.rtpu_stats(self._store, ctypes.byref(cap),
+                             ctypes.byref(used), ctypes.byref(num))
+        return {"capacity": cap.value, "used": used.value,
+                "num_objects": num.value}
+
+    def close(self) -> None:
+        if self._store:
+            self._lib.rtpu_store_close(self._store)
+            self._store = None
+
+    @staticmethod
+    def destroy(session: str) -> None:
+        lib = load_store_lib()
+        if lib is not None:
+            lib.rtpu_store_destroy(f"/rtpu-arena-{session}".encode())

@@ -33,23 +33,54 @@ def _seg_path(session: str, obj_id: ObjectID) -> str:
 
 
 class _Pinned:
-    """A mapped segment kept alive while any deserialized view exists."""
+    """A mapped segment kept alive while any deserialized view exists.
 
-    __slots__ = ("mm", "fd", "size")
+    ``fd == -2`` marks a native-arena pin; ``baseline`` is the refcount of
+    the view's base exporter right after pinning — a later refcount above
+    it means deserialized zero-copy views are still alive.
+    """
 
-    def __init__(self, mm: mmap.mmap, fd: int, size: int):
+    __slots__ = ("mm", "fd", "size", "baseline")
+
+    def __init__(self, mm, fd: int, size: int, baseline: int = 0):
         self.mm = mm
         self.fd = fd
         self.size = size
+        self.baseline = baseline
 
 
 class StoreClient:
-    """Per-process object-store client."""
+    """Per-process object-store client.
+
+    Backend selection: the C++ arena store (``native/store.cc`` via
+    ``ray_tpu._native``) when the library builds/loads — one shm arena per
+    session with a free-list allocator, refcounts, and LRU eviction (the
+    plasma-role design) — else the file-per-object fallback above. Both
+    share this client API; ``RTPU_NATIVE_STORE=0`` forces the fallback.
+    """
 
     def __init__(self, session: str):
         self.session = session
         self._pins: Dict[ObjectID, _Pinned] = {}
         self._lock = threading.Lock()
+        self._arena = None
+        if os.environ.get("RTPU_NATIVE_STORE", "1") != "0":
+            try:
+                from ray_tpu._native import NativeArena
+
+                capacity = int(os.environ.get(
+                    "RTPU_STORE_CAPACITY", str(1 << 30)))
+                self._arena = NativeArena(session, capacity)
+            except Exception as e:
+                # Loud fallback: a process silently diverging to the file
+                # backend while peers use the arena cannot read their
+                # arena-stored objects.
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "native object store unavailable (%s); "
+                    "falling back to file-per-object segments", e)
+                self._arena = None
 
     # -- write path -------------------------------------------------------
 
@@ -71,6 +102,19 @@ class StoreClient:
             out = bytearray(size)
             serialization.write_into(memoryview(out), data, buffers)
             return bytes(out)
+        if self._arena is not None:
+            view = self._arena.create(obj_id.binary(), size)
+            if view is not None:
+                serialization.write_into(view, data, buffers)
+                del view
+                self._arena.seal(obj_id.binary())
+                # The create-ref is NOT released: it is the object
+                # directory's reference, dropped only by delete(). Sealed
+                # objects with it held are never evicted, so live
+                # ObjectRefs can't lose data to allocation pressure.
+                return None
+            # arena full: fall through to a file segment (never evict
+            # referenced objects to make room)
         path = _seg_path(self.session, obj_id)
         fd = os.open(path, os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
         try:
@@ -100,6 +144,32 @@ class StoreClient:
         """Deserialize from shm; zero-copy views pin the mapping."""
         with self._lock:
             pinned = self._pins.get(obj_id)
+        if pinned is None and self._arena is not None:
+            view = self._arena.get(obj_id.binary())
+            if view is not None:
+                import numpy as _np
+
+                # Root all exports at a numpy base array: every consumer
+                # view chain holds one ref on it, so liveness is
+                # observable via getrefcount (the ctypes view itself
+                # doesn't expose its export count).
+                base = _np.frombuffer(view, dtype=_np.uint8)
+                took_pin = False
+                with self._lock:
+                    existing = self._pins.get(obj_id)
+                    if existing is not None:
+                        pinned = existing  # lost a pin race
+                    else:
+                        # Idle refcount as seen from release(): the pin's
+                        # ref + getrefcount's argument temp. Anything above
+                        # means a consumer export chain is alive.
+                        pinned = _Pinned(base, -2, len(view), baseline=2)
+                        self._pins[obj_id] = pinned
+                        took_pin = True
+                if not took_pin:
+                    # drop the extra native ref our losing get() took
+                    del base, view
+                    self._arena.release(obj_id.binary())
         if pinned is None:
             path = _seg_path(self.session, obj_id)
             fd = os.open(path, os.O_RDONLY)
@@ -108,29 +178,60 @@ class StoreClient:
                 mm = mmap.mmap(fd, size, prot=mmap.PROT_READ)
             finally:
                 os.close(fd)
-            pinned = _Pinned(mm, -1, size)
             with self._lock:
-                self._pins[obj_id] = pinned
+                existing = self._pins.get(obj_id)
+                if existing is not None:
+                    pinned = existing
+                    mm.close()
+                else:
+                    pinned = _Pinned(mm, -1, size)
+                    self._pins[obj_id] = pinned
         return serialization.read_from(memoryview(pinned.mm))
 
     def contains(self, obj_id: ObjectID) -> bool:
-        return obj_id in self._pins or os.path.exists(_seg_path(self.session, obj_id))
+        if obj_id in self._pins:
+            return True
+        if self._arena is not None and self._arena.contains(obj_id.binary()):
+            return True
+        return os.path.exists(_seg_path(self.session, obj_id))
 
     def release(self, obj_id: ObjectID) -> None:
-        """Drop this process's pin (views must no longer be used)."""
+        """Drop this process's pin (views must no longer be used).
+
+        Runs fully under the client lock (pop + liveness check + unpin are
+        one critical section): a pop-then-reinsert window would let a
+        concurrent ``get`` insert a fresh pin that the reinsert clobbers,
+        leaking its native ref.
+        """
+        import sys
+
         with self._lock:
-            pinned = self._pins.pop(obj_id, None)
-        if pinned is not None:
+            pinned = self._pins.get(obj_id)
+            if pinned is None:
+                return
+            if pinned.fd == -2:
+                # Native-pin twin of the mmap path's BufferError guard: if
+                # deserialized zero-copy views still reference the arena
+                # region (exporter refcount above the pin-time baseline),
+                # keep the pin so the bytes can't be freed/reused under
+                # them.
+                if sys.getrefcount(pinned.mm) > pinned.baseline:
+                    return
+                del self._pins[obj_id]
+                self._arena.release(obj_id.binary())
+                return
             try:
                 pinned.mm.close()
+                del self._pins[obj_id]
             except BufferError:
-                # Live views still reference the mapping; re-pin.
-                with self._lock:
-                    self._pins[obj_id] = pinned
+                # Live views still reference the mapping; keep the pin.
+                pass
 
     def delete(self, obj_id: ObjectID) -> None:
-        """Unlink the segment (owner/driver only)."""
+        """Remove the object (owner/driver only)."""
         self.release(obj_id)
+        if self._arena is not None:
+            self._arena.delete(obj_id.binary())
         try:
             os.unlink(_seg_path(self.session, obj_id))
         except FileNotFoundError:
@@ -139,6 +240,8 @@ class StoreClient:
     def store_bytes(self) -> int:
         """Total bytes of this session's segments currently in shm."""
         total = 0
+        if self._arena is not None:
+            total += self._arena.stats()["used"]
         prefix = f"rtpu-{self.session}-"
         try:
             for name in os.listdir(_SHM_DIR):
@@ -153,6 +256,12 @@ class StoreClient:
 
     @staticmethod
     def cleanup_session(session: str) -> None:
+        try:
+            from ray_tpu._native import NativeArena
+
+            NativeArena.destroy(session)
+        except Exception:
+            pass
         prefix = f"rtpu-{session}-"
         try:
             for name in os.listdir(_SHM_DIR):

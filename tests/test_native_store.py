@@ -1,0 +1,135 @@
+"""Native C++ arena store: alloc/seal/get/release/delete/evict + client."""
+
+import os
+import uuid
+
+import numpy as np
+import pytest
+
+from ray_tpu._native import NativeArena, load_store_lib
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.object_store import StoreClient
+
+pytestmark = pytest.mark.skipif(load_store_lib() is None,
+                                reason="native store lib unavailable")
+
+
+@pytest.fixture
+def arena():
+    session = uuid.uuid4().hex[:12]
+    a = NativeArena(session, capacity=1 << 20)  # 1 MiB
+    yield a
+    a.close()
+    NativeArena.destroy(session)
+
+
+def _oid(i: int) -> bytes:
+    return i.to_bytes(4, "big") + b"\x00" * 16
+
+
+def test_create_seal_get_roundtrip(arena):
+    payload = os.urandom(1000)
+    view = arena.create(_oid(1), len(payload))
+    view[:] = payload
+    del view
+    arena.seal(_oid(1))
+    arena.release(_oid(1))
+
+    got = arena.get(_oid(1))
+    assert got is not None and bytes(got) == payload
+    del got
+    arena.release(_oid(1))
+
+
+def test_get_before_seal_fails(arena):
+    v = arena.create(_oid(2), 100)
+    assert v is not None
+    del v
+    assert arena.get(_oid(2)) is None     # not sealed yet
+    assert not arena.contains(_oid(2))
+    arena.seal(_oid(2))
+    assert arena.contains(_oid(2))
+
+
+def test_delete_and_space_reuse(arena):
+    for i in range(3):
+        v = arena.create(_oid(10 + i), 200_000)
+        assert v is not None, f"alloc {i} failed"
+        del v
+        arena.seal(_oid(10 + i))
+        arena.release(_oid(10 + i))
+    used_before = arena.stats()["used"]
+    for i in range(3):
+        assert arena.delete(_oid(10 + i)) is None or True
+    assert arena.stats()["used"] < used_before
+    # space actually reusable
+    v = arena.create(_oid(99), 500_000)
+    assert v is not None
+
+
+def test_lru_eviction_on_pressure(arena):
+    # fill most of the 1 MiB arena with refcount-0 sealed objects
+    for i in range(4):
+        v = arena.create(_oid(20 + i), 200_000)
+        assert v is not None
+        del v
+        arena.seal(_oid(20 + i))
+        arena.release(_oid(20 + i))
+    # allocation beyond free space triggers LRU eviction of the oldest
+    v = arena.create(_oid(30), 300_000)
+    assert v is not None
+    assert not arena.contains(_oid(20))   # oldest got evicted
+    assert arena.contains(_oid(23))       # newest survives
+
+
+def test_pinned_objects_not_evicted(arena):
+    v = arena.create(_oid(40), 400_000)
+    del v
+    arena.seal(_oid(40))
+    arena.release(_oid(40))
+    pinned = arena.get(_oid(40))          # hold a pin
+    assert pinned is not None
+    v2 = arena.create(_oid(41), 800_000)  # cannot fit without evicting 40
+    assert v2 is None                     # eviction refused: 40 is pinned
+    del pinned
+    arena.release(_oid(40))
+    v3 = arena.create(_oid(41), 800_000)
+    assert v3 is not None
+
+
+def test_cross_handle_visibility():
+    session = uuid.uuid4().hex[:12]
+    a = NativeArena(session, capacity=1 << 20)
+    b = NativeArena(session, capacity=1 << 20)  # attach, not create
+    try:
+        v = a.create(_oid(50), 64)
+        v[:] = b"x" * 64
+        del v
+        a.seal(_oid(50))
+        got = b.get(_oid(50))
+        assert bytes(got) == b"x" * 64
+    finally:
+        a.close()
+        b.close()
+        NativeArena.destroy(session)
+
+
+def test_store_client_uses_arena_for_big_objects():
+    session = uuid.uuid4().hex[:12]
+    client = StoreClient(session)
+    if client._arena is None:
+        pytest.skip("arena unavailable")
+    try:
+        oid = ObjectID.from_random()
+        big = np.arange(100_000, dtype=np.float64)
+        inline = client.put(oid, big)
+        assert inline is None             # went to shm, not inline
+        assert client._arena.stats()["num_objects"] == 1
+        back = client.get(oid)
+        np.testing.assert_array_equal(back, big)
+        del back
+        client.release(oid)
+        client.delete(oid)
+        assert client._arena.stats()["num_objects"] == 0
+    finally:
+        StoreClient.cleanup_session(session)
