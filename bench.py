@@ -1,0 +1,92 @@
+"""Flagship benchmark: LLM train-step throughput + MFU on the local device.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The metric is model FLOPs utilization (MFU) of a Llama-family training step
+(fwd+bwd+adamw, bf16 matmuls, remat on) — the BASELINE.json north-star
+contract ("Llama-3-8B ≥45% MFU on v5e-256"); ``vs_baseline`` is MFU/0.45.
+On CPU (no TPU attached) the same harness runs a tiny config so the number
+is still produced, just not meaningful as MFU.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def main() -> None:
+    from ray_tpu.util.tpu_info import honor_jax_platform_env
+
+    honor_jax_platform_env()
+    import jax
+    import numpy as np
+    import optax
+
+    from ray_tpu import models
+    from ray_tpu.parallel import MeshConfig
+    from ray_tpu.train import TrainLoopHelper
+    from ray_tpu.util.tpu_info import is_tpu_backend, peak_flops_per_chip
+
+    on_tpu = is_tpu_backend()
+    if on_tpu:
+        config = models.llama_250m()
+        batch_size, seq = 8, 2048
+        warmup, iters = 3, 10
+    else:
+        config = models.llama_debug()
+        batch_size, seq = 4, 128
+        warmup, iters = 2, 5
+
+    n_dev = jax.device_count()
+    helper = TrainLoopHelper.create(
+        lambda: models.init_params(jax.random.PRNGKey(0), config),
+        models.param_axes(config),
+        lambda p, b: models.loss_and_metrics(p, b, config),
+        optax.adamw(1e-4),
+        mesh_config=MeshConfig(dp=1, fsdp=-1, tp=1, sp=1),
+    )
+
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, config.vocab_size, size=(batch_size, seq + 1),
+                        dtype=np.int32)
+    batch = {"inputs": toks[:, :-1], "targets": toks[:, 1:]}
+
+    for _ in range(warmup):
+        metrics = helper.run_step(batch)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        metrics = helper.run_step(batch)
+    jax.block_until_ready(metrics["loss"])
+    dt = (time.perf_counter() - t0) / iters
+
+    tokens_per_step = batch_size * seq
+    tokens_per_sec = tokens_per_step / dt
+    # fwd+bwd ≈ 6N FLOPs/token + attention term 12*L*d*s (causal halves it)
+    flops_token = config.flops_per_token() + (
+        6 * config.n_layers * config.hdim * config.n_heads * seq)
+    model_flops = flops_token * tokens_per_sec
+    peak = peak_flops_per_chip() * n_dev if on_tpu else float("nan")
+    mfu = model_flops / peak if on_tpu else 0.0
+
+    result = {
+        "metric": "llama_train_mfu" if on_tpu else "llama_train_tokens_per_sec_cpu",
+        "value": round(mfu, 4) if on_tpu else round(tokens_per_sec, 1),
+        "unit": "mfu" if on_tpu else "tokens/s",
+        "vs_baseline": round(mfu / 0.45, 4) if on_tpu else 0.0,
+        "detail": {
+            "model": "llama-250m" if on_tpu else "llama-debug",
+            "tokens_per_sec": round(tokens_per_sec, 1),
+            "step_time_ms": round(dt * 1e3, 2),
+            "devices": n_dev,
+            "backend": jax.default_backend(),
+            "loss": float(jax.device_get(metrics["loss"])),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()
