@@ -46,6 +46,16 @@ class ActorMethod:
             f"actor method {self._method_name} cannot be called directly; use .remote()"
         )
 
+    def bind(self, *args, **kwargs):
+        """Build a lazy DAG node for this method (reference
+        ``actor.method.bind``, ``python/ray/dag/class_node.py``)."""
+        from ray_tpu.dag.dag_node import ClassMethodNode
+
+        return ClassMethodNode(
+            ActorHandle(self._actor_id,
+                        {self._method_name: dict(self._options)}),
+            self._method_name, args, kwargs)
+
 
 class ActorHandle:
     def __init__(self, actor_id: ActorID, method_options: Optional[Dict[str, Dict]] = None):
@@ -53,7 +63,7 @@ class ActorHandle:
         object.__setattr__(self, "_method_options", method_options or {})
 
     def __getattr__(self, name: str):
-        if name.startswith("_"):
+        if name.startswith("_") and name != "__rtpu_call__":
             raise AttributeError(name)
         return ActorMethod(self._actor_id, name, self._method_options.get(name))
 

@@ -55,6 +55,12 @@ class RemoteFunction:
             f"use {self.__name__}.remote()"
         )
 
+    def bind(self, *args, **kwargs):
+        """Build a lazy DAG node for this function (reference ``fn.bind``)."""
+        from ray_tpu.dag.dag_node import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
     def options(self, **new_options):
         merged = {**self._options, **new_options}
         rf = RemoteFunction.__new__(RemoteFunction)

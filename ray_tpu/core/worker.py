@@ -233,8 +233,15 @@ class WorkerRuntime:
                 if instance is None:
                     raise ActorDiedError("actor instance not found in this worker")
                 self.current_actor_id = ActorID(spec["actor_id"])
-                method = getattr(instance, spec["method"])
-                value = method(*args, **kwargs)
+                if spec["method"] == "__rtpu_call__":
+                    # run an arbitrary function against the instance
+                    # (reference ``actor.__ray_call__`` analog; the
+                    # compiled-DAG exec loop rides this).
+                    fn, *rest = args
+                    value = fn(instance, *rest, **kwargs)
+                else:
+                    method = getattr(instance, spec["method"])
+                    value = method(*args, **kwargs)
                 results = self._encode_results(spec, value)
             else:
                 raise ValueError(f"unknown task type {ttype}")

@@ -1,0 +1,25 @@
+"""ray_tpu.dag — lazy DAGs of actor-method calls + compiled execution.
+
+Role analog: ``python/ray/dag`` (``dag_node.py``, ``compiled_dag_node.py:278``).
+Build a graph with ``InputNode`` and ``ActorMethod.bind``; ``execute`` runs
+it as ordinary actor calls; ``experimental_compile`` pre-allocates mutable
+shm channels per edge and starts an exec-loop thread inside each actor, so
+repeated invocations bypass task submission entirely — the driver writes
+the input channel and reads the output channel.
+"""
+
+from ray_tpu.dag.dag_node import (
+    DAGNode,
+    InputNode,
+    ClassMethodNode,
+    FunctionNode,
+)
+from ray_tpu.dag.compiled_dag import CompiledDAG
+
+__all__ = [
+    "DAGNode",
+    "InputNode",
+    "ClassMethodNode",
+    "FunctionNode",
+    "CompiledDAG",
+]

@@ -1,0 +1,210 @@
+"""CompiledDAG: channel-wired actor pipelines.
+
+Role analog: ``python/ray/dag/compiled_dag_node.py:278``. Compilation
+allocates one mutable shm channel per DAG edge and launches a long-running
+exec loop inside every participating actor (the reference's per-actor exec
+loops). After that, invoking the DAG is: driver writes the input channel →
+each actor's loop reads its upstream channels, runs its methods, writes its
+output channel → driver reads the final channel. No task submission, no
+scheduler, no per-call allocation on the hot path.
+
+The exec loop intentionally occupies the actor (submitted as a normal actor
+call that only returns at teardown) — a compiled DAG takes ownership of its
+actors, matching the reference's semantics.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.dag.dag_node import ClassMethodNode, DAGNode, InputNode
+from ray_tpu.experimental.channel import Channel
+
+
+class _Stop:
+    """Teardown sentinel propagated through the pipeline."""
+
+
+class _NodeError:
+    def __init__(self, err: BaseException, node_repr: str):
+        self.err = err
+        self.node_repr = node_repr
+
+
+class DAGExecutionError(RuntimeError):
+    pass
+
+
+def _dag_exec_loop(instance, stages: List[Dict[str, Any]]) -> int:
+    """Runs inside the actor: per invocation, execute this actor's stages
+    in topo order. ``stages``: [{method, in_channels: [(kind, key)],
+    out_channel, consts}] where kind is "chan" | "const".
+    """
+    executed = 0
+    chans: Dict[str, Channel] = {}
+
+    def chan(name: str) -> Channel:
+        if name not in chans:
+            chans[name] = Channel(name, create=False)
+        return chans[name]
+
+    while True:
+        stop = False
+        read_cache: Dict[str, Any] = {}  # one read per channel per tick
+        for stage in stages:
+            args = []
+            err: Optional[_NodeError] = None
+            for kind, key in stage["inputs"]:
+                if kind == "const":
+                    args.append(key)
+                    continue
+                if key in read_cache:
+                    val = read_cache[key]
+                else:
+                    val = chan(key).read()
+                    read_cache[key] = val
+                if isinstance(val, _Stop):
+                    stop = True
+                if isinstance(val, _NodeError):
+                    err = val
+                args.append(val)
+            out = chan(stage["out"])
+            if stop:
+                out.write(_Stop())
+                continue
+            if err is not None:
+                out.write(err)   # propagate upstream failure
+                continue
+            try:
+                method = getattr(instance, stage["method"])
+                result = method(*args)
+                out.write(result)
+            except BaseException as e:  # noqa: BLE001 — shipped to driver
+                out.write(_NodeError(e, stage["method"]))
+        if stop:
+            return executed
+        executed += 1
+
+
+class CompiledDAGFuture:
+    def __init__(self, channel: Channel, dag: "CompiledDAG"):
+        self._channel = channel
+        self._dag = dag
+        self._done = False
+        self._result: Any = None
+
+    def get(self, timeout: Optional[float] = 60.0) -> Any:
+        if self._done:
+            return self._result
+        val = self._channel.read(timeout=timeout)
+        self._done = True
+        self._dag._pending = None
+        if isinstance(val, _NodeError):
+            raise DAGExecutionError(
+                f"compiled DAG node {val.node_repr!r} failed") from val.err
+        if isinstance(val, _Stop):
+            raise DAGExecutionError("compiled DAG was torn down")
+        self._result = val
+        return val
+
+
+class CompiledDAG:
+    def __init__(self, output_node: DAGNode,
+                 buffer_size_bytes: int = 1 << 20):
+        self._output_node = output_node
+        self._buffer = buffer_size_bytes
+        self._channels: List[Channel] = []
+        self._input_channel: Optional[Channel] = None
+        self._output_channel: Optional[Channel] = None
+        self._loop_refs: List[Any] = []
+        self._torn_down = False
+        self._pending: Optional[CompiledDAGFuture] = None
+        self._compile()
+
+    def _compile(self) -> None:
+        order = self._output_node.topo_sort()
+        inputs = [n for n in order if isinstance(n, InputNode)]
+        if len(inputs) != 1:
+            raise ValueError("compiled DAG needs exactly one InputNode")
+        for n in order:
+            if not isinstance(n, (InputNode, ClassMethodNode)):
+                raise TypeError(
+                    f"compiled DAGs support actor-method nodes only, got {n!r}")
+            if isinstance(n, ClassMethodNode) and not n._upstream():
+                raise ValueError(
+                    f"{n!r} has no upstream nodes; compiled stages must be "
+                    "driven by the input (teardown could never reach it)")
+        uid = uuid.uuid4().hex[:8]
+
+        # one channel per node output
+        chan_name: Dict[int, str] = {}
+        for i, n in enumerate(order):
+            name = f"{uid}-{i}"
+            chan_name[id(n)] = name
+            ch = Channel(name, capacity=self._buffer, create=True)
+            self._channels.append(ch)
+            if isinstance(n, InputNode):
+                self._input_channel = ch
+        self._output_channel = self._channels[
+            [id(n) for n in order].index(id(self._output_node))]
+
+        # group stages by actor, preserving topo order
+        by_actor: Dict[Any, List[Dict[str, Any]]] = {}
+        for n in order:
+            if isinstance(n, InputNode):
+                continue
+            inputs_desc = []
+            for a in n.args:
+                if isinstance(a, DAGNode):
+                    inputs_desc.append(("chan", chan_name[id(a)]))
+                else:
+                    inputs_desc.append(("const", a))
+            if n.kwargs:
+                raise TypeError("compiled DAGs do not support kwargs binds")
+            by_actor.setdefault(n.actor, []).append({
+                "method": n.method_name,
+                "inputs": inputs_desc,
+                "out": chan_name[id(n)],
+            })
+
+        for actor, stages in by_actor.items():
+            self._loop_refs.append(
+                actor.__rtpu_call__.remote(_dag_exec_loop, stages))
+
+    # -- invocation -------------------------------------------------------
+
+    def execute(self, input_value: Any) -> CompiledDAGFuture:
+        if self._torn_down:
+            raise DAGExecutionError("DAG already torn down")
+        # Channels are single-slot: one execution may be in flight. A second
+        # write would silently overwrite the unread input (and the caller's
+        # first future would read the wrong result), so enforce consumption.
+        if self._pending is not None and not self._pending._done:
+            raise DAGExecutionError(
+                "previous execute() result not consumed yet; call .get() "
+                "on it first (compiled channels hold one value)")
+        self._input_channel.write(input_value)
+        fut = CompiledDAGFuture(self._output_channel, self)
+        self._pending = fut
+        return fut
+
+    def teardown(self) -> None:
+        if self._torn_down:
+            return
+        self._torn_down = True
+        try:
+            self._input_channel.write(_Stop())
+            import ray_tpu
+
+            ray_tpu.get(self._loop_refs, timeout=10)
+        except Exception:
+            pass
+        for ch in self._channels:
+            ch.unlink()
+
+    def __del__(self):
+        try:
+            self.teardown()
+        except Exception:
+            pass

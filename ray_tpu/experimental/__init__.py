@@ -1,0 +1,9 @@
+"""Experimental: mutable channels (compiled-DAG data plane)."""
+
+from ray_tpu.experimental.channel import (
+    Channel,
+    ChannelFullError,
+    ChannelTimeoutError,
+)
+
+__all__ = ["Channel", "ChannelFullError", "ChannelTimeoutError"]

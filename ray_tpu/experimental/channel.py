@@ -1,0 +1,141 @@
+"""Mutable shared-memory channels: the compiled-DAG data plane.
+
+Role analog: the reference's mutable plasma objects backing accelerated
+DAGs (``src/ray/core_worker/experimental_mutable_object_manager.h:37`` +
+``python/ray/experimental/channel/shared_memory_channel.py``). A channel is
+one fixed-capacity shm segment reused for every DAG invocation — no
+per-call allocation, no scheduler on the data path.
+
+Synchronization is a seqlock: the writer bumps the sequence to odd, writes
+payload, bumps to even; a reader waits for an even sequence greater than
+the last it consumed, reads, and validates the sequence didn't move.
+Polling backs off from spin to short sleeps (the reference blocks on
+futexes in plasma; cross-process futex on shm is overkill at these
+latencies).
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import struct
+import time
+from typing import Any, Optional
+
+from ray_tpu.core import serialization
+
+_HEADER = struct.Struct("<QQ")  # (seq, payload_size)
+_SEQ = struct.Struct("<Q")
+_SHM_DIR = "/dev/shm"
+
+
+class ChannelFullError(RuntimeError):
+    pass
+
+
+class ChannelTimeoutError(TimeoutError):
+    pass
+
+
+class Channel:
+    """Single-writer multi-reader mutable shm channel."""
+
+    def __init__(self, name: str, capacity: int = 1 << 20,
+                 create: bool = False):
+        self.name = name
+        self.path = os.path.join(_SHM_DIR, f"rtpu-chan-{name}")
+        self.capacity = capacity
+        if create:
+            fd = os.open(self.path, os.O_CREAT | os.O_RDWR, 0o600)
+            try:
+                os.ftruncate(fd, _HEADER.size + capacity)
+                self._mm = mmap.mmap(fd, _HEADER.size + capacity)
+            finally:
+                os.close(fd)
+            _HEADER.pack_into(self._mm, 0, 0, 0)
+        else:
+            # attach: wait briefly for the creator
+            deadline = time.monotonic() + 10.0
+            while True:
+                try:
+                    fd = os.open(self.path, os.O_RDWR)
+                    break
+                except FileNotFoundError:
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.001)
+            try:
+                size = os.fstat(fd).st_size
+                self._mm = mmap.mmap(fd, size)
+                self.capacity = size - _HEADER.size
+            finally:
+                os.close(fd)
+        self._last_read_seq = 0
+
+    # -- writer -----------------------------------------------------------
+
+    def write(self, value: Any) -> None:
+        data, buffers = serialization.serialize(value)
+        size = serialization.serialized_size(data, buffers)
+        if size > self.capacity:
+            raise ChannelFullError(
+                f"payload {size}B exceeds channel capacity {self.capacity}B")
+        seq, _ = _HEADER.unpack_from(self._mm, 0)
+        # Seqlock publish order matters: odd seq FIRST (readers back off),
+        # then size+payload, then even seq. Writing size together with the
+        # old even seq would let a reader pair a stale sequence with the
+        # new size and accept a torn payload.
+        _SEQ.pack_into(self._mm, 0, seq + 1)               # odd: writing
+        _SEQ.pack_into(self._mm, 8, size)
+        serialization.write_into(
+            memoryview(self._mm)[_HEADER.size:_HEADER.size + size],
+            data, buffers)
+        _SEQ.pack_into(self._mm, 0, seq + 2)               # even: ready
+
+    # -- reader -----------------------------------------------------------
+
+    def read(self, timeout: Optional[float] = None) -> Any:
+        """Block until a value newer than the last read is available."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        spins = 0
+        while True:
+            seq, size = _HEADER.unpack_from(self._mm, 0)
+            if seq % 2 == 0 and seq > self._last_read_seq:
+                payload = bytes(
+                    self._mm[_HEADER.size:_HEADER.size + size])
+                seq2, _ = _HEADER.unpack_from(self._mm, 0)
+                if seq2 == seq:          # seqlock validate
+                    self._last_read_seq = seq
+                    return serialization.read_from(memoryview(payload))
+            spins += 1
+            if spins < 1000:
+                continue
+            if deadline is not None and time.monotonic() > deadline:
+                raise ChannelTimeoutError(
+                    f"channel {self.name} read timed out after {timeout}s")
+            time.sleep(0.0002)
+
+    def poll(self) -> bool:
+        seq, _ = _HEADER.unpack_from(self._mm, 0)
+        return seq % 2 == 0 and seq > self._last_read_seq
+
+    def close(self) -> None:
+        try:
+            self._mm.close()
+        except (BufferError, ValueError):
+            pass
+
+    def unlink(self) -> None:
+        self.close()
+        try:
+            os.unlink(self.path)
+        except FileNotFoundError:
+            pass
+
+    def __reduce__(self):
+        # channels travel to actors by name; they attach on arrival
+        return (_attach_channel, (self.name,))
+
+
+def _attach_channel(name: str) -> "Channel":
+    return Channel(name, create=False)

@@ -1,0 +1,176 @@
+"""DAG API: eager execute, channels, compiled pipelines."""
+
+import time
+import uuid
+
+import pytest
+
+import ray_tpu
+from ray_tpu.dag import InputNode
+from ray_tpu.experimental.channel import Channel, ChannelTimeoutError
+
+
+@pytest.fixture
+def rt_dag():
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_channel_write_read_roundtrip():
+    name = uuid.uuid4().hex[:8]
+    ch = Channel(name, capacity=1 << 16, create=True)
+    try:
+        ch.write({"a": 1, "b": [1, 2, 3]})
+        reader = Channel(name, create=False)
+        assert reader.read(timeout=5) == {"a": 1, "b": [1, 2, 3]}
+        # mutable: same channel carries the next value
+        ch.write("second")
+        assert reader.read(timeout=5) == "second"
+        # no new value -> timeout
+        with pytest.raises(ChannelTimeoutError):
+            reader.read(timeout=0.1)
+    finally:
+        ch.unlink()
+
+
+def test_dag_eager_execute(rt_dag):
+    @ray_tpu.remote
+    class Adder:
+        def __init__(self, k):
+            self.k = k
+
+        def add(self, x):
+            return x + self.k
+
+    @ray_tpu.remote
+    class Scaler:
+        def scale(self, x):
+            return x * 10
+
+    a = Adder.remote(5)
+    s = Scaler.remote()
+    with InputNode() as inp:
+        dag = s.scale.bind(a.add.bind(inp))
+    out = ray_tpu.get(dag.execute(3))
+    assert out == 80
+
+
+def test_function_node_eager(rt_dag):
+    @ray_tpu.remote
+    def double(x):
+        return 2 * x
+
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    with InputNode() as inp:
+        dag = inc.bind(double.bind(inp))
+    assert ray_tpu.get(dag.execute(10)) == 21
+
+
+def test_compiled_dag_pipeline(rt_dag):
+    @ray_tpu.remote
+    class Stage:
+        def __init__(self, k):
+            self.k = k
+
+        def apply(self, x):
+            return x + self.k
+
+    s1 = Stage.remote(1)
+    s2 = Stage.remote(10)
+    with InputNode() as inp:
+        dag = s2.apply.bind(s1.apply.bind(inp))
+    compiled = dag.experimental_compile()
+    try:
+        # repeated invocations reuse the same channels/loops
+        for i in range(5):
+            assert compiled.execute(i).get(timeout=30) == i + 11
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_dag_fan_in(rt_dag):
+    @ray_tpu.remote
+    class Worker:
+        def double(self, x):
+            return 2 * x
+
+        def add(self, a, b):
+            return a + b
+
+    w1 = Worker.remote()
+    w2 = Worker.remote()
+    w3 = Worker.remote()
+    with InputNode() as inp:
+        dag = w3.add.bind(w1.double.bind(inp), w2.double.bind(inp))
+    compiled = dag.experimental_compile()
+    try:
+        assert compiled.execute(3).get(timeout=30) == 12
+        assert compiled.execute(5).get(timeout=30) == 20
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_dag_error_propagates(rt_dag):
+    @ray_tpu.remote
+    class Failer:
+        def boom(self, x):
+            if x == 13:
+                raise ValueError("unlucky")
+            return x
+
+    f = Failer.remote()
+    with InputNode() as inp:
+        dag = f.boom.bind(inp)
+    compiled = dag.experimental_compile()
+    try:
+        assert compiled.execute(1).get(timeout=30) == 1
+        from ray_tpu.dag.compiled_dag import DAGExecutionError
+
+        with pytest.raises(DAGExecutionError):
+            compiled.execute(13).get(timeout=30)
+        # pipeline survives the error
+        assert compiled.execute(2).get(timeout=30) == 2
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_dag_backpressure(rt_dag):
+    @ray_tpu.remote
+    class S:
+        def f(self, x):
+            return x
+
+    s = S.remote()
+    with InputNode() as inp:
+        dag = s.f.bind(inp)
+    compiled = dag.experimental_compile()
+    try:
+        fut = compiled.execute(1)
+        from ray_tpu.dag.compiled_dag import DAGExecutionError
+
+        with pytest.raises(DAGExecutionError):
+            compiled.execute(2)          # previous result unconsumed
+        assert fut.get(timeout=30) == 1
+        assert compiled.execute(2).get(timeout=30) == 2
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_dag_teardown_frees_actor(rt_dag):
+    @ray_tpu.remote
+    class S:
+        def f(self, x):
+            return x
+
+    s = S.remote()
+    with InputNode() as inp:
+        dag = s.f.bind(inp)
+    compiled = dag.experimental_compile()
+    assert compiled.execute(7).get(timeout=30) == 7
+    compiled.teardown()
+    # after teardown the actor serves normal calls again
+    assert ray_tpu.get(s.f.remote(42), timeout=30) == 42
