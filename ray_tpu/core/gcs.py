@@ -241,6 +241,16 @@ class Gcs:
         with self.lock:
             return self.named_actors.get(name)
 
+    # -- state-API accessors (reference GcsTaskManager/table dumps) -------
+
+    def all_actors(self) -> List["ActorInfo"]:
+        with self.lock:
+            return list(self.actors.values())
+
+    def all_objects(self):
+        with self.lock:
+            return list(self.objects.items())
+
     def mark_actor_dead(self, actor_id: ActorID, cause: str) -> None:
         with self.lock:
             info = self.actors.get(actor_id)

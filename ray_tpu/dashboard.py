@@ -1,0 +1,104 @@
+"""Dashboard-lite: in-driver HTTP endpoints for state + metrics.
+
+Role analog: the reference dashboard head (``dashboard/head.py``) reduced
+to its API surface: JSON state endpoints (nodes/actors/tasks/objects/
+workers/placement groups/summaries) and a Prometheus ``/metrics``
+exposition, served from the driver process on a background thread.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, *a):  # silent
+        pass
+
+    def do_GET(self):  # noqa: N802 — stdlib API
+        from ray_tpu.util import state as st
+        from ray_tpu.util.metrics import prometheus_text
+
+        routes = {
+            "/api/nodes": st.list_nodes,
+            "/api/actors": st.list_actors,
+            "/api/tasks": st.list_tasks,
+            "/api/objects": st.list_objects,
+            "/api/workers": st.list_workers,
+            "/api/placement_groups": st.list_placement_groups,
+            "/api/summary/tasks": st.summarize_tasks,
+            "/api/summary/actors": st.summarize_actors,
+            "/api/summary/objects": st.summarize_objects,
+        }
+        try:
+            if self.path == "/metrics":
+                body = prometheus_text().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            if self.path in ("/", "/api"):
+                payload = {"endpoints": sorted(routes) + ["/metrics"]}
+            elif self.path in routes:
+                payload = routes[self.path]()
+            else:
+                self.send_response(404)
+                self.end_headers()
+                return
+            body = json.dumps({"result": payload}, default=str).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        except Exception as e:  # noqa: BLE001
+            body = json.dumps({"error": str(e)}).encode()
+            self.send_response(500)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+
+class Dashboard:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8265):
+        self.host = host
+        self.port = port
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "Dashboard":
+        self._server = ThreadingHTTPServer((self.host, self.port), _Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="rtpu_dashboard")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server = None
+
+
+_dashboard: Optional[Dashboard] = None
+
+
+def start_dashboard(host: str = "127.0.0.1", port: int = 8265) -> Dashboard:
+    global _dashboard
+    if _dashboard is None:
+        _dashboard = Dashboard(host, port).start()
+    return _dashboard
+
+
+def stop_dashboard() -> None:
+    global _dashboard
+    if _dashboard is not None:
+        _dashboard.stop()
+        _dashboard = None

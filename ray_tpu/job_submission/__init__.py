@@ -1,0 +1,15 @@
+"""Job submission: run driver scripts as supervised subprocesses.
+
+Role analog: ``dashboard/modules/job`` (``JobManager :56`` spawns a
+``JobSupervisor :49`` actor which runs the entrypoint as a subprocess) and
+the ``JobSubmissionClient`` SDK. Job state lives in the GCS KV so any
+client on the cluster can query it.
+"""
+
+from ray_tpu.job_submission.job_manager import (
+    JobInfo,
+    JobStatus,
+    JobSubmissionClient,
+)
+
+__all__ = ["JobSubmissionClient", "JobStatus", "JobInfo"]

@@ -1,0 +1,227 @@
+"""JobSupervisor actor + JobSubmissionClient.
+
+Role analog: ``dashboard/modules/job/job_manager.py:56`` /
+``job_head.py:142``. A submitted job = a JobSupervisor actor running the
+entrypoint shell command as a subprocess, streaming logs to a file and
+recording status transitions in the GCS KV (PENDING → RUNNING →
+SUCCEEDED/FAILED/STOPPED).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import tempfile
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+_KV_NS = "job"
+
+
+class JobStatus:
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    STOPPED = "STOPPED"
+
+    TERMINAL = (SUCCEEDED, FAILED, STOPPED)
+
+
+@dataclass
+class JobInfo:
+    job_id: str
+    entrypoint: str
+    status: str = JobStatus.PENDING
+    message: str = ""
+    start_time: Optional[float] = None
+    end_time: Optional[float] = None
+    metadata: Dict[str, Any] = field(default_factory=dict)
+    log_path: str = ""
+    return_code: Optional[int] = None
+    pgid: Optional[int] = None     # entrypoint's process group (for stop)
+
+    def to_json(self) -> bytes:
+        return json.dumps(vars(self)).encode()
+
+    @classmethod
+    def from_json(cls, blob: bytes) -> "JobInfo":
+        return cls(**json.loads(blob))
+
+
+def _kv_put(job_id: str, info: JobInfo) -> None:
+    import ray_tpu.core.runtime as rt
+
+    rt._get_runtime().kv_op("put", job_id, info.to_json(), _KV_NS, True)
+
+
+def _kv_get(job_id: str) -> Optional[JobInfo]:
+    import ray_tpu.core.runtime as rt
+
+    blob = rt._get_runtime().kv_op("get", job_id, _KV_NS)
+    return JobInfo.from_json(blob) if blob else None
+
+
+def _kv_keys() -> List[str]:
+    import ray_tpu.core.runtime as rt
+
+    return rt._get_runtime().kv_op("keys", "", _KV_NS)
+
+
+class JobSupervisor:
+    """Actor that owns one job subprocess."""
+
+    def __init__(self, job_id: str, entrypoint: str,
+                 runtime_env: Optional[Dict[str, Any]] = None,
+                 metadata: Optional[Dict[str, Any]] = None):
+        self.job_id = job_id
+        self.entrypoint = entrypoint
+        self.runtime_env = runtime_env or {}
+        log_dir = os.path.join(tempfile.gettempdir(), "rtpu-jobs")
+        os.makedirs(log_dir, exist_ok=True)
+        self.info = JobInfo(
+            job_id=job_id, entrypoint=entrypoint,
+            metadata=metadata or {},
+            log_path=os.path.join(log_dir, f"{job_id}.log"),
+        )
+        self.proc: Optional[subprocess.Popen] = None
+        _kv_put(job_id, self.info)
+
+    def run(self) -> str:
+        """Start the subprocess and wait for completion (the actor is
+        occupied for the job's duration, like the reference supervisor)."""
+        env = dict(os.environ)
+        env.update({k: str(v) for k, v in
+                    self.runtime_env.get("env_vars", {}).items()})
+        cwd = self.runtime_env.get("working_dir") or None
+        self.info.status = JobStatus.RUNNING
+        self.info.start_time = time.time()
+        _kv_put(self.job_id, self.info)
+        with open(self.info.log_path, "wb") as logf:
+            self.proc = subprocess.Popen(
+                self.entrypoint, shell=True, stdout=logf,
+                stderr=subprocess.STDOUT, env=env, cwd=cwd,
+                start_new_session=True,
+            )
+            # publish the process group so stop_job can kill the
+            # entrypoint even while this actor is occupied by wait()
+            self.info.pgid = os.getpgid(self.proc.pid)
+            _kv_put(self.job_id, self.info)
+            rc = self.proc.wait()
+        self.info.return_code = rc
+        self.info.end_time = time.time()
+        if self.info.status == JobStatus.STOPPED:
+            pass
+        elif rc == 0:
+            self.info.status = JobStatus.SUCCEEDED
+        else:
+            self.info.status = JobStatus.FAILED
+            self.info.message = f"entrypoint exited with code {rc}"
+        _kv_put(self.job_id, self.info)
+        return self.info.status
+
+    def stop(self) -> None:
+        self.info.status = JobStatus.STOPPED
+        _kv_put(self.job_id, self.info)
+        if self.proc is not None and self.proc.poll() is None:
+            os.killpg(os.getpgid(self.proc.pid), signal.SIGTERM)
+
+
+class JobSubmissionClient:
+    """Driver-side SDK (reference ``ray.job_submission.JobSubmissionClient``)."""
+
+    def __init__(self, address: Optional[str] = None):
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(ignore_reinit_error=True)
+        self._supervisors: Dict[str, Any] = {}
+        self._run_refs: Dict[str, Any] = {}
+
+    def submit_job(self, *, entrypoint: str,
+                   runtime_env: Optional[Dict[str, Any]] = None,
+                   metadata: Optional[Dict[str, Any]] = None,
+                   submission_id: Optional[str] = None) -> str:
+        import ray_tpu
+
+        job_id = submission_id or f"rtpu-job-{uuid.uuid4().hex[:10]}"
+        # Record PENDING before the supervisor exists so status queries
+        # never race actor startup.
+        _kv_put(job_id, JobInfo(job_id=job_id, entrypoint=entrypoint,
+                                metadata=metadata or {}))
+        sup_cls = ray_tpu.remote(JobSupervisor)
+        sup = sup_cls.options(name=f"_job_supervisor_{job_id}",
+                              num_cpus=0).remote(
+            job_id, entrypoint, runtime_env, metadata)
+        self._supervisors[job_id] = sup
+        self._run_refs[job_id] = sup.run.remote()
+        return job_id
+
+    def get_job_status(self, job_id: str) -> str:
+        info = _kv_get(job_id)
+        if info is None:
+            raise ValueError(f"unknown job {job_id!r}")
+        return info.status
+
+    def get_job_info(self, job_id: str) -> JobInfo:
+        info = _kv_get(job_id)
+        if info is None:
+            raise ValueError(f"unknown job {job_id!r}")
+        return info
+
+    def get_job_logs(self, job_id: str) -> str:
+        info = self.get_job_info(job_id)
+        if info.log_path and os.path.exists(info.log_path):
+            with open(info.log_path, errors="replace") as f:
+                return f.read()
+        return ""
+
+    def list_jobs(self) -> List[JobInfo]:
+        out = []
+        for key in _kv_keys():
+            info = _kv_get(key)
+            if info:
+                out.append(info)
+        return out
+
+    def stop_job(self, job_id: str) -> bool:
+        import ray_tpu
+
+        sup = self._supervisors.get(job_id)
+        if sup is None:
+            try:
+                sup = ray_tpu.get_actor(f"_job_supervisor_{job_id}")
+            except ValueError:
+                return False
+        # stop() must preempt the running run() call: the supervisor actor
+        # is occupied by wait(), so flag the KV and kill the entrypoint's
+        # process group directly (it was started in its own session, so
+        # killing the supervisor alone would orphan it).
+        info = _kv_get(job_id)
+        if info and info.status not in JobStatus.TERMINAL:
+            info.status = JobStatus.STOPPED
+            _kv_put(job_id, info)
+        if info and info.pgid:
+            try:
+                os.killpg(info.pgid, signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                pass
+        try:
+            ray_tpu.kill(sup)
+        except Exception:
+            return False
+        return True
+
+    def wait_until_finished(self, job_id: str,
+                            timeout: float = 300.0) -> str:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            status = self.get_job_status(job_id)
+            if status in JobStatus.TERMINAL:
+                return status
+            time.sleep(0.2)
+        raise TimeoutError(f"job {job_id} not finished in {timeout}s")

@@ -1,0 +1,134 @@
+"""``python -m ray_tpu.scripts`` — the CLI.
+
+Role analog: ``python/ray/scripts/scripts.py`` (``ray status/list/
+timeline/job ...``) adapted to the daemonless architecture: commands that
+need a cluster boot one in-process (job submit), the rest inspect local
+artifacts (shm sessions, timelines, experiment dirs) or run the bench.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _cmd_status(args) -> int:
+    shm = [f for f in os.listdir("/dev/shm") if f.startswith("rtpu-")]
+    arenas = [f for f in shm if f.startswith("rtpu-arena-")]
+    print(f"shm arenas: {len(arenas)}")
+    for a in arenas:
+        size = os.stat(os.path.join("/dev/shm", a)).st_size
+        print(f"  {a}  ({size >> 20} MiB mapped)")
+    print(f"other rtpu shm segments: {len(shm) - len(arenas)}")
+    return 0
+
+
+def _cmd_job_submit(args) -> int:
+    import ray_tpu
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    ray_tpu.init(ignore_reinit_error=True)
+    client = JobSubmissionClient()
+    runtime_env = {}
+    if args.working_dir:
+        runtime_env["working_dir"] = args.working_dir
+    import shlex
+
+    job_id = client.submit_job(entrypoint=shlex.join(args.entrypoint),
+                               runtime_env=runtime_env)
+    print(f"submitted {job_id}")
+    if args.no_wait:
+        return 0
+    status = client.wait_until_finished(job_id, timeout=args.timeout)
+    print(client.get_job_logs(job_id), end="")
+    print(f"job {job_id}: {status}")
+    return 0 if status == "SUCCEEDED" else 1
+
+
+def _cmd_job_list(args) -> int:
+    import ray_tpu
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    ray_tpu.init(ignore_reinit_error=True)
+    for info in JobSubmissionClient().list_jobs():
+        print(f"{info.job_id}  {info.status}  {info.entrypoint!r}")
+    return 0
+
+
+def _cmd_timeline(args) -> int:
+    import ray_tpu
+
+    if not ray_tpu.is_initialized():
+        print("no active session in this process; timeline must be "
+              "exported by the driver (ray_tpu.timeline(filename=...))")
+        return 1
+    out = args.output or "timeline.json"
+    ray_tpu.timeline(filename=out)
+    print(f"wrote {out}")
+    return 0
+
+
+def _cmd_bench(args) -> int:
+    import runpy
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.argv = ["bench.py"]
+    runpy.run_path(os.path.join(repo, "bench.py"), run_name="__main__")
+    return 0
+
+
+def _cmd_clean(args) -> int:
+    import glob
+
+    removed = 0
+    for path in glob.glob("/dev/shm/rtpu-*"):
+        try:
+            os.unlink(path)
+            removed += 1
+        except OSError:
+            pass
+    print(f"removed {removed} shm segments")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="ray_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sub.add_parser("status", help="show local shm sessions/arenas")
+    sub.add_parser("clean", help="remove leftover rtpu shm segments")
+    sub.add_parser("bench", help="run the flagship benchmark")
+
+    tl = sub.add_parser("timeline", help="export chrome trace")
+    tl.add_argument("--output", "-o", default=None)
+
+    job = sub.add_parser("job", help="job submission")
+    jobsub = job.add_subparsers(dest="job_cmd", required=True)
+    js = jobsub.add_parser("submit")
+    js.add_argument("--working-dir", default=None)
+    js.add_argument("--no-wait", action="store_true")
+    js.add_argument("--timeout", type=float, default=600.0)
+    js.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    jobsub.add_parser("list")
+
+    args = p.parse_args(argv)
+    if args.cmd == "status":
+        return _cmd_status(args)
+    if args.cmd == "clean":
+        return _cmd_clean(args)
+    if args.cmd == "bench":
+        return _cmd_bench(args)
+    if args.cmd == "timeline":
+        return _cmd_timeline(args)
+    if args.cmd == "job":
+        if args.job_cmd == "submit":
+            return _cmd_job_submit(args)
+        if args.job_cmd == "list":
+            return _cmd_job_list(args)
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,164 @@
+"""User metrics API: Counter/Gauge/Histogram + Prometheus text exposition.
+
+Role analog: ``python/ray/util/metrics.py`` over the reference's
+OpenCensus pipeline (``src/ray/stats``) — here a process-local registry
+with a Prometheus text-format dump served by the dashboard-lite HTTP
+endpoint (``_private/metrics_agent.py`` analog).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_registry_lock = threading.Lock()
+_registry: Dict[str, "Metric"] = {}
+
+
+class Metric:
+    metric_type = "untyped"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Sequence[str]] = None):
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys or ())
+        self._default_tags: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        with _registry_lock:
+            _registry[name] = self
+
+    def set_default_tags(self, tags: Dict[str, str]) -> "Metric":
+        self._default_tags = dict(tags)
+        return self
+
+    def _key(self, tags: Optional[Dict[str, str]]) -> Tuple:
+        merged = {**self._default_tags, **(tags or {})}
+        return tuple(sorted(merged.items()))
+
+    def _samples(self) -> List[Tuple[Tuple, float]]:
+        raise NotImplementedError
+
+
+class Counter(Metric):
+    metric_type = "counter"
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._values: Dict[Tuple, float] = {}
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        if value < 0:
+            raise ValueError("counters only increase")
+        k = self._key(tags)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + value
+
+    def _samples(self):
+        with self._lock:
+            return list(self._values.items())
+
+
+class Gauge(Metric):
+    metric_type = "gauge"
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._values: Dict[Tuple, float] = {}
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._values[self._key(tags)] = float(value)
+
+    def inc(self, value: float = 1.0, tags=None) -> None:
+        k = self._key(tags)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + value
+
+    def dec(self, value: float = 1.0, tags=None) -> None:
+        self.inc(-value, tags)
+
+    def _samples(self):
+        with self._lock:
+            return list(self._values.items())
+
+
+class Histogram(Metric):
+    metric_type = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Optional[Sequence[float]] = None,
+                 tag_keys: Optional[Sequence[str]] = None):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = sorted(boundaries or
+                                 [0.001, 0.01, 0.1, 1, 10, 100, 1000])
+        self._counts: Dict[Tuple, List[int]] = {}
+        self._sums: Dict[Tuple, float] = {}
+        self._totals: Dict[Tuple, int] = {}
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None) -> None:
+        k = self._key(tags)
+        with self._lock:
+            if k not in self._counts:
+                self._counts[k] = [0] * (len(self.boundaries) + 1)
+                self._sums[k] = 0.0
+                self._totals[k] = 0
+            idx = bisect.bisect_left(self.boundaries, value)
+            self._counts[k][idx] += 1
+            self._sums[k] += value
+            self._totals[k] += 1
+
+    def _samples(self):
+        with self._lock:
+            return [(k, (list(c), self._sums[k], self._totals[k]))
+                    for k, c in self._counts.items()]
+
+
+def _escape_label(v: str) -> str:
+    # Prometheus text format: \ -> \\, " -> \", newline -> \n
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace(
+        "\n", "\\n")
+
+
+def _fmt_tags(key: Tuple) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{_escape_label(v)}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+def prometheus_text() -> str:
+    """All registered metrics in Prometheus exposition format."""
+    lines: List[str] = []
+    with _registry_lock:
+        metrics = list(_registry.values())
+    for m in metrics:
+        lines.append(f"# HELP {m.name} {m.description}")
+        lines.append(f"# TYPE {m.name} {m.metric_type}")
+        if isinstance(m, Histogram):
+            for key, (counts, total_sum, total) in m._samples():
+                cum = 0
+                for b, c in zip(m.boundaries, counts):
+                    cum += c
+                    tags = dict(key)
+                    tags["le"] = repr(b)
+                    lines.append(
+                        f"{m.name}_bucket{_fmt_tags(tuple(sorted(tags.items())))} {cum}")
+                tags = dict(key)
+                tags["le"] = "+Inf"
+                lines.append(
+                    f"{m.name}_bucket{_fmt_tags(tuple(sorted(tags.items())))} {total}")
+                lines.append(f"{m.name}_sum{_fmt_tags(key)} {total_sum}")
+                lines.append(f"{m.name}_count{_fmt_tags(key)} {total}")
+        else:
+            for key, val in m._samples():
+                lines.append(f"{m.name}{_fmt_tags(key)} {val}")
+    return "\n".join(lines) + "\n"
+
+
+def clear_registry() -> None:
+    with _registry_lock:
+        _registry.clear()

@@ -1,0 +1,141 @@
+"""State API: list/summarize cluster entities.
+
+Role analog: ``python/ray/util/state/api.py`` (``StateApiClient :110``,
+``list_actors :788``, ``summarize_tasks :1382``) — backed here by the
+driver's control plane (GCS analog) instead of a REST head.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+def _gcs():
+    from ray_tpu.core.runtime import _get_runtime
+
+    rt = _get_runtime()
+    if rt is None:
+        raise RuntimeError("ray_tpu is not initialized")
+    return rt
+
+
+def list_nodes() -> List[Dict[str, Any]]:
+    import ray_tpu
+
+    return ray_tpu.nodes()
+
+
+def list_actors(filters: Optional[List] = None) -> List[Dict[str, Any]]:
+    rt = _gcs()
+    out = []
+    for info in rt.gcs.all_actors():
+        rec = {
+            "actor_id": info.actor_id.hex(),
+            "state": info.state,
+            "name": getattr(info, "name", "") or None,
+            "restarts": getattr(info, "restarts", 0),
+        }
+        out.append(rec)
+    return _apply_filters(out, filters)
+
+
+def list_tasks(filters: Optional[List] = None) -> List[Dict[str, Any]]:
+    """Finished-task records from the driver's timeline buffer (reference
+    GcsTaskManager's task-event store)."""
+    rt = _gcs()
+    out = []
+    for ev in rt.timeline():
+        out.append({
+            "name": ev.get("name"),
+            "state": "FINISHED",
+            "duration_ms": ev.get("dur", 0) / 1e3,
+            "worker": ev.get("tid"),
+        })
+    return _apply_filters(out, filters)
+
+
+def list_objects(filters: Optional[List] = None) -> List[Dict[str, Any]]:
+    rt = _gcs()
+    out = []
+    for oid, st in rt.gcs.all_objects():
+        out.append({
+            "object_id": oid.hex(),
+            "status": st.status,
+            "size": st.size,
+            "in_plasma": st.inline is None,
+        })
+    return _apply_filters(out, filters)
+
+
+def list_placement_groups() -> List[Dict[str, Any]]:
+    rt = _gcs()
+    with rt.lock:
+        return [
+            {"placement_group_id": pgid.hex(), "bundles": pg["bundles"],
+             "strategy": pg["strategy"]}
+            for pgid, pg in rt.pgs.items()
+        ]
+
+
+def list_workers() -> List[Dict[str, Any]]:
+    rt = _gcs()
+    with rt.lock:
+        workers = list(rt.workers.values())
+    out = []
+    for ws in workers:
+        out.append({
+            "worker_id": ws.worker_id.hex(),
+            "pid": ws.proc.pid if ws.proc else None,
+            "kind": ws.kind,
+            "status": ws.status,
+        })
+    return out
+
+
+def summarize_tasks() -> Dict[str, Dict[str, int]]:
+    """Counts by (name, state) — reference ``summarize_tasks``."""
+    summary: Dict[str, Dict[str, int]] = {}
+    for t in list_tasks():
+        name = t.get("name", "unknown")
+        state = t.get("state", "unknown")
+        summary.setdefault(name, {}).setdefault(state, 0)
+        summary[name][state] += 1
+    return summary
+
+
+def summarize_actors() -> Dict[str, int]:
+    summary: Dict[str, int] = {}
+    for a in list_actors():
+        summary.setdefault(a["state"], 0)
+        summary[a["state"]] += 1
+    return summary
+
+
+def summarize_objects() -> Dict[str, Any]:
+    objs = list_objects()
+    return {
+        "total": len(objs),
+        "in_plasma": sum(1 for o in objs if o["in_plasma"]),
+        "inline": sum(1 for o in objs if not o["in_plasma"]),
+    }
+
+
+def _apply_filters(records: List[Dict[str, Any]],
+                   filters: Optional[List]) -> List[Dict[str, Any]]:
+    """filters: [(key, op, value)] with op in {'=', '!='} (reference
+    state-API filter tuples)."""
+    if not filters:
+        return records
+    out = []
+    for r in records:
+        keep = True
+        for key, op, value in filters:
+            got = r.get(key)
+            if op == "=" and got != value:
+                keep = False
+            elif op == "!=" and got == value:
+                keep = False
+        if keep:
+            out.append(r)
+    return out

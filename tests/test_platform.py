@@ -1,0 +1,157 @@
+"""Platform services: state API, metrics, dashboard HTTP, job submission, CLI."""
+
+import json
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def rt_plat():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_state_api_lists(rt_plat):
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return 1
+
+    a = A.options(name="state_test_actor").remote()
+    ray_tpu.get(a.ping.remote())
+
+    actors = state.list_actors()
+    assert any(rec["name"] == "state_test_actor" for rec in actors)
+    assert state.summarize_actors().get("ALIVE", 0) >= 1
+
+    @ray_tpu.remote
+    def work():
+        return 2
+
+    ray_tpu.get([work.remote() for _ in range(3)])
+    tasks = state.list_tasks()
+    assert len(tasks) >= 3
+    summary = state.summarize_tasks()
+    assert sum(v.get("FINISHED", 0) for v in summary.values()) >= 3
+
+    ref = ray_tpu.put(123)
+    objs = state.list_objects()
+    assert any(o["object_id"] == ref.id.hex() for o in objs)
+
+    workers = state.list_workers()
+    assert len(workers) >= 1
+
+    filtered = state.list_actors(filters=[("name", "=", "state_test_actor")])
+    assert len(filtered) == 1
+
+
+def test_metrics_prometheus_text():
+    from ray_tpu.util.metrics import (Counter, Gauge, Histogram,
+                                      clear_registry, prometheus_text)
+
+    clear_registry()
+    c = Counter("rtpu_test_total", "test counter", tag_keys=("kind",))
+    c.inc(2, tags={"kind": "a"})
+    c.inc(3, tags={"kind": "b"})
+    g = Gauge("rtpu_test_gauge", "test gauge")
+    g.set(7.5)
+    h = Histogram("rtpu_test_hist", "test hist", boundaries=[1, 10])
+    h.observe(0.5)
+    h.observe(5)
+    h.observe(50)
+
+    text = prometheus_text()
+    assert 'rtpu_test_total{kind="a"} 2.0' in text
+    assert "rtpu_test_gauge 7.5" in text
+    assert "rtpu_test_hist_count 3" in text
+    assert "rtpu_test_hist_sum 55.5" in text
+    clear_registry()
+
+
+def test_dashboard_endpoints(rt_plat):
+    import http.client
+
+    from ray_tpu.dashboard import Dashboard
+
+    dash = Dashboard(port=0).start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", dash.port, timeout=10)
+        conn.request("GET", "/api/summary/objects")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        data = json.loads(resp.read())["result"]
+        assert "total" in data
+
+        conn = http.client.HTTPConnection("127.0.0.1", dash.port, timeout=10)
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        assert resp.status == 200
+    finally:
+        dash.stop()
+
+
+def test_job_submission_lifecycle(rt_plat, tmp_path):
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    client = JobSubmissionClient()
+    script = tmp_path / "job.py"
+    script.write_text("print('hello from job'); print(6*7)\n")
+    job_id = client.submit_job(entrypoint=f"python {script}")
+    status = client.wait_until_finished(job_id, timeout=120)
+    assert status == JobStatus.SUCCEEDED
+    logs = client.get_job_logs(job_id)
+    assert "hello from job" in logs and "42" in logs
+    infos = client.list_jobs()
+    assert any(i.job_id == job_id for i in infos)
+
+
+def test_job_failure_recorded(rt_plat, tmp_path):
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    client = JobSubmissionClient()
+    job_id = client.submit_job(entrypoint="python -c 'import sys; sys.exit(3)'")
+    status = client.wait_until_finished(job_id, timeout=120)
+    assert status == JobStatus.FAILED
+    assert client.get_job_info(job_id).return_code == 3
+
+
+def test_job_stop_kills_entrypoint(rt_plat):
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    client = JobSubmissionClient()
+    job_id = client.submit_job(entrypoint="sleep 600")
+    # wait for the subprocess pgid to publish
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        info = client.get_job_info(job_id)
+        if info.pgid:
+            break
+        time.sleep(0.1)
+    assert info.pgid, "job never started"
+    assert client.stop_job(job_id)
+    assert client.get_job_status(job_id) == JobStatus.STOPPED
+    # the entrypoint process group is gone
+    import os, signal
+
+    deadline = time.time() + 10
+    gone = False
+    while time.time() < deadline:
+        try:
+            os.killpg(info.pgid, 0)
+            time.sleep(0.1)
+        except ProcessLookupError:
+            gone = True
+            break
+    assert gone, "entrypoint subprocess survived stop_job"
+
+
+def test_cli_status_and_clean():
+    from ray_tpu.scripts import main
+
+    assert main(["status"]) == 0
