@@ -127,6 +127,7 @@ class ActorClass:
             max_concurrency=int(self._options.get("max_concurrency", 1)),
             placement_group_id=pg,
             bundle_index=bundle_index,
+            runtime_env=self._options.get("runtime_env"),
         )
         rt.create_actor(spec)
         return ActorHandle(ActorID(spec["actor_id"]), self._method_options)

@@ -32,6 +32,14 @@ def _seg_path(session: str, obj_id: ObjectID) -> str:
     return os.path.join(_SHM_DIR, f"rtpu-{session}-{obj_id.hex()}")
 
 
+def _spill_dir(session: str) -> str:
+    return os.path.join("/tmp", f"rtpu-spill-{session}")
+
+
+def _spill_path(session: str, obj_id: ObjectID) -> str:
+    return os.path.join(_spill_dir(session), obj_id.hex())
+
+
 class _Pinned:
     """A mapped segment kept alive while any deserialized view exists.
 
@@ -81,6 +89,12 @@ class StoreClient:
                     "native object store unavailable (%s); "
                     "falling back to file-per-object segments", e)
                 self._arena = None
+        self._spill_threshold = int(os.environ.get(
+            "RTPU_SPILL_THRESHOLD", str(4 << 30)))
+        # Running total of THIS client's file-segment bytes: the spill
+        # check must be O(1), not a /dev/shm scan per put (store_bytes()
+        # stays the accurate cross-process accounting API).
+        self._file_bytes = 0
 
     # -- write path -------------------------------------------------------
 
@@ -115,7 +129,17 @@ class StoreClient:
                 return None
             # arena full: fall through to a file segment (never evict
             # referenced objects to make room)
-        path = _seg_path(self.session, obj_id)
+        # Spilling (reference raylet LocalObjectManager::SpillObjects):
+        # once shm usage crosses the threshold, new large objects go to
+        # disk instead of RAM-backed /dev/shm; reads are transparent.
+        arena_used = self._arena.stats()["used"] if self._arena else 0
+        spill = (arena_used + self._file_bytes + size
+                 > self._spill_threshold)
+        if spill:
+            os.makedirs(_spill_dir(self.session), exist_ok=True)
+            path = _spill_path(self.session, obj_id)
+        else:
+            path = _seg_path(self.session, obj_id)
         fd = os.open(path, os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
         try:
             os.ftruncate(fd, size)
@@ -124,6 +148,8 @@ class StoreClient:
         finally:
             os.close(fd)
         mm.close()
+        if not spill:
+            self._file_bytes += size
         return None
 
     def put_serialized(self, obj_id: ObjectID, blob: bytes) -> None:
@@ -172,6 +198,10 @@ class StoreClient:
                     self._arena.release(obj_id.binary())
         if pinned is None:
             path = _seg_path(self.session, obj_id)
+            if not os.path.exists(path):
+                spilled = _spill_path(self.session, obj_id)
+                if os.path.exists(spilled):
+                    path = spilled
             fd = os.open(path, os.O_RDONLY)
             try:
                 size = os.fstat(fd).st_size
@@ -193,7 +223,8 @@ class StoreClient:
             return True
         if self._arena is not None and self._arena.contains(obj_id.binary()):
             return True
-        return os.path.exists(_seg_path(self.session, obj_id))
+        return os.path.exists(_seg_path(self.session, obj_id)) or \
+            os.path.exists(_spill_path(self.session, obj_id))
 
     def release(self, obj_id: ObjectID) -> None:
         """Drop this process's pin (views must no longer be used).
@@ -232,8 +263,15 @@ class StoreClient:
         self.release(obj_id)
         if self._arena is not None:
             self._arena.delete(obj_id.binary())
+        seg = _seg_path(self.session, obj_id)
         try:
-            os.unlink(_seg_path(self.session, obj_id))
+            self._file_bytes = max(
+                0, self._file_bytes - os.stat(seg).st_size)
+            os.unlink(seg)
+        except FileNotFoundError:
+            pass
+        try:
+            os.unlink(_spill_path(self.session, obj_id))
         except FileNotFoundError:
             pass
 
@@ -254,6 +292,9 @@ class StoreClient:
             pass
         return total
 
+    def contains_spilled(self, obj_id: ObjectID) -> bool:
+        return os.path.exists(_spill_path(self.session, obj_id))
+
     @staticmethod
     def cleanup_session(session: str) -> None:
         try:
@@ -262,6 +303,9 @@ class StoreClient:
             NativeArena.destroy(session)
         except Exception:
             pass
+        import shutil
+
+        shutil.rmtree(_spill_dir(session), ignore_errors=True)
         prefix = f"rtpu-{session}-"
         try:
             for name in os.listdir(_SHM_DIR):

@@ -90,6 +90,7 @@ class RemoteFunction:
             max_retries=int(self._options.get("max_retries", 0)),
             placement_group_id=pg,
             bundle_index=bundle_index,
+            runtime_env=self._options.get("runtime_env"),
         )
         refs = rt.submit(spec)
         if num_returns == 1:

@@ -137,6 +137,21 @@ class DriverRuntime:
         for _ in range(min(_pool_prestart, self.pool_cap)):
             self._spawn_worker("pool")
 
+        # OOM protection (reference MemoryMonitor + worker-killing policy):
+        # kill the newest retriable task under host-RAM pressure. Killed
+        # workers re-enter the normal death path, which retries the task.
+        self._memory_monitor = None
+        if os.environ.get("RTPU_MEMORY_MONITOR", "1") != "0":
+            from ray_tpu.core.memory_monitor import (MemoryMonitor,
+                                                     kill_retriable_policy)
+
+            threshold = float(os.environ.get(
+                "RTPU_MEMORY_USAGE_THRESHOLD", "0.95"))
+            self._memory_monitor = MemoryMonitor(
+                usage_threshold=threshold,
+                on_pressure=kill_retriable_policy(self),
+            ).start()
+
     # ------------------------------------------------------------------
     # worker lifecycle
     # ------------------------------------------------------------------
@@ -954,6 +969,8 @@ class DriverRuntime:
         return list(self.timeline_events)
 
     def shutdown(self):
+        if self._memory_monitor is not None:
+            self._memory_monitor.stop()
         with self.lock:
             self._shutdown = True
             workers = list(self.workers.values())

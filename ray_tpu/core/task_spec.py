@@ -75,10 +75,12 @@ def make_task_spec(
     placement_group_id: Optional[bytes] = None,
     bundle_index: int = -1,
     scheduling_strategy: Any = None,
+    runtime_env: Optional[dict] = None,
 ) -> dict:
     task_id = TaskID.from_random()
     return {
         "type": TASK,
+        "runtime_env": runtime_env,
         "task_id": task_id.binary(),
         "fn_hash": fn_hash,
         "name": name,
@@ -103,10 +105,12 @@ def make_actor_create_spec(
     max_concurrency: int = 1,
     placement_group_id: Optional[bytes] = None,
     bundle_index: int = -1,
+    runtime_env: Optional[dict] = None,
 ) -> dict:
     actor_id = ActorID.from_random()
     return {
         "type": ACTOR_CREATE,
+        "runtime_env": runtime_env,
         "task_id": TaskID.from_random().binary(),
         "actor_id": actor_id.binary(),
         "fn_hash": cls_hash,

@@ -13,7 +13,6 @@ import itertools
 import os
 import threading
 import traceback
-from collections import deque
 from typing import Any, Dict, List, Optional
 
 import cloudpickle
@@ -36,6 +35,8 @@ class WorkerRuntime:
     is_driver = False
 
     def __init__(self, conn, session: str, worker_id: bytes):
+        import queue
+
         self.conn = conn
         self.session = session
         self.worker_id = WorkerID(worker_id)
@@ -44,8 +45,17 @@ class WorkerRuntime:
         self.registered_fns: set = set()
         self.actors: Dict[bytes, Any] = {}
         self._req_counter = itertools.count()
-        self._deferred_exec: deque = deque()
         self._send_lock = threading.Lock()
+        # Demuxed transport: exactly ONE thread reads the pipe and routes
+        # replies to the issuing thread. This lets ANY thread in the worker
+        # (the task thread, a train-session thread, a user thread) make
+        # runtime calls (get/put/remote) without racing the main loop for
+        # messages.
+        self._exec_queue: "queue.Queue" = queue.Queue()
+        self._reply_lock = threading.Lock()
+        self._replies: Dict[int, Any] = {}
+        self._reply_events: Dict[int, threading.Event] = {}
+        self._recv_started = False
         # context of the currently running task
         self.current_task_id: Optional[TaskID] = None
         self.current_actor_id: Optional[ActorID] = None
@@ -59,23 +69,46 @@ class WorkerRuntime:
     def cast(self, op: str, *args):
         self._send(("cast", op, args))
 
-    def request(self, op: str, *args):
-        req_id = next(self._req_counter)
-        self._send(("req", req_id, op, args))
+    def _start_receiver(self):
+        if self._recv_started:
+            return
+        self._recv_started = True
+        t = threading.Thread(target=self._recv_loop, daemon=True,
+                             name="rtpu_worker_recv")
+        t.start()
+
+    def _recv_loop(self):
         while True:
-            msg = self.conn.recv()
+            try:
+                msg = self.conn.recv()
+            except (EOFError, OSError):
+                os._exit(0)
             kind = msg[0]
-            if kind == "reply" and msg[1] == req_id:
-                if msg[2] == "err":
-                    raise cloudpickle.loads(msg[3])
-                return msg[3]
-            elif kind == "exec":
-                # concurrent dispatch (actor max_concurrency>1 future work):
-                # defer until the current task finishes.
-                self._deferred_exec.append(msg[1])
+            if kind == "exec":
+                self._exec_queue.put(msg[1])
+            elif kind == "reply":
+                req_id = msg[1]
+                with self._reply_lock:
+                    ev = self._reply_events.pop(req_id, None)
+                    if ev is not None:   # drop replies nobody awaits
+                        self._replies[req_id] = (msg[2], msg[3])
+                if ev is not None:
+                    ev.set()
             elif kind == "shutdown":
                 os._exit(0)
-            # stray replies for timed-out requests are dropped
+
+    def request(self, op: str, *args):
+        req_id = next(self._req_counter)
+        ev = threading.Event()
+        with self._reply_lock:
+            self._reply_events[req_id] = ev
+        self._send(("req", req_id, op, args))
+        ev.wait()
+        with self._reply_lock:
+            status, payload = self._replies.pop(req_id)
+        if status == "err":
+            raise cloudpickle.loads(payload)
+        return payload
 
     # -- object API -------------------------------------------------------
 
@@ -212,10 +245,54 @@ class WorkerRuntime:
                 results.append((rid_b, "s", None))
         return results
 
+    def _apply_runtime_env(self, spec: dict):
+        """Apply a per-task/actor runtime_env (reference
+        ``python/ray/runtime_env``: env_vars + working_dir subset — no
+        conda/pip: the image is fixed). Returns an undo closure; actor
+        creation applies permanently (the process is dedicated)."""
+        renv = spec.get("runtime_env")
+        if not renv:
+            return lambda: None
+        saved_env = {}
+        for k, v in (renv.get("env_vars") or {}).items():
+            saved_env[k] = os.environ.get(k)
+            os.environ[k] = str(v)
+        saved_cwd = None
+        path_entry = None
+        wd = renv.get("working_dir")
+        if wd:
+            saved_cwd = os.getcwd()
+            os.chdir(wd)
+            import sys
+
+            sys.path.insert(0, wd)
+            path_entry = wd
+        if spec["type"] == ts.ACTOR_CREATE:
+            return lambda: None  # permanent for the actor's lifetime
+
+        def undo():
+            import sys
+
+            for k, old in saved_env.items():
+                if old is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = old
+            if saved_cwd is not None:
+                os.chdir(saved_cwd)
+            if path_entry is not None and path_entry in sys.path:
+                sys.path.remove(path_entry)
+
+        return undo
+
     def execute(self, spec: dict):
         ttype = spec["type"]
         self.current_task_id = TaskID(spec["task_id"])
+        undo_env = lambda: None  # noqa: E731
         try:
+            # inside the try: a bad runtime_env (missing working_dir...)
+            # must fail THIS task, not crash the worker process
+            undo_env = self._apply_runtime_env(spec)
             args = [self._decode_arg(a) for a in spec["args"]]
             kwargs = {k: self._decode_arg(v) for k, v in spec["kwargs"].items()}
             if ttype == ts.TASK:
@@ -259,27 +336,14 @@ class WorkerRuntime:
             results = [(rid, "e", blob) for rid in spec["return_ids"]]
             self._send(("done", spec["task_id"], results))
         finally:
+            undo_env()
             self.current_task_id = None
 
     def main_loop(self):
+        self._start_receiver()
         self._send(("ready",))
         while True:
-            if self._deferred_exec:
-                spec = self._deferred_exec.popleft()
-            else:
-                try:
-                    msg = self.conn.recv()
-                except (EOFError, OSError):
-                    os._exit(0)
-                kind = msg[0]
-                if kind == "shutdown":
-                    os._exit(0)
-                elif kind == "exec":
-                    spec = msg[1]
-                elif kind == "reply":
-                    continue  # late reply for a timed-out request
-                else:
-                    continue
+            spec = self._exec_queue.get()
             self.execute(spec)
 
 

@@ -114,8 +114,12 @@ class JobSupervisor:
             rc = self.proc.wait()
         self.info.return_code = rc
         self.info.end_time = time.time()
-        if self.info.status == JobStatus.STOPPED:
-            pass
+        # stop_job writes STOPPED straight to the KV while this actor is
+        # occupied here — re-read it so a stop isn't overwritten by the
+        # SIGTERM'd child's exit status.
+        kv_info = _kv_get(self.job_id)
+        if kv_info is not None and kv_info.status == JobStatus.STOPPED:
+            self.info.status = JobStatus.STOPPED
         elif rc == 0:
             self.info.status = JobStatus.SUCCEEDED
         else:
@@ -205,9 +209,20 @@ class JobSubmissionClient:
         if info and info.status not in JobStatus.TERMINAL:
             info.status = JobStatus.STOPPED
             _kv_put(job_id, info)
-        if info and info.pgid:
+        # The pgid publishes right after Popen; if stop raced that window,
+        # poll briefly so the entrypoint can't slip away orphaned.
+        deadline = time.monotonic() + 5.0
+        pgid = info.pgid if info else None
+        while pgid is None and time.monotonic() < deadline:
+            time.sleep(0.05)
+            latest = _kv_get(job_id)
+            pgid = latest.pgid if latest else None
+            if latest and latest.status in (JobStatus.SUCCEEDED,
+                                            JobStatus.FAILED):
+                break  # never started long enough to matter
+        if pgid:
             try:
-                os.killpg(info.pgid, signal.SIGTERM)
+                os.killpg(pgid, signal.SIGTERM)
             except (ProcessLookupError, PermissionError):
                 pass
         try:

@@ -21,6 +21,7 @@ from ray_tpu.train.session import (
     TrainContext,
     get_checkpoint,
     get_context,
+    get_dataset_shard,
     report,
 )
 from ray_tpu.train.trainer import (
@@ -28,6 +29,11 @@ from ray_tpu.train.trainer import (
     DataParallelTrainer,
     JaxTrainer,
     TrainingFailedError,
+)
+from ray_tpu.train.pipeline import (
+    merge_microbatches,
+    pipeline_apply,
+    split_microbatches,
 )
 from ray_tpu.train.train_state import (
     TrainLoopHelper,
@@ -51,6 +57,7 @@ __all__ = [
     "TrainContext",
     "get_checkpoint",
     "get_context",
+    "get_dataset_shard",
     "report",
     "BaseTrainer",
     "DataParallelTrainer",

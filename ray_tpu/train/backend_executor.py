@@ -93,11 +93,17 @@ class BackendExecutor:
         trial_dir: str,
         experiment_name: str,
         checkpoint_path: Optional[str] = None,
+        datasets: Optional[Dict[str, Any]] = None,
     ) -> None:
         assert self.worker_group is not None
         self._backend.on_training_start(self.worker_group,
                                         self._backend_config)
         n = len(self.worker_group)
+        # dataset ingest (reference DataConfig): each named dataset is
+        # streaming_split across ranks; workers pull their shard's blocks.
+        shard_lists: Dict[str, Any] = {}
+        for name, ds in (datasets or {}).items():
+            shard_lists[name] = ds.streaming_split(n)
         refs = []
         for rank, w in enumerate(self.worker_group.workers):
             ctx = TrainContext(
@@ -110,6 +116,8 @@ class BackendExecutor:
                 trial_name=os.path.basename(trial_dir),
                 trial_dir=trial_dir,
                 loop_config=dict(loop_config),
+                dataset_shards={name: shards[rank]
+                                for name, shards in shard_lists.items()},
             )
             refs.append(w.start_session.remote(train_fn, ctx, checkpoint_path))
         ray_tpu.get(refs)

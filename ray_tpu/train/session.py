@@ -34,6 +34,7 @@ class TrainContext:
     trial_dir: str = "."
     trial_id: str = "0"
     loop_config: Dict[str, Any] = field(default_factory=dict)
+    dataset_shards: Dict[str, Any] = field(default_factory=dict)
 
     def get_world_rank(self) -> int:
         return self.world_rank
@@ -167,3 +168,17 @@ def get_checkpoint() -> Optional[Checkpoint]:
     if _session is None:
         return None
     return _session.get_checkpoint()
+
+
+def get_dataset_shard(name: str = "train"):
+    """This worker's DataIterator for the named dataset (reference
+    ``ray.train.get_dataset_shard``; sharding via ``streaming_split``)."""
+    if _session is None:
+        raise RuntimeError(
+            "get_dataset_shard() called outside a training session")
+    shard = _session.context.dataset_shards.get(name)
+    if shard is None:
+        raise KeyError(
+            f"no dataset {name!r}; pass datasets={{{name!r}: ds}} to the "
+            f"trainer (have {sorted(_session.context.dataset_shards)})")
+    return shard

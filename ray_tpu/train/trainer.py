@@ -144,6 +144,7 @@ class DataParallelTrainer(BaseTrainer):
             trial_dir=trial_dir,
             experiment_name=name,
             checkpoint_path=start_ckpt,
+            datasets=self.datasets,
         )
         progress_path = os.path.join(trial_dir, "progress.jsonl")
         last_metrics: Dict[str, Any] = {}
@@ -181,6 +182,7 @@ class DataParallelTrainer(BaseTrainer):
                 trial_dir=trial_dir, experiment_name=name,
                 checkpoint_path=(self.resume_from_checkpoint.path
                                  if self.resume_from_checkpoint else None),
+                datasets=self.datasets,
             )
             while True:
                 results = executor.get_next_results()

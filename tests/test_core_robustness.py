@@ -1,0 +1,147 @@
+"""Core robustness: runtime_env, spilling, memory monitor, retries."""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.memory_monitor import MemoryMonitor, system_memory
+from ray_tpu.core.object_store import StoreClient
+
+
+@pytest.fixture
+def rt_rob():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_task_runtime_env_env_vars(rt_rob):
+    @ray_tpu.remote
+    def read_env():
+        return os.environ.get("RTPU_TEST_VAR")
+
+    assert ray_tpu.get(read_env.remote()) is None
+    with_env = read_env.options(
+        runtime_env={"env_vars": {"RTPU_TEST_VAR": "hello"}})
+    assert ray_tpu.get(with_env.remote()) == "hello"
+    # env is restored for subsequent tasks on the same worker
+    assert ray_tpu.get(read_env.remote()) is None
+
+
+def test_task_runtime_env_working_dir(rt_rob, tmp_path):
+    (tmp_path / "marker.txt").write_text("found")
+
+    @ray_tpu.remote
+    def read_marker():
+        return open("marker.txt").read()
+
+    task = read_marker.options(runtime_env={"working_dir": str(tmp_path)})
+    assert ray_tpu.get(task.remote()) == "found"
+
+
+def test_bad_working_dir_fails_task_not_worker(rt_rob):
+    @ray_tpu.remote
+    def fine():
+        return "ok"
+
+    bad = fine.options(runtime_env={"working_dir": "/does/not/exist"})
+    from ray_tpu.core.exceptions import TaskError
+
+    with pytest.raises(TaskError):
+        ray_tpu.get(bad.remote(), timeout=30)
+    # worker survived; subsequent tasks run normally
+    assert ray_tpu.get(fine.remote(), timeout=30) == "ok"
+
+
+def test_runtime_env_sys_path_restored(rt_rob, tmp_path):
+    (tmp_path / "probe_mod.py").write_text("VALUE = 'from_tmp'\n")
+
+    @ray_tpu.remote
+    def uses_wd():
+        import probe_mod
+
+        return probe_mod.VALUE
+
+    task = uses_wd.options(runtime_env={"working_dir": str(tmp_path)})
+    assert ray_tpu.get(task.remote()) == "from_tmp"
+
+    @ray_tpu.remote
+    def path_has(entry):
+        import sys
+
+        return entry in sys.path
+
+    # run enough probes to cover every pool worker
+    checks = ray_tpu.get([path_has.remote(str(tmp_path)) for _ in range(8)])
+    assert not any(checks)
+
+
+def test_actor_runtime_env_persistent(rt_rob):
+    @ray_tpu.remote
+    class EnvActor:
+        def get(self):
+            return os.environ.get("RTPU_ACTOR_VAR")
+
+    a = EnvActor.options(
+        runtime_env={"env_vars": {"RTPU_ACTOR_VAR": "persistent"}}).remote()
+    assert ray_tpu.get(a.get.remote()) == "persistent"
+    assert ray_tpu.get(a.get.remote()) == "persistent"
+
+
+def test_spilling_to_disk(monkeypatch):
+    import uuid
+
+    session = uuid.uuid4().hex[:12]
+    monkeypatch.setenv("RTPU_SPILL_THRESHOLD", "1")   # spill everything big
+    monkeypatch.setenv("RTPU_NATIVE_STORE", "0")      # force the file path
+    client = StoreClient(session)
+    try:
+        oid = ObjectID.from_random()
+        data = np.arange(50_000, dtype=np.float64)
+        assert client.put(oid, data) is None
+        assert client.contains_spilled(oid)           # landed on disk
+        assert not os.path.exists(
+            f"/dev/shm/rtpu-{session}-{oid.hex()}")
+        back = client.get(oid)
+        np.testing.assert_array_equal(back, data)
+        del back
+        client.release(oid)
+        client.delete(oid)
+        assert not client.contains_spilled(oid)
+    finally:
+        StoreClient.cleanup_session(session)
+
+
+def test_memory_monitor_fires_on_threshold():
+    fired = []
+    mon = MemoryMonitor(usage_threshold=0.0,     # always over
+                        on_pressure=lambda mem: fired.append(mem))
+    assert mon.check()
+    assert fired and fired[0]["total"] > 0
+    mon2 = MemoryMonitor(usage_threshold=1.01)   # never over
+    assert not mon2.check()
+
+
+def test_system_memory_sane():
+    mem = system_memory()
+    assert mem["total"] > (1 << 28)
+    assert 0.0 <= mem["used_fraction"] <= 1.0
+
+
+def test_task_retry_after_worker_death(rt_rob, tmp_path):
+    marker = tmp_path / "attempted"
+
+    @ray_tpu.remote
+    def flaky(marker_path):
+        import os as _os
+
+        if not _os.path.exists(marker_path):
+            open(marker_path, "w").close()
+            _os._exit(1)          # simulate worker crash
+        return "recovered"
+
+    ref = flaky.options(max_retries=2).remote(str(marker))
+    assert ray_tpu.get(ref, timeout=60) == "recovered"

@@ -112,6 +112,35 @@ def test_jax_trainer_restart_resumes_from_checkpoint(rt_train):
     assert result.metrics["resumed_from"] == 2  # resumed after step-1 ckpt
 
 
+def test_jax_trainer_dataset_ingest(rt_train):
+    import ray_tpu.data as rdata
+
+    ds = rdata.from_items([{"x": float(i)} for i in range(40)],
+                          parallelism=4)
+
+    def loop(config):
+        import ray_tpu.train as train
+
+        it = train.get_dataset_shard("train")
+        total = 0.0
+        count = 0
+        for batch in it.iter_batches(batch_size=5):
+            total += float(batch["x"].sum())
+            count += len(batch["x"])
+        train.report({"total": total, "count": count})
+
+    trainer = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(storage_path=rt_train),
+        datasets={"train": ds},
+    )
+    result = trainer.fit()
+    # rank 0 saw a proper split; both ranks together cover everything —
+    # check via the count being half the rows (round-robin 4 blocks / 2)
+    assert result.metrics["count"] == 20
+
+
 def test_save_load_pytree_roundtrip(tmp_path):
     tree = {"a": np.arange(6, dtype=np.float32).reshape(2, 3),
             "b": {"c": np.ones((4,), np.int32)}}
