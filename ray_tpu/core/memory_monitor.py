@@ -89,7 +89,12 @@ def kill_retriable_policy(runtime) -> Callable[[dict], None]:
                 if ws.kind == "pool" and ws.status == "busy"
                 and ws.current and ws.current.get("retries_left", 0) > 0
             ]
-            victim = candidates[-1] if candidates else None
+            # newest TASK first (retriable FIFO): losing the least work
+            victim = max(
+                candidates,
+                key=lambda w: runtime._task_start_ts.get(
+                    w.current["task_id"], 0.0),
+                default=None)
             if victim is not None:
                 try:
                     victim.proc.terminate()

@@ -55,7 +55,7 @@ class _WorkerState:
     __slots__ = (
         "worker_id", "proc", "conn", "kind", "status", "current",
         "held", "actor_id", "reader", "released", "send_lock", "log_path",
-        "pending_spec",
+        "pending_spec", "inflight_specs",
     )
 
     def __init__(self, worker_id: WorkerID, proc, kind: str):
@@ -71,6 +71,9 @@ class _WorkerState:
         self.send_lock = threading.Lock()
         self.log_path = ""
         self.pending_spec: Optional[dict] = None  # dispatch once connected
+        # all dispatched-but-unfinished specs keyed by task id (>1 only for
+        # actors with max_concurrency > 1)
+        self.inflight_specs: Dict[bytes, dict] = {}
 
     def send(self, msg):
         if self.conn is None:
@@ -244,11 +247,15 @@ class DriverRuntime:
             if not ws.released:
                 self._release(ws.held)
             spec = ws.current
+            inflight = list(ws.inflight_specs.values())
+            ws.inflight_specs.clear()
             ws.current = None
-        if spec is not None:
-            if spec["type"] == ts.ACTOR_CREATE or ws.actor_id is not None:
-                self._actor_process_died(ws, spec if spec["type"] != ts.ACTOR_CREATE else None)
-            elif spec.get("retries_left", 0) > 0:
+        if spec is not None and spec["type"] == ts.ACTOR_CREATE:
+            self._actor_process_died(ws, [])
+        elif ws.actor_id is not None:
+            self._actor_process_died(ws, inflight)
+        elif spec is not None:
+            if spec.get("retries_left", 0) > 0:
                 spec["retries_left"] -= 1
                 self._enqueue_ready(spec)
             else:
@@ -257,8 +264,6 @@ class DriverRuntime:
                 )
                 for rid in spec["return_ids"]:
                     self.gcs.mark_error(ObjectID(rid), err)
-        elif ws.actor_id is not None:
-            self._actor_process_died(ws, None)
         with self.lock:
             alive_pool = sum(
                 1 for w in self.workers.values() if w.kind == "pool" and w.status != "dead"
@@ -273,18 +278,22 @@ class DriverRuntime:
             self._spawn_worker("pool")
         self._pump()
 
-    def _actor_process_died(self, ws: _WorkerState, inflight_spec: Optional[dict]):
-        aid = ws.actor_id or (inflight_spec and inflight_spec.get("actor_id"))
+    def _actor_process_died(self, ws: _WorkerState,
+                            inflight_specs: List[dict]):
+        aid = ws.actor_id or next(
+            (s.get("actor_id") for s in inflight_specs if s.get("actor_id")),
+            None)
         if aid is None:
             return
         info = self.gcs.get_actor(ActorID(aid))
         if info is None:
             return
         err = cloudpickle.dumps(ActorDiedError(f"actor {ActorID(aid).hex()} died"))
-        if inflight_spec is not None:
-            for rid in inflight_spec["return_ids"]:
+        for s in inflight_specs:
+            for rid in s["return_ids"]:
                 self.gcs.mark_error(ObjectID(rid), err)
         with self.lock:
+            info.inflight = 0
             if info.restarts < info.max_restarts or info.max_restarts == -1:
                 info.restarts += 1
                 info.state = "RESTARTING"
@@ -338,7 +347,8 @@ class DriverRuntime:
             self._handle_req(ws, msg[1], msg[2], msg[3])
 
     def _handle_done(self, ws: _WorkerState, task_id_b: bytes, results):
-        spec = ws.current
+        with self.lock:
+            spec = ws.inflight_specs.pop(task_id_b, None) or ws.current
         for rid, rkind, payload in results:
             oid = ObjectID(rid)
             if rkind == "i":
@@ -362,7 +372,8 @@ class DriverRuntime:
             )
         failed = bool(results and results[0][1] == "e")
         with self.lock:
-            ws.current = None
+            if not ws.inflight_specs:
+                ws.current = None
             if not ws.released:
                 self._release(ws.held)
             ws.held = {}
@@ -380,7 +391,8 @@ class DriverRuntime:
                 info = self.gcs.get_actor(ActorID(spec["actor_id"]))
                 if info is not None:
                     info.running = False
-                ws.status = "idle"
+                    info.inflight = max(0, info.inflight - 1)
+                ws.status = "idle" if not ws.inflight_specs else "busy"
             else:
                 ws.status = "idle"
         if spec is not None and spec["type"] == ts.ACTOR_CREATE and failed:
@@ -749,6 +761,7 @@ class DriverRuntime:
         with self.lock:
             ws.status = "busy"
             ws.current = spec
+            ws.inflight_specs[spec["task_id"]] = spec
             ws.released = False
         self._task_start_ts[spec["task_id"]] = time.time()
         try:
@@ -796,18 +809,24 @@ class DriverRuntime:
                     dispatched = True
                     break
                 else:
-                    # 2. actor method calls
+                    # 2. actor method calls (up to max_concurrency in
+                    # flight per actor; >1 executes on worker threads)
                     target = None
                     for info in list(self.gcs.actors.values()):
-                        if not info.pending_queue or info.running:
+                        if not info.pending_queue:
                             continue
                         if info.state not in ("ALIVE",):
                             continue
+                        if info.inflight >= max(info.max_concurrency, 1):
+                            continue
                         ws = self.workers.get(info.worker_id)
-                        if ws is None or ws.status != "idle":
+                        if ws is None or ws.status in ("starting", "dead"):
+                            continue
+                        if ws.status == "busy" and info.max_concurrency <= 1:
                             continue
                         spec = info.pending_queue.pop(0)
                         info.running = True
+                        info.inflight += 1
                         ws.held = {}
                         target = (ws, spec)
                         dispatched = True
@@ -925,8 +944,11 @@ class DriverRuntime:
                     return
             # mark for when deps resolve
             for ws in self.workers.values():
+                for spec in ws.inflight_specs.values():
+                    if obj_id.binary() in spec["return_ids"]:
+                        return  # running: cooperative cancel unsupported
                 if ws.current and obj_id.binary() in ws.current["return_ids"]:
-                    return  # running: cooperative cancel unsupported in round 1
+                    return  # running: cooperative cancel unsupported
         err = cloudpickle.dumps(TaskCancelledError("task was cancelled"))
         st = self.gcs.object_state(obj_id)
         if st is not None and st.status == "PENDING":

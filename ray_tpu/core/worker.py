@@ -44,6 +44,8 @@ class WorkerRuntime:
         self.fn_cache: Dict[str, Any] = {}
         self.registered_fns: set = set()
         self.actors: Dict[bytes, Any] = {}
+        self.actor_concurrency: Dict[bytes, int] = {}
+        self._actor_pools: Dict[bytes, Any] = {}  # ThreadPoolExecutor
         self._req_counter = itertools.count()
         self._send_lock = threading.Lock()
         # Demuxed transport: exactly ONE thread reads the pipe and routes
@@ -56,9 +58,26 @@ class WorkerRuntime:
         self._replies: Dict[int, Any] = {}
         self._reply_events: Dict[int, threading.Event] = {}
         self._recv_started = False
-        # context of the currently running task
-        self.current_task_id: Optional[TaskID] = None
-        self.current_actor_id: Optional[ActorID] = None
+        # context of the currently running task — thread-local because
+        # concurrent actors (max_concurrency > 1) execute methods on pool
+        # threads and must not see each other's ids
+        self._task_ctx = threading.local()
+
+    @property
+    def current_task_id(self) -> Optional[TaskID]:
+        return getattr(self._task_ctx, "task_id", None)
+
+    @current_task_id.setter
+    def current_task_id(self, value: Optional[TaskID]) -> None:
+        self._task_ctx.task_id = value
+
+    @property
+    def current_actor_id(self) -> Optional[ActorID]:
+        return getattr(self._task_ctx, "actor_id", None)
+
+    @current_actor_id.setter
+    def current_actor_id(self, value: Optional[ActorID]) -> None:
+        self._task_ctx.actor_id = value
 
     # -- transport --------------------------------------------------------
 
@@ -304,6 +323,8 @@ class WorkerRuntime:
                 self.current_actor_id = ActorID(spec["actor_id"])
                 instance = cls(*args, **kwargs)
                 self.actors[spec["actor_id"]] = instance
+                self.actor_concurrency[spec["actor_id"]] = int(
+                    spec.get("max_concurrency", 1))
                 results = self._encode_results(spec, None)
             elif ttype == ts.ACTOR_METHOD:
                 instance = self.actors.get(spec["actor_id"])
@@ -319,6 +340,13 @@ class WorkerRuntime:
                 else:
                     method = getattr(instance, spec["method"])
                     value = method(*args, **kwargs)
+                if _iscoroutine(value):
+                    # async actor method: run it to completion on a private
+                    # loop (with max_concurrency > 1 each call has its own
+                    # thread, so loops never collide)
+                    import asyncio
+
+                    value = asyncio.run(value)
                 results = self._encode_results(spec, value)
             else:
                 raise ValueError(f"unknown task type {ttype}")
@@ -344,7 +372,29 @@ class WorkerRuntime:
         self._send(("ready",))
         while True:
             spec = self._exec_queue.get()
-            self.execute(spec)
+            conc = (self.actor_concurrency.get(spec.get("actor_id", b""), 1)
+                    if spec["type"] == ts.ACTOR_METHOD else 1)
+            if conc > 1:
+                # concurrent actor: run the call on the actor's thread
+                # pool so the main loop keeps draining dispatches
+                aid = spec["actor_id"]
+                pool = self._actor_pools.get(aid)
+                if pool is None:
+                    from concurrent.futures import ThreadPoolExecutor
+
+                    pool = ThreadPoolExecutor(
+                        max_workers=conc,
+                        thread_name_prefix="rtpu_actor")
+                    self._actor_pools[aid] = pool
+                pool.submit(self.execute, spec)
+            else:
+                self.execute(spec)
+
+
+def _iscoroutine(value) -> bool:
+    import inspect
+
+    return inspect.iscoroutine(value)
 
 
 def worker_entry(conn, session: str, worker_id: bytes):

@@ -206,20 +206,25 @@ class JobSubmissionClient:
         # process group directly (it was started in its own session, so
         # killing the supervisor alone would orphan it).
         info = _kv_get(job_id)
-        if info and info.status not in JobStatus.TERMINAL:
+        if info is None:
+            return False  # unknown job — nothing to stop
+        was_running = info.status == JobStatus.RUNNING
+        if info.status not in JobStatus.TERMINAL:
             info.status = JobStatus.STOPPED
             _kv_put(job_id, info)
         # The pgid publishes right after Popen; if stop raced that window,
-        # poll briefly so the entrypoint can't slip away orphaned.
-        deadline = time.monotonic() + 5.0
-        pgid = info.pgid if info else None
-        while pgid is None and time.monotonic() < deadline:
-            time.sleep(0.05)
-            latest = _kv_get(job_id)
-            pgid = latest.pgid if latest else None
-            if latest and latest.status in (JobStatus.SUCCEEDED,
-                                            JobStatus.FAILED):
-                break  # never started long enough to matter
+        # poll briefly so the entrypoint can't slip away orphaned. Only a
+        # RUNNING job can have a subprocess pending publication.
+        pgid = info.pgid
+        if pgid is None and was_running:
+            deadline = time.monotonic() + 5.0
+            while pgid is None and time.monotonic() < deadline:
+                time.sleep(0.05)
+                latest = _kv_get(job_id)
+                pgid = latest.pgid if latest else None
+                if latest and latest.status in (JobStatus.SUCCEEDED,
+                                                JobStatus.FAILED):
+                    break  # finished on its own meanwhile
         if pgid:
             try:
                 os.killpg(pgid, signal.SIGTERM)

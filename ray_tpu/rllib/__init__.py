@@ -8,6 +8,9 @@ V-trace) ship first; replay buffers cover the off-policy family.
 """
 
 from ray_tpu.rllib.actor_manager import FaultTolerantActorManager
+from ray_tpu.rllib.anakin import AnakinPPO
+from ray_tpu.rllib.dqn import DQN, DQNConfig, DQNLearner
+from ray_tpu.rllib.jax_env import CartPoleJax, make_jax_env
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.env_runner import SingleAgentEnvRunner
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, ImpalaLearner, \
@@ -19,6 +22,12 @@ from ray_tpu.rllib.rl_module import JaxRLModule, RLModuleSpec
 
 __all__ = [
     "Algorithm",
+    "AnakinPPO",
+    "DQN",
+    "DQNConfig",
+    "DQNLearner",
+    "CartPoleJax",
+    "make_jax_env",
     "AlgorithmConfig",
     "SingleAgentEnvRunner",
     "FaultTolerantActorManager",

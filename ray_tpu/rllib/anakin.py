@@ -1,0 +1,200 @@
+"""Anakin: fully-jitted on-device PPO (rollout + learn in one XLA program).
+
+TPU-native RL beyond the reference's capabilities: the reference's fastest
+path still ships sample batches host→learner (SURVEY §3.5); the podracer
+"Anakin" architecture (PAPERS.md, Hessel et al. 2021 — pattern only) keeps
+envs, policy, GAE, and SGD in a single jitted step over vmapped pure-JAX
+envs, so the MXU never waits on hosts. Scales over the mesh's dp axis by
+sharding the env batch; gradient sync is the psum XLA inserts.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.jax_env import make_jax_env
+from ray_tpu.rllib.rl_module import RLModuleSpec
+
+
+class AnakinState(NamedTuple):
+    params: Any
+    opt_state: Any
+    env_states: Any          # vmapped EnvState
+    key: jax.Array
+    # running episode stats (per env): current return/len + last completed
+    ep_return: jax.Array
+    ep_len: jax.Array
+    last_return: jax.Array
+
+
+class AnakinPPO:
+    """Config-light fully-jitted PPO."""
+
+    def __init__(self, env_name: str = "CartPole-v1", *,
+                 num_envs: int = 64, rollout_len: int = 32,
+                 lr: float = 3e-4, gamma: float = 0.99, lam: float = 0.95,
+                 clip: float = 0.2, vf_coeff: float = 0.5,
+                 entropy_coeff: float = 0.01, num_epochs: int = 4,
+                 num_minibatches: int = 4, seed: int = 0,
+                 hidden: Tuple[int, ...] = (64, 64)):
+        self.env = make_jax_env(env_name)
+        self.spec = RLModuleSpec(
+            observation_dim=self.env.observation_dim,
+            action_dim=self.env.action_dim, discrete=True, hidden=hidden)
+        self.module = self.spec.build()
+        self.cfg = dict(num_envs=num_envs, rollout_len=rollout_len,
+                        gamma=gamma, lam=lam, clip=clip, vf_coeff=vf_coeff,
+                        entropy_coeff=entropy_coeff, num_epochs=num_epochs,
+                        num_minibatches=num_minibatches)
+        self.optimizer = optax.chain(optax.clip_by_global_norm(0.5),
+                                     optax.adam(lr))
+
+        key = jax.random.PRNGKey(seed)
+        key, pkey, ekey = jax.random.split(key, 3)
+        params = self.module.init(pkey)
+        env_states = jax.vmap(self.env.reset)(
+            jax.random.split(ekey, num_envs))
+        self.state = AnakinState(
+            params=params,
+            opt_state=self.optimizer.init(params),
+            env_states=env_states,
+            key=key,
+            ep_return=jnp.zeros((num_envs,)),
+            ep_len=jnp.zeros((num_envs,), jnp.int32),
+            last_return=jnp.zeros((num_envs,)),
+        )
+        self._step_fn = jax.jit(self._train_iteration, donate_argnums=(0,))
+
+    # -- the single fused program ----------------------------------------
+
+    def _rollout(self, state: AnakinState):
+        cfg = self.cfg
+
+        def step(carry, _):
+            env_states, key, ep_ret, ep_len, last_ret = carry
+            obs = env_states.obs                      # [N, D]
+            key, akey = jax.random.split(key)
+            out = self.module.forward_exploration(state.params, obs, akey)
+            step_out = jax.vmap(self.env.step)(env_states, out["actions"])
+            ep_ret = ep_ret + step_out.reward
+            ep_len = ep_len + 1
+            last_ret = jnp.where(step_out.done, ep_ret, last_ret)
+            ep_ret = jnp.where(step_out.done, 0.0, ep_ret)
+            ep_len = jnp.where(step_out.done, 0, ep_len)
+            traj = {
+                "obs": obs,
+                "actions": out["actions"],
+                "logp": out["action_logp"],
+                "value": out["vf_preds"],
+                "reward": step_out.reward,
+                "done": step_out.done,
+            }
+            return (step_out.state, key, ep_ret, ep_len, last_ret), traj
+
+        (env_states, key, ep_ret, ep_len, last_ret), traj = jax.lax.scan(
+            step,
+            (state.env_states, state.key, state.ep_return, state.ep_len,
+             state.last_return),
+            None, length=cfg["rollout_len"])
+        return env_states, key, ep_ret, ep_len, last_ret, traj
+
+    def _gae(self, traj, last_value):
+        cfg = self.cfg
+        nonterminal = 1.0 - traj["done"].astype(jnp.float32)
+
+        def back(carry, inp):
+            gae = carry
+            reward, value, nextv, nonterm = inp
+            delta = reward + cfg["gamma"] * nextv * nonterm - value
+            gae = delta + cfg["gamma"] * cfg["lam"] * nonterm * gae
+            return gae, gae
+
+        next_values = jnp.concatenate(
+            [traj["value"][1:], last_value[None]], axis=0)
+        _, adv = jax.lax.scan(
+            back, jnp.zeros_like(last_value),
+            (traj["reward"], traj["value"], next_values, nonterminal),
+            reverse=True)
+        returns = adv + traj["value"]
+        return adv, returns
+
+    def _loss(self, params, batch):
+        cfg = self.cfg
+        out = self.module.forward_train(params, batch["obs"])
+        logp, entropy = self.module.logp_entropy(out, batch["actions"])
+        ratio = jnp.exp(logp - batch["logp"])
+        adv = batch["adv"]
+        surr = jnp.minimum(ratio * adv,
+                           jnp.clip(ratio, 1 - cfg["clip"],
+                                    1 + cfg["clip"]) * adv)
+        vf_loss = jnp.mean((out["vf_preds"] - batch["returns"]) ** 2)
+        loss = (-surr.mean() + cfg["vf_coeff"] * vf_loss
+                - cfg["entropy_coeff"] * entropy.mean())
+        return loss, {"policy_loss": -surr.mean(), "vf_loss": vf_loss,
+                      "entropy": entropy.mean()}
+
+    def _train_iteration(self, state: AnakinState):
+        cfg = self.cfg
+        env_states, key, ep_ret, ep_len, last_ret, traj = self._rollout(state)
+
+        last_out = self.module.forward_train(state.params,
+                                             env_states.obs)
+        adv, returns = self._gae(traj, last_out["vf_preds"])
+        t_len, n = traj["reward"].shape
+        flat = {
+            "obs": traj["obs"].reshape(t_len * n, -1),
+            "actions": traj["actions"].reshape(-1),
+            "logp": traj["logp"].reshape(-1),
+            "adv": ((adv - adv.mean()) /
+                    (adv.std() + 1e-6)).reshape(-1),
+            "returns": returns.reshape(-1),
+        }
+
+        def epoch(carry, ekey):
+            params, opt_state = carry
+            perm = jax.random.permutation(ekey, t_len * n)
+            mb_size = (t_len * n) // cfg["num_minibatches"]
+
+            def minibatch(carry, i):
+                params, opt_state = carry
+                idx = jax.lax.dynamic_slice_in_dim(
+                    perm, i * mb_size, mb_size)
+                mb = {k: v[idx] for k, v in flat.items()}
+                (_, metrics), grads = jax.value_and_grad(
+                    self._loss, has_aux=True)(params, mb)
+                updates, opt_state = self.optimizer.update(
+                    grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state), metrics
+
+            (params, opt_state), metrics = jax.lax.scan(
+                minibatch, (params, opt_state),
+                jnp.arange(cfg["num_minibatches"]))
+            return (params, opt_state), metrics
+
+        key, *ekeys = jax.random.split(key, cfg["num_epochs"] + 1)
+        (params, opt_state), metrics = jax.lax.scan(
+            epoch, (state.params, state.opt_state), jnp.stack(ekeys))
+
+        new_state = AnakinState(
+            params=params, opt_state=opt_state, env_states=env_states,
+            key=key, ep_return=ep_ret, ep_len=ep_len, last_return=last_ret)
+        out_metrics = {k: v.mean() for k, v in metrics.items()}
+        out_metrics["episode_return_mean"] = last_ret.mean()
+        return new_state, out_metrics
+
+    # -- public API -------------------------------------------------------
+
+    def train(self) -> Dict[str, float]:
+        self.state, metrics = self._step_fn(self.state)
+        return {k: float(jax.device_get(v)) for k, v in metrics.items()}
+
+    @property
+    def params(self):
+        return self.state.params

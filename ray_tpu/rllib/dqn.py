@@ -1,0 +1,164 @@
+"""DQN: off-policy Q-learning with replay + target network.
+
+Role analog: ``rllib/algorithms/dqn/`` (new-stack DQN: replay buffer,
+target net sync, optional double-Q and prioritized replay — both on by
+default here, as in the reference's rainbow-lite defaults). Exploration is
+Boltzmann: the env runner samples categorically over Q-logits, annealing
+naturally as Q-value gaps grow (no epsilon schedule).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.learner import JaxLearner, LearnerGroup
+from ray_tpu.rllib.replay import PrioritizedReplayBuffer, ReplayBuffer
+
+
+class DQNLearner(JaxLearner):
+    """Q-network learner; params double as online net, target kept here."""
+
+    def __init__(self, module_spec_dict, config=None, seed: int = 0):
+        super().__init__(module_spec_dict, config, seed)
+        import jax
+
+        self.target_params = jax.tree.map(lambda x: x, self.params)
+        self._steps = 0
+
+    def compute_loss(self, params, batch):
+        import jax.numpy as jnp
+
+        cfg = self.config
+        gamma = cfg.get("gamma", 0.99)
+        double_q = cfg.get("double_q", True)
+
+        # q-values come from the pi head (action_dim outputs)
+        out = self.module.forward_train(params, batch["obs"])
+        q = out["action_dist_inputs"]
+        q_taken = jnp.take_along_axis(
+            q, batch["actions"][..., None].astype(jnp.int32), axis=-1)[..., 0]
+
+        next_out_target = self.module.forward_train(
+            batch["target_params"], batch["next_obs"])
+        q_next_target = next_out_target["action_dist_inputs"]
+        if double_q:
+            next_out_online = self.module.forward_train(
+                params, batch["next_obs"])
+            best = jnp.argmax(next_out_online["action_dist_inputs"], axis=-1)
+            q_next = jnp.take_along_axis(
+                q_next_target, best[..., None], axis=-1)[..., 0]
+        else:
+            q_next = q_next_target.max(axis=-1)
+        target = batch["rewards"] + gamma * q_next * (
+            1.0 - batch["dones"].astype(jnp.float32))
+        td_error = q_taken - jnp.asarray(target)
+        weights = batch.get("weights")
+        if weights is None:
+            loss = jnp.mean(td_error ** 2)
+        else:
+            loss = jnp.mean(weights * td_error ** 2)
+        return loss, {"td_error_abs": jnp.abs(td_error).mean(),
+                      "q_mean": q_taken.mean()}
+
+    def update(self, batch, minibatch_size=None, num_epochs: int = 1):
+        import jax
+
+        batch = dict(batch)
+        batch["target_params"] = self.target_params
+        # single full-batch step per update (off-policy convention)
+        self.params, self.opt_state, metrics = self._update_fn(
+            self.params, self.opt_state, batch)
+        self._steps += 1
+        if self._steps % self.config.get("target_update_freq", 100) == 0:
+            self.target_params = jax.tree.map(lambda x: x, self.params)
+        return {k: float(jax.device_get(v)) for k, v in metrics.items()}
+
+    def td_errors(self, batch) -> np.ndarray:
+        import jax
+        import jax.numpy as jnp
+
+        out = self.module.forward_train(self.params, batch["obs"])
+        q = np.asarray(out["action_dist_inputs"])
+        q_taken = np.take_along_axis(
+            q, batch["actions"][..., None].astype(np.int64), axis=-1)[..., 0]
+        tgt = self.module.forward_train(self.target_params, batch["next_obs"])
+        q_next = np.asarray(tgt["action_dist_inputs"]).max(axis=-1)
+        target = batch["rewards"] + self.config.get("gamma", 0.99) * \
+            q_next * (1.0 - batch["dones"].astype(np.float32))
+        return np.abs(q_taken - target)
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or DQN)
+        self.lr = 5e-4
+        self.buffer_size = 50_000
+        self.prioritized_replay = True
+        self.learning_starts = 500
+        self.train_batch_size = 64
+        self.target_update_freq = 100
+        self.double_q = True
+        self.updates_per_iteration = 16
+
+
+class DQN(Algorithm):
+    config_cls = DQNConfig
+
+    def _make_learner_group(self):
+        cfg = self.algo_config
+        learner_cfg = {
+            "lr": cfg.lr, "grad_clip": cfg.grad_clip, "gamma": cfg.gamma,
+            "double_q": getattr(cfg, "double_q", True),
+            "target_update_freq": getattr(cfg, "target_update_freq", 100),
+        }
+        # off-policy learners stay local: replay lives with the learner
+        return LearnerGroup(DQNLearner, self.module_spec, learner_cfg,
+                            num_learners=0, seed=cfg.seed)
+
+    def _setup_algo(self):
+        super()._setup_algo()
+        cfg = self.algo_config
+        if getattr(cfg, "prioritized_replay", True):
+            self.replay = PrioritizedReplayBuffer(cfg.buffer_size,
+                                                  seed=cfg.seed)
+        else:
+            self.replay = ReplayBuffer(cfg.buffer_size, seed=cfg.seed)
+        self._env_steps = 0
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        batches = self._sample(cfg.rollout_fragment_length)
+        for b in batches:
+            t_len, n = b["rewards"].shape
+            # Exploration comes from the runner's categorical sampling over
+            # Q-logits (Boltzmann); the stored action must be exactly what
+            # the env executed.
+            transitions = {
+                "obs": b["obs"].reshape(t_len * n, -1),
+                "actions": b["actions"].reshape(t_len * n),
+                "rewards": b["rewards"].reshape(-1),
+                "next_obs": np.concatenate(
+                    [b["obs"][1:].reshape((t_len - 1) * n, -1),
+                     b["next_obs"]], axis=0),
+                "dones": np.logical_or(b["terminateds"],
+                                       b["truncateds"]).reshape(-1),
+            }
+            self.replay.add(transitions)
+            self._env_steps += t_len * n
+
+        metrics: Dict[str, Any] = {"buffer_size": len(self.replay)}
+        if len(self.replay) >= cfg.learning_starts:
+            learner: DQNLearner = self.learner_group._local
+            for _ in range(cfg.updates_per_iteration):
+                batch = self.replay.sample(cfg.train_batch_size)
+                metrics.update(learner.update(batch))
+                if isinstance(self.replay, PrioritizedReplayBuffer):
+                    self.replay.update_priorities(
+                        batch["batch_indexes"], learner.td_errors(batch))
+        self._sync_runner_weights()
+        self._iteration += 1
+        metrics["num_env_steps_sampled"] = self._env_steps
+        return metrics

@@ -1,0 +1,85 @@
+"""Pure-JAX vectorized environments (the Anakin/podracer substrate).
+
+Green-field relative to the reference (gym envs are host-side there). For
+TPU-native RL the env itself is a jitted pure function, so rollout +
+learning fuse into ONE XLA program with no host round-trips (Podracer
+"Anakin" architecture, Hessel et al. 2021 — listed in PAPERS.md; pattern
+only, reimplemented from the public equations of CartPole dynamics).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class EnvState(NamedTuple):
+    obs: jax.Array        # [D] physical state
+    t: jax.Array          # step counter
+    key: jax.Array
+
+
+class StepOut(NamedTuple):
+    state: EnvState
+    obs: jax.Array
+    reward: jax.Array
+    done: jax.Array
+
+
+class CartPoleJax:
+    """CartPole-v1 dynamics as pure functions (standard published physics:
+    gravity 9.8, masscart 1.0, masspole 0.1, pole half-length 0.5,
+    force 10, dt 0.02, termination at |x|>2.4, |theta|>12deg, 500 steps)."""
+
+    observation_dim = 4
+    action_dim = 2
+    discrete = True
+    max_steps = 500
+
+    def reset(self, key: jax.Array) -> EnvState:
+        key, sub = jax.random.split(key)
+        obs = jax.random.uniform(sub, (4,), minval=-0.05, maxval=0.05)
+        return EnvState(obs=obs, t=jnp.zeros((), jnp.int32), key=key)
+
+    def step(self, state: EnvState, action: jax.Array) -> StepOut:
+        x, x_dot, theta, theta_dot = state.obs
+        force = jnp.where(action == 1, 10.0, -10.0)
+        costh = jnp.cos(theta)
+        sinth = jnp.sin(theta)
+        total_mass = 1.1
+        polemass_length = 0.05
+        temp = (force + polemass_length * theta_dot ** 2 * sinth) / total_mass
+        theta_acc = (9.8 * sinth - costh * temp) / (
+            0.5 * (4.0 / 3.0 - 0.1 * costh ** 2 / total_mass))
+        x_acc = temp - polemass_length * theta_acc * costh / total_mass
+        dt = 0.02
+        obs = jnp.stack([
+            x + dt * x_dot,
+            x_dot + dt * x_acc,
+            theta + dt * theta_dot,
+            theta_dot + dt * theta_acc,
+        ])
+        t = state.t + 1
+        done = (jnp.abs(obs[0]) > 2.4) | (jnp.abs(obs[2]) > 0.2095) | \
+            (t >= self.max_steps)
+        # auto-reset on done (standard vectorized-env semantics)
+        key, sub = jax.random.split(state.key)
+        reset_obs = jax.random.uniform(sub, (4,), minval=-0.05, maxval=0.05)
+        next_obs = jnp.where(done, reset_obs, obs)
+        next_t = jnp.where(done, 0, t)
+        new_state = EnvState(obs=next_obs, t=next_t, key=key)
+        return StepOut(state=new_state, obs=next_obs,
+                       reward=jnp.ones(()), done=done)
+
+
+REGISTRY = {"CartPole-v1": CartPoleJax}
+
+
+def make_jax_env(name: str):
+    try:
+        return REGISTRY[name]()
+    except KeyError:
+        raise ValueError(
+            f"no pure-JAX env {name!r}; have {sorted(REGISTRY)}")

@@ -70,6 +70,8 @@ class RunConfig:
     failure_config: FailureConfig = field(default_factory=FailureConfig)
     checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
     verbose: int = 1
+    callbacks: list = field(default_factory=list)   # tune logger callbacks
+    stop: Optional[Any] = None                      # Stopper | callable
 
     def resolved_storage_path(self) -> str:
         return self.storage_path or os.path.join(

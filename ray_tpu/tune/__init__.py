@@ -33,6 +33,16 @@ from ray_tpu.tune.trainable import (
     with_parameters,
     wrap_function,
 )
+from ray_tpu.tune.loggers import Callback, CSVLoggerCallback, \
+    JsonLoggerCallback
+from ray_tpu.tune.stopper import (
+    CombinedStopper,
+    MaximumIterationStopper,
+    MetricThresholdStopper,
+    Stopper,
+    TimeoutStopper,
+    TrialPlateauStopper,
+)
 from ray_tpu.tune.tune_controller import ResultGrid, TuneController, Trial
 from ray_tpu.tune.tuner import TuneConfig, Tuner, run
 
@@ -59,6 +69,15 @@ __all__ = [
     "FunctionTrainable",
     "with_parameters",
     "wrap_function",
+    "Callback",
+    "CSVLoggerCallback",
+    "JsonLoggerCallback",
+    "Stopper",
+    "CombinedStopper",
+    "MaximumIterationStopper",
+    "MetricThresholdStopper",
+    "TimeoutStopper",
+    "TrialPlateauStopper",
     "ResultGrid",
     "TuneController",
     "Trial",

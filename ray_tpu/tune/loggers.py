@@ -1,0 +1,67 @@
+"""Result loggers/callbacks: CSV + JSONL per trial.
+
+Role analog: ``python/ray/tune/logger/`` (CSV/JSON writers; W&B/MLflow
+integrations are external services and out of scope). The controller calls
+``on_trial_result``/``on_trial_complete`` on every registered callback.
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+
+class Callback:
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> None:
+        pass
+
+    def on_trial_complete(self, trial) -> None:
+        pass
+
+
+class JsonLoggerCallback(Callback):
+    """One result.json (JSONL) per trial dir."""
+
+    def on_trial_result(self, trial, result):
+        path = os.path.join(trial.trial_dir, "result.json")
+        rec = {k: v for k, v in result.items() if not k.startswith("_")}
+        rec["timestamp"] = time.time()
+        rec["trial_id"] = trial.trial_id
+        with open(path, "a") as f:
+            f.write(json.dumps(rec, default=str) + "\n")
+
+
+class CSVLoggerCallback(Callback):
+    """progress.csv per trial; header unioned from the first result."""
+
+    def __init__(self):
+        self._writers: Dict[str, csv.DictWriter] = {}
+        self._files: Dict[str, Any] = {}
+        self._fields: Dict[str, List[str]] = {}
+
+    def on_trial_result(self, trial, result):
+        rec = {k: v for k, v in result.items()
+               if not k.startswith("_") and
+               isinstance(v, (int, float, str, bool))}
+        tid = trial.trial_id
+        if tid not in self._writers:
+            path = os.path.join(trial.trial_dir, "progress.csv")
+            f = open(path, "a", newline="")
+            fields = sorted(rec)
+            w = csv.DictWriter(f, fieldnames=fields, extrasaction="ignore")
+            if f.tell() == 0:
+                w.writeheader()
+            self._writers[tid] = w
+            self._files[tid] = f
+            self._fields[tid] = fields
+        self._writers[tid].writerow(rec)
+        self._files[tid].flush()
+
+    def on_trial_complete(self, trial):
+        f = self._files.pop(trial.trial_id, None)
+        if f:
+            f.close()
+        self._writers.pop(trial.trial_id, None)

@@ -1,0 +1,90 @@
+"""Stoppers: declarative trial/experiment stop conditions.
+
+Role analog: ``python/ray/tune/stopper/``. A stopper is callable as
+``(trial_id, result) -> bool``; combine with CombinedStopper.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict, deque
+from typing import Any, Dict, Optional
+
+
+class Stopper:
+    def __call__(self, trial_id: str, result: Dict[str, Any]) -> bool:
+        raise NotImplementedError
+
+    def stop_all(self) -> bool:
+        return False
+
+
+class MaximumIterationStopper(Stopper):
+    def __init__(self, max_iter: int):
+        self.max_iter = max_iter
+
+    def __call__(self, trial_id, result):
+        return result.get("training_iteration", 0) >= self.max_iter
+
+
+class MetricThresholdStopper(Stopper):
+    def __init__(self, metric: str, threshold: float, mode: str = "min"):
+        self.metric = metric
+        self.threshold = threshold
+        self.mode = mode
+
+    def __call__(self, trial_id, result):
+        v = result.get(self.metric)
+        if v is None:
+            return False
+        return v <= self.threshold if self.mode == "min" else \
+            v >= self.threshold
+
+
+class TrialPlateauStopper(Stopper):
+    """Stop when the metric stops improving (reference
+    ``TrialPlateauStopper``: std of the last N values under a tolerance)."""
+
+    def __init__(self, metric: str, *, num_results: int = 4,
+                 std: float = 0.01, grace_period: int = 4):
+        self.metric = metric
+        self.num_results = num_results
+        self.std = std
+        self.grace = grace_period
+        self._history = defaultdict(lambda: deque(maxlen=num_results))
+        self._count = defaultdict(int)
+
+    def __call__(self, trial_id, result):
+        v = result.get(self.metric)
+        if v is None:
+            return False
+        self._history[trial_id].append(float(v))
+        self._count[trial_id] += 1
+        h = self._history[trial_id]
+        if self._count[trial_id] < self.grace or len(h) < self.num_results:
+            return False
+        mean = sum(h) / len(h)
+        var = sum((x - mean) ** 2 for x in h) / len(h)
+        return var ** 0.5 <= self.std
+
+
+class TimeoutStopper(Stopper):
+    def __init__(self, timeout_s: float):
+        self.deadline = time.monotonic() + timeout_s
+
+    def __call__(self, trial_id, result):
+        return time.monotonic() >= self.deadline
+
+    def stop_all(self):
+        return time.monotonic() >= self.deadline
+
+
+class CombinedStopper(Stopper):
+    def __init__(self, *stoppers: Stopper):
+        self.stoppers = stoppers
+
+    def __call__(self, trial_id, result):
+        return any(s(trial_id, result) for s in self.stoppers)
+
+    def stop_all(self):
+        return any(s.stop_all() for s in self.stoppers)

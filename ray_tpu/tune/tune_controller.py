@@ -105,11 +105,27 @@ class TuneController:
             except Exception:
                 pass
             trial.actor = None
+        if status in ("TERMINATED", "ERROR"):
+            for cb in self.run_config.callbacks:
+                try:
+                    cb.on_trial_complete(trial)
+                except Exception:
+                    pass
 
     # -- main loop --------------------------------------------------------
 
     def run(self) -> List[Trial]:
         while True:
+            # experiment-wide stop (Stopper.stop_all, e.g. TimeoutStopper):
+            # terminate running trials and drop pending ones
+            if getattr(self.stopper, "stop_all", None) and \
+                    self.stopper.stop_all():
+                for t in self.trials:
+                    if t.status == "RUNNING":
+                        self._finalize_and_stop(t)
+                    elif t.status == "PENDING":
+                        t.status = "TERMINATED"
+                break
             self._launch_pending()
             running = [t for t in self.trials if t.status == "RUNNING"
                        and t.pending_ref is not None]
@@ -168,6 +184,11 @@ class TuneController:
         if "_checkpoint_dir" in result:
             trial.checkpoint_dir = result["_checkpoint_dir"]
         self._append_progress(trial, result)
+        for cb in self.run_config.callbacks:
+            try:
+                cb.on_trial_result(trial, result)
+            except Exception:
+                pass
 
         # periodic class-trainable checkpointing
         freq = self.run_config.checkpoint_config.checkpoint_frequency

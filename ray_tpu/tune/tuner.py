@@ -77,6 +77,7 @@ class Tuner:
             configs,
             run_config=self.run_config,
             scheduler=tc.scheduler,
+            stopper=self.run_config.stop,
             max_concurrent=tc.max_concurrent_trials,
             resources_per_trial=tc.resources_per_trial,
             max_failures_per_trial=self.run_config.failure_config.max_failures,

@@ -1,0 +1,98 @@
+"""DQN (replay + target net) and Anakin fully-jitted PPO."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def rt_rl2():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_jax_cartpole_env_dynamics():
+    import jax
+
+    from ray_tpu.rllib import CartPoleJax
+
+    env = CartPoleJax()
+    state = env.reset(jax.random.PRNGKey(0))
+    assert state.obs.shape == (4,)
+    out = env.step(state, 1)
+    assert float(out.reward) == 1.0
+    assert not bool(out.done)
+    # pushing one way forever terminates the episode
+    s = state
+    done = False
+    for _ in range(200):
+        o = env.step(s, 1)
+        s = o.state
+        if bool(o.done):
+            done = True
+            break
+    assert done
+
+
+def test_jax_cartpole_vectorized_autoreset():
+    import jax
+
+    from ray_tpu.rllib import CartPoleJax
+
+    env = CartPoleJax()
+    keys = jax.random.split(jax.random.PRNGKey(1), 8)
+    states = jax.vmap(env.reset)(keys)
+    step = jax.jit(jax.vmap(env.step))
+    for _ in range(50):
+        actions = np.ones(8, np.int32)
+        out = step(states, actions)
+        states = out.state
+    # auto-reset keeps observations in bounds
+    assert np.all(np.abs(np.asarray(states.obs)[:, 0]) < 2.5)
+
+
+def test_dqn_learns_cartpole(rt_rl2):
+    from ray_tpu.rllib import DQNConfig
+
+    config = (DQNConfig()
+              .environment("CartPole-v1")
+              .env_runners(num_envs_per_env_runner=4,
+                           rollout_fragment_length=64)
+              .training(lr=1e-3, learning_starts=300,
+                        train_batch_size=64, updates_per_iteration=32,
+                        target_update_freq=50)
+              .debugging(seed=0))
+    algo = config.build()
+    returns = []
+    for _ in range(30):
+        result = algo.train()
+        returns.append(result.get("episode_return_mean", 0.0))
+    algo.cleanup()
+    assert max(returns[-5:]) > 40, f"DQN failed to learn: {returns}"
+
+
+def test_anakin_ppo_learns_cartpole():
+    from ray_tpu.rllib import AnakinPPO
+
+    algo = AnakinPPO("CartPole-v1", num_envs=32, rollout_len=64,
+                     lr=1e-3, entropy_coeff=0.01, seed=0)
+    returns = []
+    for _ in range(30):
+        metrics = algo.train()
+        returns.append(metrics["episode_return_mean"])
+    # fully-jitted loop learns: returns clearly above the random ~20
+    assert max(returns[-10:]) > 60, f"Anakin failed to learn: {returns}"
+
+
+def test_anakin_single_program_no_host_sync():
+    """One train() call = one jitted program (compile once, reuse)."""
+    from ray_tpu.rllib import AnakinPPO
+
+    algo = AnakinPPO("CartPole-v1", num_envs=8, rollout_len=8,
+                     num_epochs=1, num_minibatches=1, seed=1)
+    m1 = algo.train()
+    m2 = algo.train()
+    assert set(m1) == set(m2)
+    assert np.isfinite(m1["policy_loss"])

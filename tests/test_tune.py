@@ -155,6 +155,50 @@ def test_pbt_exploits_and_mutates(rt_tune):
     assert max(scores) > 0
 
 
+def test_stoppers_and_loggers(rt_tune):
+    import csv
+    import os
+
+    def objective(config):
+        for i in range(100):
+            tune.report({"loss": 1.0})   # flat: plateau after grace
+
+    csv_cb = tune.CSVLoggerCallback()
+    json_cb = tune.JsonLoggerCallback()
+    tuner = Tuner(
+        objective,
+        run_config=RunConfig(
+            storage_path=rt_tune,
+            stop=tune.CombinedStopper(
+                tune.TrialPlateauStopper("loss", num_results=3, std=0.0,
+                                         grace_period=3),
+                tune.MaximumIterationStopper(50)),
+            callbacks=[csv_cb, json_cb]),
+    )
+    grid = tuner.fit()
+    res = grid[0]
+    # plateau stopper cut it long before 100 iterations
+    assert res.metrics["training_iteration"] <= 5
+    with open(os.path.join(res.path, "progress.csv")) as f:
+        rows = list(csv.DictReader(f))
+    assert rows and rows[0]["loss"] == "1.0"
+    assert os.path.exists(os.path.join(res.path, "result.json"))
+
+
+def test_metric_threshold_stopper(rt_tune):
+    def objective(config):
+        for i in range(50):
+            tune.report({"score": float(i)})
+
+    grid = Tuner(
+        objective,
+        run_config=RunConfig(
+            storage_path=rt_tune,
+            stop=tune.MetricThresholdStopper("score", 10.0, mode="max")),
+    ).fit()
+    assert grid[0].metrics["score"] == 10.0
+
+
 def test_searcher_simple_bayes(rt_tune):
     def objective(config):
         tune.report({"loss": (config["x"] - 0.7) ** 2})
