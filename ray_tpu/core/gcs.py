@@ -34,7 +34,7 @@ class ObjectState:
 class ActorInfo:
     __slots__ = (
         "actor_id", "name", "worker_id", "state", "create_spec",
-        "max_restarts", "restarts", "pending_queue", "running",
+        "max_restarts", "restarts", "pending_queue",
         "death_cause", "max_concurrency", "inflight",
     )
 
@@ -47,7 +47,6 @@ class ActorInfo:
         self.max_restarts = create_spec.get("max_restarts", 0)
         self.restarts = 0
         self.pending_queue: List[dict] = []
-        self.running = False  # a method is currently dispatched
         self.death_cause = ""
         self.max_concurrency = create_spec.get("max_concurrency", 1)
         self.inflight = 0

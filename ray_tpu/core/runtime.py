@@ -304,7 +304,6 @@ class DriverRuntime:
             new_ws = self._spawn_worker("actor")
             new_ws.actor_id = aid
             info.worker_id = new_ws.worker_id
-            info.running = True
             new_ws.pending_spec = dict(info.create_spec)
         else:
             self._mark_actor_dead_and_flush(ActorID(aid), "process died", err)
@@ -381,7 +380,6 @@ class DriverRuntime:
             if spec is not None and spec["type"] == ts.ACTOR_CREATE:
                 info = self.gcs.get_actor(ActorID(spec["actor_id"]))
                 if info is not None:
-                    info.running = False
                     if failed:
                         info.state = "DEAD"
                     else:
@@ -390,7 +388,6 @@ class DriverRuntime:
             elif spec is not None and spec["type"] == ts.ACTOR_METHOD:
                 info = self.gcs.get_actor(ActorID(spec["actor_id"]))
                 if info is not None:
-                    info.running = False
                     info.inflight = max(0, info.inflight - 1)
                 ws.status = "idle" if not ws.inflight_specs else "busy"
             else:
@@ -794,7 +791,6 @@ class DriverRuntime:
                         info = self.gcs.get_actor(ActorID(spec["actor_id"]))
                         if info is not None:
                             info.worker_id = ws.worker_id
-                            info.running = True
                         ws.held = held
                         # worker hasn't dialed back yet; dispatch on "ready"
                         ws.pending_spec = spec
@@ -825,7 +821,6 @@ class DriverRuntime:
                         if ws.status == "busy" and info.max_concurrency <= 1:
                             continue
                         spec = info.pending_queue.pop(0)
-                        info.running = True
                         info.inflight += 1
                         ws.held = {}
                         target = (ws, spec)

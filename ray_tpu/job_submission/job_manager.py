@@ -94,6 +94,14 @@ class JobSupervisor:
     def run(self) -> str:
         """Start the subprocess and wait for completion (the actor is
         occupied for the job's duration, like the reference supervisor)."""
+        # A stop may have landed before we started: honor it and never
+        # spawn the entrypoint.
+        kv = _kv_get(self.job_id)
+        if kv is not None and kv.status == JobStatus.STOPPED:
+            self.info.status = JobStatus.STOPPED
+            self.info.end_time = time.time()
+            _kv_put(self.job_id, self.info)
+            return self.info.status
         env = dict(os.environ)
         env.update({k: str(v) for k, v in
                     self.runtime_env.get("env_vars", {}).items()})
@@ -111,6 +119,15 @@ class JobSupervisor:
             # entrypoint even while this actor is occupied by wait()
             self.info.pgid = os.getpgid(self.proc.pid)
             _kv_put(self.job_id, self.info)
+            # close the stop-vs-spawn race: a stop that raced between our
+            # RUNNING write and the pgid publish couldn't killpg — do it
+            # for them now that the pgid exists
+            kv = _kv_get(self.job_id)
+            if kv is not None and kv.status == JobStatus.STOPPED:
+                try:
+                    os.killpg(self.info.pgid, signal.SIGTERM)
+                except (ProcessLookupError, PermissionError):
+                    pass
             rc = self.proc.wait()
         self.info.return_code = rc
         self.info.end_time = time.time()
@@ -208,32 +225,34 @@ class JobSubmissionClient:
         info = _kv_get(job_id)
         if info is None:
             return False  # unknown job — nothing to stop
-        was_running = info.status == JobStatus.RUNNING
         if info.status not in JobStatus.TERMINAL:
             info.status = JobStatus.STOPPED
             _kv_put(job_id, info)
-        # The pgid publishes right after Popen; if stop raced that window,
-        # poll briefly so the entrypoint can't slip away orphaned. Only a
-        # RUNNING job can have a subprocess pending publication.
+        # The supervisor cooperates with the STOPPED flag (it refuses to
+        # spawn, or killpgs its own child right after publishing the
+        # pgid), so every interleaving is covered as long as we do NOT
+        # kill the supervisor before the pgid question is settled.
         pgid = info.pgid
-        if pgid is None and was_running:
+        if pgid is None:
             deadline = time.monotonic() + 5.0
             while pgid is None and time.monotonic() < deadline:
                 time.sleep(0.05)
                 latest = _kv_get(job_id)
                 pgid = latest.pgid if latest else None
-                if latest and latest.status in (JobStatus.SUCCEEDED,
-                                                JobStatus.FAILED):
-                    break  # finished on its own meanwhile
+                if latest and latest.status in JobStatus.TERMINAL and \
+                        latest.end_time is not None:
+                    break  # supervisor finished the job's lifecycle
         if pgid:
             try:
                 os.killpg(pgid, signal.SIGTERM)
             except (ProcessLookupError, PermissionError):
                 pass
-        try:
-            ray_tpu.kill(sup)
-        except Exception:
-            return False
+            try:
+                ray_tpu.kill(sup)
+            except Exception:
+                pass
+        # without a pgid the supervisor stays alive to enforce the STOPPED
+        # flag itself (killing it here could orphan a mid-spawn entrypoint)
         return True
 
     def wait_until_finished(self, job_id: str,

@@ -40,7 +40,6 @@ class CSVLoggerCallback(Callback):
     def __init__(self):
         self._writers: Dict[str, csv.DictWriter] = {}
         self._files: Dict[str, Any] = {}
-        self._fields: Dict[str, List[str]] = {}
 
     def on_trial_result(self, trial, result):
         rec = {k: v for k, v in result.items()
@@ -56,7 +55,6 @@ class CSVLoggerCallback(Callback):
                 w.writeheader()
             self._writers[tid] = w
             self._files[tid] = f
-            self._fields[tid] = fields
         self._writers[tid].writerow(rec)
         self._files[tid].flush()
 

@@ -69,14 +69,26 @@ class TrialPlateauStopper(Stopper):
 
 
 class TimeoutStopper(Stopper):
+    """Experiment wall-clock budget. The clock starts at the first check
+    (i.e. when the experiment actually runs), not at construction — a
+    RunConfig built ahead of time or reused across fits gets the full
+    budget each run... within one controller; reuse re-arms it."""
+
     def __init__(self, timeout_s: float):
-        self.deadline = time.monotonic() + timeout_s
+        self.timeout_s = timeout_s
+        self._deadline: Optional[float] = None
+
+    def _check(self) -> bool:
+        if self._deadline is None:
+            self._deadline = time.monotonic() + self.timeout_s
+            return False
+        return time.monotonic() >= self._deadline
 
     def __call__(self, trial_id, result):
-        return time.monotonic() >= self.deadline
+        return self._check()
 
     def stop_all(self):
-        return time.monotonic() >= self.deadline
+        return self._check()
 
 
 class CombinedStopper(Stopper):

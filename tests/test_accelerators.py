@@ -1,0 +1,120 @@
+"""TPU accelerator layer with a fake topology provider (no hardware).
+
+Mirrors the reference's mock strategy
+(``python/ray/tests/accelerators/test_tpu.py``): fake device listings, GKE
+env vars, and metadata lookups; assert env-var effects of visibility
+restriction and pod-slice resource derivation.
+"""
+
+import os
+
+import pytest
+
+from ray_tpu.accelerators.tpu import (
+    TPU_CHIPS_PER_HOST_BOUNDS_ENV,
+    TPU_HOST_BOUNDS_ENV,
+    TPU_VISIBLE_CHIPS_ENV,
+    TPUAcceleratorManager,
+    TpuTopologyProvider,
+    detect_num_tpu_chips,
+)
+
+
+class FakeProvider(TpuTopologyProvider):
+    def __init__(self, devices=(), accel_type=None, metadata=None,
+                 worker=0):
+        self._devices = list(devices)
+        self._accel_type = accel_type
+        self._metadata = metadata or {}
+        self._worker = worker
+
+    def list_accel_devices(self):
+        return self._devices
+
+    def jax_local_chip_count(self):
+        return 0
+
+    def gke_accelerator_type(self):
+        return self._accel_type
+
+    def gce_metadata(self, key):
+        return self._metadata.get(key)
+
+    def worker_id(self):
+        return self._worker
+
+
+def test_detect_chips_from_devices(monkeypatch):
+    monkeypatch.delenv(TPU_VISIBLE_CHIPS_ENV, raising=False)
+    p = FakeProvider(devices=["/dev/accel0", "/dev/accel1", "/dev/accel2",
+                              "/dev/accel3"])
+    assert detect_num_tpu_chips(p) == 4
+
+
+def test_detect_chips_respects_visibility(monkeypatch):
+    monkeypatch.setenv(TPU_VISIBLE_CHIPS_ENV, "0,1")
+    assert detect_num_tpu_chips(FakeProvider(devices=["/dev/accel0"] * 4)) == 2
+
+
+@pytest.mark.parametrize("ids,chip_bounds,host_bounds", [
+    (["0"], "1,1,1", "1,1,1"),
+    (["0", "1"], "1,2,1", "1,1,1"),
+    (["0", "1", "2", "3"], "2,2,1", "1,1,1"),
+])
+def test_visibility_env_vars(monkeypatch, ids, chip_bounds, host_bounds):
+    for var in (TPU_VISIBLE_CHIPS_ENV, TPU_CHIPS_PER_HOST_BOUNDS_ENV,
+                TPU_HOST_BOUNDS_ENV):
+        monkeypatch.delenv(var, raising=False)
+    mgr = TPUAcceleratorManager(FakeProvider())
+    mgr.set_current_process_visible_accelerator_ids(ids)
+    assert os.environ[TPU_VISIBLE_CHIPS_ENV] == ",".join(ids)
+    assert os.environ[TPU_CHIPS_PER_HOST_BOUNDS_ENV] == chip_bounds
+    assert os.environ[TPU_HOST_BOUNDS_ENV] == host_bounds
+
+
+def test_invalid_chip_subset_not_set(monkeypatch):
+    monkeypatch.delenv(TPU_VISIBLE_CHIPS_ENV, raising=False)
+    mgr = TPUAcceleratorManager(FakeProvider())
+    mgr.set_current_process_visible_accelerator_ids(["0", "1", "2"])
+    assert TPU_VISIBLE_CHIPS_ENV not in os.environ
+
+
+def test_pod_type_from_gke_env():
+    mgr = TPUAcceleratorManager(FakeProvider(accel_type="v5litepod-16"))
+    assert mgr.get_current_node_accelerator_type() == "v5litepod-16"
+
+
+def test_pod_type_from_metadata():
+    mgr = TPUAcceleratorManager(FakeProvider(
+        metadata={"accelerator-type": "v4-16"}))
+    assert mgr.get_current_node_accelerator_type() == "v4-16"
+
+
+def test_pod_type_invalid_rejected():
+    mgr = TPUAcceleratorManager(FakeProvider(accel_type="tpu-weird-3"))
+    assert mgr.get_current_node_accelerator_type() is None
+
+
+@pytest.mark.parametrize("pod_type,workers", [
+    ("v4-16", 2),          # 16 cores = 8 chips / 4 per host
+    ("v4-8", 1),
+    ("v5litepod-16", 4),   # 16 chips / 4 per host
+    ("v5litepod-256", 64),
+    ("v5p-16", 2),         # 16 chips / 8 per host
+])
+def test_pod_worker_count(pod_type, workers):
+    mgr = TPUAcceleratorManager(FakeProvider(accel_type=pod_type))
+    assert mgr.get_current_pod_worker_count() == workers
+
+
+def test_pod_slice_head_resources(monkeypatch):
+    monkeypatch.setenv("TPU_NAME", "my-slice")
+    head = TPUAcceleratorManager(FakeProvider(accel_type="v5litepod-16",
+                                              worker=0))
+    res = head.get_extra_resources()
+    assert res == {"my-slice": 1.0, "TPU-v5litepod-16-head": 1.0}
+
+    worker = TPUAcceleratorManager(FakeProvider(accel_type="v5litepod-16",
+                                                worker=3))
+    res = worker.get_extra_resources()
+    assert res == {"my-slice": 1.0}

@@ -131,6 +131,36 @@ def test_system_memory_sane():
     assert 0.0 <= mem["used_fraction"] <= 1.0
 
 
+def test_actor_restart_after_death(rt_rob):
+    @ray_tpu.remote
+    class Fragile:
+        def __init__(self):
+            self.count = 0
+
+        def incr(self):
+            self.count += 1
+            return self.count
+
+        def crash(self):
+            import os as _os
+
+            _os._exit(1)
+
+    a = Fragile.options(max_restarts=1).remote()
+    assert ray_tpu.get(a.incr.remote(), timeout=30) == 1
+    a.crash.remote()
+    # restarted actor: fresh state, same handle keeps working
+    deadline = __import__("time").time() + 30
+    value = None
+    while __import__("time").time() < deadline:
+        try:
+            value = ray_tpu.get(a.incr.remote(), timeout=10)
+            break
+        except Exception:
+            __import__("time").sleep(0.2)
+    assert value == 1, f"actor did not restart cleanly (got {value})"
+
+
 def test_task_retry_after_worker_death(rt_rob, tmp_path):
     marker = tmp_path / "attempted"
 
