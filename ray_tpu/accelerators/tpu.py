@@ -199,5 +199,11 @@ class TPUAcceleratorManager:
         if pod_name:
             out[pod_name] = 1.0
         if pod_type and self.provider.worker_id() == 0:
-            out[f"TPU-{pod_type}-head"] = 1.0
+            out[pod_head_resource(pod_type)] = 1.0
         return out
+
+
+def pod_head_resource(pod_type: str) -> str:
+    """The head-marker resource name for a pod type (single source of the
+    string both the advertiser and schedulers target)."""
+    return f"TPU-{pod_type}-head"

@@ -112,6 +112,15 @@ class DriverRuntime:
         self.total: Dict[str, float] = {"CPU": float(cpus)}
         if tpus:
             self.total["TPU"] = float(tpus)
+            # pod-slice resources (pod-name on every host, head marker on
+            # worker 0) so slice-aware scheduling patterns resolve
+            try:
+                from ray_tpu.accelerators.tpu import TPUAcceleratorManager
+
+                for k, v in TPUAcceleratorManager().get_extra_resources().items():
+                    self.total[k] = float(v)
+            except Exception:
+                pass
         for k, v in (resources or {}).items():
             self.total[k] = float(v)
         self.avail = dict(self.total)

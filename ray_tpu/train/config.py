@@ -41,7 +41,22 @@ class ScalingConfig:
         if "CPU" not in res:
             res["CPU"] = 1.0
         if self.use_tpu and "TPU" not in res:
-            res["TPU"] = 1.0
+            if self.topology:
+                # slice mode: one worker per HOST owning all its chips
+                from ray_tpu.accelerators.tpu import detect_num_tpu_chips
+
+                res["TPU"] = float(max(detect_num_tpu_chips(), 1))
+            else:
+                res["TPU"] = 1.0
+        if self.topology and self.use_tpu:
+            # pin each worker to a distinct slice host via the pod-name
+            # resource every host carries (SURVEY §2.6 pattern); resources
+            # registered by the runtime at init on TPU hosts
+            from ray_tpu.util.accelerators import get_current_pod_name
+
+            pod_name = get_current_pod_name()
+            if pod_name:
+                res[pod_name] = 1.0
         return res
 
 

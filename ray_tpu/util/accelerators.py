@@ -1,0 +1,37 @@
+"""Public TPU helpers (reference ``ray.util.accelerators.tpu``:
+``python/ray/util/accelerators/tpu.py:7,18``) plus pod-slice scheduling
+helpers built on the head-resource pattern (SURVEY §2.6)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional
+
+from ray_tpu.accelerators.tpu import TPUAcceleratorManager, \
+    pod_head_resource  # noqa: F401 — re-exported public API
+
+
+def get_current_pod_name() -> Optional[str]:
+    """Name of the TPU pod slice this host belongs to (None off-TPU)."""
+    return TPUAcceleratorManager().get_current_pod_name()
+
+
+def get_current_pod_worker_count() -> Optional[int]:
+    """Number of hosts in this pod slice (None off-TPU)."""
+    return TPUAcceleratorManager().get_current_pod_worker_count()
+
+
+def get_num_tpu_chips_on_node() -> int:
+    return TPUAcceleratorManager().get_current_node_num_accelerators()
+
+
+def fan_out_per_host(fn: Callable, pod_name: str, num_hosts: int,
+                     *args, **kwargs) -> List[Any]:
+    """Launch ``fn`` once per slice host (each consuming that host's
+    ``{pod_name: 1}`` resource) and return the refs."""
+    import ray_tpu
+
+    remote_fn = fn if hasattr(fn, "remote") else ray_tpu.remote(fn)
+    return [
+        remote_fn.options(resources={pod_name: 1}).remote(*args, **kwargs)
+        for _ in range(num_hosts)
+    ]

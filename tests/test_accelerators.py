@@ -107,6 +107,27 @@ def test_pod_worker_count(pod_type, workers):
     assert mgr.get_current_pod_worker_count() == workers
 
 
+def test_public_helpers_and_fan_out():
+    import ray_tpu
+    from ray_tpu.util.accelerators import fan_out_per_host, \
+        pod_head_resource
+
+    assert pod_head_resource("v5litepod-16") == "TPU-v5litepod-16-head"
+    ray_tpu.shutdown()   # a leaked runtime would lack the custom resource
+    ray_tpu.init(num_cpus=4, resources={"my-slice": 4})
+    try:
+        def hostname_task():
+            import os as _os
+
+            return _os.getpid()
+
+        refs = fan_out_per_host(hostname_task, "my-slice", 4)
+        pids = ray_tpu.get(refs, timeout=60)
+        assert len(pids) == 4
+    finally:
+        ray_tpu.shutdown()
+
+
 def test_pod_slice_head_resources(monkeypatch):
     monkeypatch.setenv("TPU_NAME", "my-slice")
     head = TPUAcceleratorManager(FakeProvider(accel_type="v5litepod-16",
