@@ -113,14 +113,24 @@ class DriverRuntime:
         if tpus:
             self.total["TPU"] = float(tpus)
             # pod-slice resources (pod-name on every host, head marker on
-            # worker 0) so slice-aware scheduling patterns resolve
-            try:
-                from ray_tpu.accelerators.tpu import TPUAcceleratorManager
+            # worker 0) so slice-aware scheduling patterns resolve. Only
+            # probed when TPU env/hardware signals are present — the GCE
+            # metadata lookups inside would stall init for seconds off-GCP.
+            import glob as _glob
 
-                for k, v in TPUAcceleratorManager().get_extra_resources().items():
-                    self.total[k] = float(v)
-            except Exception:
-                pass
+            on_tpu_host = bool(
+                os.environ.get("TPU_NAME")
+                or os.environ.get("TPU_ACCELERATOR_TYPE")
+                or _glob.glob("/dev/accel*"))
+            if on_tpu_host:
+                try:
+                    from ray_tpu.accelerators.tpu import TPUAcceleratorManager
+
+                    extras = TPUAcceleratorManager().get_extra_resources()
+                    for k, v in extras.items():
+                        self.total[k] = float(v)
+                except Exception:
+                    pass
         for k, v in (resources or {}).items():
             self.total[k] = float(v)
         self.avail = dict(self.total)

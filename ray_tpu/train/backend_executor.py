@@ -56,12 +56,22 @@ class BackendExecutor:
             # fall back to unconstrained placement.
             self._pg = None
         self.worker_group = WorkerGroup(n, res, placement_group=self._pg)
-        # Propagate the driver's platform choice (tests pin JAX_PLATFORMS=cpu)
+        # Readiness barrier with a deadline: an infeasible resource demand
+        # (e.g. slice-mode bundles on a host that can't fit them) must fail
+        # loudly, not hang the driver forever.
+        timeout = float(os.environ.get("RTPU_WORKER_START_TIMEOUT", "120"))
         env = {k: v for k, v in os.environ.items()
                if k in ("JAX_PLATFORMS", "XLA_FLAGS", "TPU_VISIBLE_CHIPS")}
-        if env:
-            for w in self.worker_group.workers:
-                ray_tpu.get(w.set_env_vars.remote(env))
+        try:
+            ray_tpu.get([w.set_env_vars.remote(env)
+                         for w in self.worker_group.workers],
+                        timeout=timeout)
+        except Exception as e:
+            self.shutdown()
+            raise RuntimeError(
+                f"train workers failed to start within {timeout}s — the "
+                f"resource demand {res} x{n} is likely infeasible on this "
+                f"cluster (set RTPU_WORKER_START_TIMEOUT to adjust)") from e
         self._backend.on_start(self.worker_group, self._backend_config)
 
     def shutdown(self) -> None:

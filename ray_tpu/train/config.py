@@ -43,9 +43,10 @@ class ScalingConfig:
         if self.use_tpu and "TPU" not in res:
             if self.topology:
                 # slice mode: one worker per HOST owning all its chips
-                from ray_tpu.accelerators.tpu import detect_num_tpu_chips
+                from ray_tpu.util.accelerators import \
+                    get_num_tpu_chips_on_node
 
-                res["TPU"] = float(max(detect_num_tpu_chips(), 1))
+                res["TPU"] = float(max(get_num_tpu_chips_on_node(), 1))
             else:
                 res["TPU"] = 1.0
         if self.topology and self.use_tpu:

@@ -31,7 +31,11 @@ def fan_out_per_host(fn: Callable, pod_name: str, num_hosts: int,
     import ray_tpu
 
     remote_fn = fn if hasattr(fn, "remote") else ray_tpu.remote(fn)
+    # merge with any resources already declared on the function — the pin
+    # adds to (not replaces) e.g. a per-host TPU chip demand
+    existing = dict(getattr(remote_fn, "_options", {}).get("resources") or {})
+    existing[pod_name] = 1
     return [
-        remote_fn.options(resources={pod_name: 1}).remote(*args, **kwargs)
+        remote_fn.options(resources=existing).remote(*args, **kwargs)
         for _ in range(num_hosts)
     ]
