@@ -1,34 +1,255 @@
 """Flagship benchmark: LLM train-step throughput + MFU on the local device.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "detail"}.
 
 The metric is model FLOPs utilization (MFU) of a Llama-family training step
-(fwd+bwd+adamw, bf16 matmuls, remat on) — the BASELINE.json north-star
-contract ("Llama-3-8B ≥45% MFU on v5e-256"); ``vs_baseline`` is MFU/0.45.
-On CPU (no TPU attached) the same harness runs a tiny config so the number
-is still produced, just not meaningful as MFU.
+(fwd+bwd+adamw, bf16 matmuls) — the BASELINE.json north-star contract
+("Llama-3-8B >=45% MFU on v5e-256"); ``vs_baseline`` is MFU/0.45. On CPU
+(no TPU attached) the same harness runs a tiny config so the number is
+still produced, just not meaningful as MFU.
+
+Resilience contract (the round-1 bench died on a transient backend-init
+failure and emitted nothing): the parent process never touches jax. The
+TPU train-step measurement runs in a child process with a timeout and
+retry-with-backoff around transient ``UNAVAILABLE`` backend claims; the
+Pallas flash kernel is preflighted on the real chip and the model falls
+back to the blockwise XLA kernel if Mosaic rejects it; whatever happens,
+exactly one valid JSON line is printed.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
+_CHILD_TIMEOUT_S = float(os.environ.get("RTPU_BENCH_CHILD_TIMEOUT", "900"))
+_RETRIES = int(os.environ.get("RTPU_BENCH_RETRIES", "3"))
+_BACKOFFS = (5, 15, 30)
+
+
+# ---------------------------------------------------------------------------
+# Parent: orchestrates, never imports jax, always prints one JSON line.
+# ---------------------------------------------------------------------------
 
 def main() -> None:
+    detail: dict = {}
+    errors: list = []
+
+    # Core-runtime microbench first: pure ray_tpu (no jax on the driver
+    # path), so it survives any TPU trouble — round 1 lost these numbers
+    # because the TPU crash happened first.
+    detail["core_microbench"] = _core_microbench()
+
+    child = None
+    for attempt in range(_RETRIES):
+        child = _run_train_child()
+        if child.get("ok"):
+            break
+        errors.append(f"attempt {attempt + 1}: {child.get('error', 'unknown')}")
+        if child.get("timeout"):
+            break  # a hung compile won't improve with retries
+        if "UNAVAILABLE" in child.get("error", ""):
+            # only after an observed failed claim: a stale bench child from
+            # a previous timed-out run may still be pinning the chip
+            _kill_stale_chip_holders(errors)
+        if attempt < _RETRIES - 1:
+            time.sleep(_BACKOFFS[min(attempt, len(_BACKOFFS) - 1)])
+
+    if child and child.get("ok"):
+        result = child["result"]
+        result.setdefault("detail", {}).update(detail)
+        if errors:
+            result["detail"]["transient_errors"] = errors
+        print(json.dumps(result))
+        return
+
+    # TPU path unrecoverable: one CPU-pinned attempt so the harness still
+    # exercises the full train step, then emit with an error field.
+    cpu = _run_train_child(force_cpu=True)
+    if cpu.get("ok"):
+        result = cpu["result"]
+        result.setdefault("detail", {}).update(detail)
+        result["detail"]["tpu_errors"] = errors
+        result["error"] = "tpu backend unavailable; cpu fallback numbers"
+        print(json.dumps(result))
+        return
+
+    errors.append(f"cpu fallback: {cpu.get('error', 'unknown')}")
+    mb = detail.get("core_microbench", {})
+    print(json.dumps({
+        "metric": "llama_train_mfu",
+        "value": 0.0,
+        "unit": "mfu",
+        "vs_baseline": 0.0,
+        "error": "; ".join(errors)[-2000:],
+        "detail": detail,
+        "core_tasks_per_s": mb.get("tasks_per_s"),
+    }))
+
+
+def _run_train_child(force_cpu: bool = False) -> dict:
+    """Run the train-step measurement in a subprocess; parse its JSON tail."""
+    env = dict(os.environ)
+    if force_cpu:
+        env["JAX_PLATFORMS"] = "cpu"
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--train-step"],
+            capture_output=True, text=True, timeout=_CHILD_TIMEOUT_S, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "timeout": True,
+                "error": f"train-step child timed out after {_CHILD_TIMEOUT_S}s"}
+    except Exception as e:  # pragma: no cover - spawn failure
+        return {"ok": False, "error": f"spawn failed: {e}"}
+    for line in reversed(proc.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return {"ok": True, "result": json.loads(line)}
+            except json.JSONDecodeError:
+                continue
+    tail = (proc.stderr or proc.stdout or "")[-1500:]
+    return {"ok": False, "error": f"rc={proc.returncode}: {tail}"}
+
+
+def _kill_stale_chip_holders(errors: list) -> None:
+    """Best-effort: SIGKILL stale *bench* python processes holding a TPU fd.
+
+    A previous bench run killed by an outer timeout can leave a child
+    pinning the chip, which makes every subsequent backend init fail
+    UNAVAILABLE. Called only after an observed UNAVAILABLE claim, and only
+    targets processes whose cmdline looks like a bench/python train child —
+    never system daemons, brokers, or unrelated VFIO users.
+    """
+    import signal
+
+    me = os.getpid()
+    ancestors = set()
+    pid = me
+    try:
+        for _ in range(10):
+            with open(f"/proc/{pid}/status") as f:
+                ppid_line = next(l for l in f if l.startswith("PPid:"))
+            pid = int(ppid_line.split()[1])
+            if pid <= 1:
+                break
+            ancestors.add(pid)
+    except Exception:
+        pass
+    try:
+        for pid_dir in os.listdir("/proc"):
+            if not pid_dir.isdigit():
+                continue
+            pid = int(pid_dir)
+            if pid == me or pid in ancestors:
+                continue
+            try:
+                with open(f"/proc/{pid}/cmdline", "rb") as f:
+                    cmdline = f.read().replace(b"\0", b" ").decode(
+                        "utf-8", "replace")
+            except OSError:
+                continue
+            if "python" not in cmdline or "bench.py" not in cmdline:
+                continue
+            fd_dir = f"/proc/{pid}/fd"
+            try:
+                for fd in os.listdir(fd_dir):
+                    target = os.readlink(os.path.join(fd_dir, fd))
+                    if target.startswith("/dev/accel") or target.startswith("/dev/vfio"):
+                        os.kill(pid, signal.SIGKILL)
+                        errors.append(f"killed stale chip holder pid={pid}")
+                        break
+            except (PermissionError, FileNotFoundError, OSError):
+                continue
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Child: jax lives here. Prints one JSON line on success, raises otherwise.
+# ---------------------------------------------------------------------------
+
+def train_step_child() -> None:
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from ray_tpu.util.tpu_info import honor_jax_platform_env
 
     honor_jax_platform_env()
     import jax
+
+    backend = _claim_backend(jax)
+    on_tpu = backend in ("tpu", "axon")
+
+    attn_impl, attn_note = "xla", "cpu backend: blockwise XLA attention"
+    if on_tpu:
+        attn_impl, attn_note = _preflight_pallas(jax)
+    from ray_tpu.ops.attention import set_default_attention_impl
+
+    set_default_attention_impl(attn_impl)
+
+    try:
+        result = _measure(jax, on_tpu)
+    except Exception as e:
+        if on_tpu and attn_impl == "pallas":
+            # Mosaic can reject the kernel only inside the full remat/scan
+            # program even when the standalone preflight compiled.
+            set_default_attention_impl("xla")
+            attn_note = f"pallas failed in full program ({e}); blockwise XLA fallback"
+            result = _measure(jax, on_tpu)
+        else:
+            raise
+    result["detail"]["attention_impl"] = attn_note
+    print(json.dumps(result))
+
+
+def _claim_backend(jax, retries: int = 4) -> str:
+    """jax.default_backend() with retry — the axon tunnel can be transiently
+    unclaimable (UNAVAILABLE) right after another process released it."""
+    last = None
+    for attempt in range(retries):
+        try:
+            return jax.default_backend()
+        except Exception as e:  # RuntimeError/JaxRuntimeError wrapping UNAVAILABLE
+            last = e
+            try:
+                import jax.extend.backend
+
+                jax.extend.backend.clear_backends()
+            except Exception:
+                pass
+            time.sleep(2 * (attempt + 1))
+    raise RuntimeError(f"backend init failed after {retries} attempts: {last}")
+
+
+def _preflight_pallas(jax):
+    """Compile the flash kernel on the real chip before trusting it."""
+    import jax.numpy as jnp
+
+    from ray_tpu.ops.flash_pallas import flash_attention_pallas
+
+    try:
+        q = jnp.zeros((1, 1024, 4, 128), jnp.bfloat16)
+        k = jnp.zeros((1, 1024, 2, 128), jnp.bfloat16)
+        out = flash_attention_pallas(q, k, k, causal=True)
+        jax.block_until_ready(out)
+        return "pallas", "pallas flash kernel (preflight ok)"
+    except Exception as e:
+        return "xla", f"pallas preflight failed ({type(e).__name__}: {e}); blockwise XLA fallback"
+
+
+def _measure(jax, on_tpu: bool) -> dict:
     import numpy as np
     import optax
 
     from ray_tpu import models
     from ray_tpu.parallel import MeshConfig
     from ray_tpu.train import TrainLoopHelper
-    from ray_tpu.util.tpu_info import is_tpu_backend, peak_flops_per_chip
+    from ray_tpu.util.tpu_info import peak_flops_per_chip
 
-    on_tpu = is_tpu_backend()
     if on_tpu:
         # remat off: the 250M model's activations fit HBM, and remat would
         # burn ~1/3 extra FLOPs the 6N-based MFU accounting doesn't credit
@@ -66,14 +287,14 @@ def main() -> None:
 
     tokens_per_step = batch_size * seq
     tokens_per_sec = tokens_per_step / dt
-    # fwd+bwd ≈ 6N FLOPs/token + attention term 12*L*d*s (causal halves it)
+    # fwd+bwd ~= 6N FLOPs/token + attention term 12*L*d*s (causal halves it)
     flops_token = config.flops_per_token() + (
         6 * config.n_layers * config.hdim * config.n_heads * seq)
     model_flops = flops_token * tokens_per_sec
     peak = peak_flops_per_chip() * n_dev if on_tpu else float("nan")
     mfu = model_flops / peak if on_tpu else 0.0
 
-    result = {
+    return {
         "metric": "llama_train_mfu" if on_tpu else "llama_train_tokens_per_sec_cpu",
         "value": round(mfu, 4) if on_tpu else round(tokens_per_sec, 1),
         "unit": "mfu" if on_tpu else "tokens/s",
@@ -84,17 +305,19 @@ def main() -> None:
             "step_time_ms": round(dt * 1e3, 2),
             "devices": n_dev,
             "backend": jax.default_backend(),
+            "device_kind": getattr(jax.devices()[0], "device_kind", "unknown"),
             "loss": float(jax.device_get(metrics["loss"])),
-            "core_microbench": _core_microbench(),
         },
     }
-    print(json.dumps(result))
 
+
+# ---------------------------------------------------------------------------
+# Core-runtime microbenchmark (reference analog:
+# release/microbenchmark/run_microbenchmark.py — tasks/s, actor calls/s,
+# put GB/s) on a throwaway local cluster. jax-free.
+# ---------------------------------------------------------------------------
 
 def _core_microbench() -> dict:
-    """Core-runtime rates (reference microbenchmark analog:
-    release/microbenchmark/run_microbenchmark.py — tasks/s, actor calls/s,
-    put GB/s) measured on a throwaway local cluster."""
     import numpy as np
 
     import ray_tpu
@@ -152,4 +375,7 @@ def _core_microbench() -> dict:
 
 
 if __name__ == "__main__":
-    main()
+    if "--train-step" in sys.argv:
+        train_step_child()
+    else:
+        main()

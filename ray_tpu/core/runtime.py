@@ -15,6 +15,7 @@ thread per worker plus an event-driven dispatch loop under a single lock
 from __future__ import annotations
 
 import atexit
+import logging
 import os
 import threading
 import time
@@ -35,6 +36,8 @@ from ray_tpu.core.gcs import ERROR, Gcs, READY, ActorInfo
 from ray_tpu.core.ids import ActorID, NodeID, ObjectID, TaskID, WorkerID
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.object_store import StoreClient
+
+logger = logging.getLogger(__name__)
 
 _runtime = None
 _runtime_lock = threading.Lock()
@@ -366,7 +369,15 @@ class DriverRuntime:
 
     def _handle_done(self, ws: _WorkerState, task_id_b: bytes, results):
         with self.lock:
-            spec = ws.inflight_specs.pop(task_id_b, None) or ws.current
+            spec = ws.inflight_specs.pop(task_id_b, None)
+        if spec is None:
+            # Every dispatch path goes through _dispatch_to, which populates
+            # inflight_specs — an unknown id is a duplicate or late "done"
+            # and must not be re-processed against an unrelated spec
+            # (double-decrementing actor inflight, re-marking objects).
+            logger.warning("dropping done for unknown task %s from worker %s",
+                           task_id_b.hex()[:8], ws.worker_id.hex()[:8])
+            return
         for rid, rkind, payload in results:
             oid = ObjectID(rid)
             if rkind == "i":

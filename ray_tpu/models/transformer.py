@@ -185,12 +185,15 @@ def _attention(q, k, v, config: TransformerConfig):
             check_vma=False,
         )
         return fn(q, k, v)
-    from ray_tpu.util.tpu_info import is_tpu_backend
+    from ray_tpu.ops.attention import resolve_attention_impl
 
-    if is_tpu_backend():
+    impl = resolve_attention_impl()
+    if impl == "pallas":
         from ray_tpu.ops.flash_pallas import flash_attention_pallas
 
         return flash_attention_pallas(q, k, v, causal=True)
+    if impl == "naive":
+        return naive_attention(q, k, v, causal=True)
     return blockwise_attention(q, k, v, causal=True)
 
 

@@ -15,6 +15,8 @@ from ray_tpu.ops.attention import (
     naive_attention,
     blockwise_attention,
     flash_attention,
+    set_default_attention_impl,
+    resolve_attention_impl,
 )
 from ray_tpu.ops.ring_attention import ring_attention
 from ray_tpu.ops.layers import (
@@ -32,6 +34,8 @@ __all__ = [
     "naive_attention",
     "blockwise_attention",
     "flash_attention",
+    "set_default_attention_impl",
+    "resolve_attention_impl",
     "ring_attention",
     "rms_norm",
     "rotary_embedding",

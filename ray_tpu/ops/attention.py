@@ -21,6 +21,37 @@ from jax import lax
 
 NEG_INF = -1e30
 
+# Process-wide attention implementation override. "auto" dispatches Pallas on
+# TPU / blockwise XLA elsewhere; bench/serving preflights may pin "xla" when
+# the Pallas kernel fails to compile on the attached chip (Mosaic tiling or
+# VMEM rejections surface only at real-TPU compile time). Seeded from the
+# RTPU_ATTN_IMPL env var so subprocesses inherit the choice.
+_ATTN_IMPL = None  # None -> consult env / auto
+
+
+def set_default_attention_impl(impl: Optional[str]) -> None:
+    """Pin the attention implementation: "auto" | "pallas" | "xla" | "naive".
+
+    ``None`` resets to the default (env ``RTPU_ATTN_IMPL`` or "auto").
+    Takes effect at trace time, so call before compiling the model.
+    """
+    global _ATTN_IMPL
+    if impl is not None and impl not in ("auto", "pallas", "xla", "naive"):
+        raise ValueError(f"unknown attention impl: {impl!r}")
+    _ATTN_IMPL = impl
+
+
+def resolve_attention_impl() -> str:
+    """Concrete impl for this process/backend: "pallas" | "xla" | "naive"."""
+    import os
+
+    impl = _ATTN_IMPL or os.environ.get("RTPU_ATTN_IMPL") or "auto"
+    if impl == "auto":
+        from ray_tpu.util.tpu_info import is_tpu_backend
+
+        impl = "pallas" if is_tpu_backend() else "xla"
+    return impl
+
 
 def _repeat_kv(k: jax.Array, num_q_heads: int) -> jax.Array:
     """Expand kv heads to match q heads for GQA."""
@@ -162,9 +193,7 @@ def flash_attention(
     ``impl``: ``auto`` | ``pallas`` | ``xla`` | ``naive``.
     """
     if impl == "auto":
-        from ray_tpu.util.tpu_info import is_tpu_backend
-
-        impl = "pallas" if is_tpu_backend() else "xla"
+        impl = resolve_attention_impl()
     if impl == "pallas":
         from ray_tpu.ops.flash_pallas import flash_attention_pallas
 

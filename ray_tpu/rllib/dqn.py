@@ -136,18 +136,23 @@ class DQN(Algorithm):
             # Exploration comes from the runner's categorical sampling over
             # Q-logits (Boltzmann); the stored action must be exactly what
             # the env executed.
+            # autoreset reset-step rows (valid=False) are not real
+            # transitions — the env ignored the action; keep them out of
+            # the buffer.
+            mask = b.get("valid", np.ones((t_len, n), bool)).reshape(-1)
             transitions = {
-                "obs": b["obs"].reshape(t_len * n, -1),
-                "actions": b["actions"].reshape(t_len * n),
-                "rewards": b["rewards"].reshape(-1),
+                "obs": b["obs"].reshape(t_len * n, -1)[mask],
+                "actions": b["actions"].reshape(t_len * n)[mask],
+                "rewards": b["rewards"].reshape(-1)[mask],
                 "next_obs": np.concatenate(
                     [b["obs"][1:].reshape((t_len - 1) * n, -1),
-                     b["next_obs"]], axis=0),
+                     b["next_obs"]], axis=0)[mask],
                 "dones": np.logical_or(b["terminateds"],
-                                       b["truncateds"]).reshape(-1),
+                                       b["truncateds"]).reshape(-1)[mask],
             }
             self.replay.add(transitions)
-            self._env_steps += t_len * n
+            # valid rows only, matching PPO/IMPALA's num_env_steps_sampled
+            self._env_steps += int(mask.sum())
 
         metrics: Dict[str, Any] = {"buffer_size": len(self.replay)}
         if len(self.replay) >= cfg.learning_starts:

@@ -45,6 +45,12 @@ class SingleAgentEnvRunner:
         self._episode_lens = np.zeros(num_envs, dtype=np.int64)
         self._completed_returns: List[float] = []
         self._completed_lens: List[int] = []
+        # gymnasium 1.x NEXT_STEP autoreset: the step after term|trunc is a
+        # reset step — the env ignores the action and returns the new
+        # episode's first obs with reward 0. Those transitions are not valid
+        # training samples; track episode ends across fragment boundaries so
+        # the first step of the next sample() call is masked too.
+        self._prev_finished = np.zeros(num_envs, dtype=bool)
 
     def set_weights(self, params) -> None:
         self.params = params
@@ -63,7 +69,7 @@ class SingleAgentEnvRunner:
         import jax
 
         obs_buf, act_buf, logp_buf, vf_buf = [], [], [], []
-        rew_buf, done_buf, trunc_buf = [], [], []
+        rew_buf, done_buf, trunc_buf, valid_buf = [], [], [], []
         obs = self._obs
         for _ in range(num_steps):
             self._rng, key = jax.random.split(self._rng)
@@ -81,9 +87,12 @@ class SingleAgentEnvRunner:
             rew_buf.append(reward)
             done_buf.append(term)
             trunc_buf.append(trunc)
-            self._episode_returns += reward
-            self._episode_lens += 1
+            valid = ~self._prev_finished
+            valid_buf.append(valid)
+            self._episode_returns += reward * valid
+            self._episode_lens += valid
             finished = np.logical_or(term, trunc)
+            self._prev_finished = finished
             for i in np.flatnonzero(finished):
                 self._completed_returns.append(float(self._episode_returns[i]))
                 self._completed_lens.append(int(self._episode_lens[i]))
@@ -99,6 +108,7 @@ class SingleAgentEnvRunner:
             "rewards": np.stack(rew_buf).astype(np.float32),
             "terminateds": np.stack(done_buf),
             "truncateds": np.stack(trunc_buf),
+            "valid": np.stack(valid_buf),                          # [T, N]
             "next_obs": obs.reshape(self.num_envs, -1).astype(np.float32),
         }
         return batch

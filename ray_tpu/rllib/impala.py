@@ -151,11 +151,15 @@ class IMPALA(Algorithm):
                 np.logical_or(b["terminateds"], b["truncateds"]),
                 last_values, cfg.gamma,
                 getattr(cfg, "clip_rho", 1.0), getattr(cfg, "clip_c", 1.0))
+            # drop autoreset reset-step rows (valid=False): not real
+            # transitions; the v-trace chain is already cut at the episode
+            # end one step earlier so only the row itself is garbage.
+            mask = b.get("valid", np.ones((t_len, n), bool)).reshape(-1)
             outs.append({
-                "obs": flat_obs,
-                "actions": b["actions"].reshape(t_len * n,
-                                                *b["actions"].shape[2:]),
-                "pg_advantages": pg_adv.reshape(-1).astype(np.float32),
-                "vs": vs.reshape(-1).astype(np.float32),
+                "obs": flat_obs[mask],
+                "actions": b["actions"].reshape(
+                    t_len * n, *b["actions"].shape[2:])[mask],
+                "pg_advantages": pg_adv.reshape(-1).astype(np.float32)[mask],
+                "vs": vs.reshape(-1).astype(np.float32)[mask],
             })
         return {k: np.concatenate([o[k] for o in outs]) for k in outs[0]}

@@ -22,7 +22,13 @@ def compute_gae(rewards, values, dones, truncateds, last_values,
     """GAE over [T, N] arrays; episode boundaries cut the recursion.
 
     Truncated (time-limit) ends bootstrap from the value estimate; true
-    terminations zero the bootstrap.
+    terminations zero the bootstrap. Under gymnasium 1.x NEXT_STEP
+    autoreset (what SingleAgentEnvRunner steps), ``values[t+1]`` at a
+    truncated step t is the value of the episode's TRUE final observation
+    (the env returns it from step t; the reset happens one step later), so
+    the mid-fragment truncation bootstrap is exact. The reset step itself
+    is a garbage transition — callers must drop rows where the batch's
+    ``valid`` mask is False before building the train batch.
     """
     t_len, n = rewards.shape
     adv = np.zeros((t_len, n), np.float32)
@@ -150,13 +156,17 @@ class PPO(Algorithm):
                 b["truncateds"], last_values, cfg.gamma,
                 getattr(cfg, "lam", 0.95))
             t_len, n = b["rewards"].shape
+            # drop autoreset reset-step rows (action ignored by the env,
+            # reward 0, obs = previous episode's final obs)
+            mask = b.get("valid", np.ones((t_len, n), bool)).reshape(-1)
             flat = {
-                "obs": b["obs"].reshape(t_len * n, -1),
-                "actions": b["actions"].reshape(t_len * n, *b["actions"].shape[2:]),
-                "action_logp": b["action_logp"].reshape(-1),
-                "vf_preds": b["vf_preds"].reshape(-1),
-                "advantages": adv.reshape(-1),
-                "value_targets": ret.reshape(-1),
+                "obs": b["obs"].reshape(t_len * n, -1)[mask],
+                "actions": b["actions"].reshape(
+                    t_len * n, *b["actions"].shape[2:])[mask],
+                "action_logp": b["action_logp"].reshape(-1)[mask],
+                "vf_preds": b["vf_preds"].reshape(-1)[mask],
+                "advantages": adv.reshape(-1)[mask],
+                "value_targets": ret.reshape(-1)[mask],
             }
             outs.append(flat)
         merged = {k: np.concatenate([o[k] for o in outs]) for k in outs[0]}

@@ -79,7 +79,11 @@ class _Session:
         self.continue_event = threading.Event()
         self.error: Optional[BaseException] = None
         self.finished = False
-        self._checkpoint_seq = 0
+        # Seed past any checkpoint_* already in the trial dir: after a group
+        # restart a fresh session starting at 0 would write checkpoints that
+        # name-sort BELOW the pre-crash ones, so every later resume would
+        # pick the stale pre-crash checkpoint and repeat work.
+        self._checkpoint_seq = self._next_checkpoint_seq(context.trial_dir)
 
         def runner():
             try:
@@ -91,6 +95,17 @@ class _Session:
 
         self.thread = threading.Thread(target=runner, daemon=True,
                                        name="train_loop")
+
+    @staticmethod
+    def _next_checkpoint_seq(trial_dir: str) -> int:
+        try:
+            seqs = [int(name[len("checkpoint_"):])
+                    for name in os.listdir(trial_dir)
+                    if name.startswith("checkpoint_")
+                    and name[len("checkpoint_"):].isdigit()]
+        except OSError:
+            return 0
+        return max(seqs, default=-1) + 1
 
     def start(self):
         self.thread.start()

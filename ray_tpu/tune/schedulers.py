@@ -58,6 +58,12 @@ class ASHAScheduler(TrialScheduler):
         self.time_attr = time_attr
         # rung level -> list of metric values recorded at that rung
         self.rungs: Dict[int, List[float]] = {}
+        # trial id -> highest rung level already credited: rungs trigger on
+        # *crossing* a milestone (t >= level), not exact equality — trials
+        # reporting every k iterations or with float time attrs would
+        # otherwise skip rungs and never be early-stopped (reference
+        # AsyncHyperBand cuts on milestone crossing).
+        self._credited: Dict[str, int] = {}
         levels = []
         t = grace_period
         while t < max_t:
@@ -75,8 +81,14 @@ class ASHAScheduler(TrialScheduler):
             return CONTINUE
         if t >= self.max_t:
             return STOP
-        for level in self.levels:
-            if t == level:
+        tid = getattr(trial, "trial_id", str(id(trial)))
+        last = self._credited.get(tid, 0)
+        # Only the HIGHEST newly-crossed rung gets this result: back-filling
+        # lower rungs with late-iteration (better-trained) values would make
+        # their cutoffs unfairly harsh on genuinely-young trials.
+        for level in reversed(self.levels):
+            if t >= level and level > last:
+                self._credited[tid] = level
                 recorded = self.rungs.setdefault(level, [])
                 recorded.append(float(val))
                 k = max(1, int(len(recorded) / self.rf))
@@ -85,6 +97,7 @@ class ASHAScheduler(TrialScheduler):
                 if not self._better(float(val), worst_top) and \
                         float(val) != worst_top:
                     return STOP
+                break
         return CONTINUE
 
 

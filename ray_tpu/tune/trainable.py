@@ -103,7 +103,13 @@ class FunctionTrainable(Trainable):
 
     _train_fn: Callable = None  # bound by wrap_function subclass
 
-    def setup(self, config: Dict[str, Any]) -> None:
+    def __init__(self, config: Optional[Dict[str, Any]] = None,
+                 trial_dir: str = "."):
+        super().__init__(config, trial_dir)
+        # Session state lives in __init__, NOT setup(): the controller calls
+        # restore() before the first train_step() triggers setup(), and a
+        # setup()-time reset would wipe the restore dir (PBT exploits and
+        # failure retries would silently restart from scratch).
         self._restore_dir: Optional[str] = None
         self._session: Optional[_Session] = None
 

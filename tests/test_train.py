@@ -178,3 +178,19 @@ def test_state_shardings_cover_opt_state():
     flat_state = jax.tree.leaves(state)
     flat_sh = jax.tree.leaves(sh)
     assert len(flat_state) == len(flat_sh)
+
+
+def test_session_checkpoint_seq_resumes_past_existing(tmp_path):
+    """A fresh session in a trial dir with pre-crash checkpoints must number
+    new ones AFTER them, or name-sorted "latest" resumes stale state
+    (ADVICE r1)."""
+    from ray_tpu.train.session import _Session, TrainContext
+
+    (tmp_path / "checkpoint_000003").mkdir()
+    (tmp_path / "checkpoint_000011").mkdir()
+    ctx = TrainContext(trial_dir=str(tmp_path))
+    s = _Session(lambda: None, ctx)
+    assert s._checkpoint_seq == 12
+    # empty dir starts at zero
+    s2 = _Session(lambda: None, TrainContext(trial_dir=str(tmp_path / "new")))
+    assert s2._checkpoint_seq == 0

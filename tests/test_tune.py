@@ -215,3 +215,62 @@ def test_searcher_simple_bayes(rt_tune):
     grid = tuner.fit()
     best = grid.get_best_result("loss", mode="min")
     assert best.metrics["loss"] < 0.2
+
+
+def test_function_trainable_restore_survives_setup(tmp_path):
+    """restore() state must not be wiped by the lazy setup() on first
+    train_step (ADVICE r1: PBT exploit / failure retry silently restarted
+    function trainables from scratch)."""
+    from ray_tpu.tune.trainable import wrap_function
+
+    ckpt_dir = tmp_path / "checkpoint_000007"
+    ckpt_dir.mkdir()
+    (ckpt_dir / "state.txt").write_text("42")
+
+    seen = {}
+
+    def fn(config):
+        ckpt = tune.get_checkpoint()
+        seen["path"] = ckpt.path if ckpt else None
+        tune.report({"score": 1.0})
+
+    trial_dir = tmp_path / "trial"
+    trial_dir.mkdir()
+    tr = wrap_function(fn)({}, trial_dir=str(trial_dir))
+    # controller order: restore() first, setup() lazily on first train_step
+    tr.restore(str(ckpt_dir))
+    result = tr.train_step()
+    assert result["score"] == 1.0
+    assert seen["path"] == str(ckpt_dir)
+
+
+def test_asha_credits_rungs_on_crossing():
+    """Trials that report past a rung (never exactly at it) must still be
+    evaluated there (ADVICE r1: exact-equality check silently disabled
+    early stopping for every-k reporters)."""
+    from ray_tpu.tune.schedulers import CONTINUE, STOP
+
+    sched = ASHAScheduler(metric="loss", mode="min", max_t=100,
+                          grace_period=10, reduction_factor=2)
+    assert sched.levels == [10, 20, 40, 80]
+
+    class T:
+        def __init__(self, tid):
+            self.trial_id = tid
+
+    good, bad = T("good"), T("bad")
+    # both skip t=10 and report at t=15: rung 10 must still fire
+    assert sched.on_trial_result(
+        good, {"loss": 1.0, "training_iteration": 15}) == CONTINUE
+    assert sched.on_trial_result(
+        bad, {"loss": 5.0, "training_iteration": 15}) == STOP
+    assert sched.rungs[10] == [1.0, 5.0]
+    # re-reporting below the next rung must not double-credit rung 10
+    assert sched.on_trial_result(
+        good, {"loss": 0.5, "training_iteration": 16}) == CONTINUE
+    assert sched.rungs[10] == [1.0, 5.0]
+    # crossing two rungs at once credits only the HIGHEST (no back-filling
+    # lower rungs with late, better-trained values)
+    assert sched.on_trial_result(
+        good, {"loss": 0.4, "training_iteration": 45}) == CONTINUE
+    assert sched.rungs[40] == [0.4] and 20 not in sched.rungs
