@@ -1,0 +1,32 @@
+"""Multi-node runtime: GCS server process, node daemons, object transfer.
+
+Role analog: the reference's distributed core — GCS server
+(``src/ray/gcs/gcs_server/gcs_server.cc:307-692``), per-node raylet
+(``src/ray/raylet/node_manager.h:119``), node-to-node object transfer
+(``src/ray/object_manager/object_manager.h``) and resource gossip
+(``src/ray/common/ray_syncer/ray_syncer.h:88``). The design here keeps the
+single-node runtime (``ray_tpu/core/runtime.py``) as the per-node execution
+engine: every node daemon embeds one, and a thin cluster adapter routes
+cross-node concerns (object directory, KV, named actors, task spillback,
+object pulls) through the GCS process.
+
+Processes:
+
+- ``python -m ray_tpu.cluster.gcs_server`` — control plane: node table +
+  heartbeat health checks, global object directory (status + locations),
+  KV, function table, named actors, pubsub-lite.
+- ``python -m ray_tpu.cluster.node_daemon`` — per-node daemon: embeds a
+  ``DriverRuntime`` (worker pool + local scheduler + local store) wired to
+  the GCS, serves task submissions and object pulls from peers.
+- the user driver — also a node (the head pattern): its runtime registers
+  with the GCS and schedules locally first, spilling tasks to peer nodes
+  by resource feasibility.
+
+Tests boot extra node daemons as local subprocesses
+(:class:`ray_tpu.cluster.cluster_utils.Cluster`), the reference's
+``python/ray/cluster_utils.py:135`` pattern.
+"""
+
+from ray_tpu.cluster.cluster_utils import Cluster
+
+__all__ = ["Cluster"]

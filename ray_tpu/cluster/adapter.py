@@ -1,0 +1,518 @@
+"""Cluster adapter: wires a local ``DriverRuntime`` into the GCS cluster.
+
+Role analog: the reference core worker's GCS client + raylet client +
+object directory stack (``src/ray/gcs/gcs_client/gcs_client.h:66``,
+``ownership_based_object_directory.h``). One adapter per process that hosts
+a runtime (the user driver and every node daemon). Responsibilities:
+
+- register this runtime as a node; heartbeat resources;
+- publish local object readiness/errors to the global directory;
+- watch remote objects and pull their bytes on demand (owner-directed
+  fetch: directory -> location -> node daemon pull RPC);
+- route task submissions that this node cannot satisfy to a feasible peer
+  (driver-side spillback; the reference's raylet lease/spillback role);
+- route actor calls to the hosting node;
+- react to node death: retry forwarded tasks elsewhere, fail forwarded
+  actor calls (``ActorDiedError``), re-execute lost objects' producers
+  when lineage allows.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Set
+
+import cloudpickle
+
+from ray_tpu.cluster.rpc import RpcClient, RpcServer
+from ray_tpu.core import task_spec as ts
+from ray_tpu.core.exceptions import ActorDiedError, WorkerCrashedError
+from ray_tpu.core.ids import ActorID, ObjectID
+
+logger = logging.getLogger(__name__)
+
+HEARTBEAT_S = 0.5
+NODE_VIEW_TTL_S = 0.5
+
+
+class ClusterAdapter:
+    def __init__(self, gcs_addr: str, authkey: bytes, *,
+                 is_scheduler: bool, listen_host: str = "127.0.0.1"):
+        self.gcs_addr = gcs_addr
+        self.authkey = authkey
+        self.is_scheduler = is_scheduler  # only the driver/head spills tasks
+        self.listen_host = listen_host
+        self.rt = None  # DriverRuntime, set by attach()
+        self.node_id: bytes = b""
+        self.gcs = RpcClient(gcs_addr, authkey, on_push=self._on_push)
+        self._peers: Dict[bytes, RpcClient] = {}
+        self._peer_addrs: Dict[bytes, str] = {}
+        self._peers_lock = threading.Lock()
+        self._watched: Set[bytes] = set()
+        self._watch_lock = threading.Lock()
+        self._fetching: Set[bytes] = set()
+        # forwarded work for failure handling: node_id -> {task_id: spec}
+        self._forwarded: Dict[bytes, Dict[bytes, dict]] = {}
+        # first return-id -> (node_id, task_id): completion of that object
+        # retires the forwarded entry so node death doesn't retry done work
+        self._fwd_by_oid: Dict[bytes, tuple] = {}
+        self._forwarded_lock = threading.Lock()
+        self._remote_actors: Dict[bytes, bytes] = {}  # actor_id -> node_id
+        self._node_view: List[dict] = []
+        self._node_view_ts = 0.0
+        self._stop = threading.Event()
+        self.server: Optional[RpcServer] = None
+        # All watch/deliver/fetch work runs here, NEVER on the RpcClient
+        # reader thread (a blocking gcs.call from the reader thread can
+        # never see its own reply) and never on a worker-pipe receiver
+        # thread (which must keep demuxing results).
+        self._io = ThreadPoolExecutor(max_workers=8,
+                                      thread_name_prefix="cluster-io")
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def attach(self, rt) -> None:
+        """Register ``rt`` as a cluster node and start serving peers."""
+        self.rt = rt
+        self.node_id = rt.node_id.binary()
+        rt.cluster = self
+        rt.gcs.on_object_ready = self._publish_ready
+        rt.gcs.on_object_error = self._publish_error
+        self.server = RpcServer(self.listen_host, 0, self.authkey,
+                                self._serve_peer)
+        self.gcs.call("subscribe", "nodes")
+        self.gcs.call("subscribe", "objects")
+        self.gcs.call("node_register", self.node_id, self.server.addr,
+                      rt.resources("total"), self.is_scheduler)
+        threading.Thread(target=self._heartbeat_loop, daemon=True,
+                         name="cluster-heartbeat").start()
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self.gcs.cast("node_drain", self.node_id)
+        except Exception:
+            pass
+        if self.server is not None:
+            self.server.close()
+        with self._peers_lock:
+            peers = list(self._peers.values())
+            self._peers.clear()
+        for p in peers:
+            p.close()
+        self.gcs.close()
+
+    def _heartbeat_loop(self):
+        while not self._stop.wait(HEARTBEAT_S):
+            try:
+                with self.rt.lock:
+                    avail = dict(self.rt.avail)
+                    depth = len(self.rt.ready_tasks)
+                self.gcs.cast("node_heartbeat", self.node_id, avail, depth)
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------------
+    # peer RPC service (what other nodes may ask of this one)
+    # ------------------------------------------------------------------
+
+    def _serve_peer(self, method: str, args: tuple, ctx) -> Any:
+        if method == "submit_spec":
+            self.rt.submit_spec(args[0])
+            return True
+        if method == "submit_actor_spec":
+            self.rt.submit_actor_task(args[0])
+            return True
+        if method == "pull_object":
+            return self._serve_pull(args[0])
+        if method == "kill_actor":
+            self.rt.kill_actor(args[0], args[1])
+            return True
+        if method == "cancel_task":
+            self.rt.cancel_task(ObjectID(args[0]))
+            return True
+        if method == "ping":
+            return "pong"
+        raise AttributeError(f"node: unknown method {method!r}")
+
+    def _serve_pull(self, oid_b: bytes):
+        oid = ObjectID(oid_b)
+        st = self.rt.gcs.object_state(oid)
+        if st is not None and st.status == "ERROR":
+            return ("e", st.error)
+        if st is not None and st.status == "READY" and st.inline is not None:
+            return ("i", st.inline)
+        raw = self.rt.store.get_raw(oid)
+        if raw is not None:
+            return ("s", raw)
+        # segment gone (evicted/deleted behind the directory's back)
+        self.gcs.cast("obj_forget_location", oid_b, self.node_id)
+        return None
+
+    # ------------------------------------------------------------------
+    # object directory: publish + watch + fetch
+    # ------------------------------------------------------------------
+
+    def _publish_ready(self, oid: ObjectID, inline: Optional[bytes],
+                       size: int):
+        self.gcs.cast("obj_ready", oid.binary(), inline, self.node_id, size)
+
+    def _publish_error(self, oid: ObjectID, err: bytes):
+        self.gcs.cast("obj_error", oid.binary(), err)
+
+    def watch_many(self, oids) -> None:
+        """Subscribe to global terminal state for objects not yet terminal
+        locally; delivery marks them ready/error in the local gcs (pulling
+        segment bytes from the owning node when needed). Non-blocking: the
+        initial state query runs on the adapter's io pool so hot dispatch
+        paths (worker-pipe receivers) never wait on the network."""
+        fresh = []
+        with self._watch_lock:
+            for o in oids:
+                b = o.binary() if isinstance(o, ObjectID) else o
+                if b not in self._watched:
+                    self._watched.add(b)
+                    fresh.append(b)
+        for b in fresh:
+            # subscribe-then-query closes the race where the object turned
+            # terminal between our local check and the subscription
+            self._io.submit(self._initial_query, b)
+
+    def _initial_query(self, b: bytes):
+        try:
+            state = self.gcs.call("obj_state", b, timeout=30)
+        except Exception:
+            return  # the push subscription remains our signal
+        if state is not None and state["status"] in ("READY", "ERROR"):
+            self._deliver(b, state)
+
+    def _on_push(self, channel: str, payload):
+        # runs on the RpcClient reader thread: hand everything that might
+        # issue RPCs to the io pool
+        if channel == "objects":
+            b = payload["oid"]
+            with self._watch_lock:
+                interested = b in self._watched
+            if interested:
+                self._io.submit(self._deliver, b, payload["state"])
+        elif channel == "nodes":
+            if payload.get("event") == "down":
+                self._io.submit(self._node_down, payload)
+            self._node_view_ts = 0.0  # invalidate the scheduler view
+
+    def _deliver(self, oid_b: bytes, state: dict):
+        """Apply a terminal global state to the local gcs (fetch if big)."""
+        with self._forwarded_lock:
+            ent = self._fwd_by_oid.pop(oid_b, None)
+            if ent is not None:
+                self._forwarded.get(ent[0], {}).pop(ent[1], None)
+        oid = ObjectID(oid_b)
+        st = self.rt.gcs.object_state(oid)
+        if st is not None and st.status in ("READY", "ERROR"):
+            self._unwatch(oid_b)
+            return
+        if state["status"] == "ERROR":
+            self.rt.gcs.mark_error(oid, state["error"], _local_only=True)
+            self._unwatch(oid_b)
+            return
+        if state["inline"] is not None:
+            self.rt.gcs.mark_ready(oid, inline=state["inline"],
+                                   _local_only=True)
+            self._unwatch(oid_b)
+            return
+        if self.node_id in state["locations"]:
+            # we hold the segment already (e.g. worker-produced locally)
+            self.rt.gcs.mark_ready(oid, size=state["size"], _local_only=True)
+            self._unwatch(oid_b)
+            return
+        with self._watch_lock:
+            if oid_b in self._fetching:
+                return
+            self._fetching.add(oid_b)
+        try:
+            self._fetch(oid, state)
+        finally:
+            with self._watch_lock:
+                self._fetching.discard(oid_b)
+
+    def _fetch(self, oid: ObjectID, state: dict):
+        """Owner-directed pull: try each advertised location."""
+        for node_id in state["locations"]:
+            peer = self._peer(node_id)
+            if peer is None:
+                continue
+            try:
+                payload = peer.call("pull_object", oid.binary(), timeout=60)
+            except Exception:
+                continue
+            if payload is None:
+                continue
+            kind, blob = payload
+            if kind == "e":
+                self.rt.gcs.mark_error(oid, blob, _local_only=True)
+            elif kind == "i":
+                self.rt.gcs.mark_ready(oid, inline=blob, _local_only=True)
+            else:
+                if not self.rt.store.contains(oid):
+                    self.rt.store.put_serialized(oid, blob)
+                # local copy now exists: advertise it so future readers
+                # have a second source (reference push-on-pull behavior)
+                self.rt.gcs.mark_ready(oid, size=len(blob))
+            self._unwatch(oid.binary())
+            return
+        # no location answered: wait for re-execution/another location via
+        # the still-active subscription (lineage reconstruction path)
+        logger.warning("fetch of %s found no live location", oid.hex()[:8])
+
+    def _unwatch(self, oid_b: bytes):
+        with self._watch_lock:
+            self._watched.discard(oid_b)
+
+    # ------------------------------------------------------------------
+    # scheduling (driver/head only)
+    # ------------------------------------------------------------------
+
+    def _nodes(self) -> List[dict]:
+        now = time.monotonic()
+        if now - self._node_view_ts > NODE_VIEW_TTL_S:
+            try:
+                self._node_view = self.gcs.call("node_list", timeout=5)
+                self._node_view_ts = now
+            except Exception:
+                pass
+        return self._node_view
+
+    def maybe_forward_task(self, spec: dict, deps) -> bool:
+        """Decide placement for a task/actor-create spec. Returns True when
+        the spec was forwarded to a peer node (caller only tracks refs)."""
+        if not self.is_scheduler:
+            return False  # daemons execute what they're given
+        if spec.get("pg") is not None:
+            return False  # placement groups are node-local (for now)
+        res = spec.get("resources") or {}
+        with self.rt.lock:
+            local_total_ok = all(
+                self.rt.total.get(k, 0.0) >= v for k, v in res.items())
+            local_avail_ok = all(
+                self.rt.avail.get(k, 0.0) >= v for k, v in res.items())
+        if local_avail_ok:
+            return False  # local fast path
+        candidates = [
+            n for n in self._nodes()
+            if n["alive"] and n["node_id"] != self.node_id
+            and all(n["resources"].get(k, 0.0) >= v for k, v in res.items())
+        ]
+        if not candidates:
+            return False  # infeasible everywhere -> queue locally
+        with_avail = [
+            n for n in candidates
+            if all(n["avail"].get(k, 0.0) >= v for k, v in res.items())
+        ]
+        if local_total_ok and not with_avail:
+            return False  # locally feasible soon; nobody free now anyway
+        target = (with_avail or candidates)[0]
+        # decrement the cached view so a burst of submissions spreads across
+        # peers instead of piling onto one node until the next heartbeat
+        for k, v in res.items():
+            target["avail"][k] = target["avail"].get(k, 0.0) - v
+        return self._forward(target["node_id"], spec)
+
+    def _forward(self, node_id: bytes, spec: dict) -> bool:
+        peer = self._peer(node_id)
+        if peer is None:
+            return False
+        try:
+            peer.call("submit_spec", spec, timeout=30)
+        except Exception:
+            return False
+        with self._forwarded_lock:
+            self._forwarded.setdefault(node_id, {})[spec["task_id"]] = spec
+            if spec["return_ids"]:
+                self._fwd_by_oid[spec["return_ids"][0]] = (node_id,
+                                                           spec["task_id"])
+        aid = spec.get("actor_id")
+        if aid:
+            self._remote_actors[aid] = node_id
+        self.watch_many([ObjectID(b) for b in spec["return_ids"]])
+        return True
+
+    def route_actor_call(self, spec: dict) -> bool:
+        """Forward an actor method call to the hosting node. Returns True
+        when handled (including terminal failure)."""
+        aid = spec["actor_id"]
+        node_id = self._remote_actors.get(aid)
+        if node_id is None:
+            rec = None
+            try:
+                rec = self.gcs.call("actor_get", aid, timeout=5)
+            except Exception:
+                pass
+            if rec is None:
+                return False
+            if rec["state"] == "DEAD":
+                self._fail_returns(spec, ActorDiedError("actor is dead"))
+                return True
+            node_id = rec["node_id"]
+            if node_id == self.node_id:
+                return False  # ours after all (race with registration)
+            self._remote_actors[aid] = node_id
+        for rid in spec["return_ids"]:
+            self.rt.gcs.ensure_object(ObjectID(rid))
+        peer = self._peer(node_id)
+        ok = False
+        if peer is not None:
+            try:
+                peer.call("submit_actor_spec", spec, timeout=30)
+                ok = True
+            except Exception:
+                ok = False
+        if not ok:
+            self._fail_returns(spec, ActorDiedError(
+                f"actor's node {node_id.hex()[:8]} unreachable"))
+            return True
+        with self._forwarded_lock:
+            self._forwarded.setdefault(node_id, {})[spec["task_id"]] = spec
+            if spec["return_ids"]:
+                self._fwd_by_oid[spec["return_ids"][0]] = (node_id,
+                                                           spec["task_id"])
+        self.watch_many([ObjectID(b) for b in spec["return_ids"]])
+        return True
+
+    def _fail_returns(self, spec: dict, exc: Exception):
+        err = cloudpickle.dumps(exc)
+        for rid in spec["return_ids"]:
+            self.rt.gcs.mark_error(ObjectID(rid), err, _local_only=True)
+
+    # ------------------------------------------------------------------
+    # actor + name + fn + kv global mirrors
+    # ------------------------------------------------------------------
+
+    def kill_remote_actor(self, actor_id: bytes, no_restart: bool):
+        node_id = self._remote_actors.get(actor_id)
+        if node_id is None:
+            try:
+                rec = self.gcs.call("actor_get", actor_id, timeout=5)
+            except Exception:
+                return
+            if rec is None:
+                return
+            node_id = rec["node_id"]
+        peer = self._peer(node_id)
+        if peer is not None:
+            try:
+                peer.call("kill_actor", actor_id, no_restart, timeout=10)
+            except Exception:
+                pass
+
+    def publish_actor(self, actor_id: bytes, name: str):
+        self.gcs.cast("actor_register", actor_id, self.node_id, name or "")
+
+    def publish_actor_state(self, actor_id: bytes, state: str):
+        self.gcs.cast("actor_update", actor_id, state)
+
+    def lookup_named(self, name: str) -> Optional[bytes]:
+        try:
+            return self.gcs.call("actor_lookup", name, timeout=5)
+        except Exception:
+            return None
+
+    def publish_fn(self, h: str, blob: bytes):
+        self.gcs.cast("fn_put", h, blob)
+
+    def fetch_fn(self, h: str) -> Optional[bytes]:
+        try:
+            return self.gcs.call("fn_get", h, timeout=30)
+        except Exception:
+            return None
+
+    def kv_op(self, op: str, *args):
+        """Cluster KV is globally consistent: always through the GCS.
+
+        Pads the optional trailing args (namespace / overwrite) that the
+        local ``Gcs`` signatures default.
+        """
+        full = list(args)
+        if op == "put":
+            full += ["default", True][len(full) - 2:] if len(full) < 4 else []
+        elif op in ("get", "del"):
+            if len(full) < 2:
+                full.append("default")
+        elif op == "keys":
+            if len(full) == 0:
+                full.append("")
+            if len(full) < 2:
+                full.append("default")
+        return self.gcs.call("kv_" + op, *full, timeout=30)
+
+    def node_info(self) -> List[dict]:
+        return [
+            {"NodeID": n["node_id"].hex(),
+             "Alive": n["alive"], "Resources": dict(n["resources"]),
+             "alive": n["alive"]}
+            for n in self._nodes()
+        ]
+
+    # ------------------------------------------------------------------
+    # failure handling
+    # ------------------------------------------------------------------
+
+    def _node_down(self, payload: dict):
+        node_id = payload["node_id"]
+        with self._peers_lock:
+            peer = self._peers.pop(node_id, None)
+            self._peer_addrs.pop(node_id, None)
+        if peer is not None:
+            peer.close()
+        dead_actors = set(payload.get("dead_actors", []))
+        with self._forwarded_lock:
+            lost = self._forwarded.pop(node_id, {})
+        for task_id, spec in lost.items():
+            if spec.get("actor_id") and spec["type"] != ts.ACTOR_CREATE:
+                self._fail_returns(spec, ActorDiedError(
+                    "actor's node died"))
+                continue
+            if spec.get("retries_left", 0) > 0 or spec["type"] == ts.ACTOR_CREATE:
+                spec = dict(spec)
+                if spec.get("retries_left", 0) > 0:
+                    spec["retries_left"] -= 1
+                logger.info("retrying task %s from dead node %s",
+                            task_id.hex()[:8], node_id.hex()[:8])
+                self.rt.submit_spec(spec)
+            else:
+                self._fail_returns(spec, WorkerCrashedError(
+                    f"node {node_id.hex()[:8]} died running task"))
+        for aid in dead_actors:
+            self._remote_actors.pop(aid, None)
+
+    # ------------------------------------------------------------------
+
+    def _peer(self, node_id: bytes) -> Optional[RpcClient]:
+        with self._peers_lock:
+            peer = self._peers.get(node_id)
+        if peer is not None:
+            return peer
+        addr = self._peer_addrs.get(node_id)
+        if addr is None:
+            for n in self._nodes():
+                if n["node_id"] == node_id and n["alive"]:
+                    addr = n["addr"]
+                    break
+        if not addr:
+            return None
+        try:
+            peer = RpcClient(addr, self.authkey)
+        except Exception:
+            return None
+        with self._peers_lock:
+            existing = self._peers.get(node_id)
+            if existing is not None:
+                peer.close()
+                return existing
+            self._peers[node_id] = peer
+            self._peer_addrs[node_id] = addr
+        return peer

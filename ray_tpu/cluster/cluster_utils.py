@@ -1,0 +1,130 @@
+"""Test/dev cluster: GCS + extra node daemons as local subprocesses.
+
+Role analog: ``python/ray/cluster_utils.py:135`` (``Cluster``) whose
+``add_node`` (``:201``) boots extra raylets as separate processes on one
+machine — the reference's standard way to test multi-node scheduling,
+transfer, and failover without real machines.
+
+Usage::
+
+    cluster = Cluster()                      # starts a GCS process
+    cluster.add_node(resources={"worker": 1})
+    ray_tpu.init(address=cluster.address)    # driver joins as head node
+    ...
+    cluster.shutdown()
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+import uuid
+from typing import Dict, List, Optional
+
+from ray_tpu.cluster.rpc import RpcClient, free_port
+
+
+class Cluster:
+    def __init__(self, node_timeout_s: float = 3.0):
+        self.authkey = uuid.uuid4().hex[:16]
+        port = free_port()
+        self.address = f"127.0.0.1:{port}"
+        self._procs: List[subprocess.Popen] = []
+        self._node_procs: Dict[int, subprocess.Popen] = {}
+        self._next_node = 0
+        env = self._env()
+        self._gcs_proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.cluster.gcs_server",
+             "--port", str(port), "--authkey", self.authkey,
+             "--node-timeout", str(node_timeout_s)],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+        )
+        self._procs.append(self._gcs_proc)
+        self._wait_for_gcs()
+        self._client = RpcClient(self.address, self.authkey.encode())
+
+    def _env(self):
+        env = dict(os.environ)
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        # cluster workers are CPU-only by default (same as single-node)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        return env
+
+    def _wait_for_gcs(self, timeout: float = 20.0):
+        deadline = time.monotonic() + timeout
+        last = None
+        while time.monotonic() < deadline:
+            try:
+                c = RpcClient(self.address, self.authkey.encode())
+                assert c.call("ping", timeout=2) == "pong"
+                c.close()
+                return
+            except Exception as e:
+                last = e
+                time.sleep(0.1)
+        raise TimeoutError(f"gcs did not come up at {self.address}: {last}")
+
+    def add_node(self, *, num_cpus: float = 2,
+                 resources: Optional[Dict[str, float]] = None,
+                 wait: bool = True) -> int:
+        """Boot a node daemon subprocess; returns a handle id for kill_node."""
+        import json
+
+        node_idx = self._next_node
+        self._next_node += 1
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.cluster.node_daemon",
+             "--gcs", self.address, "--authkey", self.authkey,
+             "--num-cpus", str(num_cpus),
+             "--resources", json.dumps(resources or {})],
+            env=self._env(), stdout=subprocess.DEVNULL,
+            stderr=subprocess.STDOUT,
+        )
+        self._node_procs[node_idx] = proc
+        self._procs.append(proc)
+        if wait:
+            want = len([p for p in self._node_procs.values()
+                        if p.poll() is None])
+            self.wait_for_nodes(want)
+        return node_idx
+
+    def wait_for_nodes(self, n_daemons: int, timeout: float = 30.0):
+        """Wait until ``n_daemons`` non-head nodes are alive in the GCS."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            nodes = self._client.call("node_list", timeout=5)
+            alive = [x for x in nodes if x["alive"] and not x["is_head"]]
+            if len(alive) >= n_daemons:
+                return
+            time.sleep(0.1)
+        raise TimeoutError(f"only {len(alive)} of {n_daemons} nodes alive")
+
+    def list_nodes(self):
+        return self._client.call("node_list", timeout=5)
+
+    def kill_node(self, node_idx: int):
+        """SIGKILL a node daemon (failure-injection; reference
+        ``RayletKiller`` role)."""
+        proc = self._node_procs.get(node_idx)
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+    def shutdown(self):
+        try:
+            self._client.close()
+        except Exception:
+            pass
+        for proc in self._procs:
+            if proc.poll() is None:
+                proc.terminate()
+        deadline = time.monotonic() + 3.0
+        for proc in self._procs:
+            try:
+                proc.wait(max(0.1, deadline - time.monotonic()))
+            except Exception:
+                proc.kill()

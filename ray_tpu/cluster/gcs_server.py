@@ -1,0 +1,375 @@
+"""GCS server process: cluster control plane.
+
+Role analog: ``src/ray/gcs/gcs_server/gcs_server.cc:307-692`` — node table
+with heartbeat health checks (``GcsHealthCheckManager``), global object
+directory with locations (``ownership_based_object_directory.h`` role),
+InternalKV (``gcs_kv_manager.h``), function table
+(``gcs_function_manager.h``), named-actor registry
+(``gcs_actor_manager.h``), and pubsub (``src/ray/pubsub``) collapsed into
+one threaded process over the message-RPC layer.
+
+State is deliberately coarse: per-node execution detail (worker pools,
+actor call queues) lives in the node daemons; the GCS holds only what must
+be globally consistent.
+"""
+
+from __future__ import annotations
+
+import argparse
+import threading
+import time
+from typing import Any, Dict, Optional, Set
+
+from ray_tpu.cluster.rpc import RpcServer, ServerConn
+
+DEFAULT_HEARTBEAT_S = 1.0
+DEFAULT_NODE_TIMEOUT_S = 5.0
+
+PENDING, READY, ERROR = "PENDING", "READY", "ERROR"
+
+
+class _GlobalObject:
+    __slots__ = ("status", "inline", "error", "size", "locations", "waiters")
+
+    def __init__(self):
+        self.status = PENDING
+        self.inline: Optional[bytes] = None
+        self.error: Optional[bytes] = None
+        self.size = 0
+        self.locations: Set[bytes] = set()  # node ids holding the segment
+        self.waiters: list = []  # threading.Event per blocked obj_wait
+
+
+class _NodeEntry:
+    __slots__ = ("node_id", "addr", "resources", "avail", "last_seen",
+                 "alive", "is_head")
+
+    def __init__(self, node_id: bytes, addr: str, resources: Dict[str, float],
+                 is_head: bool):
+        self.node_id = node_id
+        self.addr = addr  # node daemon RPC address ("" for the driver/head)
+        self.resources = dict(resources)
+        self.avail = dict(resources)
+        self.last_seen = time.monotonic()
+        self.alive = True
+        self.is_head = is_head
+
+
+class GcsService:
+    def __init__(self, node_timeout_s: float = DEFAULT_NODE_TIMEOUT_S):
+        self.lock = threading.RLock()
+        self.nodes: Dict[bytes, _NodeEntry] = {}
+        self.objects: Dict[bytes, _GlobalObject] = {}
+        self.kv: Dict[str, Dict[str, bytes]] = {}
+        self.functions: Dict[str, bytes] = {}
+        # named/global actor registry: actor_id -> record dict
+        self.actors: Dict[bytes, Dict[str, Any]] = {}
+        self.named_actors: Dict[str, bytes] = {}
+        self.node_timeout_s = node_timeout_s
+        self.server: Optional[RpcServer] = None
+        self._stop = threading.Event()
+
+    # ------------------------------------------------------------------
+    # RPC dispatch
+    # ------------------------------------------------------------------
+
+    def handle(self, method: str, args: tuple, ctx: ServerConn) -> Any:
+        fn = getattr(self, "rpc_" + method, None)
+        if fn is None:
+            raise AttributeError(f"gcs: unknown method {method!r}")
+        return fn(ctx, *args)
+
+    # -- nodes ----------------------------------------------------------
+
+    def rpc_node_register(self, ctx, node_id: bytes, addr: str,
+                          resources: Dict[str, float], is_head: bool):
+        with self.lock:
+            self.nodes[node_id] = _NodeEntry(node_id, addr, resources,
+                                             is_head)
+        ctx.meta["node_id"] = node_id
+        ctx.on_close = self._conn_closed
+        self._publish("nodes", {"event": "up", "node_id": node_id,
+                                "addr": addr, "resources": dict(resources)})
+        return True
+
+    def rpc_node_heartbeat(self, ctx, node_id: bytes,
+                           avail: Dict[str, float], queue_depth: int):
+        with self.lock:
+            ent = self.nodes.get(node_id)
+            if ent is None:
+                return False
+            ent.avail = dict(avail)
+            ent.last_seen = time.monotonic()
+            if not ent.alive:
+                ent.alive = True
+        return True
+
+    def rpc_node_list(self, ctx):
+        with self.lock:
+            return [
+                {"node_id": e.node_id, "addr": e.addr, "alive": e.alive,
+                 "resources": dict(e.resources), "avail": dict(e.avail),
+                 "is_head": e.is_head}
+                for e in self.nodes.values()
+            ]
+
+    def rpc_node_drain(self, ctx, node_id: bytes):
+        self._mark_node_dead(node_id, "drained")
+        return True
+
+    def _conn_closed(self, ctx: ServerConn):
+        node_id = ctx.meta.get("node_id")
+        if node_id is not None:
+            self._mark_node_dead(node_id, "connection lost")
+
+    def _mark_node_dead(self, node_id: bytes, cause: str):
+        with self.lock:
+            ent = self.nodes.get(node_id)
+            if ent is None or not ent.alive:
+                return
+            ent.alive = False
+            # objects whose only copies lived there are lost
+            lost = [oid for oid, o in self.objects.items()
+                    if o.status == READY and o.inline is None
+                    and o.locations and o.locations <= {node_id}]
+            for oid in lost:
+                o = self.objects[oid]
+                o.status = PENDING
+                o.locations.discard(node_id)
+            # actors hosted there are dead (restart is the owner's call)
+            dead_actors = [aid for aid, rec in self.actors.items()
+                           if rec.get("node_id") == node_id
+                           and rec.get("state") != "DEAD"]
+            for aid in dead_actors:
+                self.actors[aid]["state"] = "DEAD"
+                name = self.actors[aid].get("name")
+                if name:
+                    self.named_actors.pop(name, None)
+        self._publish("nodes", {"event": "down", "node_id": node_id,
+                                "cause": cause, "lost_objects": lost,
+                                "dead_actors": dead_actors})
+
+    def _health_loop(self):
+        while not self._stop.wait(DEFAULT_HEARTBEAT_S):
+            now = time.monotonic()
+            with self.lock:
+                stale = [e.node_id for e in self.nodes.values()
+                         if e.alive and not e.is_head
+                         and now - e.last_seen > self.node_timeout_s]
+            for node_id in stale:
+                self._mark_node_dead(node_id, "heartbeat timeout")
+
+    # -- object directory ----------------------------------------------
+
+    def _obj(self, oid: bytes) -> _GlobalObject:
+        o = self.objects.get(oid)
+        if o is None:
+            o = _GlobalObject()
+            self.objects[oid] = o
+        return o
+
+    def rpc_obj_ready(self, ctx, oid: bytes, inline: Optional[bytes],
+                      node_id: Optional[bytes], size: int = 0):
+        with self.lock:
+            o = self._obj(oid)
+            if o.status == ERROR:
+                return False
+            o.status = READY
+            o.inline = inline
+            o.size = size
+            if node_id is not None and inline is None:
+                o.locations.add(node_id)
+            waiters, o.waiters = o.waiters, []
+            state = {"status": o.status, "inline": o.inline, "error": o.error,
+                     "size": o.size, "locations": list(o.locations)}
+        for ev in waiters:
+            ev.set()
+        self._publish("objects", {"oid": oid, "state": state})
+        return True
+
+    def rpc_obj_error(self, ctx, oid: bytes, err: bytes):
+        with self.lock:
+            o = self._obj(oid)
+            o.status = ERROR
+            o.error = err
+            waiters, o.waiters = o.waiters, []
+            state = {"status": o.status, "inline": o.inline, "error": o.error,
+                     "size": o.size, "locations": list(o.locations)}
+        for ev in waiters:
+            ev.set()
+        self._publish("objects", {"oid": oid, "state": state})
+        return True
+
+    def rpc_obj_state(self, ctx, oid: bytes):
+        with self.lock:
+            o = self.objects.get(oid)
+            if o is None:
+                return None
+            return {"status": o.status, "inline": o.inline, "error": o.error,
+                    "size": o.size, "locations": list(o.locations)}
+
+    def rpc_obj_wait(self, ctx, oid: bytes, timeout: Optional[float]):
+        """Block until the object is terminal (READY/ERROR); returns state."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self.lock:
+                o = self._obj(oid)
+                if o.status in (READY, ERROR):
+                    return self.rpc_obj_state(ctx, oid)
+                ev = threading.Event()
+                o.waiters.append(ev)
+            remaining = None if deadline is None else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
+                return None
+            ev.wait(remaining)
+            with self.lock:
+                o2 = self.objects.get(oid)
+                if o2 is not None and o2.status in (READY, ERROR):
+                    return self.rpc_obj_state(ctx, oid)
+                if deadline is not None and time.monotonic() >= deadline:
+                    return None
+
+    def rpc_obj_drop(self, ctx, oid: bytes):
+        with self.lock:
+            self.objects.pop(oid, None)
+        return True
+
+    def rpc_obj_forget_location(self, ctx, oid: bytes, node_id: bytes):
+        """A pull found the segment missing (evicted/deleted behind the
+        directory's back): drop the stale location so re-execution can run."""
+        with self.lock:
+            o = self.objects.get(oid)
+            if o is None:
+                return False
+            o.locations.discard(node_id)
+            if not o.locations and o.inline is None and o.status == READY:
+                o.status = PENDING
+        return True
+
+    # -- KV / functions -------------------------------------------------
+
+    def rpc_kv_put(self, ctx, key: str, value: bytes, namespace: str,
+                   overwrite: bool):
+        with self.lock:
+            ns = self.kv.setdefault(namespace, {})
+            if not overwrite and key in ns:
+                return False
+            ns[key] = value
+            return True
+
+    def rpc_kv_get(self, ctx, key: str, namespace: str):
+        with self.lock:
+            return self.kv.get(namespace, {}).get(key)
+
+    def rpc_kv_del(self, ctx, key: str, namespace: str):
+        with self.lock:
+            return self.kv.get(namespace, {}).pop(key, None) is not None
+
+    def rpc_kv_keys(self, ctx, prefix: str, namespace: str):
+        with self.lock:
+            return [k for k in self.kv.get(namespace, {})
+                    if k.startswith(prefix)]
+
+    def rpc_fn_put(self, ctx, h: str, blob: bytes):
+        with self.lock:
+            self.functions.setdefault(h, blob)
+        return True
+
+    def rpc_fn_get(self, ctx, h: str):
+        with self.lock:
+            return self.functions.get(h)
+
+    # -- actors ---------------------------------------------------------
+
+    def rpc_actor_register(self, ctx, actor_id: bytes, node_id: bytes,
+                           name: str):
+        with self.lock:
+            if name and name in self.named_actors:
+                existing = self.actors.get(self.named_actors[name])
+                if existing is not None and existing.get("state") != "DEAD":
+                    raise ValueError(f"actor name {name!r} already taken")
+            self.actors[actor_id] = {"node_id": node_id, "name": name,
+                                     "state": "PENDING"}
+            if name:
+                self.named_actors[name] = actor_id
+        return True
+
+    def rpc_actor_update(self, ctx, actor_id: bytes, state: str,
+                         node_id: Optional[bytes] = None):
+        with self.lock:
+            rec = self.actors.get(actor_id)
+            if rec is None:
+                return False
+            rec["state"] = state
+            if node_id is not None:
+                rec["node_id"] = node_id
+            if state == "DEAD" and rec.get("name"):
+                if self.named_actors.get(rec["name"]) == actor_id:
+                    self.named_actors.pop(rec["name"], None)
+        return True
+
+    def rpc_actor_get(self, ctx, actor_id: bytes):
+        with self.lock:
+            rec = self.actors.get(actor_id)
+            return dict(rec) if rec else None
+
+    def rpc_actor_lookup(self, ctx, name: str):
+        with self.lock:
+            return self.named_actors.get(name)
+
+    def rpc_actor_list(self, ctx):
+        with self.lock:
+            return {aid: dict(rec) for aid, rec in self.actors.items()}
+
+    # -- pubsub ---------------------------------------------------------
+
+    def rpc_subscribe(self, ctx, channel: str):
+        ctx.subscriptions.add(channel)
+        return True
+
+    def rpc_publish(self, ctx, channel: str, payload):
+        self._publish(channel, payload)
+        return True
+
+    def _publish(self, channel: str, payload):
+        if self.server is not None:
+            self.server.broadcast(channel, payload)
+
+    def rpc_ping(self, ctx):
+        return "pong"
+
+    # ------------------------------------------------------------------
+
+    def serve(self, host: str, port: int, authkey: bytes) -> RpcServer:
+        self.server = RpcServer(host, port, authkey, self.handle)
+        threading.Thread(target=self._health_loop, daemon=True,
+                         name="gcs-health").start()
+        return self.server
+
+    def stop(self):
+        self._stop.set()
+        if self.server is not None:
+            self.server.close()
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--authkey", required=True)
+    p.add_argument("--node-timeout", type=float,
+                   default=DEFAULT_NODE_TIMEOUT_S)
+    args = p.parse_args(argv)
+
+    svc = GcsService(node_timeout_s=args.node_timeout)
+    svc.serve(args.host, args.port, args.authkey.encode())
+    print(f"gcs listening on {args.host}:{args.port}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        svc.stop()
+
+
+if __name__ == "__main__":
+    main()

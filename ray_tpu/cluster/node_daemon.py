@@ -1,0 +1,63 @@
+"""Per-node daemon: a runtime (worker pool + scheduler + store) as a process.
+
+Role analog: the raylet (``src/ray/raylet/main.cc:123`` /
+``node_manager.h:119``) — per-node worker pool, local task dispatch, local
+shared-memory store, object serving to peers, heartbeats to the GCS. The
+execution engine is the same ``DriverRuntime`` the single-node path uses;
+the :class:`~ray_tpu.cluster.adapter.ClusterAdapter` provides the
+cluster-facing RPC service and directory wiring.
+
+Daemons never spill tasks (``is_scheduler=False``): whatever the head
+forwards here runs here, mirroring the reference's lease semantics at MVP
+fidelity.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import time
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--gcs", required=True, help="GCS address host:port")
+    p.add_argument("--authkey", required=True)
+    p.add_argument("--num-cpus", type=float, default=None)
+    p.add_argument("--resources", default="{}",
+                   help="extra resources as JSON, e.g. '{\"worker\": 1}'")
+    p.add_argument("--listen-host", default="127.0.0.1")
+    args = p.parse_args(argv)
+
+    from ray_tpu.cluster.adapter import ClusterAdapter
+    from ray_tpu.core.runtime import DriverRuntime
+
+    rt = DriverRuntime(
+        num_cpus=int(args.num_cpus) if args.num_cpus else None,
+        num_tpus=0,
+        resources=json.loads(args.resources),
+    )
+    adapter = ClusterAdapter(args.gcs, args.authkey.encode(),
+                             is_scheduler=False,
+                             listen_host=args.listen_host)
+    adapter.attach(rt)
+    print(f"node daemon {rt.node_id.hex()[:8]} serving on "
+          f"{adapter.server.addr} (gcs {args.gcs})", flush=True)
+
+    stop = []
+
+    def _sig(*_):
+        stop.append(True)
+
+    signal.signal(signal.SIGTERM, _sig)
+    signal.signal(signal.SIGINT, _sig)
+    while not stop:
+        time.sleep(0.2)
+    rt.shutdown()
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

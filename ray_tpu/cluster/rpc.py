@@ -1,0 +1,243 @@
+"""Message RPC over ``multiprocessing.connection`` (TCP + authkey).
+
+Role analog: the reference's gRPC plumbing (``src/ray/rpc/grpc_server.h``,
+``client_call.h``) — reduced to what the cluster needs: request/reply with
+out-of-order completion, one-way casts, and server->client pushes
+(pubsub-lite). Wire messages are pickled tuples:
+
+    ("req",  id, method, args)      client -> server, expects a reply
+    ("rep",  id, ok, payload)       server -> client
+    ("cast", method, args)          client -> server, no reply
+    ("push", channel, payload)      server -> client (subscriptions)
+
+Each server connection gets a reader thread; request handlers run on a
+shared thread pool so a blocking handler (e.g. a directory wait) never
+stalls the connection. TCP (AF_INET) so the same code carries multi-host;
+tests run everything on localhost.
+"""
+
+from __future__ import annotations
+
+import itertools
+import socket
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from multiprocessing.connection import Client as _MpClient
+from multiprocessing.connection import Listener as _MpListener
+from typing import Any, Callable, Dict, Optional, Tuple
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def parse_addr(addr: str) -> Tuple[str, int]:
+    host, port = addr.rsplit(":", 1)
+    return host, int(port)
+
+
+class RpcServer:
+    """Serves ``handler(method, args, ctx) -> payload`` over TCP.
+
+    ``ctx`` is the per-connection :class:`ServerConn`, so handlers can
+    subscribe the caller to push channels or identify it across calls.
+    """
+
+    def __init__(self, host: str, port: int, authkey: bytes,
+                 handler: Callable[[str, tuple, "ServerConn"], Any],
+                 max_workers: int = 16):
+        self._listener = _MpListener((host, port), family="AF_INET",
+                                     authkey=authkey)
+        self.addr = f"{host}:{self._listener.address[1]}"
+        self._handler = handler
+        self._pool = ThreadPoolExecutor(max_workers=max_workers,
+                                        thread_name_prefix="rpc")
+        self._conns: Dict[int, "ServerConn"] = {}
+        self._lock = threading.Lock()
+        self._closed = False
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="rpc-accept")
+        self._accept_thread.start()
+
+    def _accept_loop(self):
+        counter = itertools.count()
+        while not self._closed:
+            try:
+                raw = self._listener.accept()
+            except (OSError, EOFError):
+                return
+            conn = ServerConn(next(counter), raw, self)
+            with self._lock:
+                self._conns[conn.conn_id] = conn
+            threading.Thread(target=conn.reader_loop, daemon=True,
+                             name=f"rpc-conn-{conn.conn_id}").start()
+
+    def _drop_conn(self, conn: "ServerConn"):
+        with self._lock:
+            self._conns.pop(conn.conn_id, None)
+
+    def broadcast(self, channel: str, payload: Any,
+                  only_subscribed: bool = True):
+        with self._lock:
+            conns = list(self._conns.values())
+        for c in conns:
+            if only_subscribed and channel not in c.subscriptions:
+                continue
+            c.push(channel, payload)
+
+    def close(self):
+        self._closed = True
+        try:
+            self._listener.close()
+        except Exception:
+            pass
+        with self._lock:
+            conns = list(self._conns.values())
+        for c in conns:
+            c.close()
+        self._pool.shutdown(wait=False)
+
+
+class ServerConn:
+    def __init__(self, conn_id: int, raw, server: RpcServer):
+        self.conn_id = conn_id
+        self.raw = raw
+        self.server = server
+        self.send_lock = threading.Lock()
+        self.subscriptions: set = set()
+        self.meta: Dict[str, Any] = {}  # handler scratch (e.g. node_id)
+        self.on_close: Optional[Callable[["ServerConn"], None]] = None
+
+    def reader_loop(self):
+        while True:
+            try:
+                msg = self.raw.recv()
+            except (EOFError, OSError, TypeError, ValueError):
+                break
+            kind = msg[0]
+            if kind == "req":
+                _, req_id, method, args = msg
+                self.server._pool.submit(self._run, req_id, method, args)
+            elif kind == "cast":
+                _, method, args = msg
+                self.server._pool.submit(self._run, None, method, args)
+        self.server._drop_conn(self)
+        cb = self.on_close
+        if cb is not None:
+            try:
+                cb(self)
+            except Exception:
+                pass
+
+    def _run(self, req_id: Optional[int], method: str, args: tuple):
+        try:
+            payload = self.server._handler(method, args, self)
+            ok = True
+        except BaseException as e:  # noqa: BLE001 — shipped to caller
+            payload, ok = e, False
+        if req_id is not None:
+            self._send(("rep", req_id, ok, payload))
+
+    def push(self, channel: str, payload: Any):
+        self._send(("push", channel, payload))
+
+    def _send(self, msg):
+        try:
+            with self.send_lock:
+                self.raw.send(msg)
+        except (OSError, BrokenPipeError, ValueError):
+            pass
+
+    def close(self):
+        try:
+            self.raw.close()
+        except Exception:
+            pass
+
+
+class RpcClient:
+    """Client with one reader thread demuxing replies and pushes."""
+
+    def __init__(self, addr: str, authkey: bytes,
+                 on_push: Optional[Callable[[str, Any], None]] = None,
+                 on_disconnect: Optional[Callable[[], None]] = None):
+        host, port = parse_addr(addr)
+        self.addr = addr
+        self._conn = _MpClient((host, port), family="AF_INET",
+                               authkey=authkey)
+        self._send_lock = threading.Lock()
+        self._pending: Dict[int, tuple] = {}  # id -> (event, box)
+        self._pending_lock = threading.Lock()
+        self._ids = itertools.count(1)
+        self._on_push = on_push
+        self._on_disconnect = on_disconnect
+        self._closed = False
+        threading.Thread(target=self._reader_loop, daemon=True,
+                         name="rpc-client-reader").start()
+
+    def _reader_loop(self):
+        while True:
+            try:
+                msg = self._conn.recv()
+            except (EOFError, OSError, TypeError, ValueError):
+                # TypeError/ValueError: multiprocessing internals raise
+                # these when the fd is closed from under a blocked recv
+                break
+            if msg[0] == "rep":
+                _, req_id, ok, payload = msg
+                with self._pending_lock:
+                    ent = self._pending.pop(req_id, None)
+                if ent is not None:
+                    ent[1][:] = [ok, payload]
+                    ent[0].set()
+            elif msg[0] == "push" and self._on_push is not None:
+                try:
+                    self._on_push(msg[1], msg[2])
+                except Exception:
+                    pass
+        with self._pending_lock:
+            pending = list(self._pending.values())
+            self._pending.clear()
+        for ev, box in pending:
+            box[:] = [False, ConnectionError(f"rpc connection to {self.addr} lost")]
+            ev.set()
+        if not self._closed and self._on_disconnect is not None:
+            try:
+                self._on_disconnect()
+            except Exception:
+                pass
+
+    def call(self, method: str, *args, timeout: Optional[float] = None) -> Any:
+        req_id = next(self._ids)
+        ev = threading.Event()
+        box: list = []
+        with self._pending_lock:
+            self._pending[req_id] = (ev, box)
+        with self._send_lock:
+            self._conn.send(("req", req_id, method, args))
+        if not ev.wait(timeout):
+            with self._pending_lock:
+                self._pending.pop(req_id, None)
+            raise TimeoutError(f"rpc {method} timed out after {timeout}s")
+        ok, payload = box
+        if not ok:
+            raise payload
+        return payload
+
+    def cast(self, method: str, *args) -> None:
+        try:
+            with self._send_lock:
+                self._conn.send(("cast", method, args))
+        except (OSError, BrokenPipeError, ValueError):
+            pass
+
+    def close(self):
+        self._closed = True
+        try:
+            self._conn.close()
+        except Exception:
+            pass

@@ -72,6 +72,11 @@ class Gcs:
         self.named_actors: Dict[str, ActorID] = {}
         self._obj_waiters: Dict[ObjectID, List[_Waiter]] = {}
         self._cv = threading.Condition(self.lock)
+        # Cluster-mode hooks (set by the cluster adapter): called AFTER an
+        # object turns terminal locally so the global directory learns about
+        # it. Must be non-blocking (they cast over a socket).
+        self.on_object_ready: Optional[Callable[[ObjectID, Optional[bytes], int], None]] = None
+        self.on_object_error: Optional[Callable[[ObjectID, bytes], None]] = None
 
     # -- function table ---------------------------------------------------
 
@@ -115,7 +120,8 @@ class Gcs:
                 self.objects[obj_id] = st
             return st
 
-    def mark_ready(self, obj_id: ObjectID, inline: Optional[bytes] = None, size: int = 0) -> None:
+    def mark_ready(self, obj_id: ObjectID, inline: Optional[bytes] = None,
+                   size: int = 0, _local_only: bool = False) -> None:
         with self.lock:
             st = self.ensure_object(obj_id)
             if st.status == ERROR:
@@ -125,14 +131,19 @@ class Gcs:
             st.size = size or (len(inline) if inline else 0)
             self._fire_waiters(obj_id)
             self._cv.notify_all()
+        if self.on_object_ready is not None and not _local_only:
+            self.on_object_ready(obj_id, inline, st.size)
 
-    def mark_error(self, obj_id: ObjectID, err_blob: bytes) -> None:
+    def mark_error(self, obj_id: ObjectID, err_blob: bytes,
+                   _local_only: bool = False) -> None:
         with self.lock:
             st = self.ensure_object(obj_id)
             st.status = ERROR
             st.error = err_blob
             self._fire_waiters(obj_id)
             self._cv.notify_all()
+        if self.on_object_error is not None and not _local_only:
+            self.on_object_error(obj_id, err_blob)
 
     def object_state(self, obj_id: ObjectID) -> Optional[ObjectState]:
         with self.lock:

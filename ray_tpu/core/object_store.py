@@ -218,6 +218,29 @@ class StoreClient:
                     self._pins[obj_id] = pinned
         return serialization.read_from(memoryview(pinned.mm))
 
+    def get_raw(self, obj_id: ObjectID) -> Optional[bytes]:
+        """The serialized segment bytes (node-to-node transfer source).
+
+        A copy, not a view: the bytes are shipped over a socket, so pinning
+        the mapping would only delay eviction for no benefit.
+        """
+        if self._arena is not None:
+            view = self._arena.get(obj_id.binary())
+            if view is not None:
+                try:
+                    return bytes(view)
+                finally:
+                    del view
+                    self._arena.release(obj_id.binary())
+        for path in (_seg_path(self.session, obj_id),
+                     _spill_path(self.session, obj_id)):
+            try:
+                with open(path, "rb") as f:
+                    return f.read()
+            except FileNotFoundError:
+                continue
+        return None
+
     def contains(self, obj_id: ObjectID) -> bool:
         if obj_id in self._pins:
             return True

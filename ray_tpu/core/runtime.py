@@ -151,6 +151,9 @@ class DriverRuntime:
         self._spawning = 0  # spawns decided but not yet registered
         self._shutdown = False
 
+        # cluster-mode adapter (ray_tpu/cluster/adapter.py); None single-node
+        self.cluster = None
+
         self.session_dir = f"/tmp/rtpu-{self.session}"
         os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
         self._sock_addr = os.path.join(self.session_dir, "driver.sock")
@@ -335,6 +338,8 @@ class DriverRuntime:
         callers blocked on queued refs would hang forever."""
         info = self.gcs.get_actor(actor_id)
         self.gcs.mark_actor_dead(actor_id, cause)
+        if self.cluster is not None:
+            self.cluster.publish_actor_state(actor_id.binary(), "DEAD")
         if info is None:
             return
         with self.lock:
@@ -414,6 +419,9 @@ class DriverRuntime:
                         info.state = "DEAD"
                     else:
                         info.state = "ALIVE"
+                    if self.cluster is not None:
+                        self.cluster.publish_actor_state(
+                            spec["actor_id"], info.state)
                 ws.status = "idle"
             elif spec is not None and spec["type"] == ts.ACTOR_METHOD:
                 info = self.gcs.get_actor(ActorID(spec["actor_id"]))
@@ -477,22 +485,27 @@ class DriverRuntime:
                 ids, num_returns, timeout = args
                 self._async_wait(ids, num_returns, timeout, reply)
             elif op == "fn_get":
-                reply(self.gcs.get_fn(args[0]))
+                blob = self.gcs.get_fn(args[0])
+                if blob is None and self.cluster is not None:
+                    blob = self.cluster.fetch_fn(args[0])
+                    if blob is not None:
+                        self.gcs.register_fn(args[0], blob)
+                reply(blob)
             elif op == "actor_create":
                 self.submit_spec(args[0])
                 reply(None)
             elif op == "name_lookup":
-                aid = self.gcs.lookup_named(args[0])
-                reply(aid.binary() if aid else None)
+                # lookup_named_actor falls through to the cluster registry,
+                # so workers resolve actors created on peer nodes too;
+                # cluster mode offloads the network hop off this receiver
+                # thread (it must keep demuxing results)
+                self._reply_offloaded(
+                    reply, lambda: self.lookup_named_actor(args[0]))
             elif op == "kv":
-                sub, rest = args[0], args[1:]
-                fn = {
-                    "put": self.gcs.kv_put,
-                    "get": self.gcs.kv_get,
-                    "del": self.gcs.kv_del,
-                    "keys": self.gcs.kv_keys,
-                }[sub]
-                reply(fn(*rest))
+                # kv_op routes to the global GCS in cluster mode — worker
+                # writes must land in the same store driver reads hit
+                self._reply_offloaded(
+                    reply, lambda: self.kv_op(args[0], *args[1:]))
             elif op == "resources":
                 with self.lock:
                     reply(dict(self.avail if args[0] == "avail" else self.total))
@@ -508,6 +521,20 @@ class DriverRuntime:
         except BaseException as e:  # noqa: BLE001
             reply(None, e)
 
+    def _reply_offloaded(self, reply, fn):
+        """Run ``fn`` and reply — on the cluster io pool when in cluster
+        mode (the call may hit the network), inline otherwise."""
+        def run():
+            try:
+                reply(fn())
+            except BaseException as e:  # noqa: BLE001
+                reply(None, e)
+
+        if self.cluster is not None:
+            self.cluster._io.submit(run)
+        else:
+            run()
+
     # -- async get/wait used by worker requests ---------------------------
 
     def _object_payload(self, oid: ObjectID):
@@ -522,6 +549,7 @@ class DriverRuntime:
 
     def _async_get(self, ids: List[bytes], timeout, reply):
         oids = [ObjectID(b) for b in ids]
+        self._cluster_watch(oids)
         fired = threading.Event()
         timer_box = []
 
@@ -549,6 +577,7 @@ class DriverRuntime:
 
     def _async_wait(self, ids: List[bytes], num_returns: int, timeout, reply):
         oids = [ObjectID(b) for b in ids]
+        self._cluster_watch(oids)
         fired = threading.Event()
         timer_box = []
 
@@ -697,26 +726,45 @@ class DriverRuntime:
 
     def register_fn(self, h: str, blob: bytes):
         self.gcs.register_fn(h, blob)
+        if self.cluster is not None:
+            self.cluster.publish_fn(h, blob)
 
     def submit_spec(self, spec: dict) -> List[ObjectRef]:
+        tid = TaskID(spec["task_id"])
+        deps = ts.arg_refs(spec["args"], spec["kwargs"])
+        if self.cluster is not None and self.cluster.maybe_forward_task(spec, deps):
+            # executes on a peer node; track refs locally + watch globally
+            for rid in spec["return_ids"]:
+                self.gcs.ensure_object(ObjectID(rid))
+            return [ObjectRef(ObjectID(b), task_id=tid)
+                    for b in spec["return_ids"]]
         if spec["type"] == ts.ACTOR_CREATE:
             info = ActorInfo(ActorID(spec["actor_id"]), spec)
             self.gcs.register_actor(info)
+            if self.cluster is not None:
+                self.cluster.publish_actor(spec["actor_id"], info.name)
         for rid in spec["return_ids"]:
             self.gcs.ensure_object(ObjectID(rid))
-        deps = ts.arg_refs(spec["args"], spec["kwargs"])
         unresolved = [
             d for d in deps
             if (st := self.gcs.object_state(d)) is None or st.status == "PENDING"
         ]
         if unresolved:
+            if self.cluster is not None:
+                # deps may be produced on peer nodes: watch the global
+                # directory so the local waiter can fire
+                self.cluster.watch_many(unresolved)
             self.gcs.add_waiter(unresolved, len(unresolved), lambda: self._enqueue_ready(spec))
         else:
             self._enqueue_ready(spec)
-        tid = TaskID(spec["task_id"])
         return [ObjectRef(ObjectID(b), task_id=tid) for b in spec["return_ids"]]
 
     def _submit_actor_spec(self, spec: dict) -> List[ObjectRef]:
+        if (self.cluster is not None
+                and self.gcs.get_actor(ActorID(spec["actor_id"])) is None
+                and self.cluster.route_actor_call(spec)):
+            # the actor lives on a peer node; refs tracked + watched there
+            return [ObjectRef(ObjectID(b)) for b in spec["return_ids"]]
         for rid in spec["return_ids"]:
             self.gcs.ensure_object(ObjectID(rid))
         deps = ts.arg_refs(spec["args"], spec["kwargs"])
@@ -725,6 +773,8 @@ class DriverRuntime:
             if (st := self.gcs.object_state(d)) is None or st.status == "PENDING"
         ]
         if unresolved:
+            if self.cluster is not None:
+                self.cluster.watch_many(unresolved)
             self.gcs.add_waiter(
                 unresolved, len(unresolved), lambda: self._enqueue_actor_call(spec)
             )
@@ -907,8 +957,21 @@ class DriverRuntime:
         self.gcs.mark_ready(oid, inline=inline)
         return ObjectRef(oid)
 
+    def _cluster_watch(self, ids: List[ObjectID]) -> None:
+        """Cluster mode: objects not terminal locally may be produced on a
+        peer node — watch the global directory so local waiters can fire."""
+        if self.cluster is None:
+            return
+        pending = [
+            o for o in ids
+            if (st := self.gcs.object_state(o)) is None or st.status == "PENDING"
+        ]
+        if pending:
+            self.cluster.watch_many(pending)
+
     def get(self, refs: List[ObjectRef], timeout: Optional[float] = None):
         ids = [r.id for r in refs]
+        self._cluster_watch(ids)
         ready, rest = self.gcs.wait_objects(ids, len(ids), timeout)
         if rest:
             raise GetTimeoutError(f"get timed out after {timeout}s; {len(rest)} pending")
@@ -925,6 +988,7 @@ class DriverRuntime:
 
     def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
         ids = [r.id for r in refs]
+        self._cluster_watch(ids)
         ready, rest = self.gcs.wait_objects(ids, num_returns, timeout)
         ready_set = set(ready)
         return (
@@ -942,11 +1006,13 @@ class DriverRuntime:
         return self._submit_actor_spec(spec)
 
     def ensure_fn(self, h: str, blob: bytes):
-        self.gcs.register_fn(h, blob)
+        self.register_fn(h, blob)
 
     def kill_actor(self, actor_id: bytes, no_restart: bool = True):
         info = self.gcs.get_actor(ActorID(actor_id))
         if info is None:
+            if self.cluster is not None:
+                self.cluster.kill_remote_actor(actor_id, no_restart)
             return
         with self.lock:
             if no_restart:
@@ -981,9 +1047,14 @@ class DriverRuntime:
 
     def lookup_named_actor(self, name: str):
         aid = self.gcs.lookup_named(name)
+        if aid is None and self.cluster is not None:
+            return self.cluster.lookup_named(name)
         return aid.binary() if aid else None
 
     def kv_op(self, op: str, *args):
+        if self.cluster is not None:
+            # cluster KV must be globally consistent across nodes
+            return self.cluster.kv_op(op, *args)
         fn = {
             "put": self.gcs.kv_put,
             "get": self.gcs.kv_get,
@@ -1001,8 +1072,14 @@ class DriverRuntime:
             oid = ObjectID(b)
             self.gcs.drop_object(oid)
             self.store.delete(oid)
+            if self.cluster is not None:
+                self.cluster.gcs.cast("obj_drop", b)
 
     def node_info(self):
+        if self.cluster is not None:
+            nodes = self.cluster.node_info()
+            if nodes:
+                return nodes
         return [
             {
                 "NodeID": self.node_id.hex(),
@@ -1016,6 +1093,12 @@ class DriverRuntime:
         return list(self.timeline_events)
 
     def shutdown(self):
+        if self.cluster is not None:
+            try:
+                self.cluster.close()
+            except Exception:
+                pass
+            self.cluster = None
         if self._memory_monitor is not None:
             self._memory_monitor.stop()
         with self.lock:
@@ -1068,8 +1151,14 @@ def init(
     **kwargs,
 ):
     """Start the runtime in this process (reference: ``ray.init``,
-    ``python/ray/_private/worker.py:1214``). Single-node; ``address`` is
-    accepted for API compatibility."""
+    ``python/ray/_private/worker.py:1214``).
+
+    ``address="host:port"`` joins an existing cluster's GCS: this process
+    becomes the head/scheduler node (tasks run locally when resources
+    allow, spill to peer node daemons otherwise; see
+    :mod:`ray_tpu.cluster`). The cluster authkey comes from ``**kwargs``
+    (``cluster_authkey=...``) or ``RTPU_CLUSTER_AUTHKEY``.
+    """
     global _runtime
     with _runtime_lock:
         if _runtime is not None:
@@ -1086,6 +1175,18 @@ def init(
             namespace=namespace,
             worker_env=worker_env,
         )
+        if address and address not in ("auto", "local"):
+            from ray_tpu.cluster.adapter import ClusterAdapter
+
+            authkey = kwargs.get("cluster_authkey") or os.environ.get(
+                "RTPU_CLUSTER_AUTHKEY", "")
+            if not authkey:
+                raise ValueError(
+                    "joining a cluster requires cluster_authkey=... or "
+                    "RTPU_CLUSTER_AUTHKEY")
+            adapter = ClusterAdapter(address, authkey.encode(),
+                                     is_scheduler=True)
+            adapter.attach(rt)
         _runtime = rt
         atexit.register(_atexit_shutdown)
         return rt

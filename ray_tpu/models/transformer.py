@@ -29,7 +29,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ray_tpu.models.config import TransformerConfig
-from ray_tpu.ops.attention import blockwise_attention, naive_attention
+from ray_tpu.ops.attention import naive_attention
 from ray_tpu.ops.layers import apply_rotary, rms_norm, rotary_embedding
 from ray_tpu.ops.moe import moe_layer_dense
 from ray_tpu.parallel.sharding import constrain
@@ -185,16 +185,13 @@ def _attention(q, k, v, config: TransformerConfig):
             check_vma=False,
         )
         return fn(q, k, v)
-    from ray_tpu.ops.attention import resolve_attention_impl
+    from ray_tpu.ops.attention import flash_attention, resolve_attention_impl
 
-    impl = resolve_attention_impl()
-    if impl == "pallas":
-        from ray_tpu.ops.flash_pallas import flash_attention_pallas
-
-        return flash_attention_pallas(q, k, v, causal=True)
-    if impl == "naive":
-        return naive_attention(q, k, v, causal=True)
-    return blockwise_attention(q, k, v, causal=True)
+    # flash_attention carries the memory-efficient custom VJP: O(L)
+    # residuals (out + lse) instead of O(L^2) probability blocks — without
+    # it the backward of a scanned-layer model OOMs HBM at long context.
+    return flash_attention(q, k, v, causal=True,
+                           impl=resolve_attention_impl())
 
 
 def forward(

@@ -177,7 +177,170 @@ def blockwise_attention(
     return out.astype(q.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "impl", "q_block", "kv_block"))
+# ---------------------------------------------------------------------------
+# Memory-efficient attention with a custom VJP (FlashAttention-2 style)
+# ---------------------------------------------------------------------------
+#
+# Differentiating the blockwise/Pallas forward directly makes jax save every
+# probability block as a residual — O(Lq*Lk) per layer, which stacks across
+# a scanned-layer model into tens of GB (the round-1 bench OOMed a 16 GB
+# v5e HBM on exactly this). The standard fix is a custom VJP: the forward
+# saves only (q, k, v, out, lse) — O(L) per token — and the backward
+# recomputes the probability blocks on the fly.
+#
+# Backward math (s = scale * q k^T, p = softmax rows = exp(s - lse)):
+#   D  = rowsum(dout * out)            [B, H, Lq]
+#   dp = dout v^T                      per block
+#   ds = p * (dp - D)
+#   dq = scale * ds k ; dk = scale * ds^T q ; dv = p^T dout
+#
+# GQA is handled OUTSIDE the custom-vjp core: kv heads are expanded with
+# jnp.repeat first, whose autodiff sums gradients back over the group.
+
+
+def _mha_fwd_blockwise(q, k, v, causal, scale, q_block, kv_block):
+    """Blockwise forward returning (out, lse). Heads already expanded.
+
+    Causal rows always see at least the diagonal key, so lse is finite.
+    """
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    nq, nk = lq // q_block, lk // kv_block
+    qf = q.astype(jnp.float32).reshape(b, nq, q_block, h, d)
+    kf = k.astype(jnp.float32).reshape(b, nk, kv_block, h, d)
+    vf = v.astype(jnp.float32).reshape(b, nk, kv_block, h, d)
+    q_ids = jnp.arange(q_block)
+    k_ids = jnp.arange(kv_block)
+
+    def per_q_block(qi, qb):
+        m0 = jnp.full((b, h, q_block), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((b, h, q_block), jnp.float32)
+        o0 = jnp.zeros((b, q_block, h, d), jnp.float32)
+
+        def kv_step(carry, inp):
+            m, l, o = carry
+            ki, kb, vb = inp
+            if causal:
+                mask = (qi * q_block + q_ids[:, None]) >= (
+                    ki * kv_block + k_ids[None, :])
+            else:
+                mask = None
+            m, l, o = _attend_block(qb, kb, vb, m, l, o, mask, scale)
+            return (m, l, o), None
+
+        (m, l, o), _ = lax.scan(
+            kv_step, (m0, l0, o0),
+            (jnp.arange(nk), kf.swapaxes(0, 1), vf.swapaxes(0, 1)))
+        lse = m + jnp.log(jnp.maximum(l, 1e-30))        # [B, H, qb]
+        return o / l.transpose(0, 2, 1)[..., None], lse
+
+    out, lse = lax.map(lambda args: per_q_block(*args),
+                       (jnp.arange(nq), qf.swapaxes(0, 1)))
+    # out: [nq, B, qb, H, D] -> [B, Lq, H, D]; lse: [nq, B, H, qb] -> [B, H, Lq]
+    out = out.swapaxes(0, 1).reshape(b, lq, h, d).astype(q.dtype)
+    lse = lse.transpose(1, 2, 0, 3).reshape(b, h, lq)
+    return out, lse
+
+
+def _mha_bwd_blockwise(causal, scale, q_block, kv_block,
+                       q, k, v, out, lse, dout):
+    """Blocked backward; recomputes p per (q-block, kv-block) pair."""
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    nq, nk = lq // q_block, lk // kv_block
+    qf = q.astype(jnp.float32).reshape(b, nq, q_block, h, d).swapaxes(0, 1)
+    kf = k.astype(jnp.float32).reshape(b, nk, kv_block, h, d).swapaxes(0, 1)
+    vf = v.astype(jnp.float32).reshape(b, nk, kv_block, h, d).swapaxes(0, 1)
+    dof = dout.astype(jnp.float32).reshape(b, nq, q_block, h, d).swapaxes(0, 1)
+    outf = out.astype(jnp.float32).reshape(b, nq, q_block, h, d).swapaxes(0, 1)
+    lsef = lse.reshape(b, h, nq, q_block).transpose(2, 0, 1, 3)  # [nq,B,H,qb]
+    q_ids = jnp.arange(q_block)
+    k_ids = jnp.arange(kv_block)
+
+    def q_step(carry, inp):
+        dk_acc, dv_acc = carry                     # [nk, B, kb, H, D]
+        qi, qb, dob, ob, lseb = inp
+        dvec = (dob * ob).sum(-1).transpose(0, 2, 1)  # D: [B, H, qb]
+
+        def kv_step(_, kin):
+            ki, kb, vb = kin
+            s = jnp.einsum("bqhd,bkhd->bhqk", qb, kb) * scale
+            if causal:
+                mask = (qi * q_block + q_ids[:, None]) >= (
+                    ki * kv_block + k_ids[None, :])
+                s = jnp.where(mask[None, None], s, NEG_INF)
+            p = jnp.exp(s - lseb[..., None])       # [B, H, qb, kb]
+            dp = jnp.einsum("bqhd,bkhd->bhqk", dob, vb)
+            ds = p * (dp - dvec[..., None])
+            dq_c = scale * jnp.einsum("bhqk,bkhd->bqhd", ds, kb)
+            dk_c = scale * jnp.einsum("bhqk,bqhd->bkhd", ds, qb)
+            dv_c = jnp.einsum("bhqk,bqhd->bkhd", p, dob)
+            return None, (dq_c, dk_c, dv_c)
+
+        _, (dq_cs, dk_cs, dv_cs) = lax.scan(
+            kv_step, None, (jnp.arange(nk), kf, vf))
+        return (dk_acc + dk_cs, dv_acc + dv_cs), dq_cs.sum(0)
+
+    zeros_kv = jnp.zeros((nk, b, kv_block, h, d), jnp.float32)
+    (dk, dv), dq_blocks = lax.scan(
+        q_step, (zeros_kv, zeros_kv),
+        (jnp.arange(nq), qf, dof, outf, lsef))
+    dq = dq_blocks.swapaxes(0, 1).reshape(b, lq, h, d).astype(q.dtype)
+    dk = dk.swapaxes(0, 1).reshape(b, lk, h, d).astype(k.dtype)
+    dv = dv.swapaxes(0, 1).reshape(b, lk, h, d).astype(v.dtype)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _mha(q, k, v, causal, scale, q_block, kv_block, use_pallas):
+    out, _ = _mha_fwd(q, k, v, causal, scale, q_block, kv_block, use_pallas)
+    return out
+
+
+def _mha_fwd(q, k, v, causal, scale, q_block, kv_block, use_pallas):
+    """k/v stay at their native (possibly fewer, GQA) head count in the
+    residuals — expanding before the VJP would multiply residual HBM by the
+    group factor, eroding the O(L) memory win this VJP exists for."""
+    if use_pallas:
+        from ray_tpu.ops.flash_pallas import flash_attention_pallas_fwd
+
+        # the Pallas kernel handles GQA natively (kv block reuse per group)
+        out, lse = flash_attention_pallas_fwd(
+            q, k, v, causal=causal, scale=scale,
+            block_q=q_block, block_k=kv_block)
+    else:
+        h = q.shape[2]
+        out, lse = _mha_fwd_blockwise(q, _repeat_kv(k, h), _repeat_kv(v, h),
+                                      causal, scale, q_block, kv_block)
+    return out, (q, k, v, out, lse)
+
+
+def _mha_fwd_rule(q, k, v, causal, scale, q_block, kv_block, use_pallas):
+    out, res = _mha_fwd(q, k, v, causal, scale, q_block, kv_block, use_pallas)
+    return out, res
+
+
+def _mha_bwd_rule(causal, scale, q_block, kv_block, use_pallas, res, dout):
+    q, k, v, out, lse = res
+    b, lk, hk, d = k.shape
+    h = q.shape[2]
+    # the backward is blockwise XLA regardless of the forward impl — O(L)
+    # residuals either way; a Pallas backward kernel can slot in here later.
+    # GQA: expand kv transiently, then group-sum the grads back (matches
+    # jnp.repeat's [k0,k0,...,k1,k1,...] layout).
+    dq, dk, dv = _mha_bwd_blockwise(causal, scale, q_block, kv_block,
+                                    q, _repeat_kv(k, h), _repeat_kv(v, h),
+                                    out, lse, dout)
+    if hk != h:
+        group = h // hk
+        dk = dk.reshape(b, lk, hk, group, d).sum(axis=3)
+        dv = dv.reshape(b, lk, hk, group, d).sum(axis=3)
+    return dq, dk, dv
+
+
+_mha.defvjp(_mha_fwd_rule, _mha_bwd_rule)
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -190,18 +353,26 @@ def flash_attention(
 ) -> jax.Array:
     """Dispatching entry point: Pallas kernel on TPU, blockwise XLA elsewhere.
 
-    ``impl``: ``auto`` | ``pallas`` | ``xla`` | ``naive``.
+    ``impl``: ``auto`` | ``pallas`` | ``xla`` | ``naive``. Both pallas and
+    xla run through the memory-efficient custom VJP above, so this is safe
+    to differentiate at long context (no O(L^2) residuals).
+
+    Deliberately NOT jitted here: "auto" must resolve at every trace so a
+    later ``set_default_attention_impl`` (e.g. a preflight pinning "xla"
+    after Mosaic rejects the kernel) is honored — a jit cache keyed on the
+    literal "auto" would replay the stale choice. Callers jit the enclosing
+    computation; eager use still compiles the Pallas/blockwise internals.
     """
     if impl == "auto":
         impl = resolve_attention_impl()
-    if impl == "pallas":
-        from ray_tpu.ops.flash_pallas import flash_attention_pallas
-
-        return flash_attention_pallas(
-            q, k, v, causal=causal, block_q=q_block, block_k=kv_block
-        )
-    if impl == "xla":
-        return blockwise_attention(
-            q, k, v, causal=causal, q_block=q_block, kv_block=kv_block
-        )
-    return naive_attention(q, k, v, causal=causal)
+    if impl == "naive":
+        return naive_attention(q, k, v, causal=causal)
+    b, lq, h, d = q.shape
+    lk, hk = k.shape[1], k.shape[2]
+    q_block = min(q_block, lq)
+    kv_block = min(kv_block, lk)
+    if lq % q_block or lk % kv_block:
+        # ragged lengths: decode paths use naive anyway
+        return naive_attention(q, k, v, causal=causal)
+    scale = d ** -0.5
+    return _mha(q, k, v, causal, scale, q_block, kv_block, impl == "pallas")

@@ -25,7 +25,7 @@ NEG_INF = -1e30
 LANES = 128  # running max / denom stored broadcast over one lane tile
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
                   *, causal: bool, scale: float, block_q: int, block_k: int):
     qi = pl.program_id(2)
     ki = pl.program_id(3)
@@ -77,12 +77,88 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
         l = l_ref[:, 0:1]
         l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> 0 output
         o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        # log-sum-exp per query row — the only residual (besides o) the
+        # memory-efficient backward needs. Broadcast across the lane dim:
+        # Mosaic requires output block last-two-dims (8,128)-tileable, so
+        # the block is [block_q, LANES] and the wrapper slices lane 0.
+        lse_ref[0, 0] = m_ref[:] + jnp.log(jnp.where(l_ref[:] == 0.0, 1.0,
+                                                     l_ref[:]))
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("causal", "scale", "block_q", "block_k", "interpret"),
 )
+def flash_attention_pallas_fwd(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: bool = False,
+):
+    """Flash attention forward returning ``(out, lse)``.
+
+    ``q``: [B, Lq, H, D]; ``k``/``v``: [B, Lk, Hk, D]; ``lse``: [B, H, Lq]
+    float32 log-sum-exp per query row, consumed by the memory-efficient
+    backward in :mod:`ray_tpu.ops.attention`.
+    """
+    b, lq, h, d = q.shape
+    lk, hk = k.shape[1], k.shape[2]
+    if h % hk:
+        raise ValueError(f"q heads {h} not divisible by kv heads {hk}")
+    group = h // hk
+    scale = scale if scale is not None else d ** -0.5
+    block_q = min(block_q, lq)
+    block_k = min(block_k, lk)
+    if lq % block_q or lk % block_k:
+        from ray_tpu.ops.attention import _mha_fwd_blockwise, _repeat_kv
+
+        return _mha_fwd_blockwise(q, _repeat_kv(k, h), _repeat_kv(v, h),
+                                  causal, scale, lq, lk)
+    nq, nk = lq // block_q, lk // block_k
+
+    qt = q.transpose(0, 2, 1, 3)  # [B, H, Lq, D]
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+
+    kernel = functools.partial(
+        _flash_kernel, causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k,
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b, h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, qi, ki: (b_, h_ // group, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, qi, ki: (b_, h_ // group, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, LANES),
+                         lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, lq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, lq, LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.transpose(0, 2, 1, 3), lse[..., 0]
+
+
 def flash_attention_pallas(
     q: jax.Array,
     k: jax.Array,
@@ -94,47 +170,10 @@ def flash_attention_pallas(
     block_k: int = 512,
     interpret: bool = False,
 ) -> jax.Array:
-    """Flash attention. ``q``: [B, Lq, H, D]; ``k``/``v``: [B, Lk, Hk, D]."""
-    b, lq, h, d = q.shape
-    lk, hk = k.shape[1], k.shape[2]
-    if h % hk:
-        raise ValueError(f"q heads {h} not divisible by kv heads {hk}")
-    group = h // hk
-    scale = scale if scale is not None else d ** -0.5
-    block_q = min(block_q, lq)
-    block_k = min(block_k, lk)
-    if lq % block_q or lk % block_k:
-        from ray_tpu.ops.attention import blockwise_attention
-
-        return blockwise_attention(q, k, v, causal=causal, scale=scale)
-    nq, nk = lq // block_q, lk // block_k
-
-    qt = q.transpose(0, 2, 1, 3)  # [B, H, Lq, D]
-    kt = k.transpose(0, 2, 1, 3)
-    vt = v.transpose(0, 2, 1, 3)
-
-    kernel = functools.partial(
-        _flash_kernel, causal=causal, scale=scale,
-        block_q=block_q, block_k=block_k,
-    )
-    out = pl.pallas_call(
-        kernel,
-        grid=(b, h, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, qi, ki: (b_, h_ // group, ki, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, qi, ki: (b_, h_ // group, ki, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, h, lq, d), q.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((block_q, d), jnp.float32),
-            pltpu.VMEM((block_q, LANES), jnp.float32),
-            pltpu.VMEM((block_q, LANES), jnp.float32),
-        ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
-        ),
-        interpret=interpret,
-    )(qt, kt, vt)
-    return out.transpose(0, 2, 1, 3)
+    """Forward-only flash attention (inference paths). For training, go
+    through :func:`ray_tpu.ops.attention.flash_attention` which attaches
+    the memory-efficient custom VJP."""
+    out, _ = flash_attention_pallas_fwd(
+        q, k, v, causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k, interpret=interpret)
+    return out
