@@ -40,7 +40,7 @@ from ray_tpu.core.runtime import (
     method,
     timeline,
 )
-from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.object_ref import ObjectRef, ObjectRefGenerator
 from ray_tpu.core.actor import ActorClass, ActorHandle
 from ray_tpu.core.runtime_context import get_runtime_context
 
@@ -63,6 +63,7 @@ __all__ = [
     "method",
     "timeline",
     "ObjectRef",
+    "ObjectRefGenerator",
     "ActorClass",
     "ActorHandle",
 ]

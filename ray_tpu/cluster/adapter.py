@@ -193,13 +193,14 @@ class ClusterAdapter:
 
     def _on_push(self, channel: str, payload):
         # runs on the RpcClient reader thread: hand everything that might
-        # issue RPCs to the io pool
+        # issue RPCs to the io pool. Object pushes are notifications only
+        # (no payload bytes); interested adapters fetch the state.
         if channel == "objects":
             b = payload["oid"]
             with self._watch_lock:
                 interested = b in self._watched
             if interested:
-                self._io.submit(self._deliver, b, payload["state"])
+                self._io.submit(self._initial_query, b)
         elif channel == "nodes":
             if payload.get("event") == "down":
                 self._io.submit(self._node_down, payload)
@@ -287,9 +288,11 @@ class ClusterAdapter:
                 pass
         return self._node_view
 
-    def maybe_forward_task(self, spec: dict, deps) -> bool:
+    def maybe_forward_task(self, spec: dict) -> bool:
         """Decide placement for a task/actor-create spec. Returns True when
-        the spec was forwarded to a peer node (caller only tracks refs)."""
+        the spec was forwarded to a peer node (caller only tracks refs).
+        Placement is resource-feasibility only; dependency locality is
+        future work (the reference's hybrid policy weighs both)."""
         if not self.is_scheduler:
             return False  # daemons execute what they're given
         if spec.get("pg") is not None:

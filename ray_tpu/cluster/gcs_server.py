@@ -29,7 +29,7 @@ PENDING, READY, ERROR = "PENDING", "READY", "ERROR"
 
 
 class _GlobalObject:
-    __slots__ = ("status", "inline", "error", "size", "locations", "waiters")
+    __slots__ = ("status", "inline", "error", "size", "locations")
 
     def __init__(self):
         self.status = PENDING
@@ -37,7 +37,6 @@ class _GlobalObject:
         self.error: Optional[bytes] = None
         self.size = 0
         self.locations: Set[bytes] = set()  # node ids holding the segment
-        self.waiters: list = []  # threading.Event per blocked obj_wait
 
 
 class _NodeEntry:
@@ -57,9 +56,13 @@ class _NodeEntry:
 
 class GcsService:
     def __init__(self, node_timeout_s: float = DEFAULT_NODE_TIMEOUT_S):
+        import os
+
         self.lock = threading.RLock()
         self.nodes: Dict[bytes, _NodeEntry] = {}
         self.objects: Dict[bytes, _GlobalObject] = {}
+        self.max_objects = int(os.environ.get("RTPU_GCS_MAX_OBJECTS",
+                                              "200000"))
         self.kv: Dict[str, Dict[str, bytes]] = {}
         self.functions: Dict[str, bytes] = {}
         # named/global actor registry: actor_id -> record dict
@@ -179,12 +182,12 @@ class GcsService:
             o.size = size
             if node_id is not None and inline is None:
                 o.locations.add(node_id)
-            waiters, o.waiters = o.waiters, []
-            state = {"status": o.status, "inline": o.inline, "error": o.error,
-                     "size": o.size, "locations": list(o.locations)}
-        for ev in waiters:
-            ev.set()
-        self._publish("objects", {"oid": oid, "state": state})
+            self._maybe_evict_locked()
+        # the broadcast is a NOTIFICATION, not a payload channel: inline
+        # bytes stay on the server (interested adapters fetch via
+        # obj_state), so completion traffic stays O(nodes), not
+        # O(nodes x payload)
+        self._publish("objects", {"oid": oid, "status": READY})
         return True
 
     def rpc_obj_error(self, ctx, oid: bytes, err: bytes):
@@ -192,13 +195,25 @@ class GcsService:
             o = self._obj(oid)
             o.status = ERROR
             o.error = err
-            waiters, o.waiters = o.waiters, []
-            state = {"status": o.status, "inline": o.inline, "error": o.error,
-                     "size": o.size, "locations": list(o.locations)}
-        for ev in waiters:
-            ev.set()
-        self._publish("objects", {"oid": oid, "state": state})
+            self._maybe_evict_locked()
+        self._publish("objects", {"oid": oid, "status": ERROR})
         return True
+
+    def _maybe_evict_locked(self):
+        """Bound the directory: evict the oldest TERMINAL entries past the
+        cap. Proper lifetime management is distributed refcounting
+        (reference reference_count.h) — future work; the cap keeps a
+        long-running cluster from growing the GCS without limit."""
+        if len(self.objects) <= self.max_objects:
+            return
+        drop = []
+        for oid, o in self.objects.items():  # insertion order
+            if o.status in (READY, ERROR):
+                drop.append(oid)
+                if len(self.objects) - len(drop) <= self.max_objects * 0.9:
+                    break
+        for oid in drop:
+            del self.objects[oid]
 
     def rpc_obj_state(self, ctx, oid: bytes):
         with self.lock:
@@ -207,27 +222,6 @@ class GcsService:
                 return None
             return {"status": o.status, "inline": o.inline, "error": o.error,
                     "size": o.size, "locations": list(o.locations)}
-
-    def rpc_obj_wait(self, ctx, oid: bytes, timeout: Optional[float]):
-        """Block until the object is terminal (READY/ERROR); returns state."""
-        deadline = None if timeout is None else time.monotonic() + timeout
-        while True:
-            with self.lock:
-                o = self._obj(oid)
-                if o.status in (READY, ERROR):
-                    return self.rpc_obj_state(ctx, oid)
-                ev = threading.Event()
-                o.waiters.append(ev)
-            remaining = None if deadline is None else deadline - time.monotonic()
-            if remaining is not None and remaining <= 0:
-                return None
-            ev.wait(remaining)
-            with self.lock:
-                o2 = self.objects.get(oid)
-                if o2 is not None and o2.status in (READY, ERROR):
-                    return self.rpc_obj_state(ctx, oid)
-                if deadline is not None and time.monotonic() >= deadline:
-                    return None
 
     def rpc_obj_drop(self, ctx, oid: bytes):
         with self.lock:

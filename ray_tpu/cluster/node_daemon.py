@@ -38,6 +38,7 @@ def main(argv=None):
         num_cpus=int(args.num_cpus) if args.num_cpus else None,
         num_tpus=0,
         resources=json.loads(args.resources),
+        log_to_driver=False,  # daemon stdout goes nowhere useful
     )
     adapter = ClusterAdapter(args.gcs, args.authkey.encode(),
                              is_scheduler=False,

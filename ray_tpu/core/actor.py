@@ -30,14 +30,21 @@ class ActorMethod:
 
         rt = _get_runtime()
         enc_args, enc_kwargs = ts.encode_args(args, kwargs, rt)
-        num_returns = int(self._options.get("num_returns", 1))
+        num_returns = self._options.get("num_returns", 1)
+        streaming = num_returns in ("streaming", "dynamic")
         spec = ts.make_actor_method_spec(
             self._actor_id.binary(),
             self._method_name,
             enc_args,
             enc_kwargs,
-            num_returns=num_returns,
+            num_returns=1 if streaming else int(num_returns),
         )
+        if streaming:
+            from ray_tpu.core.object_ref import ObjectRefGenerator
+
+            spec["streaming"] = True
+            refs = rt.submit_actor_task(spec)
+            return ObjectRefGenerator(spec["task_id"], refs[0])
         refs = rt.submit_actor_task(spec)
         return refs[0] if num_returns == 1 else refs
 
@@ -124,13 +131,26 @@ class ActorClass:
             resources=_normalize_resources(self._options, default_cpu=0.0),
             actor_name=self._options.get("name", ""),
             max_restarts=int(self._options.get("max_restarts", 0)),
-            max_concurrency=int(self._options.get("max_concurrency", 1)),
+            max_concurrency=int(self._options.get(
+                "max_concurrency", self._default_concurrency())),
             placement_group_id=pg,
             bundle_index=bundle_index,
             runtime_env=self._options.get("runtime_env"),
         )
         rt.create_actor(spec)
         return ActorHandle(ActorID(spec["actor_id"]), self._method_options)
+
+    def _default_concurrency(self) -> int:
+        """Async actors (any ``async def`` method) default to many
+        concurrent calls — they interleave on one event loop, so the limit
+        is a queue-depth guard, not a thread count (reference default 1000
+        for async actors)."""
+        import inspect
+
+        has_async = any(
+            inspect.iscoroutinefunction(getattr(self._cls, n, None))
+            for n in dir(self._cls) if not n.startswith("_"))
+        return 100 if has_async else 1
 
     def __reduce__(self):
         return (_rebuild_actor_class, (self._cls_blob, self._options))

@@ -49,3 +49,62 @@ class ObjectRef:
             return get(self)
 
         return loop.run_in_executor(None, _get).__await__()
+
+
+class ObjectRefGenerator:
+    """Iterator over the yields of a ``num_returns="streaming"`` task.
+
+    Role analog: reference ``ObjectRefGenerator`` (``_raylet.pyx:273``).
+    Each ``__next__`` returns the next item's :class:`ObjectRef` as soon as
+    the producer yields it — consumers overlap with the still-running
+    producer. The task's declared return object is the END SENTINEL: it
+    resolves to the total item count when the generator completes (or to
+    the task's error).
+
+    Item ids are derived deterministically from the task id
+    (:func:`ray_tpu.core.task_spec.streaming_return_id`).
+    """
+
+    def __init__(self, task_id: bytes, sentinel: "ObjectRef"):
+        self._task_id = task_id
+        self._sentinel = sentinel
+        self._index = 0
+        self._count = None  # known once the sentinel resolves
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> "ObjectRef":
+        from ray_tpu.core import task_spec as ts
+        from ray_tpu.core.ids import ObjectID
+        from ray_tpu.core.runtime import _get_runtime
+
+        rt = _get_runtime()
+        item = ObjectRef(ObjectID(ts.streaming_return_id(self._task_id,
+                                                         self._index)))
+        while True:
+            if self._count is not None:
+                if self._index >= self._count:
+                    raise StopIteration
+                # count known -> the item was definitely produced
+                self._index += 1
+                return item
+            ready, _ = rt.wait([item, self._sentinel], num_returns=1,
+                               timeout=None)
+            if item in ready:
+                self._index += 1
+                return item
+            # sentinel resolved first: completion (count) or task error
+            self._count = rt.get([self._sentinel], timeout=0)[0]
+
+    def __len__(self):
+        if self._count is None:
+            raise TypeError("generator still running; length unknown")
+        return self._count
+
+    def completed(self) -> "ObjectRef":
+        """The end-sentinel ref (resolves to the item count)."""
+        return self._sentinel
+
+    def __reduce__(self):
+        return (ObjectRefGenerator, (self._task_id, self._sentinel))

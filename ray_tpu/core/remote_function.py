@@ -79,12 +79,13 @@ class RemoteFunction:
         rt.ensure_fn(self._fn_hash, self._fn_blob)
         enc_args, enc_kwargs = ts.encode_args(args, kwargs, rt)
         pg, bundle_index = _pg_options(self._options)
-        num_returns = int(self._options.get("num_returns", 1))
+        num_returns = self._options.get("num_returns", 1)
+        streaming = num_returns in ("streaming", "dynamic")
         spec = ts.make_task_spec(
             self._fn_hash,
             enc_args,
             enc_kwargs,
-            num_returns=num_returns,
+            num_returns=1 if streaming else int(num_returns),
             resources=_normalize_resources(self._options),
             name=self._options.get("name", self.__name__),
             max_retries=int(self._options.get("max_retries", 0)),
@@ -92,6 +93,15 @@ class RemoteFunction:
             bundle_index=bundle_index,
             runtime_env=self._options.get("runtime_env"),
         )
+        if streaming:
+            # the declared return becomes the end sentinel; yields surface
+            # as they are produced (reference ObjectRefGenerator,
+            # _raylet.pyx:273)
+            from ray_tpu.core.object_ref import ObjectRefGenerator
+
+            spec["streaming"] = True
+            refs = rt.submit(spec)
+            return ObjectRefGenerator(spec["task_id"], refs[0])
         refs = rt.submit(spec)
         if num_returns == 1:
             return refs[0]

@@ -95,6 +95,7 @@ class DriverRuntime:
         resources: Optional[Dict[str, float]] = None,
         namespace: str = "default",
         worker_env: Optional[Dict[str, str]] = None,
+        log_to_driver: bool = True,
         _pool_prestart: int = 2,
     ):
         self.session = uuid.uuid4().hex[:12]
@@ -165,6 +166,15 @@ class DriverRuntime:
         for _ in range(min(_pool_prestart, self.pool_cap)):
             self._spawn_worker("pool")
 
+        # Log streaming to the driver (reference log_monitor.py +
+        # GcsLogSubscriber, _raylet.pyx:3148 role): tail the session's
+        # worker log files and echo new lines to the driver's stdout with
+        # a worker prefix.
+        self._log_monitor_stop = threading.Event()
+        if log_to_driver and os.environ.get("RTPU_LOG_TO_DRIVER", "1") != "0":
+            threading.Thread(target=self._log_monitor_loop, daemon=True,
+                             name="rtpu-log-monitor").start()
+
         # OOM protection (reference MemoryMonitor + worker-killing policy):
         # kill the newest retriable task under host-RAM pressure. Killed
         # workers re-enter the normal death path, which retries the task.
@@ -179,6 +189,52 @@ class DriverRuntime:
                 usage_threshold=threshold,
                 on_pressure=kill_retriable_policy(self),
             ).start()
+
+    # ------------------------------------------------------------------
+    # log streaming
+    # ------------------------------------------------------------------
+
+    def _log_monitor_loop(self):
+        import sys
+
+        logs_dir = os.path.join(self.session_dir, "logs")
+        offsets: Dict[str, int] = {}
+        partial: Dict[str, bytes] = {}
+        while not self._log_monitor_stop.wait(0.2):
+            try:
+                names = os.listdir(logs_dir)
+            except OSError:
+                continue
+            for name in names:
+                if not name.endswith(".log"):
+                    continue
+                path = os.path.join(logs_dir, name)
+                pos = offsets.get(name, 0)
+                try:
+                    size = os.path.getsize(path)
+                    if size <= pos:
+                        continue
+                    with open(path, "rb") as f:
+                        f.seek(pos)
+                        chunk = f.read(size - pos)
+                    offsets[name] = size
+                except OSError:
+                    continue
+                data = partial.pop(name, b"") + chunk
+                lines = data.split(b"\n")
+                if lines and lines[-1]:
+                    partial[name] = lines[-1]  # keep the unterminated tail
+                prefix = f"({name[:-4]}) "
+                for line in lines[:-1]:
+                    try:
+                        sys.stdout.write(
+                            prefix + line.decode("utf-8", "replace") + "\n")
+                    except Exception:
+                        pass
+            try:
+                sys.stdout.flush()
+            except Exception:
+                pass
 
     # ------------------------------------------------------------------
     # worker lifecycle
@@ -284,9 +340,12 @@ class DriverRuntime:
                 spec["retries_left"] -= 1
                 self._enqueue_ready(spec)
             else:
-                err = cloudpickle.dumps(
-                    WorkerCrashedError(f"worker {ws.worker_id.hex()} died running task")
-                )
+                if spec["task_id"] in self.cancelled:
+                    err = cloudpickle.dumps(
+                        TaskCancelledError("task was cancelled (force)"))
+                else:
+                    err = cloudpickle.dumps(WorkerCrashedError(
+                        f"worker {ws.worker_id.hex()} died running task"))
                 for rid in spec["return_ids"]:
                     self.gcs.mark_error(ObjectID(rid), err)
         with self.lock:
@@ -485,12 +544,16 @@ class DriverRuntime:
                 ids, num_returns, timeout = args
                 self._async_wait(ids, num_returns, timeout, reply)
             elif op == "fn_get":
-                blob = self.gcs.get_fn(args[0])
-                if blob is None and self.cluster is not None:
-                    blob = self.cluster.fetch_fn(args[0])
-                    if blob is not None:
-                        self.gcs.register_fn(args[0], blob)
-                reply(blob)
+                def _fn_get(h=args[0]):
+                    blob = self.gcs.get_fn(h)
+                    if blob is None and self.cluster is not None:
+                        blob = self.cluster.fetch_fn(h)
+                        if blob is not None:
+                            self.gcs.register_fn(h, blob)
+                    return blob
+
+                # may hit the cluster GCS: keep it off the receiver thread
+                self._reply_offloaded(reply, _fn_get)
             elif op == "actor_create":
                 self.submit_spec(args[0])
                 reply(None)
@@ -732,7 +795,7 @@ class DriverRuntime:
     def submit_spec(self, spec: dict) -> List[ObjectRef]:
         tid = TaskID(spec["task_id"])
         deps = ts.arg_refs(spec["args"], spec["kwargs"])
-        if self.cluster is not None and self.cluster.maybe_forward_task(spec, deps):
+        if self.cluster is not None and self.cluster.maybe_forward_task(spec):
             # executes on a peer node; track refs locally + watch globally
             for rid in spec["return_ids"]:
                 self.gcs.ensure_object(ObjectID(rid))
@@ -1025,21 +1088,34 @@ class DriverRuntime:
                 pass
 
     def cancel(self, ref: ObjectRef, force: bool = False):
-        self.cancel_task(ref.id)
+        self.cancel_task(ref.id, force)
 
-    def cancel_task(self, obj_id: ObjectID):
+    def cancel_task(self, obj_id: ObjectID, force: bool = False):
         with self.lock:
             for spec in list(self.ready_tasks):
                 if obj_id.binary() in spec["return_ids"]:
                     self.cancelled.add(spec["task_id"])
                     return
-            # mark for when deps resolve
+            # running: deliver cancellation into the worker (reference
+            # execute_task_with_cancellation_handler, _raylet.pyx:2084) —
+            # the worker raises TaskCancelledError in the task thread and
+            # the normal done(error) path resolves the refs
             for ws in self.workers.values():
-                for spec in ws.inflight_specs.values():
+                for tid, spec in ws.inflight_specs.items():
                     if obj_id.binary() in spec["return_ids"]:
-                        return  # running: cooperative cancel unsupported
-                if ws.current and obj_id.binary() in ws.current["return_ids"]:
-                    return  # running: cooperative cancel unsupported
+                        spec["retries_left"] = 0  # a cancelled task never retries
+                        self.cancelled.add(tid)
+                        if force:
+                            try:
+                                ws.proc.kill()
+                            except Exception:
+                                pass
+                        else:
+                            try:
+                                ws.send(("cancel", tid))
+                            except (OSError, BrokenPipeError):
+                                pass
+                        return
         err = cloudpickle.dumps(TaskCancelledError("task was cancelled"))
         st = self.gcs.object_state(obj_id)
         if st is not None and st.status == "PENDING":
@@ -1093,6 +1169,7 @@ class DriverRuntime:
         return list(self.timeline_events)
 
     def shutdown(self):
+        self._log_monitor_stop.set()
         if self.cluster is not None:
             try:
                 self.cluster.close()
@@ -1174,6 +1251,7 @@ def init(
             resources=resources,
             namespace=namespace,
             worker_env=worker_env,
+            log_to_driver=log_to_driver,
         )
         if address and address not in ("auto", "local"):
             from ray_tpu.cluster.adapter import ClusterAdapter

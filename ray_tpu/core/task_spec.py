@@ -32,6 +32,14 @@ def fn_digest(blob: bytes) -> str:
     return hashlib.sha256(blob).hexdigest()[:24]
 
 
+def streaming_return_id(task_id: bytes, index: int) -> bytes:
+    """Deterministic ObjectID for the ``index``-th yield of a streaming
+    task — producer and consumers derive it independently (reference:
+    ``ObjectID::ForDynamicReturn`` role, ``_raylet.pyx:273``)."""
+    return hashlib.sha256(task_id + b":stream:" +
+                          index.to_bytes(8, "little")).digest()[:16]
+
+
 def pickle_fn(fn) -> bytes:
     return cloudpickle.dumps(fn)
 

@@ -46,6 +46,14 @@ class WorkerRuntime:
         self.actors: Dict[bytes, Any] = {}
         self.actor_concurrency: Dict[bytes, int] = {}
         self._actor_pools: Dict[bytes, Any] = {}  # ThreadPoolExecutor
+        # async actors: one persistent event loop per actor — concurrent
+        # calls are coroutines on THAT loop, interleaving at awaits
+        # (reference fiber semantics, src/ray/core_worker/fiber.h)
+        self._actor_loops: Dict[bytes, Any] = {}
+        # cooperative cancel: task_id -> thread ident / asyncio future
+        self._running_threads: Dict[bytes, int] = {}
+        self._running_futs: Dict[bytes, Any] = {}
+        self._running_lock = threading.Lock()
         self._req_counter = itertools.count()
         self._send_lock = threading.Lock()
         # Demuxed transport: exactly ONE thread reads the pipe and routes
@@ -105,6 +113,8 @@ class WorkerRuntime:
             kind = msg[0]
             if kind == "exec":
                 self._exec_queue.put(msg[1])
+            elif kind == "cancel":
+                self._deliver_cancel(msg[1])
             elif kind == "reply":
                 req_id = msg[1]
                 with self._reply_lock:
@@ -218,6 +228,37 @@ class WorkerRuntime:
     def free(self, ids: List[bytes]):
         self.cast("free", ids)
 
+    # -- cooperative cancellation ----------------------------------------
+
+    def _deliver_cancel(self, task_id: bytes):
+        """Interrupt the task if it is running HERE (reference
+        ``execute_task_with_cancellation_handler``, ``_raylet.pyx:2084``).
+
+        Sync tasks get ``TaskCancelledError`` injected into their thread
+        via ``PyThreadState_SetAsyncExc`` (lands at the next bytecode
+        boundary — blocking syscalls finish first); async actor calls get
+        their asyncio future cancelled, which interrupts at the next
+        await."""
+        from ray_tpu.core.exceptions import TaskCancelledError
+
+        with self._running_lock:
+            fut = self._running_futs.get(task_id)
+            # re-read under the lock at injection time: if the task already
+            # finished, its entry is gone and we must NOT inject into a
+            # thread that has moved on (main loop / another task) — a small
+            # check->inject window remains, which main_loop's cancel guard
+            # absorbs
+            tident = self._running_threads.get(task_id)
+            if fut is not None:
+                fut.cancel()
+                return
+            if tident is None:
+                return
+            import ctypes
+
+            ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_ulong(tident), ctypes.py_object(TaskCancelledError))
+
     # -- execution --------------------------------------------------------
 
     def _resolve_fn(self, h: str):
@@ -304,10 +345,88 @@ class WorkerRuntime:
 
         return undo
 
+    def _stream_results(self, spec: dict, value):
+        """Drain a streaming task's generator: each yield becomes an object
+        under a deterministic id announced immediately (consumers overlap
+        with production); the declared return id is the end sentinel and
+        resolves to the item count."""
+        count = 0
+        for item in value:
+            oid = ObjectID(ts.streaming_return_id(spec["task_id"], count))
+            inline = self.store.put(oid, item)
+            self.cast("put", oid.binary(), inline)
+            count += 1
+        return self._encode_results(spec, count)
+
+    def _make_actor_loop(self, actor_id: bytes):
+        import asyncio
+
+        loop = asyncio.new_event_loop()
+        threading.Thread(target=loop.run_forever, daemon=True,
+                         name="rtpu_actor_loop").start()
+        self._actor_loops[actor_id] = loop
+        return loop
+
+    def _schedule_async(self, spec: dict, coro, undo_env):
+        """Schedule an async actor call on the actor's persistent loop and
+        return immediately — the main loop keeps dispatching, so concurrent
+        calls interleave at awaits. The done message is sent from the
+        future's callback."""
+        import asyncio
+
+        loop = self._actor_loops[spec["actor_id"]]
+        fut = asyncio.run_coroutine_threadsafe(coro, loop)
+        tid = spec["task_id"]
+        with self._running_lock:
+            self._running_futs[tid] = fut
+
+        def on_done(f):
+            with self._running_lock:
+                self._running_futs.pop(tid, None)
+            try:
+                try:
+                    value = f.result()
+                except BaseException as e:  # noqa: BLE001
+                    self._send_error(spec, e)
+                    return
+                results = self._encode_results(spec, value)
+                self._send(("done", tid, results))
+            except BaseException as e:  # noqa: BLE001
+                self._send_error(spec, e)
+            finally:
+                undo_env()
+
+        fut.add_done_callback(on_done)
+
+    def _send_error(self, spec: dict, e: BaseException):
+        from concurrent.futures import CancelledError
+
+        from ray_tpu.core.exceptions import TaskCancelledError
+
+        desc = f"{spec['type']} {spec.get('name') or spec.get('method', '')}"
+        if isinstance(e, (CancelledError, TaskCancelledError)):
+            # cancellation travels as a bare TaskCancelledError so callers
+            # see ONE exception type regardless of when the cancel landed
+            # (queued / running / force all match the queued path)
+            err = TaskCancelledError("task was cancelled")
+        elif isinstance(e, TaskError):
+            err = e
+        else:
+            err = TaskError(
+                e, "".join(traceback.format_exception(type(e), e,
+                                                      e.__traceback__)),
+                desc)
+        blob = cloudpickle.dumps(err)
+        results = [(rid, "e", blob) for rid in spec["return_ids"]]
+        self._send(("done", spec["task_id"], results))
+
     def execute(self, spec: dict):
         ttype = spec["type"]
         self.current_task_id = TaskID(spec["task_id"])
         undo_env = lambda: None  # noqa: E731
+        tid_b = spec["task_id"]
+        with self._running_lock:
+            self._running_threads[tid_b] = threading.get_ident()
         try:
             # inside the try: a bad runtime_env (missing working_dir...)
             # must fail THIS task, not crash the worker process
@@ -317,7 +436,10 @@ class WorkerRuntime:
             if ttype == ts.TASK:
                 fn = self._resolve_fn(spec["fn_hash"])
                 value = fn(*args, **kwargs)
-                results = self._encode_results(spec, value)
+                if spec.get("streaming"):
+                    results = self._stream_results(spec, value)
+                else:
+                    results = self._encode_results(spec, value)
             elif ttype == ts.ACTOR_CREATE:
                 cls = self._resolve_fn(spec["fn_hash"])
                 self.current_actor_id = ActorID(spec["actor_id"])
@@ -325,6 +447,8 @@ class WorkerRuntime:
                 self.actors[spec["actor_id"]] = instance
                 self.actor_concurrency[spec["actor_id"]] = int(
                     spec.get("max_concurrency", 1))
+                if _has_async_methods(cls):
+                    self._make_actor_loop(spec["actor_id"])
                 results = self._encode_results(spec, None)
             elif ttype == ts.ACTOR_METHOD:
                 instance = self.actors.get(spec["actor_id"])
@@ -341,30 +465,29 @@ class WorkerRuntime:
                     method = getattr(instance, spec["method"])
                     value = method(*args, **kwargs)
                 if _iscoroutine(value):
-                    # async actor method: run it to completion on a private
-                    # loop (with max_concurrency > 1 each call has its own
-                    # thread, so loops never collide)
+                    if spec["actor_id"] in self._actor_loops:
+                        # async actor: schedule on the persistent loop and
+                        # return — done is sent by the future callback
+                        self._schedule_async(spec, value, undo_env)
+                        undo_env = lambda: None  # noqa: E731 — owned by cb
+                        return
+                    # sync actor that returned a coroutine: run it out
                     import asyncio
 
                     value = asyncio.run(value)
-                results = self._encode_results(spec, value)
+                if spec.get("streaming"):
+                    results = self._stream_results(spec, value)
+                else:
+                    results = self._encode_results(spec, value)
             else:
                 raise ValueError(f"unknown task type {ttype}")
             self._send(("done", spec["task_id"], results))
         except BaseException as e:  # noqa: BLE001 — remote errors must not kill the worker
-            desc = f"{ttype} {spec.get('name') or spec.get('method', '')}"
-            if isinstance(e, TaskError):
-                err = e
-            else:
-                import sys
-
-                et, ev, tb = sys.exc_info()
-                err = TaskError(ev, "".join(traceback.format_exception(et, ev, tb)), desc)
-            blob = cloudpickle.dumps(err)
-            results = [(rid, "e", blob) for rid in spec["return_ids"]]
-            self._send(("done", spec["task_id"], results))
+            self._send_error(spec, e)
         finally:
             undo_env()
+            with self._running_lock:
+                self._running_threads.pop(tid_b, None)
             self.current_task_id = None
 
     def main_loop(self):
@@ -374,7 +497,13 @@ class WorkerRuntime:
             spec = self._exec_queue.get()
             conc = (self.actor_concurrency.get(spec.get("actor_id", b""), 1)
                     if spec["type"] == ts.ACTOR_METHOD else 1)
-            if conc > 1:
+            if (spec["type"] == ts.ACTOR_METHOD
+                    and spec.get("actor_id") in self._actor_loops):
+                # async actor: execute() schedules the coroutine on the
+                # actor's persistent loop and returns immediately — no
+                # thread pool needed for interleaving
+                self._execute_guarded(spec)
+            elif conc > 1:
                 # concurrent actor: run the call on the actor's thread
                 # pool so the main loop keeps draining dispatches
                 aid = spec["actor_id"]
@@ -386,15 +515,35 @@ class WorkerRuntime:
                         max_workers=conc,
                         thread_name_prefix="rtpu_actor")
                     self._actor_pools[aid] = pool
-                pool.submit(self.execute, spec)
+                pool.submit(self._execute_guarded, spec)
             else:
-                self.execute(spec)
+                self._execute_guarded(spec)
+
+    def _execute_guarded(self, spec: dict):
+        """execute() plus a guard for a cancel injection that lands after
+        the task's except/finally (the SetAsyncExc check->inject window):
+        the stray TaskCancelledError must not kill the dispatch thread."""
+        from ray_tpu.core.exceptions import TaskCancelledError
+
+        try:
+            self.execute(spec)
+        except TaskCancelledError:
+            pass
 
 
 def _iscoroutine(value) -> bool:
     import inspect
 
     return inspect.iscoroutine(value)
+
+
+def _has_async_methods(cls) -> bool:
+    import inspect
+
+    return any(
+        inspect.iscoroutinefunction(getattr(cls, name, None))
+        for name in dir(cls) if not name.startswith("_")
+    )
 
 
 def worker_entry(conn, session: str, worker_id: bytes):

@@ -123,25 +123,22 @@ def _run_map_stage(stream: Iterator[Any], op: MapOp,
                    options: ExecutionOptions) -> Iterator[Any]:
     """Bounded-in-flight task pool over input refs (streaming backpressure:
     reference ``select_operator_to_run``'s resource gating, reduced to a
-    window of ``max_in_flight`` concurrent tasks)."""
-    remote_fn = ray_tpu.remote(lambda block, _fn=op.fn: _fn(block))
-    in_flight: List[Any] = []
+    window of ``max_in_flight`` concurrent tasks).
 
-    def results_of(ref) -> List[Any]:
-        # the task returns List[Block]; flatten to per-block refs by
-        # fetching the list (cheap: refs to blocks stay in store)
-        out_blocks = ray_tpu.get(ref)
-        return [ray_tpu.put(b) for b in out_blocks]
+    Each map task is a STREAMING task: output blocks surface as refs the
+    moment the worker yields them (overlapping producer/consumer, the
+    reference's streaming-exchange behavior) and block bytes never round-
+    trip through the driver."""
+    remote_fn = ray_tpu.remote(num_returns="streaming")(
+        lambda block, _fn=op.fn: iter(_fn(block)))
+    in_flight: List[Any] = []
 
     for ref in stream:
         in_flight.append(remote_fn.remote(ref))
         while len(in_flight) >= options.max_in_flight:
-            first = in_flight.pop(0)
-            for r in results_of(first):
-                yield r
-    for ref in in_flight:
-        for r in results_of(ref):
-            yield r
+            yield from in_flight.pop(0)
+    for gen in in_flight:
+        yield from gen
 
 
 def _run_all_to_all(stream: Iterator[Any], op: AllToAllOp) -> Iterator[Any]:

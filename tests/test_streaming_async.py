@@ -1,0 +1,227 @@
+"""Streaming generators, true async actors, cooperative cancel, log
+streaming — reference analogs: ObjectRefGenerator (_raylet.pyx:273), async
+actor fibers (core_worker/fiber.h), cancellation handler (_raylet.pyx:2084),
+log monitor (GcsLogSubscriber, _raylet.pyx:3148)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import ObjectRefGenerator
+from ray_tpu.core.exceptions import TaskCancelledError, TaskError
+
+
+@pytest.fixture
+def rt():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# streaming generators
+# ---------------------------------------------------------------------------
+
+def test_streaming_generator_basic(rt):
+    @ray_tpu.remote(num_returns="streaming")
+    def gen(n):
+        for i in range(n):
+            yield i * 10
+
+    g = gen.remote(5)
+    assert isinstance(g, ObjectRefGenerator)
+    vals = [ray_tpu.get(ref) for ref in g]
+    assert vals == [0, 10, 20, 30, 40]
+    assert len(g) == 5
+
+
+def test_streaming_overlaps_producer(rt):
+    """The consumer must receive item 0 while the producer still runs."""
+    @ray_tpu.remote
+    def warm():
+        return None
+
+    ray_tpu.get([warm.remote() for _ in range(4)])  # spawn the pool first
+
+    @ray_tpu.remote(num_returns="streaming")
+    def slow_gen():
+        for i in range(4):
+            yield i
+            time.sleep(0.8)
+
+    g = slow_gen.remote()
+    t0 = time.monotonic()
+    first = ray_tpu.get(next(g))
+    first_latency = time.monotonic() - t0
+    assert first == 0
+    # producer takes ~3.2s total; the first item must arrive far sooner
+    assert first_latency < 2.0, f"first item took {first_latency:.1f}s"
+    rest = [ray_tpu.get(r) for r in g]
+    assert rest == [1, 2, 3]
+
+
+def test_streaming_generator_error_mid_stream(rt):
+    @ray_tpu.remote(num_returns="streaming")
+    def bad_gen():
+        yield 1
+        yield 2
+        raise ValueError("boom mid-stream")
+
+    g = bad_gen.remote()
+    assert ray_tpu.get(next(g)) == 1
+    assert ray_tpu.get(next(g)) == 2
+    with pytest.raises((TaskError, ValueError)):
+        for ref in g:
+            ray_tpu.get(ref)
+
+
+def test_streaming_empty_generator(rt):
+    @ray_tpu.remote(num_returns="streaming")
+    def empty():
+        if False:
+            yield
+
+    assert [ray_tpu.get(r) for r in empty.remote()] == []
+
+
+def test_streaming_actor_method(rt):
+    @ray_tpu.remote
+    class Chunker:
+        def chunks(self, n):
+            for i in range(n):
+                yield bytes([i]) * 4
+
+    c = Chunker.remote()
+    g = c.chunks.options(num_returns="streaming").remote(3)
+    vals = [ray_tpu.get(r) for r in g]
+    assert vals == [b"\x00" * 4, b"\x01" * 4, b"\x02" * 4]
+
+
+# ---------------------------------------------------------------------------
+# true async actors: awaits interleave on one loop
+# ---------------------------------------------------------------------------
+
+def test_async_actor_calls_interleave(rt):
+    """Call A blocks on an internal event; call B completes first; call C
+    releases A — impossible unless calls share one live event loop."""
+    @ray_tpu.remote
+    class Gate:
+        def __init__(self):
+            import asyncio
+
+            self.ev = asyncio.Event()
+
+        async def wait_open(self):
+            await self.ev.wait()
+            return "A-done"
+
+        async def quick(self):
+            return "B-done"
+
+        async def open(self):
+            self.ev.set()
+            return "C-done"
+
+    g = Gate.remote()
+    a = g.wait_open.remote()
+    # B completes while A is parked at its await
+    assert ray_tpu.get(g.quick.remote(), timeout=30) == "B-done"
+    _, pending = ray_tpu.wait([a], timeout=0.2)
+    assert pending == [a], "A should still be waiting"
+    assert ray_tpu.get(g.open.remote(), timeout=30) == "C-done"
+    assert ray_tpu.get(a, timeout=30) == "A-done"
+
+
+def test_async_actor_many_concurrent(rt):
+    @ray_tpu.remote
+    class Sleeper:
+        async def nap(self, t):
+            import asyncio
+
+            await asyncio.sleep(t)
+            return t
+
+    s = Sleeper.remote()
+    ray_tpu.get(s.nap.remote(0.01), timeout=60)  # warm: spawn + first call
+    t0 = time.monotonic()
+    out = ray_tpu.get([s.nap.remote(0.5) for _ in range(10)], timeout=60)
+    wall = time.monotonic() - t0
+    assert out == [0.5] * 10
+    # 10 x 0.5s sleeps must overlap on the loop, not serialize to 5s
+    assert wall < 3.0, f"async naps serialized: {wall:.1f}s"
+
+
+# ---------------------------------------------------------------------------
+# cooperative cancel
+# ---------------------------------------------------------------------------
+
+def test_cancel_running_task(rt):
+    @ray_tpu.remote
+    def spin():
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 30:
+            pass  # pure-python loop: SetAsyncExc lands between bytecodes
+        return "finished"
+
+    ref = spin.remote()
+    time.sleep(1.0)  # ensure it is running
+    t0 = time.monotonic()
+    ray_tpu.cancel(ref)
+    # cancellation surfaces as a bare TaskCancelledError no matter when the
+    # cancel landed (queued / running / force)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+    assert time.monotonic() - t0 < 10, "cancel did not interrupt the task"
+
+
+def test_cancel_queued_task(rt):
+    @ray_tpu.remote(resources={"CPU": 4})
+    def hog():
+        time.sleep(3)
+
+    @ray_tpu.remote(resources={"CPU": 4})
+    def queued():
+        return 1
+
+    h = hog.remote()
+    q = queued.remote()  # cannot start while hog holds all CPUs
+    time.sleep(0.3)
+    ray_tpu.cancel(q)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(q, timeout=30)
+    ray_tpu.get(h, timeout=30)
+
+
+def test_cancel_force_kills_worker(rt):
+    @ray_tpu.remote
+    def block_hard():
+        time.sleep(60)  # blocking syscall: only force can end it promptly
+
+    ref = block_hard.remote()
+    time.sleep(1.0)
+    ray_tpu.cancel(ref, force=True)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# log streaming to driver
+# ---------------------------------------------------------------------------
+
+def test_log_to_driver(rt, capsys):
+    @ray_tpu.remote
+    def noisy():
+        print("hello-from-worker-xyzzy", flush=True)
+        return 1
+
+    assert ray_tpu.get(noisy.remote(), timeout=30) == 1
+    deadline = time.monotonic() + 5
+    seen = ""
+    while time.monotonic() < deadline:
+        seen += capsys.readouterr().out
+        if "hello-from-worker-xyzzy" in seen:
+            break
+        time.sleep(0.2)
+    assert "hello-from-worker-xyzzy" in seen
+    assert "(worker-" in seen  # prefixed with the worker identity
