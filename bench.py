@@ -215,6 +215,8 @@ def train_step_child() -> None:
 
     set_default_attention_impl(attn_impl)
 
+    rl_rate = _rl_learner_bench(jax)
+
     result = None
     last_exc = None
     for batch_size in (16, 8, 4):
@@ -244,7 +246,40 @@ def train_step_child() -> None:
     if result is None:
         raise last_exc
     result["detail"]["attention_impl"] = attn_note
+    result["detail"]["rl_learner_grad_steps_per_s"] = rl_rate
     print(json.dumps(result))
+
+
+def _rl_learner_bench(jax) -> float:
+    """PPO learner grad-steps/s on this device (north-star: learner
+    throughput vs the reference's 8xA100 DDP learner)."""
+    try:
+        import numpy as np
+
+        from ray_tpu.rllib.ppo import PPOLearner
+
+        spec = {"observation_dim": 84, "action_dim": 6, "discrete": True,
+                "hidden": (256, 256)}
+        learner = PPOLearner(spec, {"num_devices": 1}, seed=0)
+        rng = np.random.default_rng(0)
+        n = 4096
+        batch = {
+            "obs": rng.standard_normal((n, 84)).astype(np.float32),
+            "actions": rng.integers(0, 6, n),
+            "action_logp": np.full(n, -1.79, np.float32),
+            "vf_preds": rng.standard_normal(n).astype(np.float32),
+            "advantages": rng.standard_normal(n).astype(np.float32),
+            "value_targets": rng.standard_normal(n).astype(np.float32),
+        }
+        learner.update(batch, minibatch_size=512, num_epochs=1)  # compile
+        t0 = time.perf_counter()
+        epochs = 4
+        learner.update(batch, minibatch_size=512, num_epochs=epochs)
+        dt = time.perf_counter() - t0
+        steps = epochs * (n // 512)
+        return round(steps / dt, 1)
+    except Exception:
+        return 0.0
 
 
 def _claim_backend(jax, retries: int = 4) -> str:

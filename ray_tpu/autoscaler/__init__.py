@@ -1,0 +1,29 @@
+"""Autoscaler: demand-driven TPU node/slice provisioning.
+
+Role analog: ``python/ray/autoscaler/_private/autoscaler.py:172``
+(StandardAutoscaler) + the cloud NodeProvider plugin interface
+(``node_provider.py``) + the fake in-memory provider the reference tests
+with (``autoscaler/_private/fake_multi_node/``). TPU specifics follow the
+reference's GCP provider (``gcp/node_provider.py:75-94``): a TPU *slice* is
+the provisioning unit — one create call yields every host in the slice,
+each carrying the slice-name resource and worker 0 the ``-head`` marker
+(the scheduling pattern from ``_private/accelerators/tpu.py:335-398``).
+"""
+
+from ray_tpu.autoscaler.autoscaler import (
+    AutoscalerConfig,
+    NodeTypeConfig,
+    StandardAutoscaler,
+    request_resources,
+)
+from ray_tpu.autoscaler.node_provider import NodeProvider
+from ray_tpu.autoscaler.fake_provider import FakeTpuNodeProvider
+
+__all__ = [
+    "AutoscalerConfig",
+    "NodeTypeConfig",
+    "StandardAutoscaler",
+    "NodeProvider",
+    "FakeTpuNodeProvider",
+    "request_resources",
+]

@@ -1,0 +1,266 @@
+"""StandardAutoscaler: bin-pack demand onto node types, launch/terminate.
+
+Role analog: ``python/ray/autoscaler/_private/autoscaler.py:172`` driven by
+``resource_demand_scheduler.py`` bin-packing, with the TPU twist that a
+demand bundle naming a slice-shaped resource (``TPU-v5e-16-head`` or an
+aggregate chip count beyond one host) provisions a whole SLICE. Demand
+comes from ``request_resources`` (the reference SDK call) and/or a pluggable
+``load_source`` callable returning pending bundles (wired to the GCS's
+queued-task view in cluster mode).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from ray_tpu.autoscaler.fake_provider import SLICE_SHAPES
+from ray_tpu.autoscaler.node_provider import NodeInfo, NodeProvider
+
+Bundle = Dict[str, float]
+
+
+@dataclass
+class NodeTypeConfig:
+    """One entry of ``available_node_types`` (reference YAML schema)."""
+
+    name: str
+    min_workers: int = 0
+    max_workers: int = 10
+    is_slice: bool = False  # True -> provisioned via create_slice
+
+
+@dataclass
+class AutoscalerConfig:
+    node_types: List[NodeTypeConfig] = field(default_factory=list)
+    idle_timeout_s: float = 60.0
+    max_launch_per_step: int = 8
+
+
+_requested: List[Bundle] = []
+_requested_lock = threading.Lock()
+
+
+def request_resources(bundles: List[Bundle]) -> None:
+    """Declare a standing resource demand (reference
+    ``ray.autoscaler.sdk.request_resources``): the autoscaler keeps the
+    cluster able to satisfy these bundles. Pass ``[]`` to clear."""
+    with _requested_lock:
+        _requested[:] = [dict(b) for b in bundles]
+
+
+def _get_requested() -> List[Bundle]:
+    with _requested_lock:
+        return [dict(b) for b in _requested]
+
+
+class StandardAutoscaler:
+    def __init__(self, provider: NodeProvider, config: AutoscalerConfig,
+                 load_source: Optional[Callable[[], List[Bundle]]] = None):
+        self.provider = provider
+        self.config = config
+        self.load_source = load_source
+        # node_id -> monotonic ts when it was last seen busy
+        self._last_busy: Dict[str, float] = {}
+        self._by_name = {t.name: t for t in config.node_types}
+
+    # -- one scaling step (reference StandardAutoscaler.update) ----------
+
+    def update(self,
+               used_resources: Optional[Dict[str, Dict[str, float]]] = None
+               ) -> None:
+        """One reconcile step: satisfy min_workers, bin-pack unmet demand,
+        launch, then scale idle nodes down. ``used_resources``:
+        node_id -> resources currently in use on it (for idle detection)."""
+        nodes = self.provider.non_terminated_nodes()
+        demand = _get_requested()
+        if self.load_source is not None:
+            demand = demand + list(self.load_source() or [])
+        self._scale_up(nodes, demand)
+        self._scale_down(self.provider.non_terminated_nodes(),
+                         used_resources or {}, demand)
+
+    # -- scale up --------------------------------------------------------
+
+    def _scale_up(self, nodes: List[NodeInfo], demand: List[Bundle]) -> None:
+        counts: Dict[str, int] = {}
+        for n in nodes:
+            if n.slice_id is not None:
+                counts[n.node_type] = counts.get(n.node_type, 0)
+            else:
+                counts[n.node_type] = counts.get(n.node_type, 0) + 1
+        # slices count once per slice, not per host
+        slice_ids = {}
+        for n in nodes:
+            if n.slice_id is not None:
+                slice_ids.setdefault(n.node_type, set()).add(n.slice_id)
+        for t, ids in slice_ids.items():
+            counts[t] = len(ids)
+
+        launches: Dict[str, int] = {}
+        # 1. min_workers floors
+        for t in self.config.node_types:
+            have = counts.get(t.name, 0)
+            if have < t.min_workers:
+                launches[t.name] = t.min_workers - have
+
+        # 2. bin-pack unmet demand onto virtual capacity
+        free: List[Dict[str, float]] = [dict(n.resources) for n in nodes]
+        for t, k in launches.items():
+            free.extend(self._virtual_nodes(t, k))
+        for bundle in demand:
+            if self._fit(bundle, free):
+                continue
+            t = self._pick_type(bundle, counts, launches)
+            if t is None:
+                continue
+            launches[t.name] = launches.get(t.name, 0) + 1
+            free.extend(self._virtual_nodes(t.name, 1))
+            # re-fit this bundle against the new capacity
+            self._fit(bundle, free)
+
+        # 3. launch
+        for name, k in launches.items():
+            t = self._by_name[name]
+            have = counts.get(name, 0)
+            k = min(k, t.max_workers - have, self.config.max_launch_per_step)
+            for _ in range(max(0, k)):
+                if t.is_slice:
+                    created = self.provider.create_slice(name)
+                else:
+                    created = self.provider.create_nodes(name, 1)
+                now = time.monotonic()
+                for n in created:
+                    self._last_busy[n.node_id] = now
+
+    def _virtual_nodes(self, type_name: str, k: int) -> List[Dict[str, float]]:
+        t = self._by_name[type_name]
+        out = []
+        for _ in range(k):
+            if t.is_slice:
+                hosts, chips = SLICE_SHAPES[type_name]
+                head = {"CPU": 8.0, "TPU": float(chips),
+                        f"TPU-{type_name}-head": 1.0,
+                        f"tpu-{type_name}-pending": float(hosts)}
+                out.append(head)
+                out.extend({"CPU": 8.0, "TPU": float(chips)}
+                           for _ in range(hosts - 1))
+            else:
+                out.append(dict(self._fake_type_resources(type_name)))
+        return out
+
+    def _fake_type_resources(self, type_name: str) -> Dict[str, float]:
+        getter = getattr(self.provider, "_node_types", {})
+        return getter.get(type_name, {"CPU": 1.0})
+
+    @staticmethod
+    def _fit(bundle: Bundle, free: List[Dict[str, float]]) -> bool:
+        """First-fit-decreasing single-node placement; mutates ``free``."""
+        for node in free:
+            if all(node.get(k, 0.0) >= v for k, v in bundle.items()):
+                for k, v in bundle.items():
+                    node[k] = node.get(k, 0.0) - v
+                return True
+        return False
+
+    def _pick_type(self, bundle: Bundle, counts: Dict[str, int],
+                   launches: Dict[str, int]) -> Optional[NodeTypeConfig]:
+        for t in self.config.node_types:
+            planned = counts.get(t.name, 0) + launches.get(t.name, 0)
+            if planned >= t.max_workers:
+                continue
+            if t.is_slice:
+                hosts, chips = SLICE_SHAPES[t.name]
+                cap = {"CPU": 8.0, "TPU": float(chips),
+                       f"TPU-{t.name}-head": 1.0}
+                # aggregate chip demand can ride a whole slice
+                cap_total = {"CPU": 8.0 * hosts, "TPU": float(chips * hosts),
+                             f"TPU-{t.name}-head": 1.0}
+                if all(cap.get(k, 0.0) >= v for k, v in bundle.items()) or \
+                        all(cap_total.get(k, 0.0) >= v
+                            for k, v in bundle.items()):
+                    return t
+            else:
+                cap = self._fake_type_resources(t.name)
+                if all(cap.get(k, 0.0) >= v for k, v in bundle.items()):
+                    return t
+        return None
+
+    # -- scale down ------------------------------------------------------
+
+    def _scale_down(self, nodes: List[NodeInfo],
+                    used: Dict[str, Dict[str, float]],
+                    demand: List[Bundle]) -> None:
+        now = time.monotonic()
+        by_slice: Dict[str, List[NodeInfo]] = {}
+        singles: List[NodeInfo] = []
+        for n in nodes:
+            if n.slice_id is not None:
+                by_slice.setdefault(n.slice_id, []).append(n)
+            else:
+                singles.append(n)
+            if used.get(n.node_id):
+                self._last_busy[n.node_id] = now
+            self._last_busy.setdefault(n.node_id, now)
+
+        # nodes still needed by standing demand are not idle-terminated
+        keep: set = set()
+        free = [(n.node_id, dict(n.resources)) for n in nodes]
+        # slice aggregates for bundles no single host satisfies (e.g.
+        # {"TPU": 16} riding a 4-host slice)
+        slice_free: Dict[str, Dict[str, float]] = {}
+        for sid, members in by_slice.items():
+            agg: Dict[str, float] = {}
+            for n in members:
+                for k, v in n.resources.items():
+                    agg[k] = agg.get(k, 0.0) + v
+            slice_free[sid] = agg
+        for bundle in demand:
+            placed = False
+            for nid, res in free:
+                if all(res.get(k, 0.0) >= v for k, v in bundle.items()):
+                    for k, v in bundle.items():
+                        res[k] = res.get(k, 0.0) - v
+                    keep.add(nid)
+                    placed = True
+                    break
+            if placed:
+                continue
+            for sid, agg in slice_free.items():
+                if all(agg.get(k, 0.0) >= v for k, v in bundle.items()):
+                    for k, v in bundle.items():
+                        agg[k] = agg.get(k, 0.0) - v
+                    keep.update(n.node_id for n in by_slice[sid])
+                    break
+
+        counts: Dict[str, int] = {}
+        for n in singles:
+            counts[n.node_type] = counts.get(n.node_type, 0) + 1
+        for sid, members in by_slice.items():
+            counts[members[0].node_type] = counts.get(
+                members[0].node_type, 0) + 1
+
+        def idle(n: NodeInfo) -> bool:
+            return (n.node_id not in keep
+                    and now - self._last_busy.get(n.node_id, now)
+                    > self.config.idle_timeout_s)
+
+        for n in singles:
+            t = self._by_name.get(n.node_type)
+            floor = t.min_workers if t else 0
+            if idle(n) and counts.get(n.node_type, 0) > floor:
+                self.provider.terminate_node(n.node_id)
+                counts[n.node_type] -= 1
+                self._last_busy.pop(n.node_id, None)
+
+        for sid, members in by_slice.items():
+            t = self._by_name.get(members[0].node_type)
+            floor = t.min_workers if t else 0
+            if all(idle(n) for n in members) and \
+                    counts.get(members[0].node_type, 0) > floor:
+                self.provider.terminate_slice(sid)
+                counts[members[0].node_type] -= 1
+                for n in members:
+                    self._last_busy.pop(n.node_id, None)

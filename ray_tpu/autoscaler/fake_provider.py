@@ -1,0 +1,100 @@
+"""Fake in-memory TPU node provider for tests and local dev.
+
+Role analog: ``python/ray/autoscaler/_private/fake_multi_node/node_provider.py``
+with the GCP TPU slice behavior layered in: ``create_slice("v5e-16")``
+yields hosts-per-slice nodes, each advertising the per-slice name resource
+and the head host the ``TPU-<type>-head`` marker — exactly the resource
+shapes ``ray_tpu.accelerators.tpu`` derives on real metal, so slice-aware
+scheduling logic is testable with zero hardware.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Dict, List
+
+from ray_tpu.autoscaler.node_provider import NodeInfo, NodeProvider
+
+# slice type -> (num_hosts, chips_per_host)  (v2-v4: 8-core hosts; v5+: 4)
+SLICE_SHAPES = {
+    "v4-8": (1, 4),
+    "v4-16": (2, 4),
+    "v5e-4": (1, 4),
+    "v5e-8": (2, 4),
+    "v5e-16": (4, 4),
+    "v5e-64": (16, 4),
+    "v5e-256": (64, 4),
+    "v5p-8": (1, 4),
+    "v6e-16": (4, 4),
+}
+
+
+class FakeTpuNodeProvider(NodeProvider):
+    def __init__(self, node_types: Dict[str, Dict[str, float]] = None):
+        self._node_types = dict(node_types or {})
+        self._nodes: Dict[str, NodeInfo] = {}
+        self._lock = threading.Lock()
+        self._ids = itertools.count()
+        self.create_calls: List[str] = []
+        self.terminate_calls: List[str] = []
+
+    def _next_id(self, prefix: str) -> str:
+        return f"{prefix}-{next(self._ids)}"
+
+    def create_nodes(self, node_type: str, count: int) -> List[NodeInfo]:
+        res = self._node_types.get(node_type)
+        if res is None:
+            raise ValueError(f"unknown node type {node_type!r}")
+        out = []
+        with self._lock:
+            for _ in range(count):
+                nid = self._next_id(node_type)
+                info = NodeInfo(nid, node_type, None, dict(res))
+                self._nodes[nid] = info
+                out.append(info)
+            self.create_calls.append(node_type)
+        return out
+
+    def create_slice(self, slice_type: str) -> List[NodeInfo]:
+        if slice_type not in SLICE_SHAPES:
+            raise ValueError(f"unknown slice type {slice_type!r}")
+        hosts, chips = SLICE_SHAPES[slice_type]
+        out = []
+        with self._lock:
+            slice_id = self._next_id(f"slice-{slice_type}")
+            pod_name = f"tpu-{slice_id}"
+            for h in range(hosts):
+                nid = self._next_id(slice_type)
+                resources = {
+                    "CPU": 8.0,
+                    "TPU": float(chips),
+                    pod_name: 1.0,  # per-slice name resource on every host
+                }
+                head = h == 0
+                if head:
+                    # fan-out anchor (reference tpu.py:335-398)
+                    resources[f"TPU-{slice_type}-head"] = 1.0
+                out.append(NodeInfo(nid, slice_type, slice_id, resources,
+                                    is_slice_head=head,
+                                    tags={"pod_name": pod_name}))
+                self._nodes[nid] = out[-1]
+            self.create_calls.append(slice_type)
+        return out
+
+    def terminate_node(self, node_id: str) -> None:
+        with self._lock:
+            self._nodes.pop(node_id, None)
+            self.terminate_calls.append(node_id)
+
+    def terminate_slice(self, slice_id: str) -> None:
+        with self._lock:
+            doomed = [n for n in self._nodes.values()
+                      if n.slice_id == slice_id]
+            for n in doomed:
+                del self._nodes[n.node_id]
+            self.terminate_calls.append(slice_id)
+
+    def non_terminated_nodes(self) -> List[NodeInfo]:
+        with self._lock:
+            return list(self._nodes.values())

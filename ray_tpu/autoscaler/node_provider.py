@@ -1,0 +1,45 @@
+"""NodeProvider interface: the cloud seam the autoscaler drives.
+
+Role analog: ``python/ray/autoscaler/node_provider.py`` — reduced to the
+calls the scaling loop needs. A provider manages NODES (hosts); TPU slices
+are multi-host: ``create_slice`` provisions every host of a slice in one
+call (the reference's GCP TPU path fills pod resources per host,
+``gcp/node_provider.py:283-292``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class NodeInfo:
+    node_id: str
+    node_type: str          # e.g. "cpu-worker" | "v5e-16"
+    slice_id: Optional[str]  # shared by every host of one slice
+    resources: Dict[str, float]
+    is_slice_head: bool = False
+    tags: Dict[str, str] = field(default_factory=dict)
+
+
+class NodeProvider:
+    """Subclass per cloud; all methods are called from the scaling loop."""
+
+    def create_nodes(self, node_type: str, count: int) -> List[NodeInfo]:
+        """Provision ``count`` single-host nodes of ``node_type``."""
+        raise NotImplementedError
+
+    def create_slice(self, slice_type: str) -> List[NodeInfo]:
+        """Provision one TPU slice; returns every host in it."""
+        raise NotImplementedError
+
+    def terminate_node(self, node_id: str) -> None:
+        raise NotImplementedError
+
+    def terminate_slice(self, slice_id: str) -> None:
+        """A slice lives and dies as a unit (ICI has no partial membership)."""
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> List[NodeInfo]:
+        raise NotImplementedError

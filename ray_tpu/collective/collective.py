@@ -188,28 +188,57 @@ class XlaGroup(BaseGroup):
         sharding = NamedSharding(self.mesh, P("x"))
         return jax.device_put(stacked, sharding)
 
-    def _collective(self, kind: str, op: str = "sum"):
-        key = (kind, op)
+    def _collective(self, kind: str, op: str = "sum", root: int = 0,
+                    perm: tuple = ()):
+        key = (kind, op, root, perm)
         if key in self._cache:
             return self._cache[key]
         import jax
+        import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
         from jax import lax, shard_map
+
+        def red_fn(x):
+            if op == "product":
+                g = lax.all_gather(x, "x", axis=0)
+                return jnp.prod(g, axis=0)
+            red = {"sum": lax.psum, "mean": lax.pmean, "max": lax.pmax,
+                   "min": lax.pmin}[op]
+            return red(x, "x")
 
         def body(x):
             x = x[0]  # drop the leading per-device dim of this shard
             if kind == "allreduce":
-                if op == "product":
-                    import jax.numpy as jnp
-                    g = lax.all_gather(x, "x", axis=0)
-                    return jnp.prod(g, axis=0)[None]
-                red = {"sum": lax.psum, "mean": lax.pmean, "max": lax.pmax,
-                       "min": lax.pmin}[op]
-                return red(x, "x")[None]
+                return red_fn(x)[None]
             if kind == "allgather":
                 return lax.all_gather(x, "x", axis=0, tiled=True)[None]
             if kind == "reducescatter":
                 return lax.psum_scatter(x, "x", scatter_dimension=0, tiled=True)[None]
+            if kind == "reduce":
+                # only the root keeps the reduction; others keep their input
+                # (reference collective.py:311 semantics)
+                i = lax.axis_index("x")
+                return jnp.where(i == root, red_fn(x), x)[None]
+            if kind == "broadcast":
+                # root's tensor everywhere (reference collective.py:373)
+                g = lax.all_gather(x, "x", axis=0)
+                return g[root][None]
+            if kind == "permute":
+                # device-to-device send/recv: (src, dst) pairs become one
+                # ppermute — the SPMD-native form of the reference's
+                # send/recv_multigpu (collective.py:531/594); devices not
+                # named as a destination keep their input
+                shifted = lax.ppermute(x, "x", perm=list(perm))
+                i = lax.axis_index("x")
+                is_dst = jnp.zeros((), bool)
+                for _, dst in perm:
+                    is_dst = jnp.logical_or(is_dst, i == dst)
+                return jnp.where(is_dst, shifted, x)[None]
+            if kind == "alltoall":
+                # x: [world, chunk...] per device -> transpose chunk i to
+                # device i (lax.all_to_all over ICI)
+                return lax.all_to_all(x, "x", split_axis=0, concat_axis=0,
+                                      tiled=False)[None]
             raise ValueError(kind)
 
         fn = jax.jit(shard_map(body, mesh=self.mesh, in_specs=P("x"),
@@ -217,17 +246,51 @@ class XlaGroup(BaseGroup):
         self._cache[key] = fn
         return fn
 
+    def _per_device(self, out):
+        return [np.asarray(s.data)[0] for s in out.addressable_shards]
+
     def allreduce(self, tensors: List[Any], op: ReduceOp = ReduceOp.SUM):
         out = self._collective("allreduce", op.value)(self._sharded(tensors))
-        return [np.asarray(s.data)[0] for s in out.addressable_shards]
+        return self._per_device(out)
 
     def allgather(self, tensors: List[Any]):
         out = self._collective("allgather")(self._sharded(tensors))
-        return [np.asarray(s.data)[0] for s in out.addressable_shards]
+        return self._per_device(out)
 
     def reducescatter(self, tensors: List[Any], op: ReduceOp = ReduceOp.SUM):
         out = self._collective("reducescatter", op.value)(self._sharded(tensors))
-        return [np.asarray(s.data)[0] for s in out.addressable_shards]
+        return self._per_device(out)
+
+    def reduce(self, tensors: List[Any], root_rank: int = 0,
+               op: ReduceOp = ReduceOp.SUM):
+        out = self._collective("reduce", op.value, root=root_rank)(
+            self._sharded(tensors))
+        return self._per_device(out)
+
+    def broadcast(self, tensors: List[Any], root_rank: int = 0):
+        out = self._collective("broadcast", root=root_rank)(
+            self._sharded(tensors))
+        return self._per_device(out)
+
+    def permute(self, tensors: List[Any], pairs: List[tuple]):
+        """Device-level send/recv: each (src, dst) pair ships src's tensor
+        to dst in ONE ppermute over ICI."""
+        out = self._collective("permute", perm=tuple(
+            (int(s), int(d)) for s, d in pairs))(self._sharded(tensors))
+        return self._per_device(out)
+
+    def send(self, tensors: List[Any], dst_rank: int, src_rank: int = 0):
+        """Reference send_multigpu analog: src device's tensor lands on
+        dst; returns the updated per-device list."""
+        return self.permute(tensors, [(src_rank, dst_rank)])
+
+    def alltoall(self, chunk_lists: List[Any]):
+        """``chunk_lists[i]`` = device i's world_size chunks; returns per-
+        device transposed chunk lists (device i gets everyone's chunk i)."""
+        stacked = [np.stack([np.asarray(c) for c in chunks], axis=0)
+                   for chunks in chunk_lists]
+        out = self._collective("alltoall")(self._sharded(stacked))
+        return [list(np.asarray(s.data)[0]) for s in out.addressable_shards]
 
     def barrier(self):
         self.allreduce([np.zeros((8, 128), np.float32)

@@ -153,6 +153,14 @@ class Gcs:
         with self.lock:
             self.objects.pop(obj_id, None)
 
+    def reset_object(self, obj_id: ObjectID) -> None:
+        """Back to PENDING for lineage re-execution of a lost object."""
+        with self.lock:
+            st = self.ensure_object(obj_id)
+            st.status = PENDING
+            st.inline = None
+            st.error = None
+
     def _fire_waiters(self, obj_id: ObjectID) -> None:
         # caller holds lock
         waiters = self._obj_waiters.pop(obj_id, [])

@@ -110,12 +110,19 @@ class StoreClient:
 
     def put_parts(self, obj_id: ObjectID, data: bytes, buffers) -> Optional[bytes]:
         """Like ``put`` but takes an already-serialized (data, buffers) pair
-        so callers that must size-check first don't serialize twice."""
+        so callers that must size-check first don't serialize twice.
+
+        Idempotent on duplicate ids: a lineage re-execution re-writes every
+        return of the producing task, and siblings that survived the loss
+        keep their existing segment (deterministic tasks produce the same
+        bytes)."""
         size = serialization.serialized_size(data, buffers)
         if size < INLINE_THRESHOLD:
             out = bytearray(size)
             serialization.write_into(memoryview(out), data, buffers)
             return bytes(out)
+        if self.contains(obj_id):
+            return None  # already present (lineage re-run of a survivor)
         if self._arena is not None:
             view = self._arena.create(obj_id.binary(), size)
             if view is not None:

@@ -104,10 +104,14 @@ class DriverRuntime:
         self.gcs = Gcs()
         self.store = StoreClient(self.session)
         self.worker_env = dict(worker_env or {})
-        # Workers must not grab the TPU runtime by default — the driver (or a
-        # designated actor) owns the chip. Opt back in with
+        # Workers must not grab the TPU runtime by default — the driver (or
+        # a designated actor) owns the chip. A hard "cpu" default, NOT the
+        # driver's env value: on TPU boxes the global env often pins
+        # JAX_PLATFORMS to the accelerator platform, and propagating that
+        # would make every pool worker fight for the chip (and hang when
+        # it is unclaimable). Opt back in per-actor with
         # @remote(runtime_env={"env_vars": {"JAX_PLATFORMS": ""}}).
-        self.worker_env.setdefault("JAX_PLATFORMS", os.environ.get("JAX_PLATFORMS", "cpu"))
+        self.worker_env.setdefault("JAX_PLATFORMS", "cpu")
 
         cpus = num_cpus if num_cpus is not None else (os.cpu_count() or 1)
         from ray_tpu.accelerators.tpu import detect_num_tpu_chips
@@ -154,6 +158,21 @@ class DriverRuntime:
 
         # cluster-mode adapter (ray_tpu/cluster/adapter.py); None single-node
         self.cluster = None
+
+        # Lineage for object reconstruction (reference
+        # object_recovery_manager.h:41 / task_manager.h:468): return-id ->
+        # producing TASK spec, bounded FIFO. A lost segment with live refs
+        # re-executes the producer; recursion through lost deps happens
+        # naturally (the re-executed task's worker hits the same path).
+        self._lineage: Dict[bytes, dict] = {}
+        self._lineage_cap = int(os.environ.get("RTPU_LINEAGE_MAX", "100000"))
+        # byte bound too (reference RAY_max_lineage_bytes role): specs keep
+        # inlined serialized args alive, so count alone can hold GBs
+        self._lineage_max_bytes = int(os.environ.get(
+            "RTPU_LINEAGE_MAX_BYTES", str(512 << 20)))
+        self._lineage_bytes = 0
+        self._lineage_sizes: Dict[bytes, int] = {}
+        self._reconstructing: Dict[bytes, threading.Event] = {}
 
         self.session_dir = f"/tmp/rtpu-{self.session}"
         os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
@@ -543,6 +562,19 @@ class DriverRuntime:
             elif op == "wait":
                 ids, num_returns, timeout = args
                 self._async_wait(ids, num_returns, timeout, reply)
+            elif op == "reconstruct":
+                # blocks until the producer re-ran: always off the
+                # receiver thread
+                def _rec(b=args[0]):
+                    return self.reconstruct_object(ObjectID(b))
+
+                def run():
+                    try:
+                        reply(_rec())
+                    except BaseException as e:  # noqa: BLE001
+                        reply(None, e)
+
+                threading.Thread(target=run, daemon=True).start()
             elif op == "fn_get":
                 def _fn_get(h=args[0]):
                     blob = self.gcs.get_fn(h)
@@ -583,6 +615,88 @@ class DriverRuntime:
                 reply(None, RuntimeError(f"unknown op {op}"))
         except BaseException as e:  # noqa: BLE001
             reply(None, e)
+
+    # ------------------------------------------------------------------
+    # lineage reconstruction
+    # ------------------------------------------------------------------
+
+    def _record_lineage(self, spec: dict) -> None:
+        # approximate retained size: inlined arg blobs dominate
+        approx = 256 + sum(
+            len(e[1]) for e in list(spec["args"]) + list(spec["kwargs"].values())
+            if e[0] == "v")
+        with self.lock:
+            for rid in spec["return_ids"]:
+                self._lineage[rid] = spec
+                self._lineage_sizes[rid] = approx
+                self._lineage_bytes += approx
+            # bounded FIFO by count AND bytes: evict oldest past either cap
+            while (len(self._lineage) > self._lineage_cap
+                   or self._lineage_bytes > self._lineage_max_bytes):
+                old = next(iter(self._lineage))
+                self._lineage.pop(old)
+                self._lineage_bytes -= self._lineage_sizes.pop(old, 0)
+
+    def reconstruct_object(self, oid: ObjectID,
+                           timeout: float = 120.0) -> bool:
+        """Re-execute the producer of a lost object (segment evicted or
+        deleted behind the directory's back). Returns True when the object
+        is terminal again.
+
+        Deduplication is per PRODUCING TASK: concurrent callers for any of
+        the task's return objects share one re-execution (per-object keys
+        would let siblings of a multi-return task launch duplicate runs).
+        Healthy sibling returns keep their segments — only lost ones are
+        reset, and the store's idempotent put skips re-writing survivors.
+        """
+        b = oid.binary()
+        with self.lock:
+            spec = self._lineage.get(b)
+            if spec is None:
+                return False
+            task_key = spec["task_id"]
+            ev = self._reconstructing.get(task_key)
+            if ev is not None:
+                waiter_only = True
+            else:
+                ev = threading.Event()
+                self._reconstructing[task_key] = ev
+                waiter_only = False
+        if waiter_only:
+            ev.wait(timeout)
+            st = self.gcs.object_state(oid)
+            return st is not None and st.status in (READY, ERROR)
+        try:
+            logger.info("reconstructing lost object %s via task %s",
+                        oid.hex()[:8], spec.get("name", "?"))
+            respec = dict(spec)
+            respec["retries_left"] = spec.get("max_retries", 0)
+            for rid in respec["return_ids"]:
+                roid = ObjectID(rid)
+                st = self.gcs.object_state(roid)
+                inline = st is not None and st.inline is not None
+                if not inline and not self.store.contains(roid):
+                    self.gcs.reset_object(roid)
+            self.submit_spec(respec)
+            ready, _ = self.gcs.wait_objects([oid], 1, timeout)
+            return bool(ready)
+        finally:
+            with self.lock:
+                self._reconstructing.pop(task_key, None)
+            ev.set()
+
+    def _get_with_recovery(self, oid: ObjectID):
+        try:
+            return self.store.get(oid)
+        except (FileNotFoundError, OSError):
+            if not self.reconstruct_object(oid):
+                raise
+            st = self.gcs.object_state(oid)
+            if st is not None and st.status == ERROR:
+                raise cloudpickle.loads(st.error)
+            if st is not None and st.inline is not None:
+                return serialization.loads_oob(st.inline)
+            return self.store.get(oid)
 
     def _reply_offloaded(self, reply, fn):
         """Run ``fn`` and reply — on the cluster io pool when in cluster
@@ -808,6 +922,8 @@ class DriverRuntime:
                 self.cluster.publish_actor(spec["actor_id"], info.name)
         for rid in spec["return_ids"]:
             self.gcs.ensure_object(ObjectID(rid))
+        if spec["type"] == ts.TASK and not spec.get("streaming"):
+            self._record_lineage(spec)
         unresolved = [
             d for d in deps
             if (st := self.gcs.object_state(d)) is None or st.status == "PENDING"
@@ -1046,7 +1162,7 @@ class DriverRuntime:
             if st.inline is not None:
                 out.append(serialization.loads_oob(st.inline))
             else:
-                out.append(self.store.get(oid))
+                out.append(self._get_with_recovery(oid))
         return out
 
     def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):

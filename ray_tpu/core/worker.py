@@ -167,7 +167,7 @@ class WorkerRuntime:
             if kind == "i":
                 out.append(serialization.loads_oob(payload))
             elif kind == "s":
-                out.append(self.store.get(r.id))
+                out.append(self._store_get_with_recovery(r.id))
             else:
                 raise cloudpickle.loads(payload)
         return out
@@ -279,11 +279,28 @@ class WorkerRuntime:
         if kind == "ri":
             return serialization.loads_oob(e[2])
         if kind == "r":
-            oid = ObjectID(e[1])
-            return self.store.get(oid)
+            return self._store_get_with_recovery(ObjectID(e[1]))
         if kind == "re":
             raise cloudpickle.loads(e[1])
         raise ValueError(f"bad arg encoding {kind}")
+
+    def _store_get_with_recovery(self, oid: ObjectID):
+        """Store read with lineage recovery: a missing segment (evicted /
+        deleted behind the directory) asks the driver to re-execute the
+        producer, then retries (reference object_recovery_manager.h:41)."""
+        try:
+            return self.store.get(oid)
+        except (FileNotFoundError, OSError):
+            # release our resource slot while the producer re-executes —
+            # on a saturated pool the reconstruction task needs it
+            self.cast("blocked")
+            try:
+                ok = self.request("reconstruct", oid.binary())
+            finally:
+                self.cast("unblocked")
+            if not ok:
+                raise
+            return self.store.get(oid)
 
     def _encode_results(self, spec: dict, value: Any):
         rids = spec["return_ids"]

@@ -20,6 +20,7 @@ from typing import Any, Dict, List, Optional
 
 from ray_tpu.dag.dag_node import ClassMethodNode, DAGNode, InputNode
 from ray_tpu.experimental.channel import Channel
+from ray_tpu.experimental.device_channel import DeviceChannel, DeviceTensorType
 
 
 class _Stop:
@@ -44,9 +45,10 @@ def _dag_exec_loop(instance, stages: List[Dict[str, Any]]) -> int:
     executed = 0
     chans: Dict[str, Channel] = {}
 
-    def chan(name: str) -> Channel:
+    def chan(name: str, kind: str = "obj") -> Channel:
         if name not in chans:
-            chans[name] = Channel(name, create=False)
+            cls = DeviceChannel if kind == "device" else Channel
+            chans[name] = cls(name, create=False)
         return chans[name]
 
     while True:
@@ -55,21 +57,21 @@ def _dag_exec_loop(instance, stages: List[Dict[str, Any]]) -> int:
         for stage in stages:
             args = []
             err: Optional[_NodeError] = None
-            for kind, key in stage["inputs"]:
+            for kind, key, *ck in stage["inputs"]:
                 if kind == "const":
                     args.append(key)
                     continue
                 if key in read_cache:
                     val = read_cache[key]
                 else:
-                    val = chan(key).read()
+                    val = chan(key, ck[0] if ck else "obj").read()
                     read_cache[key] = val
                 if isinstance(val, _Stop):
                     stop = True
                 if isinstance(val, _NodeError):
                     err = val
                 args.append(val)
-            out = chan(stage["out"])
+            out = chan(stage["out"], stage.get("out_kind", "obj"))
             if stop:
                 out.write(_Stop())
                 continue
@@ -137,12 +139,18 @@ class CompiledDAG:
                     "driven by the input (teardown could never reach it)")
         uid = uuid.uuid4().hex[:8]
 
-        # one channel per node output
+        # one channel per node output; DeviceTensorType-hinted edges get
+        # the raw device-tensor channel (reference NCCL-channel role)
         chan_name: Dict[int, str] = {}
+        chan_kind: Dict[int, str] = {}
         for i, n in enumerate(order):
             name = f"{uid}-{i}"
             chan_name[id(n)] = name
-            ch = Channel(name, capacity=self._buffer, create=True)
+            kind = ("device" if isinstance(getattr(n, "_type_hint", None),
+                                           DeviceTensorType) else "obj")
+            chan_kind[id(n)] = kind
+            cls = DeviceChannel if kind == "device" else Channel
+            ch = cls(name, capacity=self._buffer, create=True)
             self._channels.append(ch)
             if isinstance(n, InputNode):
                 self._input_channel = ch
@@ -157,7 +165,8 @@ class CompiledDAG:
             inputs_desc = []
             for a in n.args:
                 if isinstance(a, DAGNode):
-                    inputs_desc.append(("chan", chan_name[id(a)]))
+                    inputs_desc.append(("chan", chan_name[id(a)],
+                                        chan_kind[id(a)]))
                 else:
                     inputs_desc.append(("const", a))
             if n.kwargs:
@@ -166,6 +175,7 @@ class CompiledDAG:
                 "method": n.method_name,
                 "inputs": inputs_desc,
                 "out": chan_name[id(n)],
+                "out_kind": chan_kind[id(n)],
             })
 
         for actor, stages in by_actor.items():

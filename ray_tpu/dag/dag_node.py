@@ -11,6 +11,22 @@ from typing import Any, Dict, List, Tuple
 
 
 class DAGNode:
+    _type_hint = None  # set by with_type_hint / with_tensor_transport
+
+    def with_type_hint(self, hint) -> "DAGNode":
+        """Annotate this node's OUTPUT edge (reference
+        ``node.with_type_hint(TorchTensorType())``): a
+        :class:`~ray_tpu.experimental.device_channel.DeviceTensorType`
+        makes the compiled channel carry raw device-tensor bytes."""
+        self._type_hint = hint
+        return self
+
+    def with_tensor_transport(self, device: str = None) -> "DAGNode":
+        """Reference ``with_tensor_transport`` sugar for the device type."""
+        from ray_tpu.experimental.device_channel import DeviceTensorType
+
+        return self.with_type_hint(DeviceTensorType(device))
+
     def _upstream(self) -> List["DAGNode"]:
         out = []
         for a in list(getattr(self, "args", ())) + \

@@ -1,11 +1,22 @@
 """JaxLearner + LearnerGroup: the gradient side of RL.
 
 Role analog: ``rllib/core/learner/learner.py`` (optimizers/loss/update) and
-``learner_group.py:69``. TPU-native difference (BASELINE north star: "port
-LearnerGroup/TorchLearner gradient sync to pjit-sharded JAX learners"): one
-learner process owns a device mesh and the update is one jitted step;
-scaling learners = widening the mesh's dp axis, not spawning DDP ranks —
-gradient sync is a psum XLA inserts, not an explicit allreduce.
+``learner_group.py:69``; gradient sync matches the reference's DDP wrap
+(``rllib/core/learner/torch/torch_learner.py:387-399``) semantics.
+
+TPU-native design (BASELINE north star: "port LearnerGroup/TorchLearner
+gradient sync to pjit-sharded JAX learners"):
+
+- ONE learner process owns a device mesh: params/opt-state live replicated
+  across the mesh, the batch shards over the ``dp`` axis, and the update is
+  one jitted step whose gradient reduction is the psum XLA inserts for the
+  global-mean loss. Scaling learners = widening the mesh, not spawning DDP
+  ranks.
+- MULTIPLE learner actors (CPU scaling / multi-host) synchronize with
+  per-step gradient averaging — compute grads on each shard, average, apply
+  the SAME update everywhere — which is numerically identical to one
+  learner seeing the whole batch (NOT weight averaging after independent
+  Adam steps, which diverges).
 """
 
 from __future__ import annotations
@@ -17,27 +28,43 @@ import numpy as np
 
 class JaxLearner:
     """Owns module params + optimizer; ``update`` runs the jitted loss/grad
-    step. Subclasses implement ``compute_loss`` (pure function)."""
+    step over the learner's device mesh. Subclasses implement
+    ``compute_loss`` (pure function)."""
 
     def __init__(self, module_spec_dict: Dict[str, Any],
                  config: Optional[Dict[str, Any]] = None, seed: int = 0):
         import jax
         import optax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
         from ray_tpu.rllib.rl_module import RLModuleSpec
 
         self.config = dict(config or {})
         self.spec = RLModuleSpec(**module_spec_dict)
         self.module = self.spec.build()
-        self.params = self.module.init(jax.random.PRNGKey(seed))
+
+        # Mesh over this process's devices, one "dp" axis: RL modules are
+        # small, so params replicate and the batch shards — the grad psum
+        # is inserted by XLA because the loss means over the global batch.
+        n_dev = int(self.config.get("num_devices") or jax.device_count())
+        devices = np.array(jax.devices()[:n_dev])
+        self.mesh = Mesh(devices, axis_names=("dp",))
+        self._replicated = NamedSharding(self.mesh, P())
+        self._batch_sharding = NamedSharding(self.mesh, P("dp"))
+
+        params = self.module.init(jax.random.PRNGKey(seed))
+        self.params = jax.device_put(params, self._replicated)
         lr = self.config.get("lr", 3e-4)
         clip = self.config.get("grad_clip", 0.5)
         self.optimizer = optax.chain(
             optax.clip_by_global_norm(clip),
             optax.adam(lr),
         )
-        self.opt_state = self.optimizer.init(self.params)
+        self.opt_state = jax.device_put(self.optimizer.init(self.params),
+                                        self._replicated)
         self._update_fn = jax.jit(self._update_step)
+        self._grad_fn = jax.jit(self._grad_step)
+        self._apply_fn = jax.jit(self._apply_step)
 
     # -- override point ---------------------------------------------------
 
@@ -60,6 +87,42 @@ class JaxLearner:
         metrics["grad_norm"] = optax.global_norm(grads)
         return params, opt_state, metrics
 
+    def _grad_step(self, params, batch):
+        import jax
+
+        (loss, metrics), grads = jax.value_and_grad(
+            self.compute_loss, has_aux=True)(params, batch)
+        metrics = dict(metrics)
+        metrics["total_loss"] = loss
+        return grads, metrics
+
+    def _apply_step(self, params, opt_state, grads):
+        import optax
+
+        updates, opt_state = self.optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state
+
+    def _place_batch(self, batch):
+        import jax
+
+        with jax.set_mesh(self.mesh):
+            return jax.tree.map(
+                lambda v: jax.device_put(v, self._batch_sharding), batch)
+
+    def _pad_to_devices(self, batch):
+        """Pad the leading dim to a multiple of the mesh size (dp sharding
+        needs equal shards); padded rows get zero loss weight via
+        truncation-free repeat of the last row — acceptable for RL
+        minibatches where the loss is a mean (bias O(pad/batch))."""
+        n_dev = self.mesh.devices.size
+        n = len(next(iter(batch.values())))
+        pad = (-n) % n_dev
+        if pad == 0:
+            return batch
+        return {k: np.concatenate([v, v[-pad:]], axis=0)
+                for k, v in batch.items()}
+
     def update(self, batch: Dict[str, np.ndarray],
                minibatch_size: Optional[int] = None,
                num_epochs: int = 1) -> Dict[str, float]:
@@ -76,11 +139,37 @@ class JaxLearner:
             for start in range(0, n, minibatch_size):
                 mb_idx = idx[start:start + minibatch_size]
                 mb = {k: v[mb_idx] for k, v in batch.items()}
-                self.params, self.opt_state, metrics = self._update_fn(
-                    self.params, self.opt_state, mb)
+                mb = self._place_batch(self._pad_to_devices(mb))
+                with jax.set_mesh(self.mesh):
+                    self.params, self.opt_state, metrics = self._update_fn(
+                        self.params, self.opt_state, mb)
                 last_metrics = {k: float(jax.device_get(v))
                                 for k, v in metrics.items()}
         return last_metrics
+
+    # -- gradient-sync API (multi-learner DDP semantics) -------------------
+
+    def compute_grads(self, batch: Dict[str, np.ndarray]):
+        """Grads + metrics on this learner's shard (host pytree)."""
+        import jax
+
+        mb = self._place_batch(self._pad_to_devices(batch))
+        with jax.set_mesh(self.mesh):
+            grads, metrics = self._grad_fn(self.params, mb)
+        return (jax.device_get(grads),
+                {k: float(jax.device_get(v)) for k, v in metrics.items()})
+
+    def apply_grads(self, grads) -> None:
+        """Apply (already averaged) grads — every learner applies the SAME
+        update, so states stay bit-identical across the group."""
+        import jax
+
+        grads = jax.device_put(grads, self._replicated)
+        with jax.set_mesh(self.mesh):
+            self.params, self.opt_state = self._apply_fn(
+                self.params, self.opt_state, grads)
+
+    # -- state ------------------------------------------------------------
 
     def get_weights(self):
         import jax
@@ -88,7 +177,9 @@ class JaxLearner:
         return jax.device_get(self.params)
 
     def set_weights(self, params) -> None:
-        self.params = params
+        import jax
+
+        self.params = jax.device_put(params, self._replicated)
 
     def get_state(self) -> Dict[str, Any]:
         import jax
@@ -97,13 +188,21 @@ class JaxLearner:
                 "opt_state": jax.device_get(self.opt_state)}
 
     def set_state(self, state: Dict[str, Any]) -> None:
-        self.params = state["params"]
-        self.opt_state = state["opt_state"]
+        import jax
+
+        self.params = jax.device_put(state["params"], self._replicated)
+        self.opt_state = jax.device_put(state["opt_state"],
+                                        self._replicated)
 
 
 class LearnerGroup:
     """Local or remote learner management (reference
-    ``learner_group.py:69``; remote learners spawned like Train workers)."""
+    ``learner_group.py:69``; remote learners spawned like Train workers).
+
+    Multi-learner updates use per-step gradient averaging (reference DDP
+    semantics): shard the minibatch, gather grads, average, apply the same
+    update on every learner — never weight-averaging after independent
+    optimizer steps."""
 
     def __init__(self, learner_cls, module_spec_dict: Dict[str, Any],
                  config: Optional[Dict[str, Any]] = None,
@@ -113,47 +212,62 @@ class LearnerGroup:
             import ray_tpu
 
             cls = ray_tpu.remote(learner_cls)
+            # identical seed everywhere: gradient-sync keeps states
+            # identical only if they START identical
             self._learners = [
                 cls.options(num_cpus=1).remote(module_spec_dict, config,
-                                               seed + i)
-                for i in range(num_learners)]
+                                               seed)
+                for _ in range(num_learners)]
         else:
             self._local = learner_cls(module_spec_dict, config, seed)
 
-    def update(self, batch: Dict[str, np.ndarray], **kw) -> Dict[str, float]:
+    def update(self, batch: Dict[str, np.ndarray],
+               minibatch_size: Optional[int] = None,
+               num_epochs: int = 1) -> Dict[str, float]:
         if not self._remote:
-            return self._local.update(batch, **kw)
-        import ray_tpu
-
-        # shard batch across learners on the leading dim (dp semantics);
-        # each learner updates on its shard, then weights average.
-        n = len(self._learners)
-        size = len(next(iter(batch.values()))) // n
-        refs = []
-        for i, learner in enumerate(self._learners):
-            shard = {k: v[i * size:(i + 1) * size] for k, v in batch.items()}
-            refs.append(learner.update.remote(shard, **kw))
-        metrics = ray_tpu.get(refs)
-        self._sync_weights()
-        out = {}
-        for k in metrics[0]:
-            out[k] = float(np.mean([m[k] for m in metrics]))
-        return out
-
-    def _sync_weights(self):
-        """Average learner weights (data-parallel consensus). With one
-        learner on a multi-chip mesh this is a no-op — XLA already psums
-        grads inside the jitted step."""
+            return self._local.update(batch, minibatch_size=minibatch_size,
+                                      num_epochs=num_epochs)
         import jax
         import ray_tpu
 
-        if len(self._learners) == 1:
-            return
-        weights = ray_tpu.get([l.get_weights.remote()
-                               for l in self._learners])
-        avg = jax.tree.map(lambda *ws: np.mean(np.stack(ws), axis=0),
-                           *weights)
-        ray_tpu.get([l.set_weights.remote(avg) for l in self._learners])
+        n_learners = len(self._learners)
+        n = len(next(iter(batch.values())))
+        minibatch_size = minibatch_size or n
+        rng = np.random.default_rng(0)
+        last_metrics: Dict[str, float] = {}
+        for _ in range(num_epochs):
+            idx = rng.permutation(n)
+            for start in range(0, n, minibatch_size):
+                mb_idx = idx[start:start + minibatch_size]
+                mb = {k: v[mb_idx] for k, v in batch.items()}
+                # shard the minibatch across learners on the leading dim;
+                # near-even split, empty shards dropped (they would produce
+                # NaN metrics and mis-scale the average)
+                splits = np.array_split(np.arange(len(mb_idx)), n_learners)
+                refs, weights = [], []
+                for learner, rows in zip(self._learners, splits):
+                    if len(rows) == 0:
+                        continue
+                    shard = {k: v[rows] for k, v in mb.items()}
+                    refs.append(learner.compute_grads.remote(shard))
+                    weights.append(float(len(rows)))
+                outs = ray_tpu.get(refs)
+                grads = [g for g, _ in outs]
+                metrics_list = [m for _, m in outs]
+                # size-weighted average of per-shard MEAN grads == the
+                # global-batch mean gradient (the docstring's equivalence
+                # claim holds for uneven shards too)
+                w = np.asarray(weights) / np.sum(weights)
+                avg = jax.tree.map(
+                    lambda *gs: np.tensordot(w, np.stack(gs), axes=1),
+                    *grads)
+                ray_tpu.get([l.apply_grads.remote(avg)
+                             for l in self._learners])
+                last_metrics = {
+                    k: float(np.sum([wi * m[k] for wi, m in
+                                     zip(w, metrics_list)]))
+                    for k in metrics_list[0]}
+        return last_metrics
 
     def get_weights(self):
         if not self._remote:

