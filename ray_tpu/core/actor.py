@@ -124,6 +124,13 @@ class ActorClass:
         rt.ensure_fn(self._cls_hash, self._cls_blob)
         enc_args, enc_kwargs = ts.encode_args(args, kwargs, rt)
         pg, bundle_index = _pg_options(self._options)
+        renv = self._options.get("runtime_env")
+        if renv:
+            # no-ops without py_modules; raises loudly on pip/conda/etc
+            from ray_tpu.runtime_env import package_runtime_env
+
+            renv = package_runtime_env(renv, rt)
+            self._options = {**self._options, "runtime_env": renv}
         spec = ts.make_actor_create_spec(
             self._cls_hash,
             enc_args,

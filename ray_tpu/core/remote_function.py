@@ -79,6 +79,13 @@ class RemoteFunction:
         rt.ensure_fn(self._fn_hash, self._fn_blob)
         enc_args, enc_kwargs = ts.encode_args(args, kwargs, rt)
         pg, bundle_index = _pg_options(self._options)
+        renv = self._options.get("runtime_env")
+        if renv:
+            # no-ops without py_modules; raises loudly on pip/conda/etc
+            from ray_tpu.runtime_env import package_runtime_env
+
+            renv = package_runtime_env(renv, rt)
+            self._options = {**self._options, "runtime_env": renv}
         num_returns = self._options.get("num_returns", 1)
         streaming = num_returns in ("streaming", "dynamic")
         spec = ts.make_task_spec(

@@ -601,6 +601,8 @@ class DriverRuntime:
                 # writes must land in the same store driver reads hit
                 self._reply_offloaded(
                     reply, lambda: self.kv_op(args[0], *args[1:]))
+            elif op == "actor_depths":
+                reply(self.actor_queue_depths(args[0]))
             elif op == "resources":
                 with self.lock:
                     reply(dict(self.avail if args[0] == "avail" else self.total))
@@ -1236,6 +1238,19 @@ class DriverRuntime:
         st = self.gcs.object_state(obj_id)
         if st is not None and st.status == "PENDING":
             self.gcs.mark_error(obj_id, err)
+
+    def actor_queue_depths(self, actor_ids: List[bytes]) -> List[int]:
+        """Queued + in-flight calls per actor — the TRUE load signal the
+        serve router uses (reference keeps a replica-reported cache,
+        replica_scheduler/common.py:218; here the scheduler's own view is
+        authoritative and shared by every handle)."""
+        out = []
+        with self.lock:
+            for b in actor_ids:
+                info = self.gcs.get_actor(ActorID(b))
+                out.append(0 if info is None
+                           else len(info.pending_queue) + info.inflight)
+        return out
 
     def lookup_named_actor(self, name: str):
         aid = self.gcs.lookup_named(name)

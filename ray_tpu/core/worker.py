@@ -210,6 +210,9 @@ class WorkerRuntime:
     def lookup_named_actor(self, name: str):
         return self.request("name_lookup", name)
 
+    def actor_queue_depths(self, actor_ids):
+        return self.request("actor_depths", actor_ids)
+
     def create_placement_group(self, bundles, strategy: str) -> bytes:
         return self.request("pg_create", bundles, strategy)
 
@@ -335,7 +338,7 @@ class WorkerRuntime:
             saved_env[k] = os.environ.get(k)
             os.environ[k] = str(v)
         saved_cwd = None
-        path_entry = None
+        path_entries = []
         wd = renv.get("working_dir")
         if wd:
             saved_cwd = os.getcwd()
@@ -343,7 +346,18 @@ class WorkerRuntime:
             import sys
 
             sys.path.insert(0, wd)
-            path_entry = wd
+            path_entries.append(wd)
+        uris = renv.get("py_modules_uris")
+        if uris:
+            import sys
+
+            from ray_tpu.runtime_env import (_PKG_NAMESPACE,
+                                             materialize_py_modules)
+
+            for entry in materialize_py_modules(
+                    uris, lambda u: self.kv_op("get", u, _PKG_NAMESPACE)):
+                sys.path.insert(0, entry)
+                path_entries.append(entry)
         if spec["type"] == ts.ACTOR_CREATE:
             return lambda: None  # permanent for the actor's lifetime
 
@@ -357,8 +371,20 @@ class WorkerRuntime:
                     os.environ[k] = old
             if saved_cwd is not None:
                 os.chdir(saved_cwd)
-            if path_entry is not None and path_entry in sys.path:
-                sys.path.remove(path_entry)
+            for entry in path_entries:
+                if entry in sys.path:
+                    sys.path.remove(entry)
+            if path_entries:
+                # evict modules loaded from the removed entries, or they
+                # would leak into later tasks without this runtime_env
+                doomed = [
+                    name for name, mod in list(sys.modules.items())
+                    if getattr(mod, "__file__", None)
+                    and any(mod.__file__.startswith(e + os.sep)
+                            for e in path_entries)
+                ]
+                for name in doomed:
+                    del sys.modules[name]
 
         return undo
 

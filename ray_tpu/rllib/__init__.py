@@ -18,6 +18,22 @@ from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, ImpalaLearner, \
 from ray_tpu.rllib.learner import JaxLearner, LearnerGroup
 from ray_tpu.rllib.ppo import PPO, PPOConfig, PPOLearner, compute_gae
 from ray_tpu.rllib.replay import PrioritizedReplayBuffer, ReplayBuffer
+from ray_tpu.rllib.sac import SAC, SACConfig, SACLearner
+from ray_tpu.rllib.connectors import (
+    ClipActions,
+    ConnectorPipelineV2,
+    ConnectorV2,
+    FlattenObservations,
+    NormalizeObservations,
+    ScaleActions,
+)
+from ray_tpu.rllib.offline import (
+    BCLearner,
+    OfflineReader,
+    OfflineWriter,
+    record_episodes,
+    train_bc,
+)
 from ray_tpu.rllib.rl_module import JaxRLModule, RLModuleSpec
 
 __all__ = [
@@ -45,4 +61,18 @@ __all__ = [
     "compute_vtrace",
     "ReplayBuffer",
     "PrioritizedReplayBuffer",
+    "SAC",
+    "SACConfig",
+    "SACLearner",
+    "ConnectorV2",
+    "ConnectorPipelineV2",
+    "FlattenObservations",
+    "NormalizeObservations",
+    "ClipActions",
+    "ScaleActions",
+    "BCLearner",
+    "OfflineReader",
+    "OfflineWriter",
+    "record_episodes",
+    "train_bc",
 ]

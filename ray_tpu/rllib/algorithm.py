@@ -129,7 +129,7 @@ class Algorithm(Trainable):
         # Probe the env once to derive the module spec.
         probe = SingleAgentEnvRunner(cfg.env, 1, None, cfg.seed,
                                      cfg.env_config)
-        self.module_spec = probe.get_spec()
+        self.module_spec = self._transform_module_spec(probe.get_spec())
         probe.stop()
 
         if cfg.num_env_runners > 0:
@@ -156,6 +156,11 @@ class Algorithm(Trainable):
 
     def _make_learner_group(self):
         raise NotImplementedError
+
+    def _transform_module_spec(self, spec_dict):
+        """Hook: algorithms with custom rollout modules (e.g. SAC's
+        squashed-gaussian actor) rewrite the probed spec here."""
+        return spec_dict
 
     # -- sampling ---------------------------------------------------------
 

@@ -1,0 +1,128 @@
+"""ConnectorV2: env->module and module->env transform pipelines.
+
+Role analog: ``rllib/connectors/connector_v2.py`` — composable, stateful
+transforms between environment data and module inputs/outputs. The env
+runner applies the env-to-module pipeline to observations before the
+forward pass and the module-to-env pipeline to actions before stepping.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import numpy as np
+
+
+class ConnectorV2:
+    """One transform stage. Override ``__call__(data) -> data``; stateful
+    connectors (normalizers) keep running statistics and expose
+    get_state/set_state for checkpoint/restore."""
+
+    def __call__(self, data: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def get_state(self) -> Any:
+        return None
+
+    def set_state(self, state: Any) -> None:
+        pass
+
+
+class ConnectorPipelineV2(ConnectorV2):
+    def __init__(self, connectors: Optional[List[ConnectorV2]] = None):
+        self.connectors = list(connectors or [])
+
+    def append(self, connector: ConnectorV2) -> "ConnectorPipelineV2":
+        self.connectors.append(connector)
+        return self
+
+    def __call__(self, data, **kwargs):
+        for c in self.connectors:
+            try:
+                data = c(data, **kwargs)
+            except TypeError:
+                data = c(data)  # stateless connector without the kwarg
+        return data
+
+    def get_state(self):
+        return [c.get_state() for c in self.connectors]
+
+    def set_state(self, state):
+        for c, s in zip(self.connectors, state):
+            c.set_state(s)
+
+    def __len__(self):
+        return len(self.connectors)
+
+
+class FlattenObservations(ConnectorV2):
+    """[N, ...] -> [N, prod(...)] (reference flatten_observations)."""
+
+    def __call__(self, obs):
+        return np.asarray(obs).reshape(len(obs), -1)
+
+
+class NormalizeObservations(ConnectorV2):
+    """Running mean/std normalization (reference MeanStdFilter role).
+
+    Batched Chan parallel-variance update: O(1) numpy ops per call on the
+    sampling hot path, same running statistics as per-row Welford.
+    ``update=False`` applies the current statistics without absorbing the
+    batch (boundary observations that the next fragment re-feeds would
+    otherwise be counted twice).
+    """
+
+    def __init__(self, epsilon: float = 1e-8, clip: float = 10.0):
+        self.eps = epsilon
+        self.clip = clip
+        self._count = 0.0
+        self._mean: Optional[np.ndarray] = None
+        self._m2: Optional[np.ndarray] = None
+
+    def __call__(self, obs, update: bool = True):
+        obs = np.asarray(obs, np.float64)
+        if self._mean is None:
+            self._mean = np.zeros(obs.shape[1:], np.float64)
+            self._m2 = np.zeros(obs.shape[1:], np.float64)
+        if update and len(obs):
+            b_n = float(len(obs))
+            b_mean = obs.mean(axis=0)
+            b_m2 = ((obs - b_mean) ** 2).sum(axis=0)
+            delta = b_mean - self._mean
+            total = self._count + b_n
+            self._mean += delta * (b_n / total)
+            self._m2 += b_m2 + delta ** 2 * (self._count * b_n / total)
+            self._count = total
+        var = self._m2 / max(self._count - 1.0, 1.0)
+        out = (obs - self._mean) / np.sqrt(var + self.eps)
+        return np.clip(out, -self.clip, self.clip).astype(np.float32)
+
+    def get_state(self):
+        return (self._count, None if self._mean is None else self._mean.copy(),
+                None if self._m2 is None else self._m2.copy())
+
+    def set_state(self, state):
+        self._count, self._mean, self._m2 = state
+
+
+class ClipActions(ConnectorV2):
+    """module->env: clip continuous actions into the env's bounds."""
+
+    def __init__(self, low, high):
+        self.low = np.asarray(low)
+        self.high = np.asarray(high)
+
+    def __call__(self, actions):
+        return np.clip(actions, self.low, self.high)
+
+
+class ScaleActions(ConnectorV2):
+    """module->env: affine map from [-1, 1] (tanh policies) to [low, high]."""
+
+    def __init__(self, low, high):
+        self.low = np.asarray(low, np.float32)
+        self.high = np.asarray(high, np.float32)
+
+    def __call__(self, actions):
+        return self.low + (np.asarray(actions) + 1.0) * 0.5 * (
+            self.high - self.low)

@@ -19,7 +19,8 @@ class SingleAgentEnvRunner:
 
     def __init__(self, env_name: str, num_envs: int = 1,
                  module_spec: Optional[Dict[str, Any]] = None,
-                 seed: int = 0, env_config: Optional[Dict[str, Any]] = None):
+                 seed: int = 0, env_config: Optional[Dict[str, Any]] = None,
+                 env_to_module=None, module_to_env=None):
         import gymnasium as gym
 
         import jax
@@ -34,9 +35,18 @@ class SingleAgentEnvRunner:
             from ray_tpu.rllib.rl_module import spec_for_env
 
             self.spec = spec_for_env(self.env)
+            self.module = self.spec.build()
+        elif module_spec.get("kind") == "sac":
+            from ray_tpu.rllib.sac import SACModuleSpec, SACRolloutModule
+
+            self.spec = SACModuleSpec(
+                observation_dim=module_spec["observation_dim"],
+                action_dim=module_spec["action_dim"])
+            self.module = SACRolloutModule(self.spec)
         else:
-            self.spec = RLModuleSpec(**module_spec)
-        self.module = self.spec.build()
+            self.spec = RLModuleSpec(**{k: v for k, v in module_spec.items()
+                                        if k != "kind"})
+            self.module = self.spec.build()
         self.params = self.module.init(jax.random.PRNGKey(seed))
         self._rng = jax.random.PRNGKey(seed + 1)
         self._explore_fn = jax.jit(self.module.forward_exploration)
@@ -51,6 +61,23 @@ class SingleAgentEnvRunner:
         # training samples; track episode ends across fragment boundaries so
         # the first step of the next sample() call is masked too.
         self._prev_finished = np.zeros(num_envs, dtype=bool)
+        # ConnectorV2 pipelines (reference rllib/connectors/connector_v2.py):
+        # env->module transforms observations BEFORE the forward pass (the
+        # batch stores transformed obs so training sees what the module
+        # saw); module->env transforms actions before env.step.
+        self._env_to_module = env_to_module
+        self._module_to_env = module_to_env
+        if module_to_env is None and getattr(self.module, "squashed", False):
+            # tanh policies emit [-1, 1]; map to the env's true bounds
+            # (reference unsquash_action) or envs like Pendulum ([-2, 2])
+            # would only ever see half their action range
+            space = self.env.single_action_space
+            low = np.asarray(getattr(space, "low", -1.0), np.float32)
+            high = np.asarray(getattr(space, "high", 1.0), np.float32)
+            if np.all(np.isfinite(low)) and np.all(np.isfinite(high)):
+                from ray_tpu.rllib.connectors import ScaleActions
+
+                self._module_to_env = ScaleActions(low, high)
 
     def set_weights(self, params) -> None:
         self.params = params
@@ -73,14 +100,18 @@ class SingleAgentEnvRunner:
         obs = self._obs
         for _ in range(num_steps):
             self._rng, key = jax.random.split(self._rng)
-            out = self._explore_fn(self.params,
-                                   obs.astype(np.float32).reshape(
-                                       self.num_envs, -1), key)
+            mod_obs = obs.astype(np.float32).reshape(self.num_envs, -1)
+            if self._env_to_module is not None:
+                mod_obs = np.asarray(self._env_to_module(mod_obs),
+                                     np.float32)
+            out = self._explore_fn(self.params, mod_obs, key)
             action = np.asarray(out["actions"])
             env_action = action if self.spec.discrete else action.reshape(
                 self.env.action_space.shape)
+            if self._module_to_env is not None:
+                env_action = self._module_to_env(env_action)
             next_obs, reward, term, trunc, _ = self.env.step(env_action)
-            obs_buf.append(obs.reshape(self.num_envs, -1))
+            obs_buf.append(mod_obs)
             act_buf.append(action)
             logp_buf.append(np.asarray(out["action_logp"]))
             vf_buf.append(np.asarray(out["vf_preds"]))
@@ -109,9 +140,22 @@ class SingleAgentEnvRunner:
             "terminateds": np.stack(done_buf),
             "truncateds": np.stack(trunc_buf),
             "valid": np.stack(valid_buf),                          # [T, N]
-            "next_obs": obs.reshape(self.num_envs, -1).astype(np.float32),
+            "next_obs": self._final_obs(obs),
         }
         return batch
+
+    def _final_obs(self, obs) -> np.ndarray:
+        out = obs.reshape(self.num_envs, -1).astype(np.float32)
+        if self._env_to_module is not None:
+            # apply WITHOUT updating stateful connectors: the next
+            # fragment re-feeds these rows as its first obs, and counting
+            # them twice would skew running statistics
+            try:
+                out = np.asarray(self._env_to_module(out, update=False),
+                                 np.float32)
+            except TypeError:
+                out = np.asarray(self._env_to_module(out), np.float32)
+        return out
 
     def get_metrics(self) -> Dict[str, Any]:
         m = {

@@ -1,0 +1,146 @@
+"""Offline RL: experience IO + behavior cloning.
+
+Role analog: ``rllib/offline/`` (readers/writers, BC in
+``rllib/algorithms/bc/``). Experiences persist as npz shards readable into
+:mod:`ray_tpu.data` datasets, so offline training rides the same streaming
+data plane as everything else.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.learner import JaxLearner
+
+
+class OfflineWriter:
+    """Append sample batches as npz shards (reference JsonWriter role —
+    npz keeps tensors binary and mmap-friendly)."""
+
+    def __init__(self, path: str, max_rows_per_shard: int = 50_000):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+        self.max_rows = max_rows_per_shard
+        self._pending: List[Dict[str, np.ndarray]] = []
+        self._rows = 0
+        self._shard = 0
+
+    def write(self, batch: Dict[str, np.ndarray]) -> None:
+        batch = {k: np.asarray(v) for k, v in batch.items()}
+        self._pending.append(batch)
+        self._rows += len(next(iter(batch.values())))
+        if self._rows >= self.max_rows:
+            self.flush()
+
+    def flush(self) -> None:
+        if not self._pending:
+            return
+        merged = {k: np.concatenate([b[k] for b in self._pending])
+                  for k in self._pending[0]}
+        out = os.path.join(self.path, f"shard-{self._shard:05d}.npz")
+        # write through an open handle with a non-.npz temp name: a
+        # crashed/concurrent flush must never leave a file the reader's
+        # .npz glob can pick up
+        tmp = out + f".tmp-{os.getpid()}"
+        with open(tmp, "wb") as f:
+            np.savez_compressed(f, **merged)
+        os.rename(tmp, out)
+        self._shard += 1
+        self._pending = []
+        self._rows = 0
+
+
+class OfflineReader:
+    """Iterate shards written by :class:`OfflineWriter`."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.shards = sorted(
+            os.path.join(path, f) for f in os.listdir(path)
+            if f.endswith(".npz"))
+        if not self.shards:
+            raise FileNotFoundError(f"no npz shards under {path!r}")
+
+    def read_all(self) -> Dict[str, np.ndarray]:
+        parts = [dict(np.load(s)) for s in self.shards]
+        return {k: np.concatenate([p[k] for p in parts]) for k in parts[0]}
+
+    def iter_batches(self, batch_size: int, *, shuffle: bool = True,
+                     seed: int = 0) -> Iterator[Dict[str, np.ndarray]]:
+        data = self.read_all()
+        n = len(next(iter(data.values())))
+        idx = np.arange(n)
+        if shuffle:
+            np.random.default_rng(seed).shuffle(idx)
+        for start in range(0, n - batch_size + 1, batch_size):
+            rows = idx[start:start + batch_size]
+            yield {k: v[rows] for k, v in data.items()}
+
+    def as_dataset(self, parallelism: int = 8):
+        """The shards as a ray_tpu.data Dataset of row blocks."""
+        import ray_tpu
+        from ray_tpu.data.dataset import Dataset
+
+        whole = self.read_all()
+        n = len(next(iter(whole.values())))
+        size = max(1, (n + parallelism - 1) // parallelism)
+        blocks = [{k: v[i:i + size] for k, v in whole.items()}
+                  for i in range(0, n, size)]
+        return Dataset([ray_tpu.put(b) for b in (blocks or [{}])])
+
+
+def record_episodes(env_name: str, path: str, num_steps: int = 1000,
+                    policy=None, seed: int = 0,
+                    num_envs: int = 4) -> OfflineWriter:
+    """Roll out a policy (default: current random-init module) and persist
+    the experience — the 'generate offline data' workflow."""
+    from ray_tpu.rllib.env_runner import SingleAgentEnvRunner
+
+    runner = SingleAgentEnvRunner(env_name, num_envs=num_envs, seed=seed)
+    if policy is not None:
+        runner.set_weights(policy)
+    writer = OfflineWriter(path)
+    steps = 0
+    while steps < num_steps:
+        b = runner.sample(num_steps=min(200, num_steps - steps))
+        t_len, n = b["rewards"].shape
+        mask = b["valid"].reshape(-1)
+        writer.write({
+            "obs": b["obs"].reshape(t_len * n, -1)[mask],
+            "actions": b["actions"].reshape(
+                t_len * n, *b["actions"].shape[2:])[mask],
+            "rewards": b["rewards"].reshape(-1)[mask],
+        })
+        steps += t_len
+    writer.flush()
+    runner.stop()
+    return writer
+
+
+class BCLearner(JaxLearner):
+    """Behavior cloning: maximize log-prob of dataset actions (reference
+    rllib/algorithms/bc)."""
+
+    def compute_loss(self, params, batch):
+        out = self.module.forward_train(params, batch["obs"])
+        logp, entropy = self.module.logp_entropy(out, batch["actions"])
+        ent_coeff = self.config.get("entropy_coeff", 0.0)
+        loss = -(logp.mean() + ent_coeff * entropy.mean())
+        return loss, {"bc_logp": logp.mean(), "entropy": entropy.mean()}
+
+
+def train_bc(dataset_path: str, module_spec: Dict[str, Any],
+             *, lr: float = 1e-3, num_epochs: int = 5,
+             minibatch_size: int = 256, seed: int = 0) -> BCLearner:
+    """Offline BC training loop over recorded shards."""
+    reader = OfflineReader(dataset_path)
+    learner = BCLearner(module_spec, {"lr": lr, "num_devices": 1}, seed=seed)
+    data = reader.read_all()
+    batch = {"obs": data["obs"].astype(np.float32),
+             "actions": data["actions"]}
+    learner.update(batch, minibatch_size=minibatch_size,
+                   num_epochs=num_epochs)
+    return learner

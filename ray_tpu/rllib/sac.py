@@ -1,0 +1,310 @@
+"""SAC: soft actor-critic for continuous control.
+
+Role analog: ``rllib/algorithms/sac/`` (new API stack). Jax-native pieces:
+a tanh-squashed diagonal-gaussian actor, twin Q critics with polyak-averaged
+targets, and a learned entropy temperature — all one jitted update over the
+learner mesh (the reference splits these across three torch optimizers).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.rl_module import _mlp_apply, _mlp_init
+
+
+@dataclass(frozen=True)
+class SACModuleSpec:
+    observation_dim: int
+    action_dim: int
+    hidden: Tuple[int, ...] = (256, 256)
+    activation: str = "relu"
+    # SAC is continuous-control; the env runner consults this for action
+    # shaping (not an init field: frozen dataclass class-level constant)
+    discrete = False
+
+    def build(self) -> "SACModule":
+        return SACModule(self)
+
+
+LOG_STD_MIN, LOG_STD_MAX = -20.0, 2.0
+
+
+class SACModule:
+    """Actor (mean/log_std heads) + twin critics over (obs, action)."""
+
+    def __init__(self, spec: SACModuleSpec):
+        self.spec = spec
+
+    def init(self, rng):
+        import jax
+
+        k_pi, k_q1, k_q2 = jax.random.split(rng, 3)
+        s = self.spec
+        return {
+            "pi": _mlp_init(k_pi, (s.observation_dim, *s.hidden,
+                                   2 * s.action_dim)),
+            "q1": _mlp_init(k_q1, (s.observation_dim + s.action_dim,
+                                   *s.hidden, 1)),
+            "q2": _mlp_init(k_q2, (s.observation_dim + s.action_dim,
+                                   *s.hidden, 1)),
+        }
+
+    def actor(self, params, obs):
+        import jax.numpy as jnp
+
+        out = _mlp_apply(params["pi"], obs, self.spec.activation)
+        mean, log_std = jnp.split(out, 2, axis=-1)
+        return mean, jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+
+    def sample_action(self, params, obs, rng):
+        """Tanh-squashed gaussian sample with the change-of-variables
+        log-prob correction."""
+        import jax
+        import jax.numpy as jnp
+
+        mean, log_std = self.actor(params, obs)
+        std = jnp.exp(log_std)
+        eps = jax.random.normal(rng, mean.shape)
+        pre = mean + std * eps
+        act = jnp.tanh(pre)
+        logp = (-0.5 * (eps ** 2 + 2 * log_std + np.log(2 * np.pi))).sum(-1)
+        logp -= (2 * (np.log(2.0) - pre - jax.nn.softplus(-2 * pre))).sum(-1)
+        return act, logp
+
+    def q_values(self, params, obs, act):
+        import jax.numpy as jnp
+
+        x = jnp.concatenate([obs, act], axis=-1)
+        q1 = _mlp_apply(params["q1"], x, self.spec.activation)[..., 0]
+        q2 = _mlp_apply(params["q2"], x, self.spec.activation)[..., 0]
+        return q1, q2
+
+
+class SACRolloutModule:
+    """Runner-facing adapter: SAC actor behind the generic rollout module
+    surface (``forward_exploration``/``forward_inference``)."""
+
+    # actions are tanh-squashed into [-1, 1]; the env runner affinely maps
+    # them to the env's action bounds (reference unsquash_action behavior)
+    squashed = True
+
+    def __init__(self, spec: SACModuleSpec):
+        self.spec = spec
+        self._mod = SACModule(spec)
+
+    def init(self, rng):
+        return self._mod.init(rng)
+
+    def forward_exploration(self, params, obs, rng):
+        import jax.numpy as jnp
+
+        act, logp = self._mod.sample_action(params, obs, rng)
+        return {"actions": act, "action_logp": logp,
+                "vf_preds": jnp.zeros(act.shape[:-1])}
+
+    def forward_inference(self, params, obs):
+        import jax.numpy as jnp
+
+        mean, _ = self._mod.actor(params, obs)
+        return {"actions": jnp.tanh(mean)}
+
+    def forward_train(self, params, obs):
+        return self.forward_inference(params, obs)
+
+
+class SACLearner:
+    """One jitted SAC update: critic TD step, actor step, alpha step,
+    polyak target update."""
+
+    def __init__(self, module_spec_dict: Dict[str, Any],
+                 config: Dict[str, Any] = None, seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.config = dict(config or {})
+        self.spec = SACModuleSpec(**module_spec_dict)
+        self.module = SACModule(self.spec)
+        self.params = self.module.init(jax.random.PRNGKey(seed))
+        self.target_params = jax.tree.map(lambda x: x, self.params)
+        self.log_alpha = jnp.zeros(())
+        lr = self.config.get("lr", 3e-4)
+        self.optimizer = optax.adam(lr)
+        self.alpha_opt = optax.adam(lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self.alpha_state = self.alpha_opt.init(self.log_alpha)
+        self._rng = jax.random.PRNGKey(seed + 1)
+        self._update_fn = jax.jit(self._update_step)
+
+    def _update_step(self, params, target_params, log_alpha, opt_state,
+                     alpha_state, batch, rng):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.config
+        gamma = cfg.get("gamma", 0.99)
+        tau = cfg.get("tau", 0.005)
+        target_entropy = cfg.get("target_entropy",
+                                 -float(self.spec.action_dim))
+        alpha = jnp.exp(log_alpha)
+        k1, k2 = jax.random.split(rng)
+
+        # -- critic target (no grad) --
+        next_act, next_logp = self.module.sample_action(
+            params, batch["next_obs"], k1)
+        tq1, tq2 = self.module.q_values(target_params, batch["next_obs"],
+                                        next_act)
+        target_q = batch["rewards"] + gamma * (1.0 - batch["dones"]) * (
+            jnp.minimum(tq1, tq2) - alpha * next_logp)
+        target_q = jax.lax.stop_gradient(target_q)
+
+        def critic_actor_loss(p):
+            q1, q2 = self.module.q_values(p, batch["obs"], batch["actions"])
+            critic_loss = ((q1 - target_q) ** 2 + (q2 - target_q) ** 2).mean()
+            act, logp = self.module.sample_action(p, batch["obs"], k2)
+            aq1, aq2 = self.module.q_values(jax.lax.stop_gradient(p),
+                                            batch["obs"], act)
+            actor_loss = (alpha * logp - jnp.minimum(aq1, aq2)).mean()
+            return critic_loss + actor_loss, (critic_loss, actor_loss, logp)
+
+        (loss, (c_loss, a_loss, logp)), grads = jax.value_and_grad(
+            critic_actor_loss, has_aux=True)(params)
+        updates, opt_state = self.optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+
+        # -- temperature --
+        def alpha_loss_fn(la):
+            return -(jnp.exp(la) * jax.lax.stop_gradient(
+                logp + target_entropy)).mean()
+
+        a_grad = jax.grad(alpha_loss_fn)(log_alpha)
+        a_updates, alpha_state = self.alpha_opt.update(a_grad, alpha_state)
+        log_alpha = optax.apply_updates(log_alpha, a_updates)
+
+        # -- polyak target update --
+        target_params = jax.tree.map(
+            lambda t, o: (1 - tau) * t + tau * o, target_params, params)
+        metrics = {"critic_loss": c_loss, "actor_loss": a_loss,
+                   "alpha": jnp.exp(log_alpha),
+                   "entropy": -logp.mean()}
+        return params, target_params, log_alpha, opt_state, alpha_state, metrics
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        import jax
+
+        self._rng, key = jax.random.split(self._rng)
+        (self.params, self.target_params, self.log_alpha, self.opt_state,
+         self.alpha_state, metrics) = self._update_fn(
+            self.params, self.target_params, self.log_alpha,
+            self.opt_state, self.alpha_state, batch, key)
+        return {k: float(jax.device_get(v)) for k, v in metrics.items()}
+
+    def get_state(self):
+        import jax
+
+        return {k: jax.device_get(getattr(self, k)) for k in
+                ("params", "target_params", "log_alpha", "opt_state",
+                 "alpha_state")}
+
+    def set_state(self, state):
+        for k, v in state.items():
+            setattr(self, k, v)
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or SAC)
+        self.lr = 3e-4
+        self.gamma = 0.99
+        self.tau = 0.005
+        self.buffer_size = 100_000
+        self.train_batch_size = 256
+        self.learning_starts = 1000
+        self.updates_per_iteration = 16
+
+
+class SAC(Algorithm):
+    config_cls = SACConfig
+
+    def _transform_module_spec(self, spec_dict):
+        if spec_dict.get("discrete", False):
+            raise ValueError("SAC supports continuous action spaces only")
+        return {"kind": "sac",
+                "observation_dim": spec_dict["observation_dim"],
+                "action_dim": spec_dict["action_dim"]}
+
+    def _make_learner_group(self):
+        # SAC owns its learner directly (three-part state doesn't fit the
+        # generic param/opt pair the shared LearnerGroup syncs); replay
+        # state rides along since this hook runs during algorithm setup
+        # (Trainable.setup is a no-op here — see Algorithm.__init__)
+        from ray_tpu.rllib.replay import ReplayBuffer
+
+        cfg = self.algo_config
+        spec = dict(self.module_spec)
+        self._sac_learner = SACLearner(
+            {"observation_dim": spec["observation_dim"],
+             "action_dim": spec["action_dim"]},
+            {"lr": cfg.lr, "gamma": cfg.gamma, "tau": cfg.tau},
+            seed=cfg.seed or 0)
+        self.replay = ReplayBuffer(cfg.buffer_size, seed=cfg.seed or 0)
+        self._env_steps = 0
+        return _SacLearnerGroupShim(self._sac_learner, self.module_spec)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        batches = self._sample(cfg.rollout_fragment_length)
+        for b in batches:
+            t_len, n = b["rewards"].shape
+            mask = b.get("valid", np.ones((t_len, n), bool)).reshape(-1)
+            self.replay.add({
+                "obs": b["obs"].reshape(t_len * n, -1)[mask],
+                "actions": b["actions"].reshape(
+                    t_len * n, *b["actions"].shape[2:])[mask],
+                "rewards": b["rewards"].reshape(-1)[mask],
+                "next_obs": np.concatenate(
+                    [b["obs"][1:].reshape((t_len - 1) * n, -1),
+                     b["next_obs"]], axis=0)[mask],
+                # SAC bootstraps through truncation, cuts at termination
+                "dones": b["terminateds"].reshape(-1)[mask].astype(
+                    np.float32),
+            })
+            self._env_steps += int(mask.sum())
+
+        metrics: Dict[str, Any] = {"buffer_size": len(self.replay)}
+        if len(self.replay) >= cfg.learning_starts:
+            for _ in range(cfg.updates_per_iteration):
+                batch = self.replay.sample(cfg.train_batch_size)
+                metrics.update(self._sac_learner.update(batch))
+        self._sync_runner_weights()
+        self._iteration += 1
+        metrics["num_env_steps_sampled"] = self._env_steps
+        return metrics
+
+
+class _SacLearnerGroupShim:
+    """Adapts SACLearner to the Algorithm's LearnerGroup surface (weights
+    for env runners, checkpoint state)."""
+
+    def __init__(self, learner: SACLearner, module_spec):
+        self._learner = learner
+        self._module_spec = module_spec
+
+    def get_weights(self):
+        import jax
+
+        # env runners run the generic actor-critic module; hand them the
+        # SAC actor packed into that layout (mean head only for rollouts)
+        return jax.device_get(self._learner.params)
+
+    def get_state(self):
+        return self._learner.get_state()
+
+    def set_state(self, state):
+        self._learner.set_state(state)

@@ -1,0 +1,96 @@
+"""Runtime environments: per-task/actor env_vars, working_dir, py_modules.
+
+Role analog: ``python/ray/runtime_env`` + ``_private/runtime_env/``
+(``working_dir.py``, ``py_modules.py``, packaging/URI cache). The image is
+fixed (no network), so ``pip``/``conda`` are rejected loudly instead of
+silently ignored; ``py_modules`` ships local packages through the GCS KV as
+zip blobs the same way the reference uploads working-dir packages to its
+GCS package store, with content-addressed caching on both sides.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+import zipfile
+from typing import Any, Dict, Optional
+
+_PKG_NAMESPACE = "rtpu_pkg"
+_UNSUPPORTED = ("pip", "conda", "container", "uv")
+
+
+def package_runtime_env(renv: Optional[Dict[str, Any]],
+                        runtime) -> Optional[Dict[str, Any]]:
+    """Driver-side: turn local ``py_modules`` paths into content-addressed
+    KV URIs so any worker on any node can materialize them."""
+    if not renv:
+        return renv
+    for key in _UNSUPPORTED:
+        if renv.get(key):
+            raise ValueError(
+                f"runtime_env[{key!r}] is not supported: the image is fixed "
+                f"(no package installation at runtime). Bake dependencies "
+                f"into the image or ship pure-python code via py_modules.")
+    mods = renv.get("py_modules")
+    if not mods:
+        return renv
+    out = dict(renv)
+    uris = []
+    for mod in mods:
+        path = getattr(mod, "__path__", None)
+        if path:  # a module object
+            mod = list(path)[0]
+        mod = os.path.abspath(str(mod))
+        blob = _zip_dir(mod)
+        uri = f"pkg-{hashlib.sha256(blob).hexdigest()[:24]}"
+        # content-addressed: overwrite=False makes re-uploads free
+        runtime.kv_op("put", uri, blob, _PKG_NAMESPACE, False)
+        uris.append((uri, os.path.basename(mod)))
+    out.pop("py_modules")
+    out["py_modules_uris"] = uris
+    return out
+
+
+def _zip_dir(path: str) -> bytes:
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"py_modules path {path!r} does not exist")
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        if os.path.isfile(path):
+            zf.write(path, os.path.basename(path))
+        else:
+            base = os.path.basename(path)
+            for root, _, files in os.walk(path):
+                for f in files:
+                    if f.endswith(".pyc"):
+                        continue
+                    full = os.path.join(root, f)
+                    rel = os.path.join(base, os.path.relpath(full, path))
+                    zf.write(full, rel)
+    return buf.getvalue()
+
+
+def materialize_py_modules(uris, kv_get) -> list:
+    """Worker-side: fetch + extract each package (cached by content hash);
+    returns the sys.path entries to add."""
+    out = []
+    cache_root = os.path.join("/tmp", "rtpu-pkgs")
+    for uri, _name in uris:
+        target = os.path.join(cache_root, uri)
+        if not os.path.isdir(target):
+            blob = kv_get(uri)
+            if blob is None:
+                raise RuntimeError(f"py_modules package {uri} not found in KV")
+            tmp = target + ".tmp-" + str(os.getpid())
+            os.makedirs(tmp, exist_ok=True)
+            with zipfile.ZipFile(io.BytesIO(blob)) as zf:
+                zf.extractall(tmp)
+            try:
+                os.rename(tmp, target)  # atomic publish; loser cleans up
+            except OSError:
+                import shutil
+
+                shutil.rmtree(tmp, ignore_errors=True)
+        out.append(target)
+    return out

@@ -2,10 +2,15 @@
 
 Role analog: ``python/ray/serve/handle.py:711`` → ``Router``
 (``router.py:312``) → ``PowerOfTwoChoicesReplicaScheduler``
-(``replica_scheduler/pow_2_scheduler.py:49``). The handle keeps a local
-in-flight count per replica (the reference's client-side queue-length cache,
-``common.py:218``) and picks the less-loaded of two random replicas; the
-routing table refreshes from the controller when its version bumps.
+(``replica_scheduler/pow_2_scheduler.py:49``). Routing load comes from the
+RUNTIME's actor queue depths (queued + in-flight calls per replica actor) —
+the authoritative version of the reference's replica-reported queue-length
+cache (``replica_scheduler/common.py:218``), shared by every handle in the
+cluster instead of per-handle local guesses; a short-TTL cache plus local
+in-flight deltas keeps the hot path cheap. Streaming responses
+(``handle.options(stream=True)``) ride ``num_returns="streaming"`` actor
+calls and yield results as the replica produces them (reference
+``handle.py`` streaming / ``proxy.py`` chunked responses).
 """
 
 from __future__ import annotations
@@ -51,16 +56,57 @@ class DeploymentResponse:
         return self._ref.__await__()
 
 
+class DeploymentResponseGenerator:
+    """Iterates a streaming deployment call, yielding RESULTS as the
+    replica produces them (reference streaming DeploymentResponse)."""
+
+    def __init__(self, ref_gen, on_done=None):
+        self._ref_gen = ref_gen
+        self._on_done = on_done
+        self._finished = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        import ray_tpu
+
+        try:
+            ref = next(self._ref_gen)
+        except StopIteration:
+            self._finish()
+            raise
+        try:
+            return ray_tpu.get(ref)
+        except BaseException:
+            self._finish()
+            raise
+
+    def _finish(self):
+        if not self._finished:
+            self._finished = True
+            if self._on_done:
+                self._on_done()
+
+
+_DEPTH_TTL_S = 0.05
+
+
 class DeploymentHandle:
     def __init__(self, deployment_name: str, controller=None,
-                 method_name: str = "__call__"):
+                 method_name: str = "__call__", stream: bool = False):
         self.deployment_name = deployment_name
         self._controller = controller
         self._method = method_name
+        self._stream = stream
         self._replicas: List[Any] = []
         self._version = -1
         self._max_ongoing = 8
-        self._inflight: Dict[int, int] = {}
+        # shared load view: runtime queue depths (TTL-cached) + local
+        # in-flight deltas since the last refresh
+        self._depths: List[int] = []
+        self._depth_ts = 0.0
+        self._delta: Dict[int, int] = {}
         self._rng = random.Random()
 
     # -- controller sync --------------------------------------------------
@@ -86,44 +132,69 @@ class DeploymentHandle:
             self._replicas = info["replicas"]
             self._max_ongoing = info["max_ongoing_requests"]
             self._version = info["version"]
-            self._inflight = {i: 0 for i in range(len(self._replicas))}
+            self._depths = [0] * len(self._replicas)
+            self._depth_ts = 0.0
+            self._delta = {i: 0 for i in range(len(self._replicas))}
 
     # -- routing ----------------------------------------------------------
+
+    def _load_view(self) -> List[float]:
+        now = time.monotonic()
+        if now - self._depth_ts > _DEPTH_TTL_S:
+            from ray_tpu.core.runtime import _get_runtime
+
+            try:
+                ids = [r._actor_id.binary() for r in self._replicas]
+                self._depths = _get_runtime().actor_queue_depths(ids)
+                self._delta = {i: 0 for i in range(len(self._replicas))}
+                self._depth_ts = now
+            except Exception:
+                pass  # stale view beats no view
+        if len(self._depths) != len(self._replicas):
+            # cloned handle whose first refresh failed: all-zero view
+            self._depths = [0] * len(self._replicas)
+        return [self._depths[i] + self._delta.get(i, 0)
+                for i in range(len(self._replicas))]
 
     def _pick_replica(self) -> int:
         n = len(self._replicas)
         if n == 1:
             return 0
+        load = self._load_view()
         i, j = self._rng.sample(range(n), 2)
-        return i if self._inflight.get(i, 0) <= self._inflight.get(j, 0) else j
+        return i if load[i] <= load[j] else j
 
-    def options(self, *, method_name: Optional[str] = None
-                ) -> "DeploymentHandle":
+    def options(self, *, method_name: Optional[str] = None,
+                stream: Optional[bool] = None) -> "DeploymentHandle":
         h = DeploymentHandle(self.deployment_name, self._controller,
-                             method_name or self._method)
+                             method_name or self._method,
+                             self._stream if stream is None else stream)
         h._replicas = self._replicas
         h._version = self._version
         h._max_ongoing = self._max_ongoing
-        h._inflight = self._inflight   # share the load view
         return h
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
+    def remote(self, *args, **kwargs):
         self._refresh()
         idx = self._pick_replica()
         replica = self._replicas[idx]
-        self._inflight[idx] = self._inflight.get(idx, 0) + 1
-        ref = replica.handle_request.remote(self._method, args, kwargs)
+        self._delta[idx] = self._delta.get(idx, 0) + 1
 
         def _done(i=idx):
-            self._inflight[i] = max(0, self._inflight.get(i, 0) - 1)
+            self._delta[i] = self._delta.get(i, 0) - 1
             self._report_metrics()
 
+        if self._stream:
+            ref_gen = replica.handle_request.options(
+                num_returns="streaming").remote(self._method, args, kwargs)
+            return DeploymentResponseGenerator(ref_gen, on_done=_done)
+        ref = replica.handle_request.remote(self._method, args, kwargs)
         return DeploymentResponse(ref, on_done=_done)
 
     def _report_metrics(self):
         try:
             ctrl = self._get_controller()
-            total = float(sum(self._inflight.values()))
+            total = float(sum(self._load_view()))
             ctrl.record_request_metrics.remote(self.deployment_name, total)
         except Exception:
             pass
@@ -134,4 +205,5 @@ class DeploymentHandle:
         return self.options(method_name=name)
 
     def __reduce__(self):
-        return (DeploymentHandle, (self.deployment_name, None, self._method))
+        return (DeploymentHandle,
+                (self.deployment_name, None, self._method, self._stream))

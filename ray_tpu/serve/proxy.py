@@ -49,6 +49,9 @@ class HTTPProxy:
             n = int(headers.get("content-length", 0))
             if n:
                 body = await reader.readexactly(n)
+            if "?stream=1" in path or path.endswith("&stream=1"):
+                await self._route_streaming(method, path, body, writer)
+                return
             status, payload = await self._route(method, path, body)
             data = json.dumps(payload).encode()
             writer.write(
@@ -65,7 +68,7 @@ class HTTPProxy:
                 pass
 
     async def _route(self, method: str, path: str, body: bytes):
-        name = path.strip("/").split("/")[0]
+        name = path.strip("/").split("?")[0].split("/")[0]
         if name == "-" or name == "":
             return "200 OK", {"status": "ok",
                               "routes": sorted(self._handles)}
@@ -86,6 +89,49 @@ class HTTPProxy:
         except Exception as e:  # noqa: BLE001
             return "500 Internal Server Error", {"error": str(e)}
 
+    async def _route_streaming(self, method: str, path: str, body: bytes,
+                               writer: asyncio.StreamWriter):
+        """Chunked transfer: one JSON line per yielded item (reference
+        HTTPProxy streaming responses, proxy.py:748 role)."""
+        name = path.strip("/").split("?")[0].split("/")[0]
+        handle = self._handles.get(name)
+        if handle is None:
+            data = json.dumps({"error": f"no deployment {name!r}"}).encode()
+            writer.write(
+                f"HTTP/1.1 404 Not Found\r\nContent-Length: {len(data)}"
+                f"\r\nConnection: close\r\n\r\n".encode() + data)
+            await writer.drain()
+            return
+        arg: Any = None
+        if body:
+            try:
+                arg = json.loads(body)
+            except json.JSONDecodeError:
+                arg = body.decode()
+        writer.write(
+            b"HTTP/1.1 200 OK\r\nContent-Type: application/x-ndjson\r\n"
+            b"Transfer-Encoding: chunked\r\nConnection: close\r\n\r\n")
+        loop = asyncio.get_event_loop()
+        gen = (handle.options(stream=True).remote(arg) if arg is not None
+               else handle.options(stream=True).remote())
+        it = iter(gen)
+
+        def _next():
+            try:
+                return True, next(it)
+            except StopIteration:
+                return False, None
+
+        while True:
+            more, item = await loop.run_in_executor(None, _next)
+            if not more:
+                break
+            chunk = (json.dumps({"result": item}) + "\n").encode()
+            writer.write(f"{len(chunk):x}\r\n".encode() + chunk + b"\r\n")
+            await writer.drain()
+        writer.write(b"0\r\n\r\n")
+        await writer.drain()
+
     def _run(self):
         self._loop = asyncio.new_event_loop()
         asyncio.set_event_loop(self._loop)
@@ -101,7 +147,9 @@ class HTTPProxy:
 
         try:
             self._loop.run_until_complete(main())
-        except asyncio.CancelledError:
+        except (asyncio.CancelledError, RuntimeError):
+            # RuntimeError("Event loop stopped before Future completed."):
+            # the expected shape of stop() interrupting serve_forever
             pass
 
     def start(self) -> None:

@@ -265,3 +265,43 @@ def test_placement_group(rt):
     remove_placement_group(pg)
     avail = ray_tpu.available_resources()
     assert avail["CPU"] == 4.0
+
+
+def test_runtime_env_py_modules(rt, tmp_path):
+    """py_modules ships a local package through the GCS KV: workers import
+    it without sharing the driver's filesystem layout (reference
+    _private/runtime_env/py_modules.py)."""
+    pkg = tmp_path / "mylib"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("MAGIC = 12345\n")
+    (pkg / "calc.py").write_text("def triple(x):\n    return 3 * x\n")
+
+    @ray_tpu.remote(runtime_env={"py_modules": [str(pkg)]})
+    def use_pkg():
+        import mylib
+        from mylib.calc import triple
+
+        return mylib.MAGIC, triple(7)
+
+    assert ray_tpu.get(use_pkg.remote(), timeout=60) == (12345, 21)
+
+    # the module must NOT leak into tasks without the runtime_env
+    @ray_tpu.remote
+    def no_pkg():
+        try:
+            import mylib  # noqa: F401
+
+            return "leaked"
+        except ImportError:
+            return "clean"
+
+    assert ray_tpu.get(no_pkg.remote(), timeout=60) == "clean"
+
+
+def test_runtime_env_pip_rejected(rt):
+    @ray_tpu.remote(runtime_env={"pip": ["requests"]})
+    def f():
+        return 1
+
+    with pytest.raises(ValueError, match="image is fixed"):
+        f.remote()

@@ -163,3 +163,88 @@ def test_http_proxy(rt_serve):
         assert json.loads(resp.read())["routes"] == ["echo"]
     finally:
         proxy.stop()
+
+
+def test_streaming_deployment_handle(rt_serve):
+    """handle.options(stream=True) yields results as the replica produces
+    them (reference Serve streaming responses)."""
+    import time as _t
+
+    from ray_tpu import serve
+
+    @serve.deployment
+    class Tokens:
+        def __call__(self, n):
+            for i in range(int(n)):
+                yield f"tok-{i}"
+                _t.sleep(0.3)
+
+    handle = serve.run(Tokens.bind(), name="stream_app")
+    # warm: one full request
+    assert list(handle.options(stream=True).remote(2)) == ["tok-0", "tok-1"]
+    t0 = _t.monotonic()
+    gen = handle.options(stream=True).remote(4)
+    first = next(iter(gen))
+    first_latency = _t.monotonic() - t0
+    assert first == "tok-0"
+    assert first_latency < 1.0, f"first token took {first_latency:.1f}s"
+    rest = list(gen)
+    assert rest == ["tok-1", "tok-2", "tok-3"]
+    serve.delete("stream_app")
+
+
+def test_http_proxy_streaming_chunks(rt_serve):
+    import http.client
+    import json as _json
+
+    from ray_tpu import serve
+
+    @serve.deployment
+    class Chunks:
+        def __call__(self, n):
+            for i in range(int(n)):
+                yield {"i": i}
+
+    handle = serve.run(Chunks.bind(), name="chunks_app")
+    proxy = serve.HTTPProxy(port=0)
+    proxy.register("chunks", handle)
+    proxy.start()
+    conn = http.client.HTTPConnection(proxy.host, proxy.port, timeout=30)
+    conn.request("POST", "/chunks?stream=1", body=b"3")
+    resp = conn.getresponse()
+    assert resp.status == 200
+    assert resp.getheader("Content-Type") == "application/x-ndjson"
+    lines = [l for l in resp.read().decode().strip().splitlines() if l]
+    assert [_json.loads(l)["result"]["i"] for l in lines] == [0, 1, 2]
+    conn.close()
+    proxy.stop()
+    serve.delete("chunks_app")
+
+
+def test_router_uses_shared_queue_depths(rt_serve):
+    """Two handles must share the replicas' true queue depths — the r1
+    per-handle view let independent handles pile onto one replica."""
+    import time as _t
+
+    from ray_tpu import serve
+    from ray_tpu.serve.handle import DeploymentHandle
+
+    @serve.deployment(num_replicas=2, max_ongoing_requests=4)
+    class Slow:
+        def __call__(self):
+            _t.sleep(1.0)
+            return "ok"
+
+    serve.run(Slow.bind(), name="depth_app")
+    h1 = serve.get_deployment_handle("Slow")
+    h2 = serve.get_deployment_handle("Slow")
+    assert h1 is not h2
+    # saturate replica views via h1, then h2 must see the load
+    rs = [h1.remote() for _ in range(4)]
+    _t.sleep(0.3)
+    h2._refresh()
+    load = h2._load_view()
+    assert sum(load) >= 2, f"h2 blind to h1's load: {load}"
+    for r in rs:
+        r.result(timeout_s=60)
+    serve.delete("depth_app")
