@@ -1,0 +1,106 @@
+// TSAN stress harness for the arena store (reference role: the C++ core's
+// TSAN CI gate, SURVEY §5 "keep TSAN-clean C++ core as a CI gate").
+//
+// Hammers one arena from several threads: create/seal/get/release/delete
+// race while an eviction thread applies pressure. Run under
+// -fsanitize=thread via `make -C native tsan` — any data race in the
+// store's mutex/refcount/free-list logic trips the sanitizer.
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+// Prototypes MUST match store.cc exactly (mismatched function types are
+// UB that can miscompile under LTO/CFI — defeating a sanitizer gate).
+struct Store;
+extern "C" {
+Store *rtpu_store_open(const char *name, uint64_t capacity);
+void rtpu_store_close(Store *store);
+uint64_t rtpu_create(Store *store, const uint8_t *id, uint64_t size);
+int rtpu_seal(Store *store, const uint8_t *id);
+uint64_t rtpu_get(Store *store, const uint8_t *id, uint64_t *size);
+int rtpu_contains(Store *store, const uint8_t *id);
+int rtpu_release(Store *store, const uint8_t *id);
+int rtpu_delete(Store *store, const uint8_t *id);
+uint64_t rtpu_evict(Store *store, uint64_t nbytes);
+void rtpu_stats(Store *store, uint64_t *cap, uint64_t *used, uint64_t *num);
+uint8_t *rtpu_base(Store *store);
+void rtpu_store_destroy(const char *name);
+}
+
+static const int kThreads = 4;
+static const int kIters = 800;
+static const uint64_t kObjSize = 64 * 1024;
+
+static void make_id(uint8_t *out, int thread, int i) {
+  // 20-byte id field; zero-pad
+  std::memset(out, 0, 20);
+  std::snprintf(reinterpret_cast<char *>(out), 20, "t%02d-%06d", thread, i);
+}
+
+int main() {
+  const char *name = "/rtpu-arena-tsan-stress";
+  rtpu_store_destroy(name);
+  Store *store = rtpu_store_open(name, 64ull << 20);  // small: forces churn
+  if (!store) {
+    std::fprintf(stderr, "open failed\n");
+    return 1;
+  }
+  uint8_t *base = rtpu_base(store);
+  std::atomic<bool> stop{false};
+  std::atomic<uint64_t> created{0}, read_ok{0};
+
+  std::vector<std::thread> workers;
+  for (int t = 0; t < kThreads; ++t) {
+    workers.emplace_back([&, t] {
+      uint8_t id[20];
+      for (int i = 0; i < kIters; ++i) {
+        make_id(id, t, i);
+        uint64_t off = rtpu_create(store, id, kObjSize);
+        if (off != 0) {
+          std::memset(base + off, t + 1, kObjSize);
+          rtpu_seal(store, id);
+          rtpu_release(store, id);  // drop the create ref: evictable
+          created.fetch_add(1);
+        }
+        // read a neighbor thread's recent object
+        make_id(id, (t + 1) % kThreads, i > 10 ? i - 10 : 0);
+        uint64_t size = 0;
+        uint64_t roff = rtpu_get(store, id, &size);
+        if (roff != 0) {
+          volatile uint8_t sink = base[roff];  // touch shared bytes
+          (void)sink;
+          rtpu_release(store, id);
+          read_ok.fetch_add(1);
+        }
+        // churn: delete our own older object
+        if (i > 20) {
+          make_id(id, t, i - 20);
+          rtpu_delete(store, id);
+        }
+      }
+    });
+  }
+  std::thread evictor([&] {
+    while (!stop.load()) {
+      rtpu_evict(store, 4ull << 20);
+      std::this_thread::yield();
+    }
+  });
+  for (auto &w : workers) w.join();
+  stop.store(true);
+  evictor.join();
+
+  uint64_t cap = 0, used = 0, num = 0;
+  rtpu_stats(store, &cap, &used, &num);
+  std::printf("tsan-stress ok: created=%llu read=%llu live=%llu used=%llu\n",
+              (unsigned long long)created.load(),
+              (unsigned long long)read_ok.load(),
+              (unsigned long long)num, (unsigned long long)used);
+  rtpu_store_close(store);
+  rtpu_store_destroy(name);
+  return 0;
+}

@@ -250,6 +250,17 @@ class Dataset:
 
         return block_to_pandas(concat_blocks(list(self.iter_blocks())))
 
+    def to_arrow(self):
+        """One pyarrow Table over all blocks (reference
+        ``Dataset.to_arrow_refs`` role, materialized). Tensor columns
+        (ndim > 1) become arrow list columns, matching write_parquet."""
+        import pyarrow as pa
+
+        block = concat_blocks(list(self.iter_blocks()))
+        return pa.table({k: pa.array(list(v) if getattr(v, "ndim", 1) > 1
+                                     else v)
+                         for k, v in block.items()})
+
     def sum(self, col: str) -> float:
         return float(sum(b[col].sum() for b in self.iter_blocks() if col in b))
 

@@ -323,14 +323,22 @@ def _mha_fwd_rule(q, k, v, causal, scale, q_block, kv_block, use_pallas):
 def _mha_bwd_rule(causal, scale, q_block, kv_block, use_pallas, res, dout):
     q, k, v, out, lse = res
     b, lk, hk, d = k.shape
-    h = q.shape[2]
-    # the backward is blockwise XLA regardless of the forward impl — O(L)
-    # residuals either way; a Pallas backward kernel can slot in here later.
+    lq, h = q.shape[1], q.shape[2]
     # GQA: expand kv transiently, then group-sum the grads back (matches
-    # jnp.repeat's [k0,k0,...,k1,k1,...] layout).
-    dq, dk, dv = _mha_bwd_blockwise(causal, scale, q_block, kv_block,
-                                    q, _repeat_kv(k, h), _repeat_kv(v, h),
-                                    out, lse, dout)
+    # jnp.repeat's [k0,k0,...,k1,k1,...] layout). Backward impl follows
+    # the forward: hand-tiled Pallas kernels (FA2 dKV/dQ sweeps) on TPU,
+    # blockwise XLA elsewhere — O(L) residuals either way.
+    kx, vx = _repeat_kv(k, h), _repeat_kv(v, h)
+    if (use_pallas and lq % min(q_block, lq) == 0
+            and lk % min(kv_block, lk) == 0):
+        from ray_tpu.ops.flash_pallas import flash_attention_pallas_bwd
+
+        dq, dk, dv = flash_attention_pallas_bwd(
+            q, kx, vx, out, lse, dout, causal=causal, scale=scale,
+            block_q=q_block, block_k=kv_block)
+    else:
+        dq, dk, dv = _mha_bwd_blockwise(causal, scale, q_block, kv_block,
+                                        q, kx, vx, out, lse, dout)
     if hk != h:
         group = h // hk
         dk = dk.reshape(b, lk, hk, group, d).sum(axis=3)

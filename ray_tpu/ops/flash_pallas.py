@@ -177,3 +177,210 @@ def flash_attention_pallas(
         q, k, v, causal=causal, scale=scale,
         block_q=block_q, block_k=block_k, interpret=interpret)
     return out
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels (FlashAttention-2 split: dKV sweep + dQ sweep)
+# ---------------------------------------------------------------------------
+#
+# Residuals are (q, k, v, out, lse) — O(L). The backward recomputes p
+# blockwise:  D = rowsum(dO * O);  p = exp(s - lse);  dp = dO V^T;
+# ds = p * (dp - D);  dV += p^T dO;  dK += scale * ds^T Q;
+# dQ += scale * ds K.  Two kernels so each output has one sequential axis:
+# the dKV kernel owns a kv block and sweeps q blocks; the dQ kernel owns a
+# q block and sweeps kv blocks.
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, dk_acc, dv_acc,
+                          *, causal: bool, scale: float,
+                          block_q: int, block_k: int):
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    live = True
+    if causal:
+        # q block contributes iff its last row can see this kv block
+        live = (qi + 1) * block_q - 1 >= ki * block_k
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)           # [bq, d]
+        k = k_ref[0, 0].astype(jnp.float32)           # [bk, d]
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)         # [bq, d]
+        lse = lse_ref[0, 0][:, 0:1]                   # [bq, 1]
+        delta = delta_ref[0, 0][:, 0:1]               # [bq, 1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)                          # [bq, bk]
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # p^T dO: [bk, d]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)                         # [bq, bk]
+        dk_acc[:] += scale * jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # ds^T q: [bk, d]
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, dq_acc,
+                         *, causal: bool, scale: float,
+                         block_q: int, block_k: int):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    live = True
+    if causal:
+        live = ki * block_k <= (qi + 1) * block_q - 1
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, 0:1]
+        delta = delta_ref[0, 0][:, 0:1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dq_acc[:] += scale * jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # ds k: [bq, d]
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "scale", "block_q", "block_k", "interpret"),
+)
+def flash_attention_pallas_bwd(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    out: jax.Array,
+    lse: jax.Array,
+    dout: jax.Array,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: bool = False,
+):
+    """Backward pass. All tensors [B, L, H, D] (kv heads already expanded);
+    ``lse`` [B, H, L]. Returns (dq, dk, dv) in the inputs' dtypes."""
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    scale = scale if scale is not None else d ** -0.5
+    block_q = min(block_q, lq)
+    block_k = min(block_k, lk)
+    nq, nk = lq // block_q, lk // block_k
+
+    qt = q.transpose(0, 2, 1, 3)      # [B, H, L, D]
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    dot = dout.transpose(0, 2, 1, 3)
+    outt = out.transpose(0, 2, 1, 3)
+    # D = rowsum(dO * O), lane-broadcast like lse for tileable blocks
+    delta = (dot.astype(jnp.float32) * outt.astype(jnp.float32)).sum(-1)
+    lse_b = jnp.broadcast_to(lse[..., None], (*lse.shape, LANES))
+    delta_b = jnp.broadcast_to(delta[..., None], (*delta.shape, LANES))
+
+    dkv_kernel = functools.partial(
+        _flash_bwd_dkv_kernel, causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k)
+    dk_t, dv_t = pl.pallas_call(
+        dkv_kernel,
+        grid=(b, h, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, ki, qi: (b_, h_, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, ki, qi: (b_, h_, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, ki, qi: (b_, h_, ki, 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, ki, qi: (b_, h_, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, LANES), lambda b_, h_, ki, qi: (b_, h_, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, LANES), lambda b_, h_, ki, qi: (b_, h_, qi, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, ki, qi: (b_, h_, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, ki, qi: (b_, h_, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, lk, d), k.dtype),
+            jax.ShapeDtypeStruct((b, h, lk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse_b, delta_b)
+
+    dq_kernel = functools.partial(
+        _flash_bwd_dq_kernel, causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k)
+    dq_t = pl.pallas_call(
+        dq_kernel,
+        grid=(b, h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, qi, ki: (b_, h_, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, qi, ki: (b_, h_, ki, 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, LANES), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, LANES), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, lq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse_b, delta_b)
+
+    return (dq_t.transpose(0, 2, 1, 3), dk_t.transpose(0, 2, 1, 3),
+            dv_t.transpose(0, 2, 1, 3))

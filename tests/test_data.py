@@ -167,3 +167,23 @@ def test_iteration_overlaps_producer(rt_data):
     # before the end (generous ratio: 2-vCPU box, CLAUDE.md margins rule)
     assert first_latency < wall * 0.75, (
         f"first batch at {first_latency:.1f}s of {wall:.1f}s total")
+
+
+def test_arrow_roundtrip(rt_data):
+    import pyarrow as pa
+
+    table = pa.table({"x": [1, 2, 3, 4], "y": [0.5, 1.5, 2.5, 3.5]})
+    ds = rdata.from_arrow(table)
+    out = ds.map_batches(lambda b: {"x": b["x"] * 2, "y": b["y"]}).to_arrow()
+    assert out.column("x").to_pylist() == [2, 4, 6, 8]
+    assert out.column("y").to_pylist() == [0.5, 1.5, 2.5, 3.5]
+
+
+def test_arrow_tensor_columns(rt_data):
+    import pyarrow as pa
+
+    ds = rdata.from_items([{"vec": np.arange(3, dtype=np.float32) + i}
+                           for i in range(4)])
+    table = ds.to_arrow()
+    assert isinstance(table, pa.Table)
+    assert table.column("vec").to_pylist()[0] == [0.0, 1.0, 2.0]
