@@ -47,7 +47,9 @@ class ClusterAdapter:
         self.listen_host = listen_host
         self.rt = None  # DriverRuntime, set by attach()
         self.node_id: bytes = b""
-        self.gcs = RpcClient(gcs_addr, authkey, on_push=self._on_push)
+        self.gcs = RpcClient(gcs_addr, authkey, on_push=self._on_push,
+                             reconnect=True,
+                             on_reconnect=self._on_gcs_reconnect)
         self._peers: Dict[bytes, RpcClient] = {}
         self._peer_addrs: Dict[bytes, str] = {}
         self._peers_lock = threading.Lock()
@@ -63,6 +65,7 @@ class ClusterAdapter:
         self._remote_actors: Dict[bytes, bytes] = {}  # actor_id -> node_id
         self._node_view: List[dict] = []
         self._node_view_ts = 0.0
+        self._spread_rr = 0
         self._stop = threading.Event()
         self.server: Optional[RpcServer] = None
         # All watch/deliver/fetch work runs here, NEVER on the RpcClient
@@ -85,10 +88,7 @@ class ClusterAdapter:
         rt.gcs.on_object_error = self._publish_error
         self.server = RpcServer(self.listen_host, 0, self.authkey,
                                 self._serve_peer)
-        self.gcs.call("subscribe", "nodes")
-        self.gcs.call("subscribe", "objects")
-        self.gcs.call("node_register", self.node_id, self.server.addr,
-                      rt.resources("total"), self.is_scheduler)
+        self._register()
         threading.Thread(target=self._heartbeat_loop, daemon=True,
                          name="cluster-heartbeat").start()
 
@@ -113,9 +113,28 @@ class ClusterAdapter:
                 with self.rt.lock:
                     avail = dict(self.rt.avail)
                     depth = len(self.rt.ready_tasks)
-                self.gcs.cast("node_heartbeat", self.node_id, avail, depth)
+                known = self.gcs.call("node_heartbeat", self.node_id, avail,
+                                      depth, timeout=5)
+                if known is False:
+                    # a restarted GCS lost the (non-durable) node table:
+                    # re-register + re-subscribe (GCS FT path)
+                    self._register()
             except Exception:
                 pass
+
+    def _register(self):
+        self.gcs.call("subscribe", "nodes", timeout=10)
+        self.gcs.call("subscribe", "objects", timeout=10)
+        self.gcs.call("node_register", self.node_id, self.server.addr,
+                      self.rt.resources("total"), self.is_scheduler,
+                      timeout=10)
+        self._node_view_ts = 0.0
+
+    def _on_gcs_reconnect(self):
+        try:
+            self._register()
+        except Exception:
+            pass
 
     # ------------------------------------------------------------------
     # peer RPC service (what other nodes may ask of this one)
@@ -291,13 +310,23 @@ class ClusterAdapter:
     def maybe_forward_task(self, spec: dict) -> bool:
         """Decide placement for a task/actor-create spec. Returns True when
         the spec was forwarded to a peer node (caller only tracks refs).
-        Placement is resource-feasibility only; dependency locality is
-        future work (the reference's hybrid policy weighs both)."""
+        Placement is resource-feasibility first-fit with spillback;
+        NodeAffinity / SPREAD strategies are honored (reference
+        scheduling_strategies.py); dependency locality is future work
+        (the reference's hybrid policy weighs both)."""
         if not self.is_scheduler:
             return False  # daemons execute what they're given
         if spec.get("pg") is not None:
             return False  # placement groups are node-local (for now)
         res = spec.get("resources") or {}
+        strat = spec.get("strategy")
+        if strat is not None and strat[0] == "node_affinity":
+            out = self._place_node_affinity(spec, strat[1], strat[2])
+            if out is not None:
+                return out
+            # soft affinity to a dead node: fall through to normal placement
+        elif strat is not None and strat[0] == "spread":
+            return self._place_spread(spec, res)
         with self.rt.lock:
             local_total_ok = all(
                 self.rt.total.get(k, 0.0) >= v for k, v in res.items())
@@ -324,6 +353,40 @@ class ClusterAdapter:
         for k, v in res.items():
             target["avail"][k] = target["avail"].get(k, 0.0) - v
         return self._forward(target["node_id"], spec)
+
+    def _place_node_affinity(self, spec: dict, node_id: bytes, soft: bool):
+        """Pin to a node (reference NodeAffinitySchedulingStrategy). Hard
+        affinity to a dead/unknown node fails the task; soft falls back to
+        normal placement (``None`` = caller continues the normal path)."""
+        if node_id == self.node_id:
+            return False  # pinned here: run locally
+        target = next((n for n in self._nodes()
+                       if n["node_id"] == node_id and n["alive"]), None)
+        if target is None:
+            if soft:
+                return None  # soft: let normal placement handle it
+            self._fail_returns(spec, WorkerCrashedError(
+                f"node affinity target {node_id.hex()[:8]} is not alive"))
+            return True
+        return self._forward(node_id, spec)
+
+    def _place_spread(self, spec: dict, res: Dict[str, float]) -> bool:
+        """Round-robin over feasible nodes including this one (reference
+        SPREAD strategy)."""
+        feasible = [n for n in self._nodes() if n["alive"] and all(
+            n["resources"].get(k, 0.0) >= v for k, v in res.items())]
+        with self.rt.lock:
+            local_ok = all(self.rt.total.get(k, 0.0) >= v
+                           for k, v in res.items())
+        slots = ([{"node_id": self.node_id}] if local_ok else []) + [
+            n for n in feasible if n["node_id"] != self.node_id]
+        if not slots:
+            return False
+        pick = slots[self._spread_rr % len(slots)]
+        self._spread_rr += 1
+        if pick["node_id"] == self.node_id:
+            return False
+        return self._forward(pick["node_id"], spec)
 
     def _forward(self, node_id: bytes, spec: dict) -> bool:
         peer = self._peer(node_id)
@@ -425,13 +488,26 @@ class ClusterAdapter:
             return None
 
     def publish_fn(self, h: str, blob: bytes):
-        self.gcs.cast("fn_put", h, blob)
-
-    def fetch_fn(self, h: str) -> Optional[bytes]:
+        # synchronous: the blob must be globally visible BEFORE any spec
+        # referencing it can be forwarded (an async cast races the forward
+        # and a remote worker's fn_get can observe not-found)
         try:
-            return self.gcs.call("fn_get", h, timeout=30)
+            self.gcs.call("fn_put", h, blob, timeout=30)
         except Exception:
-            return None
+            self.gcs.cast("fn_put", h, blob)  # best effort under outage
+
+    def fetch_fn(self, h: str, timeout_s: float = 15.0) -> Optional[bytes]:
+        """Poll: the publishing driver may still be mid-flight (blobs are
+        immutable, so waiting is safe)."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            try:
+                blob = self.gcs.call("fn_get", h, timeout=10)
+            except Exception:
+                blob = None
+            if blob is not None or time.monotonic() >= deadline:
+                return blob
+            time.sleep(0.1)
 
     def kv_op(self, op: str, *args):
         """Cluster KV is globally consistent: always through the GCS.

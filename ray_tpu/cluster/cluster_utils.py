@@ -27,22 +27,47 @@ from ray_tpu.cluster.rpc import RpcClient, free_port
 
 
 class Cluster:
-    def __init__(self, node_timeout_s: float = 3.0):
+    def __init__(self, node_timeout_s: float = 3.0,
+                 gcs_snapshot: Optional[str] = None):
         self.authkey = uuid.uuid4().hex[:16]
-        port = free_port()
-        self.address = f"127.0.0.1:{port}"
+        self._port = free_port()
+        self.address = f"127.0.0.1:{self._port}"
+        self._node_timeout_s = node_timeout_s
+        self._gcs_snapshot = gcs_snapshot
         self._procs: List[subprocess.Popen] = []
         self._node_procs: Dict[int, subprocess.Popen] = {}
         self._next_node = 0
-        env = self._env()
-        self._gcs_proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.cluster.gcs_server",
-             "--port", str(port), "--authkey", self.authkey,
-             "--node-timeout", str(node_timeout_s)],
-            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
-        )
-        self._procs.append(self._gcs_proc)
+        self._gcs_proc = self._spawn_gcs()
         self._wait_for_gcs()
+        self._client = RpcClient(self.address, self.authkey.encode())
+
+    def _spawn_gcs(self) -> subprocess.Popen:
+        cmd = [sys.executable, "-m", "ray_tpu.cluster.gcs_server",
+               "--port", str(self._port), "--authkey", self.authkey,
+               "--node-timeout", str(self._node_timeout_s)]
+        if self._gcs_snapshot:
+            cmd += ["--snapshot", self._gcs_snapshot]
+        proc = subprocess.Popen(cmd, env=self._env(),
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.STDOUT)
+        self._procs.append(proc)
+        return proc
+
+    def restart_gcs(self):
+        """Kill + restart the GCS process on the same port (GCS FT test
+        path; with a snapshot configured, durable tables survive and
+        daemons re-register via heartbeat NACK)."""
+        self._gcs_proc.kill()
+        self._gcs_proc.wait()
+        import time as _t
+
+        _t.sleep(0.2)  # let the port free
+        self._gcs_proc = self._spawn_gcs()
+        self._wait_for_gcs()
+        try:
+            self._client.close()
+        except Exception:
+            pass
         self._client = RpcClient(self.address, self.authkey.encode())
 
     def _env(self):

@@ -55,7 +55,8 @@ class _NodeEntry:
 
 
 class GcsService:
-    def __init__(self, node_timeout_s: float = DEFAULT_NODE_TIMEOUT_S):
+    def __init__(self, node_timeout_s: float = DEFAULT_NODE_TIMEOUT_S,
+                 snapshot_path: Optional[str] = None):
         import os
 
         self.lock = threading.RLock()
@@ -71,6 +72,53 @@ class GcsService:
         self.node_timeout_s = node_timeout_s
         self.server: Optional[RpcServer] = None
         self._stop = threading.Event()
+        # Fault tolerance (reference: GCS tables over a Redis StoreClient,
+        # gcs/store_client/redis_store_client.h): durable tables persist to
+        # a snapshot file; a restarted GCS reloads them, nodes re-register
+        # via heartbeat NACK, and the directory repopulates as owners
+        # publish. objects/nodes are runtime state and deliberately NOT
+        # snapshotted.
+        self.snapshot_path = snapshot_path
+        self._dirty = False
+        if snapshot_path:
+            self._load_snapshot()
+            threading.Thread(target=self._snapshot_loop, daemon=True,
+                             name="gcs-snapshot").start()
+
+    def _load_snapshot(self):
+        import pickle
+
+        try:
+            with open(self.snapshot_path, "rb") as f:
+                snap = pickle.load(f)
+        except (FileNotFoundError, EOFError, pickle.PickleError):
+            return
+        self.kv = snap.get("kv", {})
+        self.functions = snap.get("functions", {})
+        self.actors = snap.get("actors", {})
+        self.named_actors = snap.get("named_actors", {})
+
+    def _snapshot_loop(self):
+        import os
+        import pickle
+
+        while not self._stop.wait(1.0):
+            with self.lock:
+                if not self._dirty:
+                    continue
+                snap = {"kv": {ns: dict(d) for ns, d in self.kv.items()},
+                        "functions": dict(self.functions),
+                        "actors": {a: dict(r)
+                                   for a, r in self.actors.items()},
+                        "named_actors": dict(self.named_actors)}
+                self._dirty = False
+            tmp = f"{self.snapshot_path}.tmp-{os.getpid()}"
+            try:
+                with open(tmp, "wb") as f:
+                    pickle.dump(snap, f)
+                os.rename(tmp, self.snapshot_path)
+            except OSError:
+                pass
 
     # ------------------------------------------------------------------
     # RPC dispatch
@@ -249,6 +297,7 @@ class GcsService:
             if not overwrite and key in ns:
                 return False
             ns[key] = value
+            self._dirty = True
             return True
 
     def rpc_kv_get(self, ctx, key: str, namespace: str):
@@ -257,6 +306,7 @@ class GcsService:
 
     def rpc_kv_del(self, ctx, key: str, namespace: str):
         with self.lock:
+            self._dirty = True
             return self.kv.get(namespace, {}).pop(key, None) is not None
 
     def rpc_kv_keys(self, ctx, prefix: str, namespace: str):
@@ -267,6 +317,7 @@ class GcsService:
     def rpc_fn_put(self, ctx, h: str, blob: bytes):
         with self.lock:
             self.functions.setdefault(h, blob)
+            self._dirty = True
         return True
 
     def rpc_fn_get(self, ctx, h: str):
@@ -286,6 +337,7 @@ class GcsService:
                                      "state": "PENDING"}
             if name:
                 self.named_actors[name] = actor_id
+            self._dirty = True
         return True
 
     def rpc_actor_update(self, ctx, actor_id: bytes, state: str,
@@ -300,6 +352,7 @@ class GcsService:
             if state == "DEAD" and rec.get("name"):
                 if self.named_actors.get(rec["name"]) == actor_id:
                     self.named_actors.pop(rec["name"], None)
+            self._dirty = True
         return True
 
     def rpc_actor_get(self, ctx, actor_id: bytes):
@@ -353,9 +406,12 @@ def main(argv=None):
     p.add_argument("--authkey", required=True)
     p.add_argument("--node-timeout", type=float,
                    default=DEFAULT_NODE_TIMEOUT_S)
+    p.add_argument("--snapshot", default=None,
+                   help="snapshot file for durable-table fault tolerance")
     args = p.parse_args(argv)
 
-    svc = GcsService(node_timeout_s=args.node_timeout)
+    svc = GcsService(node_timeout_s=args.node_timeout,
+                     snapshot_path=args.snapshot)
     svc.serve(args.host, args.port, args.authkey.encode())
     print(f"gcs listening on {args.host}:{args.port}", flush=True)
     try:

@@ -21,6 +21,7 @@ from __future__ import annotations
 import itertools
 import socket
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from multiprocessing.connection import Client as _MpClient
 from multiprocessing.connection import Listener as _MpListener
@@ -160,13 +161,23 @@ class ServerConn:
 
 
 class RpcClient:
-    """Client with one reader thread demuxing replies and pushes."""
+    """Client with one reader thread demuxing replies and pushes.
+
+    ``reconnect=True`` keeps retrying the server after a drop (in-flight
+    calls still fail — callers own retries) and fires ``on_reconnect`` so
+    owners can re-subscribe/re-register; this is what lets node daemons
+    survive a GCS restart (reference GCS fault tolerance role).
+    """
 
     def __init__(self, addr: str, authkey: bytes,
                  on_push: Optional[Callable[[str, Any], None]] = None,
-                 on_disconnect: Optional[Callable[[], None]] = None):
+                 on_disconnect: Optional[Callable[[], None]] = None,
+                 reconnect: bool = False,
+                 on_reconnect: Optional[Callable[[], None]] = None):
         host, port = parse_addr(addr)
         self.addr = addr
+        self._hostport = (host, port)
+        self._authkey = authkey
         self._conn = _MpClient((host, port), family="AF_INET",
                                authkey=authkey)
         self._send_lock = threading.Lock()
@@ -175,18 +186,52 @@ class RpcClient:
         self._ids = itertools.count(1)
         self._on_push = on_push
         self._on_disconnect = on_disconnect
+        self._reconnect = reconnect
+        self._on_reconnect = on_reconnect
         self._closed = False
         threading.Thread(target=self._reader_loop, daemon=True,
                          name="rpc-client-reader").start()
 
     def _reader_loop(self):
+        while not self._closed:
+            self._read_until_drop()
+            with self._pending_lock:
+                pending = list(self._pending.values())
+                self._pending.clear()
+            for ev, box in pending:
+                box[:] = [False,
+                          ConnectionError(f"rpc connection to {self.addr} lost")]
+                ev.set()
+            if self._closed or not self._reconnect:
+                break
+            if not self._try_reconnect():
+                break
+            if self._on_reconnect is not None:
+                # NEVER run the callback on this thread: replies to any RPC
+                # it issues are demuxed HERE, so a synchronous callback
+                # would deadlock its own calls into timeouts
+                def _cb():
+                    try:
+                        self._on_reconnect()
+                    except Exception:
+                        pass
+
+                threading.Thread(target=_cb, daemon=True,
+                                 name="rpc-reconnect-cb").start()
+        if not self._closed and self._on_disconnect is not None:
+            try:
+                self._on_disconnect()
+            except Exception:
+                pass
+
+    def _read_until_drop(self):
         while True:
             try:
                 msg = self._conn.recv()
             except (EOFError, OSError, TypeError, ValueError):
                 # TypeError/ValueError: multiprocessing internals raise
                 # these when the fd is closed from under a blocked recv
-                break
+                return
             if msg[0] == "rep":
                 _, req_id, ok, payload = msg
                 with self._pending_lock:
@@ -199,17 +244,35 @@ class RpcClient:
                     self._on_push(msg[1], msg[2])
                 except Exception:
                     pass
-        with self._pending_lock:
-            pending = list(self._pending.values())
-            self._pending.clear()
-        for ev, box in pending:
-            box[:] = [False, ConnectionError(f"rpc connection to {self.addr} lost")]
-            ev.set()
-        if not self._closed and self._on_disconnect is not None:
+
+    def _try_reconnect(self, max_wait_s: float = 120.0) -> bool:
+        deadline = time.monotonic() + max_wait_s
+        delay = 0.2
+        while not self._closed and time.monotonic() < deadline:
             try:
-                self._on_disconnect()
+                conn = _MpClient(self._hostport, family="AF_INET",
+                                 authkey=self._authkey)
+                with self._send_lock:
+                    # calls that raced the outage and sent into the dying
+                    # socket would otherwise wait out their full timeout
+                    # (or forever): fail them now so callers retry
+                    with self._pending_lock:
+                        stale = list(self._pending.values())
+                        self._pending.clear()
+                    for ev, box in stale:
+                        box[:] = [False, ConnectionError(
+                            f"rpc connection to {self.addr} was replaced")]
+                        ev.set()
+                    old, self._conn = self._conn, conn
+                try:
+                    old.close()  # don't leak one fd per outage
+                except Exception:
+                    pass
+                return True
             except Exception:
-                pass
+                time.sleep(delay)
+                delay = min(delay * 1.6, 3.0)
+        return False
 
     def call(self, method: str, *args, timeout: Optional[float] = None) -> Any:
         req_id = next(self._ids)

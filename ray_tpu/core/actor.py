@@ -144,6 +144,11 @@ class ActorClass:
             bundle_index=bundle_index,
             runtime_env=self._options.get("runtime_env"),
         )
+        from ray_tpu.core.remote_function import _strategy_spec
+
+        strat = _strategy_spec(self._options)
+        if strat is not None:
+            spec["strategy"] = strat
         rt.create_actor(spec)
         return ActorHandle(ActorID(spec["actor_id"]), self._method_options)
 

@@ -40,6 +40,24 @@ def _pg_options(opts: Dict[str, Any]):
     return pg, bundle_index
 
 
+def _strategy_spec(opts: Dict[str, Any]):
+    """Encode a scheduling strategy into the task spec (cluster placement
+    honors it; single-node ignores it). Reference strategies:
+    "SPREAD"/"DEFAULT" strings and NodeAffinitySchedulingStrategy."""
+    strategy = opts.get("scheduling_strategy")
+    if strategy is None or hasattr(strategy, "placement_group"):
+        return None
+    if isinstance(strategy, str):
+        return ("spread",) if strategy.upper() == "SPREAD" else None
+    if hasattr(strategy, "node_id"):
+        node_id = strategy.node_id
+        if isinstance(node_id, str):
+            node_id = bytes.fromhex(node_id)
+        return ("node_affinity", node_id, bool(getattr(strategy, "soft",
+                                                       False)))
+    return None
+
+
 class RemoteFunction:
     def __init__(self, fn, options: Dict[str, Any]):
         self._function = fn
@@ -100,6 +118,9 @@ class RemoteFunction:
             bundle_index=bundle_index,
             runtime_env=self._options.get("runtime_env"),
         )
+        strat = _strategy_spec(self._options)
+        if strat is not None:
+            spec["strategy"] = strat
         if streaming:
             # the declared return becomes the end sentinel; yields surface
             # as they are produced (reference ObjectRefGenerator,
