@@ -74,6 +74,10 @@ class ClusterAdapter:
         # thread (which must keep demuxing results).
         self._io = ThreadPoolExecutor(max_workers=8,
                                       thread_name_prefix="cluster-io")
+        # fn publishes get their own lane: queued behind saturated fetch
+        # work they could exceed the consumer's fetch_fn poll window
+        self._publish_io = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="cluster-publish")
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -106,6 +110,8 @@ class ClusterAdapter:
         for p in peers:
             p.close()
         self.gcs.close()
+        self._io.shutdown(wait=False)
+        self._publish_io.shutdown(wait=False)
 
     def _heartbeat_loop(self):
         while not self._stop.wait(HEARTBEAT_S):
@@ -315,7 +321,17 @@ class ClusterAdapter:
         scheduling_strategies.py); dependency locality is future work
         (the reference's hybrid policy weighs both)."""
         if not self.is_scheduler:
-            return False  # daemons execute what they're given
+            # daemons execute what they're given — EXCEPT nested
+            # submissions this node can never satisfy, which would queue
+            # forever; those spill to a feasible peer (reference raylet
+            # spillback, hybrid_scheduling_policy.h:50 role). Node
+            # affinity binds nested submissions too.
+            strat = spec.get("strategy")
+            if strat is not None and strat[0] == "node_affinity":
+                out = self._place_node_affinity(spec, strat[1], strat[2])
+                if out is not None:
+                    return out
+            return self._spill_if_infeasible(spec)
         if spec.get("pg") is not None:
             return False  # placement groups are node-local (for now)
         res = spec.get("resources") or {}
@@ -350,6 +366,32 @@ class ClusterAdapter:
         target = (with_avail or candidates)[0]
         # decrement the cached view so a burst of submissions spreads across
         # peers instead of piling onto one node until the next heartbeat
+        for k, v in res.items():
+            target["avail"][k] = target["avail"].get(k, 0.0) - v
+        return self._forward(target["node_id"], spec)
+
+    def _spill_if_infeasible(self, spec: dict) -> bool:
+        if spec.get("pg") is not None:
+            return False
+        res = spec.get("resources") or {}
+        with self.rt.lock:
+            if all(self.rt.total.get(k, 0.0) >= v for k, v in res.items()):
+                return False  # feasible here: run/queue locally
+        candidates = [
+            n for n in self._nodes()
+            if n["alive"] and n["node_id"] != self.node_id
+            and all(n["resources"].get(k, 0.0) >= v for k, v in res.items())
+        ]
+        with_avail = [
+            n for n in candidates
+            if all(n["avail"].get(k, 0.0) >= v for k, v in res.items())
+        ]
+        picks = (with_avail or candidates)
+        if not picks:
+            return False  # nowhere feasible: queue locally (matches head)
+        target = picks[0]
+        # decrement the cached view so a burst of nested submissions
+        # spreads across peers (same hygiene as the scheduler path)
         for k, v in res.items():
             target["avail"][k] = target["avail"].get(k, 0.0) - v
         return self._forward(target["node_id"], spec)
@@ -495,6 +537,12 @@ class ClusterAdapter:
             self.gcs.call("fn_put", h, blob, timeout=30)
         except Exception:
             self.gcs.cast("fn_put", h, blob)  # best effort under outage
+
+    def publish_fn_async(self, h: str, blob: bytes):
+        """For worker-pipe receiver threads (must not block): a dedicated
+        single-thread lane bounds the publish delay under io-pool
+        saturation; remote consumers' fetch_fn poll covers the gap."""
+        self._publish_io.submit(self.publish_fn, h, blob)
 
     def fetch_fn(self, h: str, timeout_s: float = 15.0) -> Optional[bytes]:
         """Poll: the publishing driver may still be mid-flight (blobs are

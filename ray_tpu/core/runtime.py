@@ -524,6 +524,11 @@ class DriverRuntime:
             self._submit_actor_spec(args[0])
         elif op == "fn_put":
             self.gcs.register_fn(args[0], args[1])
+            if self.cluster is not None:
+                # publish to the global table too (worker-submitted specs
+                # may spill to peers); async — this receiver thread must
+                # keep demuxing, and consumers poll fetch_fn meanwhile
+                self.cluster.publish_fn_async(args[0], args[1])
         elif op == "blocked":
             with self.lock:
                 if not ws.released and ws.current is not None:

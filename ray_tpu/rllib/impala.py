@@ -59,6 +59,55 @@ class ImpalaLearner(JaxLearner):
                       "entropy": ent}
 
 
+class _Aggregator:
+    """Aggregation-tree worker (reference ``impala.py:676-696``): pulls
+    sample batches, runs the v-trace postprocess with current weights, and
+    hands the learner ONE train-ready batch — ingest compute scales with
+    aggregators instead of piling on the driver/learner."""
+
+    def __init__(self, module_spec: Dict[str, Any], cfg: Dict[str, Any]):
+        from ray_tpu.rllib.rl_module import RLModuleSpec
+
+        self._module = RLModuleSpec(**{k: v for k, v in module_spec.items()
+                                       if k != "kind"}).build()
+        self._cfg = cfg
+
+    def aggregate(self, weights, *batches):
+        outs = [
+            _vtrace_postprocess(self._module, weights, b, self._cfg)
+            for b in batches
+        ]
+        return {k: np.concatenate([o[k] for o in outs]) for k in outs[0]}
+
+
+def _vtrace_postprocess(module, weights, b, cfg: Dict[str, Any]):
+    t_len, n = b["rewards"].shape
+    flat_obs = b["obs"].reshape(t_len * n, -1)
+    out = module.forward_train(weights, flat_obs)
+    target_logp, _ = module.logp_entropy(
+        out, b["actions"].reshape(t_len * n, *b["actions"].shape[2:]))
+    target_logp = np.asarray(target_logp).reshape(t_len, n)
+    values = np.asarray(out["vf_preds"]).reshape(t_len, n)
+    last_out = module.forward_train(weights, b["next_obs"])
+    last_values = np.asarray(last_out["vf_preds"])
+    vs, pg_adv = compute_vtrace(
+        b["action_logp"], target_logp, b["rewards"], values,
+        np.logical_or(b["terminateds"], b["truncateds"]),
+        last_values, cfg.get("gamma", 0.99),
+        cfg.get("clip_rho", 1.0), cfg.get("clip_c", 1.0))
+    # drop autoreset reset-step rows (valid=False): not real transitions;
+    # the v-trace chain is already cut at the episode end one step earlier
+    # so only the row itself is garbage.
+    mask = b.get("valid", np.ones((t_len, n), bool)).reshape(-1)
+    return {
+        "obs": flat_obs[mask],
+        "actions": b["actions"].reshape(
+            t_len * n, *b["actions"].shape[2:])[mask],
+        "pg_advantages": pg_adv.reshape(-1).astype(np.float32)[mask],
+        "vs": vs.reshape(-1).astype(np.float32)[mask],
+    }
+
+
 class IMPALAConfig(AlgorithmConfig):
     def __init__(self, algo_class=None):
         super().__init__(algo_class or IMPALA)
@@ -68,6 +117,7 @@ class IMPALAConfig(AlgorithmConfig):
         self.clip_c = 1.0
         self.lr = 5e-4
         self.num_epochs = 1          # off-policy: single pass
+        self.num_aggregation_workers = 0  # reference impala.py:676-696
 
     def copy(self):
         import copy as _copy
@@ -91,6 +141,31 @@ class IMPALA(Algorithm):
     def _setup_algo(self):
         super()._setup_algo()
         self._inflight: Dict[Any, int] = {}
+        self._aggregators: List[Any] = []
+        self._agg_rr = 0
+        n_agg = getattr(self.algo_config, "num_aggregation_workers", 0)
+        if n_agg > 0:
+            import ray_tpu
+
+            cfg = self.algo_config
+            agg_cfg = {"gamma": cfg.gamma,
+                       "clip_rho": getattr(cfg, "clip_rho", 1.0),
+                       "clip_c": getattr(cfg, "clip_c", 1.0)}
+            cls = ray_tpu.remote(_Aggregator)
+            self._aggregators = [
+                cls.options(num_cpus=1).remote(self.module_spec, agg_cfg)
+                for _ in range(n_agg)]
+
+    def cleanup(self) -> None:
+        super().cleanup()
+        import ray_tpu
+
+        for agg in self._aggregators:
+            try:
+                ray_tpu.kill(agg)
+            except Exception:
+                pass
+        self._aggregators = []
 
     def training_step(self) -> Dict[str, Any]:
         """Async: keep one sample() in flight per runner; update on what
@@ -131,35 +206,34 @@ class IMPALA(Algorithm):
                      ) -> Dict[str, np.ndarray]:
         cfg = self.algo_config
         weights = self.learner_group.get_weights()
+        if self._aggregators:
+            # aggregation tree: fan batches over aggregator actors,
+            # round-robin; weights ship once as a shared ref
+            import ray_tpu
+            from ray_tpu.core.runtime import _get_runtime
+
+            w_ref = ray_tpu.put(weights)
+            refs = []
+            n_agg = len(self._aggregators)
+            for i in range(n_agg):
+                mine = batches[i::n_agg]
+                if not mine:
+                    continue
+                agg = self._aggregators[(self._agg_rr + i) % n_agg]
+                refs.append(agg.aggregate.remote(w_ref, *mine))
+            self._agg_rr += 1
+            outs = ray_tpu.get(refs)
+            # a weights blob per step would accumulate forever (no
+            # distributed refcounting): free it once consumed
+            _get_runtime().free([w_ref.id.binary()])
+            return {k: np.concatenate([o[k] for o in outs])
+                    for k in outs[0]}
         from ray_tpu.rllib.rl_module import RLModuleSpec
 
         module = RLModuleSpec(**self.module_spec).build()
-        outs = []
-        for b in batches:
-            t_len, n = b["rewards"].shape
-            flat_obs = b["obs"].reshape(t_len * n, -1)
-            out = module.forward_train(weights, flat_obs)
-            target_logp, _ = module.logp_entropy(
-                out, b["actions"].reshape(t_len * n,
-                                          *b["actions"].shape[2:]))
-            target_logp = np.asarray(target_logp).reshape(t_len, n)
-            values = np.asarray(out["vf_preds"]).reshape(t_len, n)
-            last_out = module.forward_train(weights, b["next_obs"])
-            last_values = np.asarray(last_out["vf_preds"])
-            vs, pg_adv = compute_vtrace(
-                b["action_logp"], target_logp, b["rewards"], values,
-                np.logical_or(b["terminateds"], b["truncateds"]),
-                last_values, cfg.gamma,
-                getattr(cfg, "clip_rho", 1.0), getattr(cfg, "clip_c", 1.0))
-            # drop autoreset reset-step rows (valid=False): not real
-            # transitions; the v-trace chain is already cut at the episode
-            # end one step earlier so only the row itself is garbage.
-            mask = b.get("valid", np.ones((t_len, n), bool)).reshape(-1)
-            outs.append({
-                "obs": flat_obs[mask],
-                "actions": b["actions"].reshape(
-                    t_len * n, *b["actions"].shape[2:])[mask],
-                "pg_advantages": pg_adv.reshape(-1).astype(np.float32)[mask],
-                "vs": vs.reshape(-1).astype(np.float32)[mask],
-            })
+        agg_cfg = {"gamma": cfg.gamma,
+                   "clip_rho": getattr(cfg, "clip_rho", 1.0),
+                   "clip_c": getattr(cfg, "clip_c", 1.0)}
+        outs = [_vtrace_postprocess(module, weights, b, agg_cfg)
+                for b in batches]
         return {k: np.concatenate([o[k] for o in outs]) for k in outs[0]}

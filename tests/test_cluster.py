@@ -261,3 +261,29 @@ def test_gcs_restart_fault_tolerance(tmp_path):
     finally:
         ray_tpu.shutdown()
         c.shutdown()
+
+
+def test_nested_task_spills_between_daemons(cluster):
+    """A task on daemon A submits a nested task only daemon B can run:
+    the daemon spills it instead of queueing forever (reference raylet
+    spillback role)."""
+    cluster.add_node(num_cpus=2, resources={"a": 1})
+    cluster.add_node(num_cpus=2, resources={"b": 1})
+    _init(cluster)
+
+    @ray_tpu.remote(resources={"b": 1})
+    def inner():
+        from ray_tpu.core.runtime import _get_runtime
+
+        return _get_runtime().store.session
+
+    @ray_tpu.remote(resources={"a": 1})
+    def outer():
+        import ray_tpu as r
+        from ray_tpu.core.runtime import _get_runtime
+
+        inner_session = r.get(inner.remote(), timeout=90)
+        return inner_session, _get_runtime().store.session
+
+    inner_session, outer_session = ray_tpu.get(outer.remote(), timeout=120)
+    assert inner_session != outer_session  # ran on the OTHER daemon

@@ -278,3 +278,24 @@ def test_learner_group_grad_sync_matches_local(rt_rl):
     wg, wl = group.get_weights(), local.get_weights()
     for a, b in zip(jax.tree.leaves(wg), jax.tree.leaves(wl)):
         np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
+
+
+def test_impala_aggregation_tree(rt_rl):
+    """num_aggregation_workers > 0: the v-trace postprocess runs on
+    aggregator actors (reference impala.py:676-696 tree), same training
+    result surface."""
+    from ray_tpu.rllib import IMPALAConfig
+
+    config = (IMPALAConfig()
+              .environment("CartPole-v1")
+              .env_runners(num_env_runners=2, num_envs_per_env_runner=2,
+                           rollout_fragment_length=32)
+              .debugging(seed=0))
+    config.num_aggregation_workers = 2
+    algo = config.build()
+    assert len(algo._aggregators) == 2
+    r1 = algo.train()
+    r2 = algo.train()
+    assert "policy_loss" in r2 and np.isfinite(r2["policy_loss"])
+    assert r2["num_env_steps_sampled"] > 0
+    algo.cleanup()
