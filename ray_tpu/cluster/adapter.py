@@ -350,20 +350,29 @@ class ClusterAdapter:
                 self.rt.avail.get(k, 0.0) >= v for k, v in res.items())
         if local_avail_ok:
             return False  # local fast path
+        candidates, with_avail = self._feasible_peers(res)
+        if not candidates:
+            return False  # infeasible everywhere -> queue locally
+        if local_total_ok and not with_avail:
+            return False  # locally feasible soon; nobody free now anyway
+        return self._forward_to_best(with_avail or candidates, res, spec)
+
+    def _feasible_peers(self, res: Dict[str, float]):
+        """(feasible-by-total, also-free-now) peer views for ``res``."""
         candidates = [
             n for n in self._nodes()
             if n["alive"] and n["node_id"] != self.node_id
             and all(n["resources"].get(k, 0.0) >= v for k, v in res.items())
         ]
-        if not candidates:
-            return False  # infeasible everywhere -> queue locally
         with_avail = [
             n for n in candidates
             if all(n["avail"].get(k, 0.0) >= v for k, v in res.items())
         ]
-        if local_total_ok and not with_avail:
-            return False  # locally feasible soon; nobody free now anyway
-        target = (with_avail or candidates)[0]
+        return candidates, with_avail
+
+    def _forward_to_best(self, picks, res: Dict[str, float],
+                         spec: dict) -> bool:
+        target = picks[0]
         # decrement the cached view so a burst of submissions spreads across
         # peers instead of piling onto one node until the next heartbeat
         for k, v in res.items():
@@ -377,24 +386,11 @@ class ClusterAdapter:
         with self.rt.lock:
             if all(self.rt.total.get(k, 0.0) >= v for k, v in res.items()):
                 return False  # feasible here: run/queue locally
-        candidates = [
-            n for n in self._nodes()
-            if n["alive"] and n["node_id"] != self.node_id
-            and all(n["resources"].get(k, 0.0) >= v for k, v in res.items())
-        ]
-        with_avail = [
-            n for n in candidates
-            if all(n["avail"].get(k, 0.0) >= v for k, v in res.items())
-        ]
+        candidates, with_avail = self._feasible_peers(res)
         picks = (with_avail or candidates)
         if not picks:
             return False  # nowhere feasible: queue locally (matches head)
-        target = picks[0]
-        # decrement the cached view so a burst of nested submissions
-        # spreads across peers (same hygiene as the scheduler path)
-        for k, v in res.items():
-            target["avail"][k] = target["avail"].get(k, 0.0) - v
-        return self._forward(target["node_id"], spec)
+        return self._forward_to_best(picks, res, spec)
 
     def _place_node_affinity(self, spec: dict, node_id: bytes, soft: bool):
         """Pin to a node (reference NodeAffinitySchedulingStrategy). Hard
@@ -434,6 +430,13 @@ class ClusterAdapter:
         peer = self._peer(node_id)
         if peer is None:
             return False
+        if spec.get("stream_backpressure"):
+            # permit waits would land on the EXECUTING node while consumer
+            # acks land here — cross-node permit plumbing doesn't exist
+            # yet, so a forwarded producer would park forever. Stream
+            # unthrottled instead.
+            spec = dict(spec)
+            spec.pop("stream_backpressure")
         try:
             peer.call("submit_spec", spec, timeout=30)
         except Exception:

@@ -88,14 +88,37 @@ class ObjectRefGenerator:
                     raise StopIteration
                 # count known -> the item was definitely produced
                 self._index += 1
+                self._ack(rt)
                 return item
             ready, _ = rt.wait([item, self._sentinel], num_returns=1,
                                timeout=None)
             if item in ready:
                 self._index += 1
+                self._ack(rt)
                 return item
             # sentinel resolved first: completion (count) or task error
             self._count = rt.get([self._sentinel], timeout=0)[0]
+
+    def _ack(self, rt) -> None:
+        """Report consumption so a backpressured producer may continue."""
+        try:
+            rt.stream_consumed(self._task_id, self._index)
+        except Exception:
+            pass
+
+    def close(self) -> None:
+        """Abandon the stream: release any backpressured producer (it runs
+        to completion; surplus items are dropped with the task)."""
+        try:
+            from ray_tpu.core.runtime import _get_runtime
+
+            _get_runtime().stream_consumed(self._task_id, 1 << 60)
+        except Exception:
+            pass
+
+    def __del__(self):
+        if self._count is None:  # never finished: producer may be parked
+            self.close()
 
     def __len__(self):
         if self._count is None:

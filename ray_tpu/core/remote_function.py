@@ -128,6 +128,11 @@ class RemoteFunction:
             from ray_tpu.core.object_ref import ObjectRefGenerator
 
             spec["streaming"] = True
+            bp = self._options.get("_generator_backpressure_num_objects")
+            if bp:
+                # producer pauses when this many yields are unconsumed
+                # (reference generator_waiter.cc)
+                spec["stream_backpressure"] = int(bp)
             refs = rt.submit(spec)
             return ObjectRefGenerator(spec["task_id"], refs[0])
         refs = rt.submit(spec)

@@ -164,6 +164,16 @@ class DriverRuntime:
         # producing TASK spec, bounded FIFO. A lost segment with live refs
         # re-executes the producer; recursion through lost deps happens
         # naturally (the re-executed task's worker hits the same path).
+        # streaming-generator backpressure: task_id -> items consumed by
+        # the ObjectRefGenerator; producers block on stream_permit until
+        # consumption catches up (reference generator_waiter.cc). Permit
+        # waits are entries in _stream_waiters serviced by whichever
+        # thread advances consumption — no thread per permit. The counter
+        # dict is bounded (entries are re-creatable by late acks).
+        self._stream_consumed: Dict[bytes, int] = {}
+        self._stream_waiters: List[tuple] = []  # (task_id, need, reply)
+        self._stream_cv = threading.Condition(self.lock)
+
         self._lineage: Dict[bytes, dict] = {}
         self._lineage_cap = int(os.environ.get("RTPU_LINEAGE_MAX", "100000"))
         # byte bound too (reference RAY_max_lineage_bytes role): specs keep
@@ -469,6 +479,18 @@ class DriverRuntime:
                 self.gcs.mark_ready(oid)
             else:
                 self.gcs.mark_error(oid, payload)
+        fire = []
+        with self._stream_cv:
+            self._stream_consumed.pop(task_id_b, None)
+            kept = []
+            for tid, need, rep in self._stream_waiters:
+                if tid == task_id_b:
+                    fire.append(rep)  # task over: release any blocked producer
+                else:
+                    kept.append((tid, need, rep))
+            self._stream_waiters = kept
+        for rep in fire:
+            rep(True)
         start = self._task_start_ts.pop(task_id_b, None)
         if start is not None and len(self.timeline_events) < 200_000:
             name = (spec or {}).get("name") or (spec or {}).get("method") or "task"
@@ -544,6 +566,8 @@ class DriverRuntime:
             self.kill_actor(args[0], args[1])
         elif op == "cancel":
             self.cancel_task(ObjectID(args[0]))
+        elif op == "stream_consumed":
+            self.stream_consumed(args[0], args[1])
         elif op == "free":
             for b in args[0]:
                 oid = ObjectID(b)
@@ -567,6 +591,17 @@ class DriverRuntime:
             elif op == "wait":
                 ids, num_returns, timeout = args
                 self._async_wait(ids, num_returns, timeout, reply)
+            elif op == "stream_permit":
+                tid, need = args[0], args[1]
+                with self._stream_cv:
+                    if (self._stream_consumed.get(tid, 0) >= need
+                            or self._shutdown):
+                        fire = True
+                    else:
+                        self._stream_waiters.append((tid, need, reply))
+                        fire = False
+                if fire:
+                    reply(True)
             elif op == "reconstruct":
                 # blocks until the producer re-ran: always off the
                 # receiver thread
@@ -1243,6 +1278,25 @@ class DriverRuntime:
         st = self.gcs.object_state(obj_id)
         if st is not None and st.status == "PENDING":
             self.gcs.mark_error(obj_id, err)
+
+    def stream_consumed(self, task_id: bytes, n: int) -> None:
+        fire = []
+        with self._stream_cv:
+            if n > self._stream_consumed.get(task_id, 0):
+                self._stream_consumed[task_id] = n
+            # bound the counter dict (late acks re-create entries)
+            while len(self._stream_consumed) > 10000:
+                self._stream_consumed.pop(
+                    next(iter(self._stream_consumed)))
+            kept = []
+            for tid, need, rep in self._stream_waiters:
+                if self._stream_consumed.get(tid, 0) >= need:
+                    fire.append(rep)
+                else:
+                    kept.append((tid, need, rep))
+            self._stream_waiters = kept
+        for rep in fire:
+            rep(True)
 
     def actor_queue_depths(self, actor_ids: List[bytes]) -> List[int]:
         """Queued + in-flight calls per actor — the TRUE load signal the

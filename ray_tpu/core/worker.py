@@ -132,7 +132,13 @@ class WorkerRuntime:
         with self._reply_lock:
             self._reply_events[req_id] = ev
         self._send(("req", req_id, op, args))
-        ev.wait()
+        # polled wait, not a bare ev.wait(): an injected cancellation
+        # (PyThreadState_SetAsyncExc) can only be delivered while this
+        # thread executes bytecode — a C-level block would pin a cancelled
+        # task forever (e.g. a backpressured producer whose consumer went
+        # away)
+        while not ev.wait(0.5):
+            pass
         with self._reply_lock:
             status, payload = self._replies.pop(req_id)
         if status == "err":
@@ -392,14 +398,33 @@ class WorkerRuntime:
         """Drain a streaming task's generator: each yield becomes an object
         under a deterministic id announced immediately (consumers overlap
         with production); the declared return id is the end sentinel and
-        resolves to the item count."""
+        resolves to the item count.
+
+        With ``stream_backpressure`` = N, production pauses while N yields
+        are unconsumed (reference ``generator_waiter.cc``): the driver
+        tracks consumption from the ObjectRefGenerator and releases
+        permits."""
+        bp = spec.get("stream_backpressure")
         count = 0
         for item in value:
+            if bp and count >= bp:
+                # permit to produce item `count`: at most bp outstanding.
+                # Release our resource slot while parked — a consumer
+                # draining slowly must not starve the pool.
+                self.cast("blocked")
+                try:
+                    self.request("stream_permit", spec["task_id"],
+                                 count + 1 - bp)
+                finally:
+                    self.cast("unblocked")
             oid = ObjectID(ts.streaming_return_id(spec["task_id"], count))
             inline = self.store.put(oid, item)
             self.cast("put", oid.binary(), inline)
             count += 1
         return self._encode_results(spec, count)
+
+    def stream_consumed(self, task_id: bytes, n: int) -> None:
+        self.cast("stream_consumed", task_id, n)
 
     def _make_actor_loop(self, actor_id: bytes):
         import asyncio

@@ -222,10 +222,13 @@ class IMPALA(Algorithm):
                 agg = self._aggregators[(self._agg_rr + i) % n_agg]
                 refs.append(agg.aggregate.remote(w_ref, *mine))
             self._agg_rr += 1
-            outs = ray_tpu.get(refs)
-            # a weights blob per step would accumulate forever (no
-            # distributed refcounting): free it once consumed
-            _get_runtime().free([w_ref.id.binary()])
+            try:
+                outs = ray_tpu.get(refs)
+            finally:
+                # a weights blob per step would accumulate forever (no
+                # distributed refcounting): free it even when an
+                # aggregator died mid-step
+                _get_runtime().free([w_ref.id.binary()])
             return {k: np.concatenate([o[k] for o in outs])
                     for k in outs[0]}
         from ray_tpu.rllib.rl_module import RLModuleSpec

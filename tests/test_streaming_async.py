@@ -225,3 +225,56 @@ def test_log_to_driver(rt, capsys):
         time.sleep(0.2)
     assert "hello-from-worker-xyzzy" in seen
     assert "(worker-" in seen  # prefixed with the worker identity
+
+
+def test_streaming_backpressure_paces_producer(rt):
+    """_generator_backpressure_num_objects=2: the producer pauses while 2
+    yields are unconsumed (reference generator_waiter.cc). A slow consumer
+    therefore paces production instead of letting it run ahead."""
+    @ray_tpu.remote
+    def warm():
+        return None
+
+    ray_tpu.get([warm.remote() for _ in range(2)])
+
+    @ray_tpu.remote(num_returns="streaming",
+                    _generator_backpressure_num_objects=2)
+    def fast_gen():
+        out = []
+        for i in range(6):
+            out.append((i, time.monotonic()))
+            yield out[-1]
+        return
+
+    g = fast_gen.remote()
+    stamps = []
+    for ref in g:
+        stamps.append(ray_tpu.get(ref))
+        time.sleep(0.5)  # slow consumer
+    assert [i for i, _ in stamps] == list(range(6))
+    t = [ts for _, ts in stamps]
+    # without backpressure all 6 produce within ~ms of each other; with
+    # bp=2 item 5's production trails item 0 by >= ~3 consumer intervals
+    spread = t[5] - t[0]
+    assert spread > 1.0, f"producer ran ahead of backpressure: {spread:.2f}s"
+
+
+def test_streaming_no_backpressure_runs_ahead(rt):
+    @ray_tpu.remote
+    def warm():
+        return None
+
+    ray_tpu.get([warm.remote() for _ in range(2)])
+
+    @ray_tpu.remote(num_returns="streaming")
+    def fast_gen():
+        for i in range(6):
+            yield (i, time.monotonic())
+
+    g = fast_gen.remote()
+    stamps = []
+    for ref in g:
+        stamps.append(ray_tpu.get(ref))
+        time.sleep(0.2)
+    t = [ts for _, ts in stamps]
+    assert t[5] - t[0] < 0.5, "unbackpressured producer should not wait"
