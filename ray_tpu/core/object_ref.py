@@ -65,11 +65,14 @@ class ObjectRefGenerator:
     (:func:`ray_tpu.core.task_spec.streaming_return_id`).
     """
 
-    def __init__(self, task_id: bytes, sentinel: "ObjectRef"):
+    def __init__(self, task_id: bytes, sentinel: "ObjectRef",
+                 backpressured: bool = False):
         self._task_id = task_id
         self._sentinel = sentinel
         self._index = 0
         self._count = None  # known once the sentinel resolves
+        self._bp = backpressured
+        self._handed_off = False  # serialized to another consumer
 
     def __iter__(self):
         return self
@@ -100,7 +103,12 @@ class ObjectRefGenerator:
             self._count = rt.get([self._sentinel], timeout=0)[0]
 
     def _ack(self, rt) -> None:
-        """Report consumption so a backpressured producer may continue."""
+        """Report consumption so a backpressured producer may continue.
+
+        Skipped entirely for unthrottled streams (no per-item IPC on the
+        hot path) and once the producer finished (nobody is waiting)."""
+        if not self._bp or self._count is not None:
+            return
         try:
             rt.stream_consumed(self._task_id, self._index)
         except Exception:
@@ -117,7 +125,9 @@ class ObjectRefGenerator:
             pass
 
     def __del__(self):
-        if self._count is None:  # never finished: producer may be parked
+        # release a parked producer ONLY when this was the sole consumer:
+        # a serialized copy (handed to another task) owns consumption now
+        if self._bp and self._count is None and not self._handed_off:
             self.close()
 
     def __len__(self):
@@ -130,4 +140,6 @@ class ObjectRefGenerator:
         return self._sentinel
 
     def __reduce__(self):
-        return (ObjectRefGenerator, (self._task_id, self._sentinel))
+        self._handed_off = True
+        return (ObjectRefGenerator,
+                (self._task_id, self._sentinel, self._bp))

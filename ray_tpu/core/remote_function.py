@@ -134,7 +134,9 @@ class RemoteFunction:
                 # (reference generator_waiter.cc)
                 spec["stream_backpressure"] = int(bp)
             refs = rt.submit(spec)
-            return ObjectRefGenerator(spec["task_id"], refs[0])
+            return ObjectRefGenerator(
+                spec["task_id"], refs[0],
+                backpressured=bool(spec.get("stream_backpressure")))
         refs = rt.submit(spec)
         if num_returns == 1:
             return refs[0]

@@ -304,11 +304,22 @@ class DriverRuntime:
         env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
         log_path = os.path.join(self.session_dir, "logs", f"worker-{wid.hex()[:8]}.log")
         log_f = open(log_path, "wb", buffering=0)
+        # The bootstrap ignores SIGUSR1 FIRST: a `ray_tpu stack` signal
+        # landing during the multi-second interpreter boot must not kill
+        # the worker before its faulthandler registers. Done in-child via
+        # -c (preexec_fn is documented-unsafe in threaded parents); the
+        # literal "ray_tpu.core.worker" stays in the cmdline for
+        # `ray_tpu stack` discovery.
+        bootstrap = (
+            "import signal, runpy; "
+            "signal.signal(signal.SIGUSR1, signal.SIG_IGN); "
+            "runpy.run_module('ray_tpu.core.worker', run_name='__main__')"
+        )
         proc = subprocess.Popen(
             [
                 sys.executable,
-                "-m",
-                "ray_tpu.core.worker",
+                "-c",
+                bootstrap,
                 "--addr",
                 self._sock_addr,
                 "--session",
@@ -713,6 +724,9 @@ class DriverRuntime:
                         oid.hex()[:8], spec.get("name", "?"))
             respec = dict(spec)
             respec["retries_left"] = spec.get("max_retries", 0)
+            # the original consumer is gone: a re-run producer waiting on
+            # backpressure permits would park forever
+            respec.pop("stream_backpressure", None)
             for rid in respec["return_ids"]:
                 roid = ObjectID(rid)
                 st = self.gcs.object_state(roid)
@@ -1284,10 +1298,15 @@ class DriverRuntime:
         with self._stream_cv:
             if n > self._stream_consumed.get(task_id, 0):
                 self._stream_consumed[task_id] = n
-            # bound the counter dict (late acks re-create entries)
-            while len(self._stream_consumed) > 10000:
-                self._stream_consumed.pop(
-                    next(iter(self._stream_consumed)))
+            # bound the counter dict (late acks re-create entries) —
+            # never evicting a stream with a parked producer
+            if len(self._stream_consumed) > 10000:
+                live = {tid for tid, _, _ in self._stream_waiters}
+                for tid in list(self._stream_consumed):
+                    if len(self._stream_consumed) <= 10000:
+                        break
+                    if tid not in live:
+                        del self._stream_consumed[tid]
             kept = []
             for tid, need, rep in self._stream_waiters:
                 if self._stream_consumed.get(tid, 0) >= need:

@@ -27,6 +27,9 @@ from ray_tpu.core.ids import ActorID, ObjectID, TaskID, WorkerID
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.object_store import INLINE_THRESHOLD, StoreClient
 
+# sentinel for request() timeouts (None is a legitimate reply payload)
+_TIMEOUT = object()
+
 
 class WorkerRuntime:
     """Runtime interface bound inside a worker process (see runtime.py for
@@ -126,19 +129,36 @@ class WorkerRuntime:
             elif kind == "shutdown":
                 os._exit(0)
 
-    def request(self, op: str, *args):
+    def request(self, op: str, *args, timeout: Optional[float] = None):
+        """Request/reply over the pipe. Returns the payload, or the
+        ``_TIMEOUT`` sentinel when ``timeout`` expires first."""
+        import time as _time
+
         req_id = next(self._req_counter)
         ev = threading.Event()
         with self._reply_lock:
             self._reply_events[req_id] = ev
-        self._send(("req", req_id, op, args))
-        # polled wait, not a bare ev.wait(): an injected cancellation
-        # (PyThreadState_SetAsyncExc) can only be delivered while this
-        # thread executes bytecode — a C-level block would pin a cancelled
-        # task forever (e.g. a backpressured producer whose consumer went
-        # away)
-        while not ev.wait(0.5):
-            pass
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        try:
+            self._send(("req", req_id, op, args))
+            # polled wait, not a bare ev.wait(): an injected cancellation
+            # (PyThreadState_SetAsyncExc) can only be delivered while this
+            # thread executes bytecode — a C-level block would pin a
+            # cancelled task forever (e.g. a backpressured producer whose
+            # consumer went away)
+            while not ev.wait(0.5):
+                if deadline is not None and _time.monotonic() > deadline:
+                    with self._reply_lock:
+                        self._reply_events.pop(req_id, None)
+                        self._replies.pop(req_id, None)
+                    return _TIMEOUT
+        except BaseException:
+            # interrupted (cancel injection): a late reply must not leak
+            # into self._replies forever
+            with self._reply_lock:
+                self._reply_events.pop(req_id, None)
+                self._replies.pop(req_id, None)
+            raise
         with self._reply_lock:
             status, payload = self._replies.pop(req_id)
         if status == "err":
@@ -410,13 +430,18 @@ class WorkerRuntime:
             if bp and count >= bp:
                 # permit to produce item `count`: at most bp outstanding.
                 # Release our resource slot while parked — a consumer
-                # draining slowly must not starve the pool.
+                # draining slowly must not starve the pool. The timeout is
+                # a deadlock valve (e.g. a consumer whose acks land on a
+                # different node): proceed unthrottled rather than park a
+                # worker forever.
                 self.cast("blocked")
                 try:
-                    self.request("stream_permit", spec["task_id"],
-                                 count + 1 - bp)
+                    out = self.request("stream_permit", spec["task_id"],
+                                       count + 1 - bp, timeout=300.0)
                 finally:
                     self.cast("unblocked")
+                if out is _TIMEOUT:
+                    bp = None  # give up pacing for the rest of the stream
             oid = ObjectID(ts.streaming_return_id(spec["task_id"], count))
             inline = self.store.put(oid, item)
             self.cast("put", oid.binary(), inline)
@@ -638,7 +663,16 @@ def _main():
     ``if __name__ == "__main__"`` guard can never fork-bomb.
     """
     import argparse
+    import faulthandler
+    import signal
     from multiprocessing.connection import Client
+
+    # `ray_tpu stack` analog of `ray stack` (py-spy role): SIGUSR1 dumps
+    # every thread's python stack into the worker's log file. The spawner
+    # pre-sets SIGUSR1 to SIG_IGN across exec (ignored dispositions
+    # survive), so a stray signal during the multi-second interpreter
+    # boot cannot kill the worker before this register runs.
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
 
     ap = argparse.ArgumentParser()
     ap.add_argument("--addr", required=True)

@@ -79,6 +79,58 @@ def _cmd_bench(args) -> int:
     return 0
 
 
+def _cmd_stack(args) -> int:
+    """Dump python stacks of every live ray_tpu worker (reference
+    ``ray stack``, scripts.py:1830 — py-spy there, SIGUSR1+faulthandler
+    here: workers register the handler at startup and append to their
+    session log)."""
+    import signal
+    import time
+
+    signaled = []
+    for pid_dir in os.listdir("/proc"):
+        if not pid_dir.isdigit():
+            continue
+        pid = int(pid_dir)
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmdline = f.read().replace(b"\0", b" ").decode("utf-8",
+                                                               "replace")
+        except OSError:
+            continue
+        if "ray_tpu.core.worker" in cmdline:
+            try:
+                os.kill(pid, signal.SIGUSR1)
+                signaled.append(pid)
+            except OSError:
+                pass
+    if not signaled:
+        print("no live ray_tpu workers found")
+        return 0
+    time.sleep(0.5)  # let faulthandler write
+    print(f"signaled {len(signaled)} workers: {signaled}")
+    import glob
+
+    shown = 0
+    for log in sorted(glob.glob("/tmp/rtpu-*/logs/worker-*.log"),
+                      key=os.path.getmtime, reverse=True):
+        try:
+            with open(log, errors="replace") as f:
+                content = f.read()
+        except OSError:
+            continue
+        if "Current thread" not in content:
+            continue
+        idx = content.rindex("Current thread")
+        window = content[max(0, idx - 2000):idx + 4000]
+        print(f"\n==== {log} ====")
+        print(window)
+        shown += 1
+        if shown >= args.limit:
+            break
+    return 0
+
+
 def _cmd_clean(args) -> int:
     import glob
 
@@ -104,6 +156,9 @@ def main(argv=None) -> int:
     tl = sub.add_parser("timeline", help="export chrome trace")
     tl.add_argument("--output", "-o", default=None)
 
+    st = sub.add_parser("stack", help="dump python stacks of live workers")
+    st.add_argument("--limit", type=int, default=16)
+
     job = sub.add_parser("job", help="job submission")
     jobsub = job.add_subparsers(dest="job_cmd", required=True)
     js = jobsub.add_parser("submit")
@@ -122,6 +177,8 @@ def main(argv=None) -> int:
         return _cmd_bench(args)
     if args.cmd == "timeline":
         return _cmd_timeline(args)
+    if args.cmd == "stack":
+        return _cmd_stack(args)
     if args.cmd == "job":
         if args.job_cmd == "submit":
             return _cmd_job_submit(args)
