@@ -287,3 +287,28 @@ def test_nested_task_spills_between_daemons(cluster):
 
     inner_session, outer_session = ray_tpu.get(outer.remote(), timeout=120)
     assert inner_session != outer_session  # ran on the OTHER daemon
+
+
+def test_named_actor_visible_across_nodes(cluster):
+    """A named actor created on a daemon resolves from the driver via the
+    global registry, and calls route to the hosting node."""
+    cluster.add_node(num_cpus=2, resources={"worker": 1})
+    _init(cluster)
+
+    @ray_tpu.remote(resources={"worker": 1}, name="kvstore")
+    class KV:
+        def __init__(self):
+            self.d = {}
+
+        def put(self, k, v):
+            self.d[k] = v
+            return True
+
+        def get(self, k):
+            return self.d.get(k)
+
+    kv = KV.remote()
+    assert ray_tpu.get(kv.put.remote("a", 1), timeout=90)
+    # resolve BY NAME from the driver: global registry lookup
+    handle = ray_tpu.get_actor("kvstore")
+    assert ray_tpu.get(handle.get.remote("a"), timeout=60) == 1
