@@ -37,6 +37,9 @@ logger = logging.getLogger(__name__)
 HEARTBEAT_S = 0.5
 NODE_VIEW_TTL_S = 0.5
 
+# sentinel: "could not reach the GCS" — distinct from "GCS says gone"
+GCS_UNAVAILABLE = object()
+
 
 class ClusterAdapter:
     def __init__(self, gcs_addr: str, authkey: bytes, *,
@@ -63,6 +66,17 @@ class ClusterAdapter:
         self._fwd_by_oid: Dict[bytes, tuple] = {}
         self._forwarded_lock = threading.Lock()
         self._remote_actors: Dict[bytes, bytes] = {}  # actor_id -> node_id
+        # placement groups: cached assignment maps (pg_id -> {idx: node}),
+        # full meta for groups THIS adapter created (it owns rescheduling),
+        # bundles lost to node death awaiting re-placement, and task specs
+        # parked on a lost bundle
+        self._pg_nodes: Dict[bytes, Dict[int, Optional[bytes]]] = {}
+        self._pg_meta: Dict[bytes, dict] = {}
+        self._my_pgs: Dict[bytes, dict] = {}
+        self._pg_pending: Dict[bytes, Set[int]] = {}
+        self._pg_parked: Dict[bytes, List[dict]] = {}
+        self._pg_lock = threading.Lock()
+        self._pg_rr = 0
         self._node_view: List[dict] = []
         self._node_view_ts = 0.0
         self._spread_rr = 0
@@ -116,6 +130,7 @@ class ClusterAdapter:
     def _heartbeat_loop(self):
         while not self._stop.wait(HEARTBEAT_S):
             try:
+                self.rt.reap_stale_pg_stages()
                 with self.rt.lock:
                     avail = dict(self.rt.avail)
                     depth = len(self.rt.ready_tasks)
@@ -131,6 +146,7 @@ class ClusterAdapter:
     def _register(self):
         self.gcs.call("subscribe", "nodes", timeout=10)
         self.gcs.call("subscribe", "objects", timeout=10)
+        self.gcs.call("subscribe", "pgs", timeout=10)
         self.gcs.call("node_register", self.node_id, self.server.addr,
                       self.rt.resources("total"), self.is_scheduler,
                       timeout=10)
@@ -159,7 +175,18 @@ class ClusterAdapter:
             self.rt.kill_actor(args[0], args[1])
             return True
         if method == "cancel_task":
-            self.rt.cancel_task(ObjectID(args[0]))
+            force = args[1] if len(args) > 1 else False
+            self.rt.cancel_task(ObjectID(args[0]), force)
+            return True
+        if method == "pg_prepare":
+            return self.rt.pg_prepare(args[0], args[1])
+        if method == "pg_commit":
+            return self.rt.pg_commit(args[0])
+        if method == "pg_abort":
+            self.rt.pg_abort(args[0])
+            return True
+        if method == "pg_release":
+            self.rt.pg_release_local(args[0])
             return True
         if method == "ping":
             return "pong"
@@ -189,6 +216,14 @@ class ClusterAdapter:
 
     def _publish_error(self, oid: ObjectID, err: bytes):
         self.gcs.cast("obj_error", oid.binary(), err)
+
+    def pin_object(self, oid_b: bytes) -> None:
+        """First live reference on this node: the directory must keep the
+        entry (and holders their segments) until we unpin."""
+        self.gcs.cast("obj_pin", oid_b, self.node_id)
+
+    def unpin_object(self, oid_b: bytes) -> None:
+        self.gcs.cast("obj_unpin", oid_b, self.node_id)
 
     def watch_many(self, oids) -> None:
         """Subscribe to global terminal state for objects not yet terminal
@@ -222,6 +257,12 @@ class ClusterAdapter:
         # (no payload bytes); interested adapters fetch the state.
         if channel == "objects":
             b = payload["oid"]
+            if payload.get("freed"):
+                # global refcount hit zero: free our segment copy (the
+                # reference's owner-driven object free)
+                if self.node_id in (payload.get("locations") or ()):
+                    self._io.submit(self._free_local_copy, b)
+                return
             with self._watch_lock:
                 interested = b in self._watched
             if interested:
@@ -229,7 +270,12 @@ class ClusterAdapter:
         elif channel == "nodes":
             if payload.get("event") == "down":
                 self._io.submit(self._node_down, payload)
+            elif payload.get("event") == "up":
+                # a fresh node may make pending pg bundles placeable
+                self._io.submit(self._pg_reschedule_pending)
             self._node_view_ts = 0.0  # invalidate the scheduler view
+        elif channel == "pgs":
+            self._io.submit(self._on_pg_event, payload)
 
     def _deliver(self, oid_b: bytes, state: dict):
         """Apply a terminal global state to the local gcs (fetch if big)."""
@@ -299,6 +345,14 @@ class ClusterAdapter:
         with self._watch_lock:
             self._watched.discard(oid_b)
 
+    def _free_local_copy(self, oid_b: bytes):
+        oid = ObjectID(oid_b)
+        try:
+            self.rt.store.delete(oid)
+        except Exception:
+            pass
+        self.rt.gcs.drop_object(oid)
+
     # ------------------------------------------------------------------
     # scheduling (driver/head only)
     # ------------------------------------------------------------------
@@ -320,6 +374,10 @@ class ClusterAdapter:
         NodeAffinity / SPREAD strategies are honored (reference
         scheduling_strategies.py); dependency locality is future work
         (the reference's hybrid policy weighs both)."""
+        if spec.get("pg") is not None:
+            # bundle-pinned work routes to the node that reserved the
+            # bundle — on head AND daemons (stale forwards re-route)
+            return self._route_pg(spec)
         if not self.is_scheduler:
             # daemons execute what they're given — EXCEPT nested
             # submissions this node can never satisfy, which would queue
@@ -332,8 +390,6 @@ class ClusterAdapter:
                 if out is not None:
                     return out
             return self._spill_if_infeasible(spec)
-        if spec.get("pg") is not None:
-            return False  # placement groups are node-local (for now)
         res = spec.get("resources") or {}
         strat = spec.get("strategy")
         if strat is not None and strat[0] == "node_affinity":
@@ -380,8 +436,6 @@ class ClusterAdapter:
         return self._forward(target["node_id"], spec)
 
     def _spill_if_infeasible(self, spec: dict) -> bool:
-        if spec.get("pg") is not None:
-            return False
         res = spec.get("resources") or {}
         with self.rt.lock:
             if all(self.rt.total.get(k, 0.0) >= v for k, v in res.items()):
@@ -452,6 +506,382 @@ class ClusterAdapter:
         self.watch_many([ObjectID(b) for b in spec["return_ids"]])
         return True
 
+    # ------------------------------------------------------------------
+    # placement groups: cross-node gang scheduling
+    #
+    # Role analog: GcsPlacementGroupManager + GcsPlacementGroupScheduler
+    # (``src/ray/gcs/gcs_server/gcs_placement_group_scheduler.h:111``,
+    # bundle policies ``bundle_scheduling_policy.h``): 2-phase bundle
+    # reservation (prepare on every node, then commit — abort-all on any
+    # failure, so reservation is all-or-nothing), strategy-driven
+    # placement, release + reschedule on node death. The CREATING adapter
+    # owns the protocol; the GCS records decisions and broadcasts updates.
+    # ------------------------------------------------------------------
+
+    def create_pg(self, pg_id: bytes, bundles: List[Dict[str, float]],
+                  strategy: str) -> None:
+        bmap = {i: b for i, b in enumerate(bundles)}
+        last_err = None
+        for attempt in range(3):  # avail races: re-place with a fresh view
+            try:
+                assignment = self._assign_bundles(bmap, strategy)
+            except ValueError as e:
+                raise ValueError(
+                    f"placement group infeasible under {strategy}: {e}"
+                ) from None
+            committed = self._reserve_assignment(pg_id, bmap, assignment)
+            if committed:
+                break
+            last_err = "reservation failed"
+            self._node_view_ts = 0.0  # force-refresh the resource view
+            time.sleep(0.2 * (attempt + 1))
+        else:
+            raise ValueError(
+                f"placement group infeasible under {strategy}: {last_err}")
+        failed = [i for i in range(len(bundles)) if i not in committed]
+        self.gcs.call("pg_register", pg_id, bundles, strategy,
+                      [committed.get(i) for i in range(len(bundles))],
+                      self.node_id, timeout=30)
+        with self._pg_lock:
+            self._pg_nodes[pg_id] = {i: committed.get(i)
+                                     for i in range(len(bundles))}
+            self._pg_meta[pg_id] = {"bundles": bundles, "strategy": strategy}
+            self._my_pgs[pg_id] = {"bundles": bundles, "strategy": strategy}
+            if failed:
+                # commit failed on a live-but-unreachable node: its stage
+                # was aborted — re-place those bundles like a node death
+                self._pg_pending.setdefault(pg_id, set()).update(failed)
+        if failed:
+            self._io.submit(self._pg_reschedule_pending)
+
+    def remove_pg(self, pg_id: bytes) -> None:
+        amap = self._pg_assignment(pg_id)
+        if not isinstance(amap, dict):
+            amap = {}
+        nodes = {nid for nid in amap.values() if nid is not None}
+        nodes.add(self.node_id)
+        for nid in nodes:
+            try:
+                self._pg_call(nid, "pg_release", pg_id)
+            except Exception:
+                pass
+        try:
+            self.gcs.call("pg_remove", pg_id, timeout=10)
+        except Exception:
+            pass
+        with self._pg_lock:
+            self._pg_nodes.pop(pg_id, None)
+            self._pg_meta.pop(pg_id, None)
+            self._my_pgs.pop(pg_id, None)
+            self._pg_pending.pop(pg_id, None)
+            parked = self._pg_parked.pop(pg_id, [])
+        for spec in parked:
+            self._fail_returns(spec, ValueError("placement group removed"))
+
+    def _assign_bundles(self, bundles: Dict[int, Dict[str, float]],
+                        strategy: str,
+                        used_nodes: frozenset = frozenset()
+                        ) -> Dict[int, bytes]:
+        """Pick a node per bundle against the cluster resource view.
+        ``used_nodes``: nodes already holding OTHER bundles of this group
+        (partial reschedule) — STRICT_PACK must join them, STRICT_SPREAD
+        must avoid them. Raises ValueError when infeasible."""
+        nodes = [n for n in self._nodes() if n["alive"]]
+        avail = {n["node_id"]: dict(n["avail"]) for n in nodes}
+        if self.node_id in avail:
+            with self.rt.lock:  # our own view is fresher than heartbeats
+                avail[self.node_id] = dict(self.rt.avail)
+        if not avail:
+            raise ValueError("no alive nodes")
+
+        def fits(nid, res):
+            return all(avail[nid].get(k, 0.0) >= v for k, v in res.items())
+
+        def take(nid, res):
+            for k, v in res.items():
+                avail[nid][k] = avail[nid].get(k, 0.0) - v
+
+        order = sorted(avail, key=lambda nid: -sum(avail[nid].values()))
+        out: Dict[int, bytes] = {}
+        if strategy == "STRICT_PACK":
+            candidates = [n for n in used_nodes if n in avail] or order
+            for nid in candidates:
+                scratch = dict(avail[nid])
+                ok = True
+                for _, res in sorted(bundles.items()):
+                    if not all(scratch.get(k, 0.0) >= v
+                               for k, v in res.items()):
+                        ok = False
+                        break
+                    for k, v in res.items():
+                        scratch[k] -= v
+                if ok:
+                    return {i: nid for i in bundles}
+            raise ValueError("no single node fits all bundles")
+        if strategy in ("STRICT_SPREAD", "SLICE_PACK"):
+            # one bundle per DISTINCT node, all-or-nothing (a multi-host
+            # TPU slice: one bundle per host, SLICE_PACK semantics)
+            for i, res in sorted(bundles.items()):
+                pick = next(
+                    (nid for nid in order
+                     if nid not in used_nodes and nid not in out.values()
+                     and fits(nid, res)), None)
+                if pick is None:
+                    raise ValueError(
+                        f"bundle {i} has no distinct feasible node")
+                out[i] = pick
+                take(pick, res)
+            return out
+        if strategy == "SPREAD":
+            for i, res in sorted(bundles.items()):
+                fresh = [nid for nid in order
+                         if nid not in out.values() and fits(nid, res)]
+                anyn = [nid for nid in order if fits(nid, res)]
+                pick = (fresh or anyn or [None])[0]
+                if pick is None:
+                    raise ValueError(f"bundle {i} fits no node")
+                out[i] = pick
+                take(pick, res)
+            return out
+        # PACK (default): minimize node count — prefer nodes already used
+        for i, res in sorted(bundles.items()):
+            cur = [nid for nid in dict.fromkeys(out.values())
+                   if fits(nid, res)]
+            pick = (cur or [nid for nid in order if fits(nid, res)]
+                    or [None])[0]
+            if pick is None:
+                raise ValueError(f"bundle {i} fits no node")
+            out[i] = pick
+            take(pick, res)
+        return out
+
+    def _pg_call(self, node_id: bytes, method: str, *args):
+        if node_id == self.node_id:
+            return {
+                "pg_prepare": self.rt.pg_prepare,
+                "pg_commit": self.rt.pg_commit,
+                "pg_abort": self.rt.pg_abort,
+                "pg_release": self.rt.pg_release_local,
+            }[method](*args)
+        peer = self._peer(node_id)
+        if peer is None:
+            raise OSError(f"peer {node_id.hex()[:8]} unreachable")
+        return peer.call(method, *args, timeout=30)
+
+    def _reserve_assignment(self, pg_id: bytes,
+                            bundles: Dict[int, Dict[str, float]],
+                            assignment: Dict[int, bytes]
+                            ) -> Optional[Dict[int, bytes]]:
+        """2-phase: prepare on every target node; abort ALL on any prepare
+        failure (atomicity — an infeasible group reserves nothing). Commit
+        is retried; a node whose commit still fails is aborted and its
+        bundles left out of the result so the caller reschedules them —
+        swallowing the failure would let the 30s stage reaper release
+        resources a registered assignment still points at, hanging every
+        task pinned to that bundle. Returns the committed
+        ``{bundle_idx: node_id}`` or None when nothing was reserved."""
+        per_node: Dict[bytes, Dict[int, dict]] = {}
+        for i, nid in assignment.items():
+            per_node.setdefault(nid, {})[i] = bundles[i]
+        prepared: List[bytes] = []
+        ok = True
+        for nid, bmap in per_node.items():
+            try:
+                r = self._pg_call(nid, "pg_prepare", pg_id, bmap)
+            except Exception:
+                r = False
+            if not r:
+                ok = False
+                break
+            prepared.append(nid)
+        if not ok:
+            for nid in prepared:
+                try:
+                    self._pg_call(nid, "pg_abort", pg_id)
+                except Exception:
+                    pass
+            return None
+        committed: Dict[int, bytes] = {}
+        for nid, bmap in per_node.items():
+            done = False
+            for attempt in range(3):
+                try:
+                    self._pg_call(nid, "pg_commit", pg_id)
+                    done = True
+                    break
+                except Exception:
+                    time.sleep(0.2 * (attempt + 1))
+            if done:
+                committed.update({i: nid for i in bmap})
+            else:
+                try:
+                    self._pg_call(nid, "pg_abort", pg_id)
+                except Exception:
+                    pass  # dead node: its daemon's state died with it
+        return committed or None
+
+    def _pg_assignment(self, pg_id: bytes, refresh: bool = False
+                       ) -> Optional[Dict[int, Optional[bytes]]]:
+        """None = the GCS says the group does not exist; ``GCS_UNAVAILABLE``
+        = could not ask (transient) — callers must NOT treat the latter as
+        removal (a cold-cache daemon routing during a GCS restart would
+        terminally fail live work)."""
+        if not refresh:
+            with self._pg_lock:
+                m = self._pg_nodes.get(pg_id)
+            if m is not None:
+                return dict(m)
+        rec = None
+        for attempt in range(3):
+            try:
+                rec = self.gcs.call("pg_get", pg_id, timeout=10)
+                break
+            except Exception:
+                time.sleep(0.3 * (attempt + 1))
+        else:
+            return GCS_UNAVAILABLE
+        if rec is None:
+            return None
+        amap = {i: nid for i, nid in enumerate(rec["assignments"])}
+        with self._pg_lock:
+            self._pg_nodes[pg_id] = dict(amap)
+            self._pg_meta[pg_id] = {"bundles": rec["bundles"],
+                                    "strategy": rec["strategy"]}
+        return amap
+
+    def _route_pg(self, spec: dict) -> bool:
+        """Route a bundle-pinned spec to the node holding its bundle.
+        Returns False to run locally; True when forwarded, parked (bundle
+        lost, awaiting reschedule), or terminally failed."""
+        pg_id = spec["pg"]
+        idx = spec.get("bundle_index", -1)
+        amap = self._pg_assignment(pg_id)
+        if amap is GCS_UNAVAILABLE:
+            # transient GCS outage, not removal: retry shortly
+            t = threading.Timer(2.0, lambda: self.rt.submit_spec(spec))
+            t.daemon = True
+            t.start()
+            return True
+        if amap is None:
+            with self.rt.lock:
+                if pg_id in self.rt.pgs:
+                    return False  # locally-known group (pre-cluster)
+            self._fail_returns(spec, ValueError(
+                "placement group not found (removed?)"))
+            return True
+        if idx >= 0:
+            target = amap.get(idx)
+            if target is None:
+                self._park_pg_spec(pg_id, spec)  # lost bundle: reschedule
+                return True
+            if target == self.node_id:
+                return False
+            if self._forward(target, spec):
+                return True
+            self._park_pg_spec(pg_id, spec)
+            return True
+        # any-bundle: round-robin over nodes whose bundle TOTALS fit the
+        # request (live availability is enforced by the executing node)
+        with self._pg_lock:
+            meta = self._pg_meta.get(pg_id) or {}
+        bundles = meta.get("bundles") or []
+        res = spec.get("resources") or {}
+        cands = []
+        for i, nid in sorted(amap.items()):
+            if nid is None or i >= len(bundles) or nid in cands:
+                continue
+            if all(bundles[i].get(k, 0.0) >= v for k, v in res.items()):
+                cands.append(nid)
+        if not cands:
+            self._fail_returns(spec, ValueError(
+                "no bundle in the placement group fits the request"))
+            return True
+        self._pg_rr += 1
+        pick = cands[self._pg_rr % len(cands)]
+        if pick == self.node_id:
+            return False
+        if self._forward(pick, spec):
+            return True
+        for nid in cands:  # fallback sweep
+            if nid == self.node_id:
+                return False
+            if self._forward(nid, spec):
+                return True
+        self._park_pg_spec(pg_id, spec)
+        return True
+
+    def _park_pg_spec(self, pg_id: bytes, spec: dict) -> None:
+        with self._pg_lock:
+            self._pg_parked.setdefault(pg_id, []).append(spec)
+
+    def _on_pg_event(self, payload: dict) -> None:
+        pg_id = payload["pg_id"]
+        if payload.get("event") == "removed":
+            with self._pg_lock:
+                self._pg_nodes.pop(pg_id, None)
+                self._pg_meta.pop(pg_id, None)
+                self._my_pgs.pop(pg_id, None)
+                self._pg_pending.pop(pg_id, None)
+                parked = self._pg_parked.pop(pg_id, [])
+            for spec in parked:
+                self._fail_returns(spec, ValueError("placement group removed"))
+            self.rt.pg_release_local(pg_id)  # idempotent local cleanup
+            return
+        amap = {i: nid for i, nid in enumerate(payload["assignments"])}
+        with self._pg_lock:
+            if pg_id in self._pg_nodes or pg_id in self._pg_parked:
+                self._pg_nodes[pg_id] = dict(amap)
+            parked = self._pg_parked.pop(pg_id, [])
+        back = []
+        for spec in parked:
+            idx = spec.get("bundle_index", -1)
+            if idx >= 0 and amap.get(idx) is None:
+                back.append(spec)  # still unplaced
+            else:
+                self.rt.submit_spec(spec)  # re-enters routing
+        if back:
+            with self._pg_lock:
+                self._pg_parked.setdefault(pg_id, []).extend(back)
+
+    def _pg_reschedule_pending(self) -> None:
+        """Re-place bundles lost to node death for groups WE created."""
+        with self._pg_lock:
+            pending = {pg: set(idxs)
+                       for pg, idxs in self._pg_pending.items() if idxs}
+        for pg_id, idxs in pending.items():
+            meta = self._my_pgs.get(pg_id)
+            if meta is None:
+                continue
+            bundles = {i: meta["bundles"][i] for i in idxs}
+            amap = self._pg_assignment(pg_id, refresh=True)
+            if not isinstance(amap, dict):
+                continue  # GCS unreachable or group gone: next trigger
+            used = frozenset(nid for i, nid in amap.items()
+                             if nid is not None and i not in idxs)
+            self._node_view_ts = 0.0
+            try:
+                newa = self._assign_bundles(bundles, meta["strategy"],
+                                            used_nodes=used)
+            except ValueError:
+                continue  # infeasible now; retried on the next node-up
+            committed = self._reserve_assignment(pg_id, bundles, newa)
+            if not committed:
+                continue
+            try:
+                self.gcs.call("pg_update_assignment", pg_id,
+                              {i: nid for i, nid in committed.items()},
+                              timeout=30)
+            except Exception:
+                pass
+            with self._pg_lock:
+                m = self._pg_nodes.setdefault(pg_id, {})
+                m.update(committed)
+                rem = self._pg_pending.get(pg_id)
+                if rem:
+                    rem.difference_update(committed)
+            logger.info("rescheduled %d bundle(s) of pg %s",
+                        len(committed), pg_id.hex()[:8])
+
     def route_actor_call(self, spec: dict) -> bool:
         """Forward an actor method call to the hosting node. Returns True
         when handled (including terminal failure)."""
@@ -502,6 +932,24 @@ class ClusterAdapter:
     # ------------------------------------------------------------------
     # actor + name + fn + kv global mirrors
     # ------------------------------------------------------------------
+
+    def cancel_remote(self, oid_b: bytes, force: bool = False) -> bool:
+        """Route a cancel to the node actually running the task (it was
+        forwarded there). True when delivered — the peer's normal
+        done(error) path resolves the refs globally."""
+        with self._forwarded_lock:
+            ent = self._fwd_by_oid.get(oid_b)
+        if ent is None:
+            return False
+        node_id, _task_id = ent
+        peer = self._peer(node_id)
+        if peer is None:
+            return False
+        try:
+            peer.call("cancel_task", oid_b, force, timeout=10)
+            return True
+        except Exception:
+            return False
 
     def kill_remote_actor(self, actor_id: bytes, no_restart: bool):
         node_id = self._remote_actors.get(actor_id)
@@ -618,6 +1066,19 @@ class ClusterAdapter:
                     f"node {node_id.hex()[:8]} died running task"))
         for aid in dead_actors:
             self._remote_actors.pop(aid, None)
+        lost_pgs = payload.get("lost_pgs") or {}
+        mine = False
+        with self._pg_lock:
+            for pg_id, idxs in lost_pgs.items():
+                m = self._pg_nodes.get(pg_id)
+                if m is not None:
+                    for i in idxs:
+                        m[i] = None
+                if pg_id in self._my_pgs:
+                    self._pg_pending.setdefault(pg_id, set()).update(idxs)
+                    mine = True
+        if mine:
+            self._pg_reschedule_pending()
 
     # ------------------------------------------------------------------
 

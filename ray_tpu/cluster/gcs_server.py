@@ -29,7 +29,8 @@ PENDING, READY, ERROR = "PENDING", "READY", "ERROR"
 
 
 class _GlobalObject:
-    __slots__ = ("status", "inline", "error", "size", "locations")
+    __slots__ = ("status", "inline", "error", "size", "locations",
+                 "pins", "was_pinned", "t_terminal")
 
     def __init__(self):
         self.status = PENDING
@@ -37,6 +38,13 @@ class _GlobalObject:
         self.error: Optional[bytes] = None
         self.size = 0
         self.locations: Set[bytes] = set()  # node ids holding the segment
+        # distributed refcount (reference reference_count.h:61 role):
+        # nodes with >=1 live reference. Pinned entries are never evicted;
+        # when the LAST pin drops on a terminal object that was ever
+        # pinned, holders are told to free their segments.
+        self.pins: Set[bytes] = set()
+        self.was_pinned = False
+        self.t_terminal = 0.0
 
 
 class _NodeEntry:
@@ -64,11 +72,18 @@ class GcsService:
         self.objects: Dict[bytes, _GlobalObject] = {}
         self.max_objects = int(os.environ.get("RTPU_GCS_MAX_OBJECTS",
                                               "200000"))
+        self.evict_min_age_s = float(os.environ.get(
+            "RTPU_GCS_EVICT_MIN_AGE_S", "30"))
         self.kv: Dict[str, Dict[str, bytes]] = {}
         self.functions: Dict[str, bytes] = {}
         # named/global actor registry: actor_id -> record dict
         self.actors: Dict[bytes, Dict[str, Any]] = {}
         self.named_actors: Dict[str, bytes] = {}
+        # placement groups (reference GcsPlacementGroupManager): pg_id ->
+        # {"bundles": [res dicts], "strategy", "assignments": [node_id or
+        # None per bundle], "creator": node_id}. The GCS records placement
+        # decisions; the 2-phase reservation itself runs creator->daemons.
+        self.pgs: Dict[bytes, Dict[str, Any]] = {}
         self.node_timeout_s = node_timeout_s
         self.server: Optional[RpcServer] = None
         self._stop = threading.Event()
@@ -97,6 +112,7 @@ class GcsService:
         self.functions = snap.get("functions", {})
         self.actors = snap.get("actors", {})
         self.named_actors = snap.get("named_actors", {})
+        self.pgs = snap.get("pgs", {})
 
     def _snapshot_loop(self):
         import os
@@ -110,7 +126,8 @@ class GcsService:
                         "functions": dict(self.functions),
                         "actors": {a: dict(r)
                                    for a, r in self.actors.items()},
-                        "named_actors": dict(self.named_actors)}
+                        "named_actors": dict(self.named_actors),
+                        "pgs": {p: dict(r) for p, r in self.pgs.items()}}
                 self._dirty = False
             tmp = f"{self.snapshot_path}.tmp-{os.getpid()}"
             try:
@@ -187,6 +204,15 @@ class GcsService:
                 o = self.objects[oid]
                 o.status = PENDING
                 o.locations.discard(node_id)
+            # a dead node's references die with it; objects it alone kept
+            # alive are freed on the surviving holders
+            freed_objs = []
+            for oid, o in list(self.objects.items()):
+                if node_id in o.pins:
+                    o.pins.discard(node_id)
+                    locs = self._maybe_free_locked(oid, o)
+                    if locs:
+                        freed_objs.append((oid, locs))
             # actors hosted there are dead (restart is the owner's call)
             dead_actors = [aid for aid, rec in self.actors.items()
                            if rec.get("node_id") == node_id
@@ -196,9 +222,25 @@ class GcsService:
                 name = self.actors[aid].get("name")
                 if name:
                     self.named_actors.pop(name, None)
+            # bundles reserved there are released (reference
+            # gcs_placement_group_scheduler node-death bundle release);
+            # the creating adapter reschedules them on live nodes
+            lost_pgs: Dict[bytes, list] = {}
+            for pg_id, rec in self.pgs.items():
+                idxs = [i for i, nid in enumerate(rec["assignments"])
+                        if nid == node_id]
+                if idxs:
+                    for i in idxs:
+                        rec["assignments"][i] = None
+                    lost_pgs[pg_id] = idxs
+                    self._dirty = True
+        for oid, locs in freed_objs:
+            self._publish("objects", {"oid": oid, "freed": True,
+                                      "locations": locs})
         self._publish("nodes", {"event": "down", "node_id": node_id,
                                 "cause": cause, "lost_objects": lost,
-                                "dead_actors": dead_actors})
+                                "dead_actors": dead_actors,
+                                "lost_pgs": lost_pgs})
 
     def _health_loop(self):
         while not self._stop.wait(DEFAULT_HEARTBEAT_S):
@@ -221,6 +263,7 @@ class GcsService:
 
     def rpc_obj_ready(self, ctx, oid: bytes, inline: Optional[bytes],
                       node_id: Optional[bytes], size: int = 0):
+        freed = None
         with self.lock:
             o = self._obj(oid)
             if o.status == ERROR:
@@ -228,9 +271,18 @@ class GcsService:
             o.status = READY
             o.inline = inline
             o.size = size
+            o.t_terminal = time.monotonic()
             if node_id is not None and inline is None:
                 o.locations.add(node_id)
+            # every ref was already dropped while the task ran
+            # (fire-and-forget): free on the terminal transition — unpin
+            # alone never re-checks a then-PENDING entry
+            freed = self._maybe_free_locked(oid, o)
             self._maybe_evict_locked()
+        if freed is not None:
+            self._publish("objects", {"oid": oid, "freed": True,
+                                      "locations": freed})
+            return True
         # the broadcast is a NOTIFICATION, not a payload channel: inline
         # bytes stay on the server (interested adapters fetch via
         # obj_state), so completion traffic stays O(nodes), not
@@ -239,29 +291,67 @@ class GcsService:
         return True
 
     def rpc_obj_error(self, ctx, oid: bytes, err: bytes):
+        freed = None
         with self.lock:
             o = self._obj(oid)
             o.status = ERROR
             o.error = err
+            o.t_terminal = time.monotonic()
+            freed = self._maybe_free_locked(oid, o)
             self._maybe_evict_locked()
+        if freed is not None:
+            self._publish("objects", {"oid": oid, "freed": True,
+                                      "locations": freed})
+            return True
         self._publish("objects", {"oid": oid, "status": ERROR})
         return True
 
     def _maybe_evict_locked(self):
-        """Bound the directory: evict the oldest TERMINAL entries past the
-        cap. Proper lifetime management is distributed refcounting
-        (reference reference_count.h) — future work; the cap keeps a
-        long-running cluster from growing the GCS without limit."""
+        """Bound the directory: evict old TERMINAL entries past the cap —
+        but NEVER one some node still references (pins) and never one that
+        turned terminal within the age floor (a consumer may be between
+        its subscribe and its pin; reference reference_count.h role)."""
         if len(self.objects) <= self.max_objects:
             return
+        now = time.monotonic()
         drop = []
-        for oid, o in self.objects.items():  # insertion order
-            if o.status in (READY, ERROR):
+        for oid, o in self.objects.items():  # insertion order = oldest first
+            if (o.status in (READY, ERROR) and not o.pins
+                    and now - o.t_terminal >= self.evict_min_age_s):
                 drop.append(oid)
                 if len(self.objects) - len(drop) <= self.max_objects * 0.9:
                     break
         for oid in drop:
             del self.objects[oid]
+
+    def rpc_obj_pin(self, ctx, oid: bytes, node_id: bytes):
+        with self.lock:
+            o = self._obj(oid)
+            o.pins.add(node_id)
+            o.was_pinned = True
+        return True
+
+    def rpc_obj_unpin(self, ctx, oid: bytes, node_id: bytes):
+        freed = None
+        with self.lock:
+            o = self.objects.get(oid)
+            if o is None:
+                return False
+            o.pins.discard(node_id)
+            freed = self._maybe_free_locked(oid, o)
+        if freed is not None:
+            self._publish("objects", {"oid": oid, "freed": True,
+                                      "locations": freed})
+        return True
+
+    def _maybe_free_locked(self, oid: bytes, o: _GlobalObject):
+        """Last pin dropped on a terminal, previously-referenced object:
+        drop the entry and return holder nodes so they free segments."""
+        if o.pins or not o.was_pinned or o.status not in (READY, ERROR):
+            return None
+        locations = list(o.locations)
+        del self.objects[oid]
+        return locations
 
     def rpc_obj_state(self, ctx, oid: bytes):
         with self.lock:
@@ -367,6 +457,51 @@ class GcsService:
     def rpc_actor_list(self, ctx):
         with self.lock:
             return {aid: dict(rec) for aid, rec in self.actors.items()}
+
+    # -- placement groups ------------------------------------------------
+
+    def rpc_pg_register(self, ctx, pg_id: bytes, bundles, strategy: str,
+                        assignments, creator: bytes):
+        with self.lock:
+            self.pgs[pg_id] = {"bundles": [dict(b) for b in bundles],
+                               "strategy": strategy,
+                               "assignments": list(assignments),
+                               "creator": creator}
+            self._dirty = True
+        self._publish("pgs", {"event": "update", "pg_id": pg_id,
+                              "assignments": list(assignments)})
+        return True
+
+    def rpc_pg_get(self, ctx, pg_id: bytes):
+        with self.lock:
+            rec = self.pgs.get(pg_id)
+            return dict(rec) if rec else None
+
+    def rpc_pg_update_assignment(self, ctx, pg_id: bytes, updates):
+        """``updates``: {bundle_idx: node_id} after a reschedule."""
+        with self.lock:
+            rec = self.pgs.get(pg_id)
+            if rec is None:
+                return False
+            for i, nid in updates.items():
+                rec["assignments"][int(i)] = nid
+            assignments = list(rec["assignments"])
+            self._dirty = True
+        self._publish("pgs", {"event": "update", "pg_id": pg_id,
+                              "assignments": assignments})
+        return True
+
+    def rpc_pg_remove(self, ctx, pg_id: bytes):
+        with self.lock:
+            rec = self.pgs.pop(pg_id, None)
+            self._dirty = True
+        if rec is not None:
+            self._publish("pgs", {"event": "removed", "pg_id": pg_id})
+        return True
+
+    def rpc_pg_list(self, ctx):
+        with self.lock:
+            return {p: dict(r) for p, r in self.pgs.items()}
 
     # -- pubsub ---------------------------------------------------------
 

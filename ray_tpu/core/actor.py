@@ -29,7 +29,7 @@ class ActorMethod:
         from ray_tpu.core.runtime import _get_runtime
 
         rt = _get_runtime()
-        enc_args, enc_kwargs = ts.encode_args(args, kwargs, rt)
+        enc_args, enc_kwargs, nested_refs = ts.encode_args(args, kwargs, rt)
         num_returns = self._options.get("num_returns", 1)
         streaming = num_returns in ("streaming", "dynamic")
         spec = ts.make_actor_method_spec(
@@ -39,12 +39,19 @@ class ActorMethod:
             enc_kwargs,
             num_returns=1 if streaming else int(num_returns),
         )
+        if nested_refs:
+            spec["borrowed"] = nested_refs
         if streaming:
             from ray_tpu.core.object_ref import ObjectRefGenerator
 
             spec["streaming"] = True
+            bp = self._options.get("_generator_backpressure_num_objects")
+            if bp:
+                spec["stream_backpressure"] = int(bp)
             refs = rt.submit_actor_task(spec)
-            return ObjectRefGenerator(spec["task_id"], refs[0])
+            return ObjectRefGenerator(
+                spec["task_id"], refs[0],
+                backpressured=bool(spec.get("stream_backpressure")))
         refs = rt.submit_actor_task(spec)
         return refs[0] if num_returns == 1 else refs
 
@@ -122,7 +129,7 @@ class ActorClass:
 
         rt = _get_runtime()
         rt.ensure_fn(self._cls_hash, self._cls_blob)
-        enc_args, enc_kwargs = ts.encode_args(args, kwargs, rt)
+        enc_args, enc_kwargs, nested_refs = ts.encode_args(args, kwargs, rt)
         pg, bundle_index = _pg_options(self._options)
         renv = self._options.get("runtime_env")
         if renv:
@@ -144,6 +151,8 @@ class ActorClass:
             bundle_index=bundle_index,
             runtime_env=self._options.get("runtime_env"),
         )
+        if nested_refs:
+            spec["borrowed"] = nested_refs
         from ray_tpu.core.remote_function import _strategy_spec
 
         strat = _strategy_spec(self._options)
@@ -161,6 +170,7 @@ class ActorClass:
 
         has_async = any(
             inspect.iscoroutinefunction(getattr(self._cls, n, None))
+            or inspect.isasyncgenfunction(getattr(self._cls, n, None))
             for n in dir(self._cls) if not n.startswith("_"))
         return 100 if has_async else 1
 

@@ -77,6 +77,10 @@ class Gcs:
         # it. Must be non-blocking (they cast over a socket).
         self.on_object_ready: Optional[Callable[[ObjectID, Optional[bytes], int], None]] = None
         self.on_object_error: Optional[Callable[[ObjectID, bytes], None]] = None
+        # Fired on EVERY terminal transition (local or delivered), the one
+        # choke point all completion paths share — the runtime releases
+        # task-argument reference pins here.
+        self.on_terminal: Optional[Callable[[ObjectID], None]] = None
 
     # -- function table ---------------------------------------------------
 
@@ -133,6 +137,8 @@ class Gcs:
             self._cv.notify_all()
         if self.on_object_ready is not None and not _local_only:
             self.on_object_ready(obj_id, inline, st.size)
+        if self.on_terminal is not None:
+            self.on_terminal(obj_id)
 
     def mark_error(self, obj_id: ObjectID, err_blob: bytes,
                    _local_only: bool = False) -> None:
@@ -144,6 +150,8 @@ class Gcs:
             self._cv.notify_all()
         if self.on_object_error is not None and not _local_only:
             self.on_object_error(obj_id, err_blob)
+        if self.on_terminal is not None:
+            self.on_terminal(obj_id)
 
     def object_state(self, obj_id: ObjectID) -> Optional[ObjectState]:
         with self.lock:

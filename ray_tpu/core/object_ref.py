@@ -7,14 +7,69 @@ from __future__ import annotations
 
 from ray_tpu.core.ids import ObjectID
 
+# Process-local reference hook (reference ReferenceCounter,
+# ``src/ray/core_worker/reference_count.h:61`` role): every live ObjectRef
+# instance counts as one local reference. The driver runtime / worker
+# installs (on_add, on_del); processes that never handle refs (GCS) keep
+# the no-op default. Distributed liveness: local 0<->1 transitions become
+# node-level pins at the cluster directory.
+_ref_hook = None
+
+
+def set_ref_hook(on_add, on_del) -> None:
+    global _ref_hook
+    _ref_hook = (on_add, on_del)
+
+
+def clear_ref_hook() -> None:
+    global _ref_hook
+    _ref_hook = None
+
+
+# Serialization-time ref collection: while a collector list is active on
+# this thread, every ObjectRef that gets pickled records its id. Task-arg
+# encoding uses this to pin refs NESTED inside inline values (the
+# reference's "borrowed references in serialized arguments").
+import threading as _threading
+
+_collect = _threading.local()
+
+
+class collect_serialized_refs:
+    def __enter__(self):
+        self.prev = getattr(_collect, "refs", None)
+        _collect.refs = []
+        return _collect.refs
+
+    def __exit__(self, *exc):
+        _collect.refs = self.prev
+        return False
+
 
 class ObjectRef:
-    __slots__ = ("id", "owner", "_task_id")
+    __slots__ = ("id", "owner", "_task_id", "_counted")
 
     def __init__(self, object_id: ObjectID, owner: str = "", task_id=None):
         self.id = object_id
         self.owner = owner
         self._task_id = task_id
+        self._counted = False
+        hook = _ref_hook
+        if hook is not None:
+            try:
+                hook[0](object_id.binary())
+                self._counted = True
+            except Exception:
+                pass
+
+    def __del__(self):
+        if self._counted:
+            hook = _ref_hook
+            if hook is not None:
+                try:
+                    hook[1](self.id.binary())
+                except Exception:
+                    pass
 
     def binary(self) -> bytes:
         return self.id.binary()
@@ -35,6 +90,9 @@ class ObjectRef:
         return f"ObjectRef({self.id.hex()})"
 
     def __reduce__(self):
+        refs = getattr(_collect, "refs", None)
+        if refs is not None:
+            refs.append(self.id.binary())
         return (ObjectRef, (self.id, self.owner, self._task_id))
 
     # ``await ref`` support inside async actors / drivers.

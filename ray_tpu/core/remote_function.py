@@ -95,7 +95,7 @@ class RemoteFunction:
 
         rt = _get_runtime()
         rt.ensure_fn(self._fn_hash, self._fn_blob)
-        enc_args, enc_kwargs = ts.encode_args(args, kwargs, rt)
+        enc_args, enc_kwargs, nested_refs = ts.encode_args(args, kwargs, rt)
         pg, bundle_index = _pg_options(self._options)
         renv = self._options.get("runtime_env")
         if renv:
@@ -118,6 +118,8 @@ class RemoteFunction:
             bundle_index=bundle_index,
             runtime_env=self._options.get("runtime_env"),
         )
+        if nested_refs:
+            spec["borrowed"] = nested_refs
         strat = _strategy_spec(self._options)
         if strat is not None:
             spec["strategy"] = strat

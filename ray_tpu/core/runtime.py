@@ -58,7 +58,7 @@ class _WorkerState:
     __slots__ = (
         "worker_id", "proc", "conn", "kind", "status", "current",
         "held", "actor_id", "reader", "released", "send_lock", "log_path",
-        "pending_spec", "inflight_specs",
+        "pending_spec", "inflight_specs", "pinned",
     )
 
     def __init__(self, worker_id: WorkerID, proc, kind: str):
@@ -77,6 +77,8 @@ class _WorkerState:
         # all dispatched-but-unfinished specs keyed by task id (>1 only for
         # actors with max_concurrency > 1)
         self.inflight_specs: Dict[bytes, dict] = {}
+        # objects this worker process borrows (oid -> transition count)
+        self.pinned: Dict[bytes, int] = {}
 
     def send(self, msg):
         if self.conn is None:
@@ -148,7 +150,16 @@ class DriverRuntime:
         self.ready_tasks: deque = deque()
         self.waiting_specs: Dict[bytes, dict] = {}
         self.cancelled: set = set()
-        self.pgs: Dict[bytes, dict] = {}  # pg_id -> {"bundles": [avail dicts], "totals": [...]}
+        # pg_id -> {"bundles": {global idx: avail dict}, "totals": {...}}.
+        # Keyed by GLOBAL bundle index: in cluster mode a node holds only
+        # the bundles reserved on it (reference
+        # placement_group_resource_manager.h role).
+        self.pgs: Dict[bytes, dict] = {}
+        # 2-phase reservation staging (reference GCS placement group
+        # scheduler's prepare/commit, gcs_placement_group_scheduler.h:111):
+        # resources are deducted at prepare, become a live pg at commit,
+        # and return at abort (or reap, if the creator died mid-protocol).
+        self._pg_staged: Dict[bytes, dict] = {}
         self.timeline_events: List[dict] = []
         self._task_start_ts: Dict[bytes, float] = {}
         self.pool_cap = max(4, cpus)
@@ -173,6 +184,23 @@ class DriverRuntime:
         self._stream_consumed: Dict[bytes, int] = {}
         self._stream_waiters: List[tuple] = []  # (task_id, need, reply)
         self._stream_cv = threading.Condition(self.lock)
+
+        # Distributed object lifetime (reference ReferenceCounter,
+        # reference_count.h:61 role): per-object pin counts aggregate
+        # (a) live ObjectRef instances in THIS process, (b) worker-reported
+        # borrows, (c) task-argument pins held from submit until the task's
+        # first return turns terminal. Node-level 0<->1 transitions are
+        # reported to the cluster directory, which never evicts pinned
+        # entries and tells holders to free segments on the last unpin.
+        self._ref_lock = threading.Lock()
+        self._pin_total: Dict[bytes, int] = {}
+        self._arg_pins: Dict[bytes, List[bytes]] = {}
+        from ray_tpu.core import object_ref as _object_ref
+
+        _object_ref.set_ref_hook(
+            lambda b: self._pin_delta(b, 1),
+            lambda b: self._pin_delta(b, -1))
+        self.gcs.on_terminal = self._release_arg_pins
 
         self._lineage: Dict[bytes, dict] = {}
         self._lineage_cap = int(os.environ.get("RTPU_LINEAGE_MAX", "100000"))
@@ -365,6 +393,8 @@ class DriverRuntime:
                 return
             was = ws.status
             ws.status = "dead"
+        self._drop_worker_pins(ws)
+        with self.lock:
             if not ws.released:
                 self._release(ws.held)
             spec = ws.current
@@ -576,9 +606,12 @@ class DriverRuntime:
         elif op == "kill_actor":
             self.kill_actor(args[0], args[1])
         elif op == "cancel":
-            self.cancel_task(ObjectID(args[0]))
+            self.cancel_task(ObjectID(args[0]),
+                             args[1] if len(args) > 1 else False)
         elif op == "stream_consumed":
             self.stream_consumed(args[0], args[1])
+        elif op == "refpin":
+            self.worker_ref_delta(ws, args[0], args[1])
         elif op == "free":
             for b in args[0]:
                 oid = ObjectID(b)
@@ -660,14 +693,88 @@ class DriverRuntime:
             elif op == "nodes":
                 reply(self.node_info())
             elif op == "pg_create":
-                reply(self.create_placement_group(args[0], args[1]))
+                # cluster mode reserves bundles over the network: offload
+                self._reply_offloaded(
+                    reply,
+                    lambda: self.create_placement_group(args[0], args[1]))
             elif op == "pg_remove":
-                self.remove_placement_group(args[0])
-                reply(None)
+                def _rm(pg_id=args[0]):
+                    self.remove_placement_group(pg_id)
+
+                self._reply_offloaded(reply, _rm)
             else:
                 reply(None, RuntimeError(f"unknown op {op}"))
         except BaseException as e:  # noqa: BLE001
             reply(None, e)
+
+    # ------------------------------------------------------------------
+    # object reference pins
+    # ------------------------------------------------------------------
+
+    def _pin_delta(self, oid_b: bytes, d: int) -> None:
+        if self._shutdown:
+            return
+        with self._ref_lock:
+            before = self._pin_total.get(oid_b, 0)
+            after = before + d
+            if after > 0:
+                self._pin_total[oid_b] = after
+            else:
+                self._pin_total.pop(oid_b, None)
+            # notify INSIDE the lock: pin/unpin casts must reach the
+            # directory in transition order or a 1->0->1 race could leave
+            # a live object unpinned remotely
+            if self.cluster is not None:
+                if before == 0 and after > 0:
+                    self.cluster.pin_object(oid_b)
+                elif before > 0 and after <= 0:
+                    self.cluster.unpin_object(oid_b)
+
+    def _pin_args(self, spec: dict) -> None:
+        """Pin a spec's argument objects until its first return is
+        terminal — a submitted task keeps its args alive even when the
+        caller dropped every ObjectRef (reference 'submitted task
+        reference' semantics)."""
+        deps = ts.arg_refs(spec["args"], spec["kwargs"])
+        borrowed = spec.get("borrowed") or []
+        if (not deps and not borrowed) or not spec["return_ids"]:
+            return
+        key = spec["return_ids"][0]
+        with self._ref_lock:
+            already = key in self._arg_pins
+        if already:
+            return  # resubmission (retry/reconstruction): pins survive
+        dep_bytes = [d.binary() for d in deps] + list(borrowed)
+        with self._ref_lock:
+            self._arg_pins[key] = dep_bytes
+        for b in dep_bytes:
+            self._pin_delta(b, 1)
+
+    def _release_arg_pins(self, oid: ObjectID) -> None:
+        with self._ref_lock:
+            deps = self._arg_pins.pop(oid.binary(), None)
+        if deps:
+            for b in deps:
+                self._pin_delta(b, -1)
+
+    def worker_ref_delta(self, ws, oid_b: bytes, d: int) -> None:
+        """A worker reported a borrow transition (0<->1 in that process)."""
+        if d > 0:
+            ws.pinned[oid_b] = ws.pinned.get(oid_b, 0) + 1
+        else:
+            n = ws.pinned.get(oid_b, 0) - 1
+            if n <= 0:
+                ws.pinned.pop(oid_b, None)
+            else:
+                ws.pinned[oid_b] = n
+        self._pin_delta(oid_b, d)
+
+    def _drop_worker_pins(self, ws) -> None:
+        pins = ws.pinned
+        ws.pinned = {}
+        for oid_b, n in pins.items():
+            for _ in range(n):
+                self._pin_delta(oid_b, -1)
 
     # ------------------------------------------------------------------
     # lineage reconstruction
@@ -856,11 +963,14 @@ class DriverRuntime:
             if pgs is None:
                 return False
             if bundle >= 0:
-                pool = pgs["bundles"][bundle]
+                pool = pgs["bundles"].get(bundle)
+                if pool is None:
+                    return False  # bundle reserved on another node
                 return all(pool.get(k, 0.0) >= v for k, v in res.items())
-            # any-bundle: fits in some single bundle
+            # any-bundle: fits in some single locally-held bundle
             return any(
-                all(b.get(k, 0.0) >= v for k, v in res.items()) for b in pgs["bundles"]
+                all(b.get(k, 0.0) >= v for k, v in res.items())
+                for b in pgs["bundles"].values()
             )
         return all(self.avail.get(k, 0.0) >= v for k, v in res.items())
 
@@ -873,7 +983,7 @@ class DriverRuntime:
             if idx < 0:
                 idx = next(
                     i
-                    for i, b in enumerate(pgs["bundles"])
+                    for i, b in sorted(pgs["bundles"].items())
                     if all(b.get(k, 0.0) >= v for k, v in res.items())
                 )
             pool = pgs["bundles"][idx]
@@ -925,33 +1035,105 @@ class DriverRuntime:
     def create_placement_group(self, bundles: List[Dict[str, float]], strategy: str) -> bytes:
         from ray_tpu.core.ids import PlacementGroupID
 
+        pg_id = PlacementGroupID.from_random().binary()
+        if self.cluster is not None:
+            # cluster mode: bundles gang-reserve ACROSS nodes via 2-phase
+            # prepare/commit (raises when infeasible, nothing reserved)
+            self.cluster.create_pg(pg_id, [dict(b) for b in bundles],
+                                   strategy)
+            return pg_id
         with self.lock:
+            scratch = dict(self.avail)
             for b in bundles:
                 for k, v in b.items():
-                    if self.avail.get(k, 0.0) < v:
+                    if scratch.get(k, 0.0) < v:
                         raise ValueError(
                             f"cannot reserve bundle {b}: insufficient {k} "
-                            f"(avail {self.avail.get(k, 0.0)})"
+                            f"(avail {scratch.get(k, 0.0)})"
                         )
-            pg_id = PlacementGroupID.from_random().binary()
+                    scratch[k] -= v
             for b in bundles:
                 for k, v in b.items():
                     self.avail[k] -= v
             self.pgs[pg_id] = {
-                "bundles": [dict(b) for b in bundles],
-                "totals": [dict(b) for b in bundles],
+                "bundles": {i: dict(b) for i, b in enumerate(bundles)},
+                "totals": {i: dict(b) for i, b in enumerate(bundles)},
                 "strategy": strategy,
             }
             return pg_id
 
     def remove_placement_group(self, pg_id: bytes) -> None:
+        if self.cluster is not None:
+            self.cluster.remove_pg(pg_id)
+            return
+        self.pg_release_local(pg_id)
+
+    # -- cluster-facing 2-phase reservation (called by the adapter / peers)
+
+    def pg_prepare(self, pg_id: bytes,
+                   bundle_map: Dict[int, Dict[str, float]]) -> bool:
+        """Phase 1: atomically reserve this node's share of a group.
+        Resources leave ``avail`` now so no concurrent task or competing
+        group can take them before commit."""
+        with self.lock:
+            if pg_id in self._pg_staged or pg_id in self.pgs:
+                return False  # duplicate prepare
+            need: Dict[str, float] = {}
+            for b in bundle_map.values():
+                for k, v in b.items():
+                    need[k] = need.get(k, 0.0) + v
+            if not all(self.avail.get(k, 0.0) >= v for k, v in need.items()):
+                return False
+            for k, v in need.items():
+                self.avail[k] -= v
+            self._pg_staged[pg_id] = {
+                "bundles": {int(i): dict(b) for i, b in bundle_map.items()},
+                "ts": time.monotonic(),
+            }
+        return True
+
+    def pg_commit(self, pg_id: bytes) -> bool:
+        with self.lock:
+            st = self._pg_staged.pop(pg_id, None)
+            if st is None:
+                return False
+            ent = self.pgs.setdefault(
+                pg_id, {"bundles": {}, "totals": {}, "strategy": ""})
+            for i, b in st["bundles"].items():
+                ent["bundles"][i] = dict(b)
+                ent["totals"][i] = dict(b)
+        self._pump()
+        return True
+
+    def pg_abort(self, pg_id: bytes) -> None:
+        with self.lock:
+            st = self._pg_staged.pop(pg_id, None)
+            if st is None:
+                return
+            for b in st["bundles"].values():
+                for k, v in b.items():
+                    self.avail[k] = self.avail.get(k, 0.0) + v
+
+    def pg_release_local(self, pg_id: bytes) -> None:
+        """Release every bundle of ``pg_id`` held on THIS node."""
+        self.pg_abort(pg_id)  # staged-but-uncommitted share, if any
         with self.lock:
             pgs = self.pgs.pop(pg_id, None)
             if pgs is None:
                 return
-            for b in pgs["totals"]:
+            for b in pgs["totals"].values():
                 for k, v in b.items():
                     self.avail[k] = self.avail.get(k, 0.0) + v
+
+    def reap_stale_pg_stages(self, max_age_s: float = 30.0) -> None:
+        """Abort prepared-but-never-committed reservations (creator died
+        mid-protocol) so their resources don't leak."""
+        now = time.monotonic()
+        with self.lock:
+            stale = [pid for pid, st in self._pg_staged.items()
+                     if now - st["ts"] > max_age_s]
+        for pid in stale:
+            self.pg_abort(pid)
 
     # ------------------------------------------------------------------
     # submission + dispatch
@@ -965,6 +1147,7 @@ class DriverRuntime:
     def submit_spec(self, spec: dict) -> List[ObjectRef]:
         tid = TaskID(spec["task_id"])
         deps = ts.arg_refs(spec["args"], spec["kwargs"])
+        self._pin_args(spec)
         if self.cluster is not None and self.cluster.maybe_forward_task(spec):
             # executes on a peer node; track refs locally + watch globally
             for rid in spec["return_ids"]:
@@ -995,6 +1178,7 @@ class DriverRuntime:
         return [ObjectRef(ObjectID(b), task_id=tid) for b in spec["return_ids"]]
 
     def _submit_actor_spec(self, spec: dict) -> List[ObjectRef]:
+        self._pin_args(spec)
         if (self.cluster is not None
                 and self.gcs.get_actor(ActorID(spec["actor_id"])) is None
                 and self.cluster.route_actor_call(spec)):
@@ -1183,14 +1367,19 @@ class DriverRuntime:
     def put(self, value: Any) -> ObjectRef:
         oid = ObjectID.from_random()
         inline = self.store.put(oid, value)
+        # ref BEFORE publishing ready: the pin cast precedes obj_ready on
+        # the same connection, so the directory never sees this entry
+        # terminal-and-unpinned
+        ref = ObjectRef(oid)
         self.gcs.mark_ready(oid, inline=inline)
-        return ObjectRef(oid)
+        return ref
 
     def put_parts(self, data: bytes, buffers) -> ObjectRef:
         oid = ObjectID.from_random()
         inline = self.store.put_parts(oid, data, buffers)
+        ref = ObjectRef(oid)
         self.gcs.mark_ready(oid, inline=inline)
-        return ObjectRef(oid)
+        return ref
 
     def _cluster_watch(self, ids: List[ObjectID]) -> None:
         """Cluster mode: objects not terminal locally may be produced on a
@@ -1288,6 +1477,13 @@ class DriverRuntime:
                             except (OSError, BrokenPipeError):
                                 pass
                         return
+        # cluster mode: the task may be executing on a peer node (forwarded
+        # task / routed actor call) — deliver the cancel THERE, where the
+        # running worker lives (ADVICE r2: the fallback below would mark
+        # the object cancelled while the remote task kept running)
+        if (self.cluster is not None
+                and self.cluster.cancel_remote(obj_id.binary(), force)):
+            return
         err = cloudpickle.dumps(TaskCancelledError("task was cancelled"))
         st = self.gcs.object_state(obj_id)
         if st is not None and st.status == "PENDING":
@@ -1378,6 +1574,10 @@ class DriverRuntime:
         return list(self.timeline_events)
 
     def shutdown(self):
+        from ray_tpu.core import object_ref as _object_ref
+
+        _object_ref.clear_ref_hook()
+        self.gcs.on_terminal = None
         self._log_monitor_stop.set()
         if self.cluster is not None:
             try:

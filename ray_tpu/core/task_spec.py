@@ -44,15 +44,24 @@ def pickle_fn(fn) -> bytes:
     return cloudpickle.dumps(fn)
 
 
-def encode_args(args, kwargs, runtime) -> Tuple[list, dict]:
+def encode_args(args, kwargs, runtime) -> Tuple[list, dict, list]:
     """Encode call args. Oversized inline values are promoted to store
     objects (mirrors the reference: large args are implicitly ``ray.put``).
-    Each value is serialized exactly once."""
+    Each value is serialized exactly once. The third element lists refs
+    NESTED inside inline values (e.g. ``f.remote([ref])``) — the submitter
+    pins those until the task completes, closing the window between the
+    caller dropping its ObjectRef and the worker deserializing its borrow
+    (reference: borrowed references in serialized arguments)."""
+    from ray_tpu.core.object_ref import collect_serialized_refs
+
+    nested: List[bytes] = []
 
     def enc(a: Any):
         if isinstance(a, ObjectRef):
             return ("r", a.id.binary())
-        data, buffers = serialization.serialize(a)
+        with collect_serialized_refs() as got:
+            data, buffers = serialization.serialize(a)
+        nested.extend(got)
         size = serialization.serialized_size(data, buffers)
         if size >= INLINE_THRESHOLD:
             ref = runtime.put_parts(data, buffers)
@@ -61,7 +70,9 @@ def encode_args(args, kwargs, runtime) -> Tuple[list, dict]:
         serialization.write_into(memoryview(out), data, buffers)
         return ("v", bytes(out))
 
-    return [enc(a) for a in args], {k: enc(v) for k, v in (kwargs or {}).items()}
+    return ([enc(a) for a in args],
+            {k: enc(v) for k, v in (kwargs or {}).items()},
+            nested)
 
 
 def arg_refs(enc_args: list, enc_kwargs: dict) -> List[ObjectID]:

@@ -59,6 +59,15 @@ class WorkerRuntime:
         self._running_lock = threading.Lock()
         self._req_counter = itertools.count()
         self._send_lock = threading.Lock()
+        # Borrowed-reference tracking (reference reference_count.h:61
+        # "borrower" role): live ObjectRef instances in THIS worker pin the
+        # object at the driver (which aggregates into node-level pins at
+        # the cluster directory). Only 0<->1 transitions cross the pipe.
+        self._refs_lock = threading.Lock()
+        self._ref_counts: Dict[bytes, int] = {}
+        from ray_tpu.core import object_ref as _object_ref
+
+        _object_ref.set_ref_hook(self._ref_added, self._ref_removed)
         # Demuxed transport: exactly ONE thread reads the pipe and routes
         # replies to the issuing thread. This lets ANY thread in the worker
         # (the task thread, a train-session thread, a user thread) make
@@ -98,6 +107,29 @@ class WorkerRuntime:
 
     def cast(self, op: str, *args):
         self._send(("cast", op, args))
+
+    def _ref_added(self, oid_b: bytes) -> None:
+        with self._refs_lock:
+            before = self._ref_counts.get(oid_b, 0)
+            self._ref_counts[oid_b] = before + 1
+            if before == 0:
+                try:
+                    self.cast("refpin", oid_b, 1)
+                except Exception:
+                    pass
+
+    def _ref_removed(self, oid_b: bytes) -> None:
+        with self._refs_lock:
+            n = self._ref_counts.get(oid_b, 0) - 1
+            if n > 0:
+                self._ref_counts[oid_b] = n
+                return
+            self._ref_counts.pop(oid_b, None)
+            if n == 0:
+                try:
+                    self.cast("refpin", oid_b, -1)
+                except Exception:
+                    pass
 
     def _start_receiver(self):
         if self._recv_started:
@@ -491,6 +523,63 @@ class WorkerRuntime:
 
         fut.add_done_callback(on_done)
 
+    def _schedule_async_stream(self, spec: dict, agen, undo_env):
+        """``num_returns="streaming"`` on an ASYNC actor method: drain the
+        async generator on the actor's persistent loop, announcing each
+        yield through the same put path as the sync stream — concurrent
+        calls keep interleaving at awaits (ADVICE r2: a sync ``for`` over
+        an async generator raised TypeError). Backpressure permits are
+        awaited off-loop so the actor loop never blocks."""
+        import asyncio
+
+        loop = self._actor_loops[spec["actor_id"]]
+
+        async def drain():
+            bp = spec.get("stream_backpressure")
+            count = 0
+            aloop = asyncio.get_running_loop()
+            async for item in agen:
+                if bp and count >= bp:
+                    self.cast("blocked")
+                    try:
+                        out = await aloop.run_in_executor(
+                            None, lambda c=count: self.request(
+                                "stream_permit", spec["task_id"],
+                                c + 1 - bp, timeout=300.0))
+                    finally:
+                        self.cast("unblocked")
+                    if out is _TIMEOUT:
+                        bp = None
+                oid = ObjectID(ts.streaming_return_id(spec["task_id"],
+                                                      count))
+                inline = self.store.put(oid, item)
+                self.cast("put", oid.binary(), inline)
+                count += 1
+            return count
+
+        fut = asyncio.run_coroutine_threadsafe(drain(), loop)
+        tid = spec["task_id"]
+        with self._running_lock:
+            self._running_futs[tid] = fut
+
+        def on_done(f):
+            with self._running_lock:
+                self._running_futs.pop(tid, None)
+            try:
+                try:
+                    count = f.result()
+                except BaseException as e:  # noqa: BLE001
+                    self._send_error(spec, e)
+                    return
+                results = self._encode_results(spec, count)
+                self._send(("done", tid, results))
+            except BaseException as e:  # noqa: BLE001
+                self._send_error(spec, e)
+            finally:
+                undo_env()
+
+        fut.add_done_callback(on_done)
+
     def _send_error(self, spec: dict, e: BaseException):
         from concurrent.futures import CancelledError
 
@@ -557,6 +646,31 @@ class WorkerRuntime:
                 else:
                     method = getattr(instance, spec["method"])
                     value = method(*args, **kwargs)
+                import inspect as _inspect
+
+                if _inspect.isasyncgen(value):
+                    if (spec.get("streaming")
+                            and spec["actor_id"] in self._actor_loops):
+                        self._schedule_async_stream(spec, value, undo_env)
+                        undo_env = lambda: None  # noqa: E731 — owned by cb
+                        return
+
+                    # non-streaming call: drain the async generator to a
+                    # list. On an async actor this becomes a coroutine and
+                    # flows into the persistent-loop branch below — running
+                    # it inline here would freeze the dispatch thread (and
+                    # deadlock if the generator awaits another method of
+                    # the same actor).
+                    async def _collect(g=value):
+                        return [x async for x in g]
+
+                    if spec["actor_id"] in self._actor_loops:
+                        value = _collect()
+                    else:
+                        import asyncio
+
+                        value = asyncio.run(_collect())
+
                 if _iscoroutine(value):
                     if spec["actor_id"] in self._actor_loops:
                         # async actor: schedule on the persistent loop and
@@ -581,6 +695,17 @@ class WorkerRuntime:
             undo_env()
             with self._running_lock:
                 self._running_threads.pop(tid_b, None)
+                # Absorb a cancel injected but not yet DELIVERED: a pending
+                # async exc landing after this frame returns would kill an
+                # unrelated frame (e.g. actor thread-pool internals,
+                # permanently shrinking the pool). Clearing under the same
+                # lock the injector holds closes the window: once the entry
+                # is gone no new injection can target this thread.
+                import ctypes
+
+                ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                    ctypes.c_ulong(threading.get_ident()),
+                    ctypes.c_void_p(0))
             self.current_task_id = None
 
     def main_loop(self):
@@ -634,7 +759,8 @@ def _has_async_methods(cls) -> bool:
     import inspect
 
     return any(
-        inspect.iscoroutinefunction(getattr(cls, name, None))
+        inspect.iscoroutinefunction(m := getattr(cls, name, None))
+        or inspect.isasyncgenfunction(m)
         for name in dir(cls) if not name.startswith("_")
     )
 

@@ -208,7 +208,15 @@ def forward(
     if positions is None:
         positions = jnp.arange(l)[None, :]
 
+    # Embedding lookup in two sharding steps: first pin the gather's
+    # OUTPUT to its natural sharding (model dim follows the table's
+    # "embed" axis), then reshard the plain tensor to activation layout.
+    # Forcing (batch, seq, None) directly onto the gather op makes the
+    # SPMD partitioner fully rematerialize (replicate) the embedding
+    # activations — the MULTICHIP_r02 "Involuntary full rematerialization"
+    # warnings; a reshard on an ordinary tensor lowers to all-to-all.
     x = params["embed"].astype(dt)[tokens]
+    x = constrain(x, (None, None, "embed"))
     if c.positions == "learned":
         x = x + params["pos_embed"].astype(dt)[positions[0]][None]
     x = constrain(x, ("batch", "seq", None))

@@ -14,7 +14,7 @@ from typing import Any, Dict, List
 import numpy as np
 
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
-from ray_tpu.rllib.learner import JaxLearner, LearnerGroup
+from ray_tpu.rllib.learner import JaxLearner, LearnerGroup, masked_mean
 
 
 def compute_vtrace(behavior_logp, target_logp, rewards, values, dones,
@@ -49,11 +49,12 @@ class ImpalaLearner(JaxLearner):
         vf_coeff = cfg.get("vf_loss_coeff", 0.5)
         ent_coeff = cfg.get("entropy_coeff", 0.01)
 
+        mask = batch.get("loss_mask")
         out = self.module.forward_train(params, batch["obs"])
         logp, entropy = self.module.logp_entropy(out, batch["actions"])
-        pg_loss = -(logp * batch["pg_advantages"]).mean()
-        vf_loss = jnp.square(out["vf_preds"] - batch["vs"]).mean()
-        ent = entropy.mean()
+        pg_loss = -masked_mean(logp * batch["pg_advantages"], mask)
+        vf_loss = masked_mean(jnp.square(out["vf_preds"] - batch["vs"]), mask)
+        ent = masked_mean(entropy, mask)
         loss = pg_loss + vf_coeff * vf_loss - ent_coeff * ent
         return loss, {"policy_loss": pg_loss, "vf_loss": vf_loss,
                       "entropy": ent}

@@ -26,6 +26,16 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 
+def masked_mean(x, mask):
+    """Mean of ``x`` over rows where ``mask`` is 1. Padded rows (mask 0)
+    contribute exactly zero to both numerator and denominator, so the
+    result equals the unpadded mean (reference learners achieve this with
+    per-row loss weights; rllib/core/learner/learner.py minibatch path)."""
+    if mask is None:
+        return x.mean()
+    return (x * mask).sum() / mask.sum()
+
+
 class JaxLearner:
     """Owns module params + optimizer; ``update`` runs the jitted loss/grad
     step over the learner's device mesh. Subclasses implement
@@ -112,16 +122,22 @@ class JaxLearner:
 
     def _pad_to_devices(self, batch):
         """Pad the leading dim to a multiple of the mesh size (dp sharding
-        needs equal shards); padded rows get zero loss weight via
-        truncation-free repeat of the last row — acceptable for RL
-        minibatches where the loss is a mean (bias O(pad/batch))."""
+        needs equal shards) by repeating trailing rows, and attach a
+        ``loss_mask`` (1 real / 0 padded). Losses take ``masked_mean`` so
+        padded rows carry ZERO loss weight — the update is identical to the
+        unpadded batch, not biased toward repeated rows. The mask is always
+        present so jit sees one batch signature."""
         n_dev = self.mesh.devices.size
         n = len(next(iter(batch.values())))
         pad = (-n) % n_dev
+        mask = np.ones(n + pad, dtype=np.float32)
         if pad == 0:
-            return batch
-        return {k: np.concatenate([v, v[-pad:]], axis=0)
-                for k, v in batch.items()}
+            return {**batch, "loss_mask": mask}
+        mask[n:] = 0.0
+        out = {k: np.concatenate([v, v[-pad:]], axis=0)
+               for k, v in batch.items()}
+        out["loss_mask"] = mask
+        return out
 
     def update(self, batch: Dict[str, np.ndarray],
                minibatch_size: Optional[int] = None,

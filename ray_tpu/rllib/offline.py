@@ -125,11 +125,16 @@ class BCLearner(JaxLearner):
     rllib/algorithms/bc)."""
 
     def compute_loss(self, params, batch):
+        from ray_tpu.rllib.learner import masked_mean
+
+        mask = batch.get("loss_mask")
         out = self.module.forward_train(params, batch["obs"])
         logp, entropy = self.module.logp_entropy(out, batch["actions"])
         ent_coeff = self.config.get("entropy_coeff", 0.0)
-        loss = -(logp.mean() + ent_coeff * entropy.mean())
-        return loss, {"bc_logp": logp.mean(), "entropy": entropy.mean()}
+        mean_logp = masked_mean(logp, mask)
+        mean_ent = masked_mean(entropy, mask)
+        loss = -(mean_logp + ent_coeff * mean_ent)
+        return loss, {"bc_logp": mean_logp, "entropy": mean_ent}
 
 
 def train_bc(dataset_path: str, module_spec: Dict[str, Any],

@@ -14,7 +14,7 @@ from typing import Any, Dict, List
 import numpy as np
 
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
-from ray_tpu.rllib.learner import JaxLearner, LearnerGroup
+from ray_tpu.rllib.learner import JaxLearner, LearnerGroup, masked_mean
 
 
 def compute_gae(rewards, values, dones, truncateds, last_values,
@@ -59,6 +59,7 @@ class PPOLearner(JaxLearner):
         vf_coeff = cfg.get("vf_loss_coeff", 0.5)
         ent_coeff = cfg.get("entropy_coeff", 0.0)
 
+        mask = batch.get("loss_mask")
         out = self.module.forward_train(params, batch["obs"])
         logp, entropy = self.module.logp_entropy(out, batch["actions"])
         ratio = jnp.exp(logp - batch["action_logp"])
@@ -66,18 +67,18 @@ class PPOLearner(JaxLearner):
         surr = jnp.minimum(
             ratio * adv,
             jnp.clip(ratio, 1 - clip, 1 + clip) * adv)
-        policy_loss = -surr.mean()
+        policy_loss = -masked_mean(surr, mask)
 
         vf = out["vf_preds"]
         vf_err = jnp.square(vf - batch["value_targets"])
         vf_clipped = batch["vf_preds"] + jnp.clip(
             vf - batch["vf_preds"], -vf_clip, vf_clip)
         vf_err_clipped = jnp.square(vf_clipped - batch["value_targets"])
-        vf_loss = jnp.maximum(vf_err, vf_err_clipped).mean()
+        vf_loss = masked_mean(jnp.maximum(vf_err, vf_err_clipped), mask)
 
-        ent = entropy.mean()
+        ent = masked_mean(entropy, mask)
         loss = policy_loss + vf_coeff * vf_loss - ent_coeff * ent
-        kl = (batch["action_logp"] - logp).mean()
+        kl = masked_mean(batch["action_logp"] - logp, mask)
         return loss, {
             "policy_loss": policy_loss,
             "vf_loss": vf_loss,

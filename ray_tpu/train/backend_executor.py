@@ -46,14 +46,19 @@ class BackendExecutor:
     def start(self) -> None:
         n = self._scaling.num_workers
         res = self._scaling.worker_resources()
+        strategy = self._scaling.effective_placement_strategy()
         try:
             self._pg = create_pg(
                 bundles=[dict(res) for _ in range(n)],
-                strategy=self._scaling.placement_strategy,
+                strategy=strategy,
             )
         except Exception:
-            # Resource pool too small for a PG (tests with tiny clusters):
-            # fall back to unconstrained placement.
+            if strategy in ("STRICT_SPREAD", "STRICT_PACK", "SLICE_PACK"):
+                # gang semantics were REQUESTED: an infeasible reservation
+                # must fail loudly, not silently degrade placement
+                raise
+            # Resource pool too small for a PACK/SPREAD group (tests with
+            # tiny clusters): fall back to unconstrained placement.
             self._pg = None
         self.worker_group = WorkerGroup(n, res, placement_group=self._pg)
         # Readiness barrier with a deadline: an infeasible resource demand

@@ -60,6 +60,14 @@ class ScalingConfig:
                 res[pod_name] = 1.0
         return res
 
+    def effective_placement_strategy(self) -> str:
+        """Multi-host slices gang-schedule one bundle per DISTINCT host
+        (SLICE_PACK); everything else keeps the configured strategy."""
+        if (self.use_tpu and self.topology and self.num_workers > 1
+                and self.placement_strategy == "PACK"):
+            return "SLICE_PACK"
+        return self.placement_strategy
+
 
 @dataclass
 class FailureConfig:

@@ -72,7 +72,8 @@ def list_placement_groups() -> List[Dict[str, Any]]:
     rt = _gcs()
     with rt.lock:
         return [
-            {"placement_group_id": pgid.hex(), "bundles": pg["bundles"],
+            {"placement_group_id": pgid.hex(),
+             "bundles": {i: dict(b) for i, b in pg["bundles"].items()},
              "strategy": pg["strategy"]}
             for pgid, pg in rt.pgs.items()
         ]
