@@ -20,6 +20,7 @@ a runtime (the user driver and every node daemon). Responsibilities:
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -40,6 +41,25 @@ NODE_VIEW_TTL_S = 0.5
 # sentinel: "could not reach the GCS" — distinct from "GCS says gone"
 GCS_UNAVAILABLE = object()
 
+# node-to-node object transfer (reference push_manager.h / pull_manager.h
+# roles): objects above PULL_CHUNK_BYTES stream in chunks straight into a
+# preallocated segment — neither end ever materializes the whole blob —
+# and at most PULL_CONCURRENCY big pulls run at once (pull admission).
+PULL_CHUNK_BYTES = int(os.environ.get("RTPU_PULL_CHUNK_BYTES",
+                                      str(4 << 20)))
+PULL_CONCURRENCY = int(os.environ.get("RTPU_PULL_CONCURRENCY", "2"))
+
+# dependency-locality scheduling (reference hybrid_scheduling_policy.h:50
+# + scorer.h roles): ship the task to its data when the data is big.
+# Below this many dependency bytes, moving the data is cheaper than
+# disturbing placement.
+LOCALITY_MIN_BYTES = int(os.environ.get("RTPU_LOCALITY_MIN_BYTES",
+                                        str(1 << 20)))
+# hybrid pack/spread: pack onto busier feasible nodes while their CPU
+# utilization is below this, then spread to the least-loaded
+HYBRID_PACK_THRESHOLD = float(os.environ.get("RTPU_HYBRID_THRESHOLD",
+                                             "0.5"))
+
 
 class ClusterAdapter:
     def __init__(self, gcs_addr: str, authkey: bytes, *,
@@ -56,7 +76,8 @@ class ClusterAdapter:
         self._peers: Dict[bytes, RpcClient] = {}
         self._peer_addrs: Dict[bytes, str] = {}
         self._peers_lock = threading.Lock()
-        self._watched: Set[bytes] = set()
+        # oid -> fetch flag (True: pull the value; False: state-only)
+        self._watched: Dict[bytes, bool] = {}
         self._watch_lock = threading.Lock()
         self._fetching: Set[bytes] = set()
         # forwarded work for failure handling: node_id -> {task_id: spec}
@@ -66,6 +87,27 @@ class ClusterAdapter:
         self._fwd_by_oid: Dict[bytes, tuple] = {}
         self._forwarded_lock = threading.Lock()
         self._remote_actors: Dict[bytes, bytes] = {}  # actor_id -> node_id
+        # streaming tasks forwarded with backpressure: task_id -> executing
+        # node, so consumer-side acks relay to where the producer parks
+        self._stream_routes: Dict[bytes, bytes] = {}
+        # owner hints: return oid -> node the producing task was forwarded
+        # to. Unlike _fwd_by_oid (popped when delivery STARTS), hints live
+        # until the object is terminal LOCALLY — the locality scheduler
+        # consults them while a result exists only on its producer's node
+        self._result_hints: Dict[bytes, bytes] = {}
+        # pull admission: big (chunked) fetches run on their own bounded
+        # pool — its size IS the concurrent-pull cap. Blocking admission
+        # inside the shared _io pool would let queued pulls starve
+        # stream-consumed relays / node-down handling / state queries.
+        self._pull_io = ThreadPoolExecutor(max_workers=PULL_CONCURRENCY,
+                                           thread_name_prefix="cluster-pull")
+        # (size, locations) cache for dependency-locality scoring: fan-outs
+        # of one big ref to N tasks pay one directory lookup, not N.
+        # _obj_info_down_until: circuit breaker — while the GCS is not
+        # answering, placement proceeds without locality instead of taxing
+        # every submit with a timed-out RPC
+        self._obj_info: Dict[bytes, tuple] = {}
+        self._obj_info_down_until = 0.0
         # placement groups: cached assignment maps (pg_id -> {idx: node}),
         # full meta for groups THIS adapter created (it owns rescheduling),
         # bundles lost to node death awaiting re-placement, and task specs
@@ -126,6 +168,7 @@ class ClusterAdapter:
         self.gcs.close()
         self._io.shutdown(wait=False)
         self._publish_io.shutdown(wait=False)
+        self._pull_io.shutdown(wait=False)
 
     def _heartbeat_loop(self):
         while not self._stop.wait(HEARTBEAT_S):
@@ -171,6 +214,11 @@ class ClusterAdapter:
             return True
         if method == "pull_object":
             return self._serve_pull(args[0])
+        if method == "pull_chunk":
+            return self._serve_pull_chunk(args[0], args[1], args[2])
+        if method == "stream_consumed":
+            self.rt.stream_consumed(args[0], args[1])
+            return True
         if method == "kill_actor":
             self.rt.kill_actor(args[0], args[1])
             return True
@@ -206,6 +254,13 @@ class ClusterAdapter:
         self.gcs.cast("obj_forget_location", oid_b, self.node_id)
         return None
 
+    def _serve_pull_chunk(self, oid_b: bytes, offset: int, length: int):
+        """One chunk of a segment; only ``length`` bytes leave the store."""
+        blob = self.rt.store.get_raw_chunk(ObjectID(oid_b), offset, length)
+        if blob is None:
+            self.gcs.cast("obj_forget_location", oid_b, self.node_id)
+        return blob
+
     # ------------------------------------------------------------------
     # object directory: publish + watch + fetch
     # ------------------------------------------------------------------
@@ -225,19 +280,29 @@ class ClusterAdapter:
     def unpin_object(self, oid_b: bytes) -> None:
         self.gcs.cast("obj_unpin", oid_b, self.node_id)
 
-    def watch_many(self, oids) -> None:
+    def watch_many(self, oids, fetch: bool = True) -> None:
         """Subscribe to global terminal state for objects not yet terminal
         locally; delivery marks them ready/error in the local gcs (pulling
         segment bytes from the owning node when needed). Non-blocking: the
         initial state query runs on the adapter's io pool so hot dispatch
-        paths (worker-pipe receivers) never wait on the network."""
+        paths (worker-pipe receivers) never wait on the network.
+
+        ``fetch=False`` is a STATE-ONLY watch (forwarded-result tracking):
+        completion retires bookkeeping but segment bytes are NOT pulled —
+        eagerly copying every forwarded result to the watcher both wastes
+        bandwidth and destroys the ship-task-to-data locality signal. A
+        later value watch on the same object upgrades it."""
         fresh = []
         with self._watch_lock:
             for o in oids:
                 b = o.binary() if isinstance(o, ObjectID) else o
-                if b not in self._watched:
-                    self._watched.add(b)
+                cur = self._watched.get(b)
+                if cur is None:
+                    self._watched[b] = fetch
                     fresh.append(b)
+                elif fetch and not cur:
+                    self._watched[b] = True
+                    fresh.append(b)  # re-query: may already be terminal
         for b in fresh:
             # subscribe-then-query closes the race where the object turned
             # terminal between our local check and the subscription
@@ -283,6 +348,7 @@ class ClusterAdapter:
             ent = self._fwd_by_oid.pop(oid_b, None)
             if ent is not None:
                 self._forwarded.get(ent[0], {}).pop(ent[1], None)
+                self._stream_routes.pop(ent[1], None)
         oid = ObjectID(oid_b)
         st = self.rt.gcs.object_state(oid)
         if st is not None and st.status in ("READY", "ERROR"):
@@ -303,6 +369,26 @@ class ClusterAdapter:
             self._unwatch(oid_b)
             return
         with self._watch_lock:
+            fetch = self._watched.get(oid_b, True)
+        if not fetch:
+            # state-only watch: completion bookkeeping done above; the
+            # bytes stay with their producer (a value watch pulls later)
+            self._unwatch(oid_b)
+            return
+        if int(state.get("size") or 0) > PULL_CHUNK_BYTES:
+            # big pulls move to the dedicated bounded pool (admission):
+            # a minutes-long stream must not occupy an _io thread
+            self._pull_io.submit(self._fetch_guarded, oid_b, state)
+            return
+        self._fetch_guarded(oid_b, state)
+
+    def _fetch_guarded(self, oid_b: bytes, state: dict):
+        oid = ObjectID(oid_b)
+        st = self.rt.gcs.object_state(oid)
+        if st is not None and st.status in ("READY", "ERROR"):
+            self._unwatch(oid_b)  # resolved while queued behind other pulls
+            return
+        with self._watch_lock:
             if oid_b in self._fetching:
                 return
             self._fetching.add(oid_b)
@@ -311,12 +397,24 @@ class ClusterAdapter:
         finally:
             with self._watch_lock:
                 self._fetching.discard(oid_b)
+            st = self.rt.gcs.object_state(oid)
+            if st is not None and st.status in ("READY", "ERROR"):
+                # terminal here now: the owner hint served its purpose
+                with self._forwarded_lock:
+                    self._result_hints.pop(oid_b, None)
 
     def _fetch(self, oid: ObjectID, state: dict):
-        """Owner-directed pull: try each advertised location."""
+        """Owner-directed pull: try each advertised location. Big segments
+        stream in chunks (bounded memory on both ends + pull admission)."""
+        size = int(state.get("size") or 0)
         for node_id in state["locations"]:
             peer = self._peer(node_id)
             if peer is None:
+                continue
+            if size > PULL_CHUNK_BYTES:
+                if self._fetch_chunked(oid, peer, size):
+                    self._unwatch(oid.binary())
+                    return
                 continue
             try:
                 payload = peer.call("pull_object", oid.binary(), timeout=60)
@@ -341,9 +439,37 @@ class ClusterAdapter:
         # the still-active subscription (lineage reconstruction path)
         logger.warning("fetch of %s found no live location", oid.hex()[:8])
 
+    def _fetch_chunked(self, oid: ObjectID, peer: RpcClient,
+                       size: int) -> bool:
+        """Stream one object in PULL_CHUNK_BYTES pieces straight into a
+        preallocated segment. Peak extra memory per end is one chunk (+
+        RPC framing), not the object size. Runs on _pull_io, whose size is
+        the concurrent-pull admission cap."""
+        w = self.rt.store.begin_receive(oid, size)
+        if w is None:  # already present locally
+            self.rt.gcs.mark_ready(oid, size=size)
+            return True
+        off = 0
+        try:
+            while off < size:
+                ln = min(PULL_CHUNK_BYTES, size - off)
+                blob = peer.call("pull_chunk", oid.binary(), off, ln,
+                                 timeout=60)
+                if blob is None or len(blob) != ln:
+                    w.abort()
+                    return False
+                w.write(off, blob)
+                off += ln
+            w.seal()
+        except Exception:
+            w.abort()
+            return False
+        self.rt.gcs.mark_ready(oid, size=size)
+        return True
+
     def _unwatch(self, oid_b: bytes):
         with self._watch_lock:
-            self._watched.discard(oid_b)
+            self._watched.pop(oid_b, None)
 
     def _free_local_copy(self, oid_b: bytes):
         oid = ObjectID(oid_b)
@@ -404,14 +530,35 @@ class ClusterAdapter:
                 self.rt.total.get(k, 0.0) >= v for k, v in res.items())
             local_avail_ok = all(
                 self.rt.avail.get(k, 0.0) >= v for k, v in res.items())
+        dep_bytes = self._dep_bytes_by_node(spec)
         if local_avail_ok:
-            return False  # local fast path
+            # local fast path — UNLESS the task's big dependencies live on
+            # a peer that could also run it: ship the task to the data
+            # rather than the data to the task (reference hybrid policy's
+            # locality scoring, scorer.h)
+            if dep_bytes:
+                best = max(dep_bytes, key=dep_bytes.get)
+                gain = dep_bytes[best] - dep_bytes.get(self.node_id, 0)
+                if best != self.node_id and gain >= LOCALITY_MIN_BYTES:
+                    # require TOTAL feasibility, not instantaneous avail:
+                    # the dep's producer often just finished there, so the
+                    # heartbeat view still shows its slot taken — queueing
+                    # at the data beats shipping the data
+                    target = next(
+                        (n for n in self._nodes()
+                         if n["node_id"] == best and n["alive"]
+                         and all(n["resources"].get(k, 0.0) >= v
+                                 for k, v in res.items())), None)
+                    if target is not None and self._forward(best, spec):
+                        return True
+            return False
         candidates, with_avail = self._feasible_peers(res)
         if not candidates:
             return False  # infeasible everywhere -> queue locally
         if local_total_ok and not with_avail:
             return False  # locally feasible soon; nobody free now anyway
-        return self._forward_to_best(with_avail or candidates, res, spec)
+        return self._forward_to_best(with_avail or candidates, res, spec,
+                                     dep_bytes)
 
     def _feasible_peers(self, res: Dict[str, float]):
         """(feasible-by-total, also-free-now) peer views for ``res``."""
@@ -427,13 +574,79 @@ class ClusterAdapter:
         return candidates, with_avail
 
     def _forward_to_best(self, picks, res: Dict[str, float],
-                         spec: dict) -> bool:
-        target = picks[0]
+                         spec: dict, dep_bytes=None) -> bool:
+        """Rank feasible peers: dependency bytes first, then hybrid
+        pack-until-threshold-then-spread on CPU utilization (reference
+        hybrid_scheduling_policy.h:50 — pack onto busy-but-not-saturated
+        nodes to keep the cluster compact, spread past the threshold)."""
+
+        def key(n):
+            total = n["resources"].get("CPU", 0.0)
+            avail = n["avail"].get("CPU", 0.0)
+            util = 1.0 - (avail / total) if total else 0.0
+            packing = util < HYBRID_PACK_THRESHOLD
+            return (-(dep_bytes or {}).get(n["node_id"], 0),
+                    0 if packing else 1,
+                    -util if packing else util)
+
+        target = min(picks, key=key)
         # decrement the cached view so a burst of submissions spreads across
         # peers instead of piling onto one node until the next heartbeat
         for k, v in res.items():
             target["avail"][k] = target["avail"].get(k, 0.0) - v
         return self._forward(target["node_id"], spec)
+
+    def _dep_bytes_by_node(self, spec: dict) -> Dict[bytes, int]:
+        """READY-segment bytes of the spec's direct ref args, per holder
+        node. Pending deps contribute nothing (their location is unknown at
+        submit time — the reference schedules those by owner hint, future
+        work here). Served from a local cache; misses cost one batched
+        directory lookup."""
+        all_refs = ts.arg_refs(spec["args"], spec["kwargs"])[:16]
+        if not all_refs:
+            return {}
+        # hot-path guard: the local view already knows most args (driver
+        # puts, delivered results). Only refs that are locally unknown or
+        # locally big are worth a directory round-trip.
+        refs = []
+        for o in all_refs:
+            st = self.rt.gcs.object_state(o)
+            if st is None or st.status == "PENDING":
+                # Locally pending may be READY in the global directory —
+                # but ONLY if its producer was forwarded to a peer. A
+                # locally-produced pending ref (the f.remote(g.remote())
+                # chain hot path) cannot be remote: skip the round-trip,
+                # every submit would pay it (review r3 finding).
+                with self._forwarded_lock:
+                    fwd = o.binary() in self._result_hints
+                if fwd:
+                    refs.append(o)
+            elif (st.status == "READY" and st.inline is None
+                    and st.size >= LOCALITY_MIN_BYTES):
+                refs.append(o)
+        if not refs:
+            return {}
+        missing = [o.binary() for o in refs
+                   if o.binary() not in self._obj_info]
+        if missing and time.monotonic() >= self._obj_info_down_until:
+            try:
+                infos = self.gcs.call("obj_info", missing, timeout=5)
+            except Exception:
+                infos = {}
+                self._obj_info_down_until = time.monotonic() + 5.0
+            if len(self._obj_info) > 4096:
+                self._obj_info.clear()
+            for b, inf in (infos or {}).items():
+                self._obj_info[b] = inf
+        out: Dict[bytes, int] = {}
+        for o in refs:
+            inf = self._obj_info.get(o.binary())
+            if not inf:
+                continue
+            size, locs = inf
+            for nid in locs:
+                out[nid] = out.get(nid, 0) + int(size)
+        return out
 
     def _spill_if_infeasible(self, spec: dict) -> bool:
         res = spec.get("resources") or {}
@@ -480,30 +693,39 @@ class ClusterAdapter:
             return False
         return self._forward(pick["node_id"], spec)
 
-    def _forward(self, node_id: bytes, spec: dict) -> bool:
-        peer = self._peer(node_id)
-        if peer is None:
-            return False
-        if spec.get("stream_backpressure"):
-            # permit waits would land on the EXECUTING node while consumer
-            # acks land here — cross-node permit plumbing doesn't exist
-            # yet, so a forwarded producer would park forever. Stream
-            # unthrottled instead.
-            spec = dict(spec)
-            spec.pop("stream_backpressure")
-        try:
-            peer.call("submit_spec", spec, timeout=30)
-        except Exception:
-            return False
+    def _record_forward(self, node_id: bytes, spec: dict) -> None:
+        """Bookkeeping after handing a spec to a peer: failure-retry map,
+        completion retirement, owner hints for locality, the permit-relay
+        route for backpressured streams, and a state-only watch on the
+        returns (bytes stay with the producer)."""
         with self._forwarded_lock:
             self._forwarded.setdefault(node_id, {})[spec["task_id"]] = spec
             if spec["return_ids"]:
                 self._fwd_by_oid[spec["return_ids"][0]] = (node_id,
                                                            spec["task_id"])
+            if len(self._result_hints) > 100000:
+                self._result_hints.clear()
+            for rid in spec["return_ids"]:
+                self._result_hints[rid] = node_id
+            if spec.get("stream_backpressure"):
+                # the producer parks on the EXECUTING node's permit
+                # counter; consumer acks arriving here must relay there
+                self._stream_routes[spec["task_id"]] = node_id
+        self.watch_many([ObjectID(b) for b in spec["return_ids"]],
+                        fetch=False)
+
+    def _forward(self, node_id: bytes, spec: dict) -> bool:
+        peer = self._peer(node_id)
+        if peer is None:
+            return False
+        try:
+            peer.call("submit_spec", spec, timeout=30)
+        except Exception:
+            return False
+        self._record_forward(node_id, spec)
         aid = spec.get("actor_id")
         if aid:
             self._remote_actors[aid] = node_id
-        self.watch_many([ObjectID(b) for b in spec["return_ids"]])
         return True
 
     # ------------------------------------------------------------------
@@ -916,13 +1138,34 @@ class ClusterAdapter:
             self._fail_returns(spec, ActorDiedError(
                 f"actor's node {node_id.hex()[:8]} unreachable"))
             return True
-        with self._forwarded_lock:
-            self._forwarded.setdefault(node_id, {})[spec["task_id"]] = spec
-            if spec["return_ids"]:
-                self._fwd_by_oid[spec["return_ids"][0]] = (node_id,
-                                                           spec["task_id"])
-        self.watch_many([ObjectID(b) for b in spec["return_ids"]])
+        self._record_forward(node_id, spec)
         return True
+
+    def relay_stream_consumed(self, task_id: bytes, n: int,
+                              owner: Optional[bytes] = None) -> None:
+        """Consumer-side ack for a stream whose producer runs on a peer:
+        forward the absolute consumed count (idempotent, monotonic) to the
+        node holding the parked producer. Chains across multi-hop
+        forwarding: each hop relays to the next. A consumer on a node with
+        NO route (the generator was handed to a third node) relays to the
+        stream's OWNER, which does hold the route."""
+        with self._forwarded_lock:
+            node_id = self._stream_routes.get(task_id)
+        if node_id is None:
+            if owner is not None and owner != self.node_id:
+                node_id = owner
+            else:
+                return
+        self._io.submit(self._relay_sc, node_id, task_id, n)
+
+    def _relay_sc(self, node_id: bytes, task_id: bytes, n: int) -> None:
+        peer = self._peer(node_id)
+        if peer is None:
+            return
+        try:
+            peer.cast("stream_consumed", task_id, n)
+        except Exception:
+            pass  # producer unthrottles via its permit-wait timeout valve
 
     def _fail_returns(self, spec: dict, exc: Exception):
         err = cloudpickle.dumps(exc)
@@ -1049,6 +1292,8 @@ class ClusterAdapter:
         dead_actors = set(payload.get("dead_actors", []))
         with self._forwarded_lock:
             lost = self._forwarded.pop(node_id, {})
+            for tid in lost:
+                self._stream_routes.pop(tid, None)
         for task_id, spec in lost.items():
             if spec.get("actor_id") and spec["type"] != ts.ACTOR_CREATE:
                 self._fail_returns(spec, ActorDiedError(

@@ -74,6 +74,14 @@ class GcsService:
                                               "200000"))
         self.evict_min_age_s = float(os.environ.get(
             "RTPU_GCS_EVICT_MIN_AGE_S", "30"))
+        # refcount-zero objects are freed after a GRACE, not inline: a
+        # consumer's pin cast rides a different connection than the
+        # producer's obj_ready, so "no pins right now" can be an in-flight
+        # pin (freeing inline deleted entries a consumer was about to
+        # watch, hanging its get forever)
+        self.free_grace_s = float(os.environ.get(
+            "RTPU_GCS_FREE_GRACE_S", "10"))
+        self._free_candidates: Dict[bytes, float] = {}
         self.kv: Dict[str, Dict[str, bytes]] = {}
         self.functions: Dict[str, bytes] = {}
         # named/global actor registry: actor_id -> record dict
@@ -205,14 +213,11 @@ class GcsService:
                 o.status = PENDING
                 o.locations.discard(node_id)
             # a dead node's references die with it; objects it alone kept
-            # alive are freed on the surviving holders
-            freed_objs = []
-            for oid, o in list(self.objects.items()):
+            # alive free (after the grace) on the surviving holders
+            for oid, o in self.objects.items():
                 if node_id in o.pins:
                     o.pins.discard(node_id)
-                    locs = self._maybe_free_locked(oid, o)
-                    if locs:
-                        freed_objs.append((oid, locs))
+                    self._mark_free_candidate_locked(oid, o)
             # actors hosted there are dead (restart is the owner's call)
             dead_actors = [aid for aid, rec in self.actors.items()
                            if rec.get("node_id") == node_id
@@ -234,9 +239,6 @@ class GcsService:
                         rec["assignments"][i] = None
                     lost_pgs[pg_id] = idxs
                     self._dirty = True
-        for oid, locs in freed_objs:
-            self._publish("objects", {"oid": oid, "freed": True,
-                                      "locations": locs})
         self._publish("nodes", {"event": "down", "node_id": node_id,
                                 "cause": cause, "lost_objects": lost,
                                 "dead_actors": dead_actors,
@@ -251,6 +253,7 @@ class GcsService:
                          and now - e.last_seen > self.node_timeout_s]
             for node_id in stale:
                 self._mark_node_dead(node_id, "heartbeat timeout")
+            self._sweep_free_candidates()
 
     # -- object directory ----------------------------------------------
 
@@ -263,7 +266,6 @@ class GcsService:
 
     def rpc_obj_ready(self, ctx, oid: bytes, inline: Optional[bytes],
                       node_id: Optional[bytes], size: int = 0):
-        freed = None
         with self.lock:
             o = self._obj(oid)
             if o.status == ERROR:
@@ -275,14 +277,10 @@ class GcsService:
             if node_id is not None and inline is None:
                 o.locations.add(node_id)
             # every ref was already dropped while the task ran
-            # (fire-and-forget): free on the terminal transition — unpin
-            # alone never re-checks a then-PENDING entry
-            freed = self._maybe_free_locked(oid, o)
+            # (fire-and-forget): mark for freeing on the terminal
+            # transition — unpin alone never re-checks a then-PENDING entry
+            self._mark_free_candidate_locked(oid, o)
             self._maybe_evict_locked()
-        if freed is not None:
-            self._publish("objects", {"oid": oid, "freed": True,
-                                      "locations": freed})
-            return True
         # the broadcast is a NOTIFICATION, not a payload channel: inline
         # bytes stay on the server (interested adapters fetch via
         # obj_state), so completion traffic stays O(nodes), not
@@ -291,18 +289,13 @@ class GcsService:
         return True
 
     def rpc_obj_error(self, ctx, oid: bytes, err: bytes):
-        freed = None
         with self.lock:
             o = self._obj(oid)
             o.status = ERROR
             o.error = err
             o.t_terminal = time.monotonic()
-            freed = self._maybe_free_locked(oid, o)
+            self._mark_free_candidate_locked(oid, o)
             self._maybe_evict_locked()
-        if freed is not None:
-            self._publish("objects", {"oid": oid, "freed": True,
-                                      "locations": freed})
-            return True
         self._publish("objects", {"oid": oid, "status": ERROR})
         return True
 
@@ -329,29 +322,59 @@ class GcsService:
             o = self._obj(oid)
             o.pins.add(node_id)
             o.was_pinned = True
+            self._free_candidates.pop(oid, None)
         return True
 
     def rpc_obj_unpin(self, ctx, oid: bytes, node_id: bytes):
-        freed = None
         with self.lock:
             o = self.objects.get(oid)
             if o is None:
                 return False
             o.pins.discard(node_id)
-            freed = self._maybe_free_locked(oid, o)
-        if freed is not None:
-            self._publish("objects", {"oid": oid, "freed": True,
-                                      "locations": freed})
+            self._mark_free_candidate_locked(oid, o)
         return True
 
-    def _maybe_free_locked(self, oid: bytes, o: _GlobalObject):
-        """Last pin dropped on a terminal, previously-referenced object:
-        drop the entry and return holder nodes so they free segments."""
+    def _mark_free_candidate_locked(self, oid: bytes, o: _GlobalObject):
+        """Refcount hit zero on a terminal, previously-referenced object:
+        queue it for freeing after the grace (see free_grace_s — an
+        in-flight pin on another connection may still land)."""
         if o.pins or not o.was_pinned or o.status not in (READY, ERROR):
-            return None
-        locations = list(o.locations)
-        del self.objects[oid]
-        return locations
+            return
+        self._free_candidates.setdefault(oid, time.monotonic())
+
+    def _sweep_free_candidates(self):
+        """Free candidates whose grace elapsed with no pin arriving: drop
+        the directory entry and tell holder nodes to free their segments
+        (the reference's owner-driven object free)."""
+        now = time.monotonic()
+        freed = []
+        with self.lock:
+            for oid, t in list(self._free_candidates.items()):
+                if now - t < self.free_grace_s:
+                    continue
+                del self._free_candidates[oid]
+                o = self.objects.get(oid)
+                if (o is None or o.pins or not o.was_pinned
+                        or o.status not in (READY, ERROR)):
+                    continue
+                freed.append((oid, list(o.locations)))
+                del self.objects[oid]
+        for oid, locations in freed:
+            self._publish("objects", {"oid": oid, "freed": True,
+                                      "locations": locations})
+
+    def rpc_obj_info(self, ctx, oids):
+        """Batch (size, locations) for READY segment objects — the
+        scheduler's dependency-locality signal (reference scorer.h role).
+        Pending/inline/error entries are omitted: they carry no locality."""
+        out = {}
+        with self.lock:
+            for oid in oids:
+                o = self.objects.get(oid)
+                if (o is not None and o.status == READY
+                        and o.inline is None and o.locations):
+                    out[oid] = (o.size, list(o.locations))
+        return out
 
     def rpc_obj_state(self, ctx, oid: bytes):
         with self.lock:

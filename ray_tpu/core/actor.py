@@ -51,7 +51,8 @@ class ActorMethod:
             refs = rt.submit_actor_task(spec)
             return ObjectRefGenerator(
                 spec["task_id"], refs[0],
-                backpressured=bool(spec.get("stream_backpressure")))
+                backpressured=bool(spec.get("stream_backpressure")),
+                owner=getattr(rt, "cluster_node_id", None))
         refs = rt.submit_actor_task(spec)
         return refs[0] if num_returns == 1 else refs
 

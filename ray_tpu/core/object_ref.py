@@ -124,12 +124,16 @@ class ObjectRefGenerator:
     """
 
     def __init__(self, task_id: bytes, sentinel: "ObjectRef",
-                 backpressured: bool = False):
+                 backpressured: bool = False,
+                 owner: "Optional[bytes]" = None):
         self._task_id = task_id
         self._sentinel = sentinel
         self._index = 0
         self._count = None  # known once the sentinel resolves
         self._bp = backpressured
+        # node that submitted the stream: a consumer on a THIRD node routes
+        # its acks there (the owner holds the producer's forward route)
+        self._owner = owner
         self._handed_off = False  # serialized to another consumer
 
     def __iter__(self):
@@ -168,7 +172,8 @@ class ObjectRefGenerator:
         if not self._bp or self._count is not None:
             return
         try:
-            rt.stream_consumed(self._task_id, self._index)
+            rt.stream_consumed(self._task_id, self._index,
+                               owner=self._owner)
         except Exception:
             pass
 
@@ -178,7 +183,8 @@ class ObjectRefGenerator:
         try:
             from ray_tpu.core.runtime import _get_runtime
 
-            _get_runtime().stream_consumed(self._task_id, 1 << 60)
+            _get_runtime().stream_consumed(self._task_id, 1 << 60,
+                                           owner=self._owner)
         except Exception:
             pass
 
@@ -200,4 +206,4 @@ class ObjectRefGenerator:
     def __reduce__(self):
         self._handed_off = True
         return (ObjectRefGenerator,
-                (self._task_id, self._sentinel, self._bp))
+                (self._task_id, self._sentinel, self._bp, self._owner))

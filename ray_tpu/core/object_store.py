@@ -98,17 +98,19 @@ class StoreClient:
 
     # -- write path -------------------------------------------------------
 
-    def put(self, obj_id: ObjectID, value: Any) -> Optional[bytes]:
-        """Serialize ``value``.
+    def put(self, obj_id: ObjectID, value: Any):
+        """Serialize ``value``; returns ``(inline, size)``.
 
-        Returns the serialized blob if it is small enough to inline in the
-        directory (caller ships it over the control channel), else writes a
-        shm segment and returns None.
+        ``inline`` is the serialized blob when small enough to live in the
+        directory (caller ships it over the control channel), else None
+        with the bytes written to a shm segment. ``size`` is the
+        serialized size either way — callers report it to the directory so
+        peers can plan chunked pulls without re-statting the segment.
         """
         data, buffers = serialization.serialize(value)
         return self.put_parts(obj_id, data, buffers)
 
-    def put_parts(self, obj_id: ObjectID, data: bytes, buffers) -> Optional[bytes]:
+    def put_parts(self, obj_id: ObjectID, data: bytes, buffers):
         """Like ``put`` but takes an already-serialized (data, buffers) pair
         so callers that must size-check first don't serialize twice.
 
@@ -120,9 +122,9 @@ class StoreClient:
         if size < INLINE_THRESHOLD:
             out = bytearray(size)
             serialization.write_into(memoryview(out), data, buffers)
-            return bytes(out)
+            return bytes(out), size
         if self.contains(obj_id):
-            return None  # already present (lineage re-run of a survivor)
+            return None, size  # already present (lineage re-run survivor)
         if self._arena is not None:
             view = self._arena.create(obj_id.binary(), size)
             if view is not None:
@@ -133,7 +135,7 @@ class StoreClient:
                 # directory's reference, dropped only by delete(). Sealed
                 # objects with it held are never evicted, so live
                 # ObjectRefs can't lose data to allocation pressure.
-                return None
+                return None, size
             # arena full: fall through to a file segment (never evict
             # referenced objects to make room)
         # Spilling (reference raylet LocalObjectManager::SpillObjects):
@@ -157,7 +159,7 @@ class StoreClient:
         mm.close()
         if not spill:
             self._file_bytes += size
-        return None
+        return None, size
 
     def put_serialized(self, obj_id: ObjectID, blob: bytes) -> None:
         """Write an already-serialized blob into a segment (spill-in path)."""
@@ -247,6 +249,38 @@ class StoreClient:
             except FileNotFoundError:
                 continue
         return None
+
+    def get_raw_chunk(self, obj_id: ObjectID, offset: int,
+                      length: int) -> Optional[bytes]:
+        """A slice of the serialized segment (chunked node-to-node pull,
+        reference ObjectBufferPool chunk-read role): only ``length`` bytes
+        are copied, so serving a multi-GB object never materializes it."""
+        if self._arena is not None:
+            view = self._arena.get(obj_id.binary())
+            if view is not None:
+                try:
+                    return bytes(view[offset:offset + length])
+                finally:
+                    del view
+                    self._arena.release(obj_id.binary())
+        for path in (_seg_path(self.session, obj_id),
+                     _spill_path(self.session, obj_id)):
+            try:
+                with open(path, "rb") as f:
+                    f.seek(offset)
+                    return f.read(length)
+            except FileNotFoundError:
+                continue
+        return None
+
+    def begin_receive(self, obj_id: ObjectID,
+                      size: int) -> Optional["IncomingObject"]:
+        """Allocate the full segment for an incremental cross-node receive;
+        chunks are written at offsets, then sealed. Returns None when the
+        object is already present."""
+        if self.contains(obj_id):
+            return None
+        return IncomingObject(self, obj_id, size)
 
     def contains(self, obj_id: ObjectID) -> bool:
         if obj_id in self._pins:
@@ -346,3 +380,83 @@ class StoreClient:
                         pass
         except OSError:
             pass
+
+
+class IncomingObject:
+    """Incremental cross-node receive: allocate the full segment up front,
+    write chunks at offsets, then seal. Arena-backed when possible
+    (create -> seal, so readers never attach early); else a ``.part`` file
+    renamed into place on seal — ``contains()`` checks the final path, so a
+    partial segment is never visible. Role analog: the reference
+    ObjectBufferPool create-and-fill (``object_manager/object_buffer_pool.h``).
+    """
+
+    def __init__(self, store: StoreClient, obj_id: ObjectID, size: int):
+        self._store = store
+        self._oid = obj_id
+        self._size = size
+        self._view = None
+        self._mm = None
+        self._path = None
+        self._spilled = False
+        self._done = False
+        if store._arena is not None:
+            self._view = store._arena.create(obj_id.binary(), size)
+        if self._view is None:
+            # same spill decision as put_parts: past the shm threshold,
+            # large incoming objects land on disk
+            arena_used = (store._arena.stats()["used"]
+                          if store._arena else 0)
+            self._spilled = (arena_used + store._file_bytes + size
+                             > store._spill_threshold)
+            if self._spilled:
+                os.makedirs(_spill_dir(store.session), exist_ok=True)
+                self._path = _spill_path(store.session, obj_id)
+            else:
+                self._path = _seg_path(store.session, obj_id)
+            part = self._path + ".part"
+            try:
+                fd = os.open(part, os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
+            except FileExistsError:
+                os.unlink(part)  # stale leftover from an aborted fetch
+                fd = os.open(part, os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
+            try:
+                os.ftruncate(fd, size)
+                self._mm = mmap.mmap(fd, size) if size else None
+            finally:
+                os.close(fd)
+
+    def write(self, offset: int, data: bytes) -> None:
+        if self._view is not None:
+            self._view[offset:offset + len(data)] = data
+        elif self._mm is not None:
+            self._mm[offset:offset + len(data)] = data
+
+    def seal(self) -> None:
+        self._done = True
+        if self._view is not None:
+            self._view = None  # release the export before sealing
+            self._store._arena.seal(self._oid.binary())
+        else:
+            if self._mm is not None:
+                self._mm.close()
+                self._mm = None
+            os.rename(self._path + ".part", self._path)
+            if not self._spilled:
+                self._store._file_bytes += self._size
+
+    def abort(self) -> None:
+        if self._done:
+            return
+        self._done = True
+        if self._view is not None:
+            self._view = None
+            self._store._arena.delete(self._oid.binary())
+        else:
+            if self._mm is not None:
+                self._mm.close()
+                self._mm = None
+            try:
+                os.unlink(self._path + ".part")
+            except OSError:
+                pass

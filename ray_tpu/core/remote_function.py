@@ -138,7 +138,8 @@ class RemoteFunction:
             refs = rt.submit(spec)
             return ObjectRefGenerator(
                 spec["task_id"], refs[0],
-                backpressured=bool(spec.get("stream_backpressure")))
+                backpressured=bool(spec.get("stream_backpressure")),
+                owner=getattr(rt, "cluster_node_id", None))
         refs = rt.submit(spec)
         if num_returns == 1:
             return refs[0]

@@ -517,7 +517,9 @@ class DriverRuntime:
             if rkind == "i":
                 self.gcs.mark_ready(oid, inline=payload)
             elif rkind == "s":
-                self.gcs.mark_ready(oid)
+                # payload = segment size (directory needs it so peers can
+                # pick chunked vs whole-blob pulls)
+                self.gcs.mark_ready(oid, size=payload or 0)
             else:
                 self.gcs.mark_error(oid, payload)
         fire = []
@@ -580,9 +582,16 @@ class DriverRuntime:
     def _handle_cast(self, ws: _WorkerState, op: str, args):
         if op == "put":
             oid = ObjectID(args[0])
-            self.gcs.mark_ready(oid, inline=args[1])
+            # size rides the message (worker had it in hand at write time)
+            size = args[2] if len(args) > 2 and args[1] is None else 0
+            self.gcs.mark_ready(oid, inline=args[1], size=size)
         elif op == "submit":
-            self.submit_spec(args[0])
+            if self.cluster is not None:
+                # placement may consult the GCS (dependency locality):
+                # never block the worker-pipe receiver on the network
+                self.cluster._io.submit(self.submit_spec, args[0])
+            else:
+                self.submit_spec(args[0])
         elif op == "actor_call":
             self._submit_actor_spec(args[0])
         elif op == "fn_put":
@@ -609,7 +618,8 @@ class DriverRuntime:
             self.cancel_task(ObjectID(args[0]),
                              args[1] if len(args) > 1 else False)
         elif op == "stream_consumed":
-            self.stream_consumed(args[0], args[1])
+            self.stream_consumed(args[0], args[1],
+                                 args[2] if len(args) > 2 else None)
         elif op == "refpin":
             self.worker_ref_delta(ws, args[0], args[1])
         elif op == "free":
@@ -1366,19 +1376,21 @@ class DriverRuntime:
 
     def put(self, value: Any) -> ObjectRef:
         oid = ObjectID.from_random()
-        inline = self.store.put(oid, value)
+        inline, size = self.store.put(oid, value)
         # ref BEFORE publishing ready: the pin cast precedes obj_ready on
         # the same connection, so the directory never sees this entry
         # terminal-and-unpinned
         ref = ObjectRef(oid)
-        self.gcs.mark_ready(oid, inline=inline)
+        self.gcs.mark_ready(oid, inline=inline,
+                            size=0 if inline is not None else size)
         return ref
 
     def put_parts(self, data: bytes, buffers) -> ObjectRef:
         oid = ObjectID.from_random()
-        inline = self.store.put_parts(oid, data, buffers)
+        inline, size = self.store.put_parts(oid, data, buffers)
         ref = ObjectRef(oid)
-        self.gcs.mark_ready(oid, inline=inline)
+        self.gcs.mark_ready(oid, inline=inline,
+                            size=0 if inline is not None else size)
         return ref
 
     def _cluster_watch(self, ids: List[ObjectID]) -> None:
@@ -1489,11 +1501,18 @@ class DriverRuntime:
         if st is not None and st.status == "PENDING":
             self.gcs.mark_error(obj_id, err)
 
-    def stream_consumed(self, task_id: bytes, n: int) -> None:
+    @property
+    def cluster_node_id(self):
+        """This node's cluster id (owner tag on streaming generators)."""
+        return self.node_id.binary() if self.cluster is not None else None
+
+    def stream_consumed(self, task_id: bytes, n: int, owner=None) -> None:
         fire = []
+        advanced = False
         with self._stream_cv:
             if n > self._stream_consumed.get(task_id, 0):
                 self._stream_consumed[task_id] = n
+                advanced = True
             # bound the counter dict (late acks re-create entries) —
             # never evicting a stream with a parked producer
             if len(self._stream_consumed) > 10000:
@@ -1510,6 +1529,12 @@ class DriverRuntime:
                 else:
                     kept.append((tid, need, rep))
             self._stream_waiters = kept
+        if advanced and self.cluster is not None:
+            # producer may be parked on a PEER node (forwarded/actor-routed
+            # stream): relay the absolute count there, non-blocking. Only
+            # on ADVANCE — an unconditional relay + a stale reciprocal
+            # route pair would ping-pong the same ack forever.
+            self.cluster.relay_stream_consumed(task_id, n, owner)
         for rep in fire:
             rep(True)
 

@@ -201,14 +201,14 @@ class WorkerRuntime:
 
     def put(self, value: Any) -> ObjectRef:
         obj_id = ObjectID.from_random()
-        inline = self.store.put(obj_id, value)
-        self.cast("put", obj_id.binary(), inline)
+        inline, size = self.store.put(obj_id, value)
+        self.cast("put", obj_id.binary(), inline, size)
         return ObjectRef(obj_id)
 
     def put_parts(self, data: bytes, buffers) -> ObjectRef:
         obj_id = ObjectID.from_random()
-        inline = self.store.put_parts(obj_id, data, buffers)
-        self.cast("put", obj_id.binary(), inline)
+        inline, size = self.store.put_parts(obj_id, data, buffers)
+        self.cast("put", obj_id.binary(), inline, size)
         return ObjectRef(obj_id)
 
     def get(self, refs: List[ObjectRef], timeout: Optional[float] = None):
@@ -376,11 +376,14 @@ class WorkerRuntime:
         results = []
         for rid_b, v in zip(rids, values):
             oid = ObjectID(rid_b)
-            inline = self.store.put(oid, v)
+            inline, size = self.store.put(oid, v)
             if inline is not None:
                 results.append((rid_b, "i", inline))
             else:
-                results.append((rid_b, "s", None))
+                # payload = segment size: the runtime records it in the
+                # directory so peers can plan chunked pulls (re-statting
+                # on the demux thread would tax every result)
+                results.append((rid_b, "s", size))
         return results
 
     def _apply_runtime_env(self, spec: dict):
@@ -460,28 +463,37 @@ class WorkerRuntime:
         count = 0
         for item in value:
             if bp and count >= bp:
-                # permit to produce item `count`: at most bp outstanding.
-                # Release our resource slot while parked — a consumer
-                # draining slowly must not starve the pool. The timeout is
-                # a deadlock valve (e.g. a consumer whose acks land on a
-                # different node): proceed unthrottled rather than park a
-                # worker forever.
-                self.cast("blocked")
-                try:
-                    out = self.request("stream_permit", spec["task_id"],
-                                       count + 1 - bp, timeout=300.0)
-                finally:
-                    self.cast("unblocked")
-                if out is _TIMEOUT:
-                    bp = None  # give up pacing for the rest of the stream
-            oid = ObjectID(ts.streaming_return_id(spec["task_id"], count))
-            inline = self.store.put(oid, item)
-            self.cast("put", oid.binary(), inline)
+                bp = self._await_stream_permit(spec, count, bp)
+            self._emit_stream_item(spec, count, item)
             count += 1
         return self._encode_results(spec, count)
 
-    def stream_consumed(self, task_id: bytes, n: int) -> None:
-        self.cast("stream_consumed", task_id, n)
+    def _await_stream_permit(self, spec: dict, count: int, bp: int):
+        """Permit to produce item ``count``: at most ``bp`` outstanding.
+        Releases our resource slot while parked — a consumer draining
+        slowly must not starve the pool. The timeout is a deadlock valve
+        (e.g. consumer acks lost to a dead node): proceed unthrottled
+        rather than park a worker forever. Returns bp, or None when pacing
+        was abandoned."""
+        self.cast("blocked")
+        try:
+            out = self.request("stream_permit", spec["task_id"],
+                               count + 1 - bp, timeout=300.0)
+        finally:
+            self.cast("unblocked")
+        return None if out is _TIMEOUT else bp
+
+    def _emit_stream_item(self, spec: dict, count: int, item) -> None:
+        oid = ObjectID(ts.streaming_return_id(spec["task_id"], count))
+        inline, size = self.store.put(oid, item)
+        self.cast("put", oid.binary(), inline, size)
+
+    def stream_consumed(self, task_id: bytes, n: int, owner=None) -> None:
+        self.cast("stream_consumed", task_id, n, owner)
+
+    @property
+    def cluster_node_id(self):
+        return None  # workers tag no owner; their node runtime routes
 
     def _make_actor_loop(self, actor_id: bytes):
         import asyncio
@@ -532,53 +544,21 @@ class WorkerRuntime:
         awaited off-loop so the actor loop never blocks."""
         import asyncio
 
-        loop = self._actor_loops[spec["actor_id"]]
-
         async def drain():
             bp = spec.get("stream_backpressure")
             count = 0
             aloop = asyncio.get_running_loop()
             async for item in agen:
                 if bp and count >= bp:
-                    self.cast("blocked")
-                    try:
-                        out = await aloop.run_in_executor(
-                            None, lambda c=count: self.request(
-                                "stream_permit", spec["task_id"],
-                                c + 1 - bp, timeout=300.0))
-                    finally:
-                        self.cast("unblocked")
-                    if out is _TIMEOUT:
-                        bp = None
-                oid = ObjectID(ts.streaming_return_id(spec["task_id"],
-                                                      count))
-                inline = self.store.put(oid, item)
-                self.cast("put", oid.binary(), inline)
+                    bp = await aloop.run_in_executor(
+                        None, self._await_stream_permit, spec, count, bp)
+                self._emit_stream_item(spec, count, item)
                 count += 1
             return count
 
-        fut = asyncio.run_coroutine_threadsafe(drain(), loop)
-        tid = spec["task_id"]
-        with self._running_lock:
-            self._running_futs[tid] = fut
-
-        def on_done(f):
-            with self._running_lock:
-                self._running_futs.pop(tid, None)
-            try:
-                try:
-                    count = f.result()
-                except BaseException as e:  # noqa: BLE001
-                    self._send_error(spec, e)
-                    return
-                results = self._encode_results(spec, count)
-                self._send(("done", tid, results))
-            except BaseException as e:  # noqa: BLE001
-                self._send_error(spec, e)
-            finally:
-                undo_env()
-
-        fut.add_done_callback(on_done)
+        # the sentinel return id resolves to the item count, exactly like
+        # a plain async call resolves to its value
+        self._schedule_async(spec, drain(), undo_env)
 
     def _send_error(self, spec: dict, e: BaseException):
         from concurrent.futures import CancelledError

@@ -1,20 +1,30 @@
-"""HTTP proxy: minimal asyncio HTTP/1.1 server routing to deployments.
+"""HTTP proxy: asyncio HTTP/1.1 server routing to deployments.
 
 Role analog: ``python/ray/serve/_private/proxy.py:1112`` (``HTTPProxy``
-:748). The reference runs uvicorn/ASGI per node; here a stdlib asyncio
-server (no external deps) parses requests, routes ``/<deployment>`` to the
-deployment's handle, and returns JSON. Runs on a daemon thread in the
-driver process (single-node data plane).
+:748). The reference rides uvicorn/ASGI per node; here a stdlib asyncio
+server (no external deps) speaks enough HTTP/1.1 for a real client
+matrix — keep-alive, chunked request bodies, 400/404/405/413/500 — and
+routes ``/<deployment>`` to the deployment's handle. Runs on a daemon
+thread in the driver process (single-node data plane). The gRPC ingress
+with the same routing lives in ``grpc_proxy.py``.
 """
 
 from __future__ import annotations
 
 import asyncio
 import json
+import os
 import threading
 from typing import Any, Dict, Optional
 
 from ray_tpu.serve.handle import DeploymentHandle
+
+MAX_BODY = int(os.environ.get("RTPU_SERVE_MAX_BODY", str(64 << 20)))
+ALLOWED_METHODS = {"GET", "POST", "PUT", "DELETE", "HEAD"}
+
+
+class _BodyTooLarge(Exception):
+    pass
 
 
 class HTTPProxy:
@@ -34,31 +44,11 @@ class HTTPProxy:
     async def _handle_conn(self, reader: asyncio.StreamReader,
                            writer: asyncio.StreamWriter):
         try:
-            request_line = await reader.readline()
-            if not request_line:
-                return
-            method, path, _ = request_line.decode().split(" ", 2)
-            headers: Dict[str, str] = {}
             while True:
-                line = await reader.readline()
-                if line in (b"\r\n", b"\n", b""):
+                if not await self._handle_one(reader, writer):
                     break
-                k, _, v = line.decode().partition(":")
-                headers[k.strip().lower()] = v.strip()
-            body = b""
-            n = int(headers.get("content-length", 0))
-            if n:
-                body = await reader.readexactly(n)
-            if "?stream=1" in path or path.endswith("&stream=1"):
-                await self._route_streaming(method, path, body, writer)
-                return
-            status, payload = await self._route(method, path, body)
-            data = json.dumps(payload).encode()
-            writer.write(
-                f"HTTP/1.1 {status}\r\nContent-Type: application/json\r\n"
-                f"Content-Length: {len(data)}\r\nConnection: close"
-                f"\r\n\r\n".encode() + data)
-            await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
         except Exception:
             pass
         finally:
@@ -66,6 +56,104 @@ class HTTPProxy:
                 writer.close()
             except Exception:
                 pass
+
+    async def _read_chunked(self, reader: asyncio.StreamReader) -> bytes:
+        """Chunked request body (curl --data with unknown length, gRPC-web
+        style clients). Trailers are read and dropped."""
+        body = b""
+        while True:
+            szline = await reader.readline()
+            if not szline:
+                raise asyncio.IncompleteReadError(b"", None)
+            size = int(szline.strip().split(b";")[0], 16)
+            if size == 0:
+                while True:
+                    line = await reader.readline()
+                    if line in (b"\r\n", b"\n", b""):
+                        break
+                return body
+            if len(body) + size > MAX_BODY:
+                raise _BodyTooLarge
+            body += await reader.readexactly(size)
+            await reader.readexactly(2)  # trailing CRLF
+
+    async def _respond(self, writer, status: str, payload: dict,
+                       keep: bool, head_only: bool = False,
+                       extra_headers: str = ""):
+        data = json.dumps(payload).encode()
+        writer.write(
+            f"HTTP/1.1 {status}\r\nContent-Type: application/json\r\n"
+            f"Content-Length: {len(data)}\r\n{extra_headers}"
+            f"Connection: {'keep-alive' if keep else 'close'}"
+            f"\r\n\r\n".encode() + (b"" if head_only else data))
+        await writer.drain()
+
+    async def _handle_one(self, reader: asyncio.StreamReader,
+                          writer: asyncio.StreamWriter) -> bool:
+        """One request/response exchange; returns False to end the
+        connection (keep-alive loop otherwise)."""
+        request_line = await reader.readline()
+        if not request_line or request_line in (b"\r\n", b"\n"):
+            return False
+        parts = request_line.decode("latin1").rstrip("\r\n").split(" ")
+        if len(parts) != 3:
+            await self._respond(writer, "400 Bad Request",
+                               {"error": "malformed request line"}, False)
+            return False
+        method, path, version = parts
+        headers: Dict[str, str] = {}
+        hdr_bytes = 0
+        while True:
+            line = await reader.readline()
+            if line in (b"\r\n", b"\n", b""):
+                break
+            hdr_bytes += len(line)
+            if len(headers) > 256 or hdr_bytes > 64 << 10:
+                # headers are attacker-controlled input too: bound them
+                await self._respond(
+                    writer, "431 Request Header Fields Too Large",
+                    {"error": "too many/large headers"}, False)
+                return False
+            k, _, v = line.decode("latin1").partition(":")
+            headers[k.strip().lower()] = v.strip()
+        conn_hdr = headers.get("connection", "").lower()
+        if version == "HTTP/1.0":
+            keep = conn_hdr == "keep-alive"
+        else:
+            keep = conn_hdr != "close"
+        # body — Content-Length or chunked, both bounded by MAX_BODY
+        try:
+            if "chunked" in headers.get("transfer-encoding", "").lower():
+                body = await self._read_chunked(reader)
+            else:
+                n = int(headers.get("content-length", 0) or 0)
+                if n > MAX_BODY:
+                    raise _BodyTooLarge
+                body = await reader.readexactly(n) if n else b""
+        except _BodyTooLarge:
+            # the unread body makes the stream unparseable: must close
+            await self._respond(writer, "413 Payload Too Large",
+                               {"error": f"body exceeds {MAX_BODY} bytes"},
+                               False)
+            return False
+        except ValueError:
+            await self._respond(writer, "400 Bad Request",
+                               {"error": "bad framing headers"}, False)
+            return False
+        if method not in ALLOWED_METHODS:
+            await self._respond(
+                writer, "405 Method Not Allowed",
+                {"error": f"method {method} not allowed"}, keep,
+                extra_headers="Allow: " + ", ".join(
+                    sorted(ALLOWED_METHODS)) + "\r\n")
+            return keep
+        if "?stream=1" in path or path.endswith("&stream=1"):
+            await self._route_streaming(method, path, body, writer)
+            return False  # streaming responses close the connection
+        status, payload = await self._route(method, path, body)
+        await self._respond(writer, status, payload, keep,
+                            head_only=(method == "HEAD"))
+        return keep
 
     async def _route(self, method: str, path: str, body: bytes):
         name = path.strip("/").split("?")[0].split("/")[0]

@@ -20,6 +20,17 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import pytest  # noqa: E402
 
+# `kill -USR1 <pytest pid>` dumps all thread stacks — the only way to see
+# where the DRIVER side of a hung cluster test is parked (workers already
+# register this in worker.py).
+import faulthandler  # noqa: E402
+import signal  # noqa: E402
+
+try:
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
+except (AttributeError, ValueError):
+    pass
+
 
 @pytest.fixture
 def rt():

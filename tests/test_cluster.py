@@ -583,3 +583,172 @@ def test_cancel_routes_to_remote_node(cluster, tmp_path):
     with pytest.raises(TaskCancelledError):
         ray_tpu.get(ref, timeout=45)
     assert time.monotonic() - t0 < 30, "remote cancel did not interrupt"
+
+
+def _vm_hwm_kb(pid: int) -> int:
+    with open(f"/proc/{pid}/status") as f:
+        for line in f:
+            if line.startswith("VmHWM:"):
+                return int(line.split()[1])
+    return 0
+
+
+def test_chunked_transfer_bounded_memory(cluster):
+    """A large object moves node-to-node in chunks (reference
+    push_manager.h/pull_manager.h roles): neither daemon materializes the
+    whole blob — peak RSS grows by ~the object (shm pages touched), never
+    by 2-3x of it (whole-blob pickle/recv buffers)."""
+    src = cluster.add_node(num_cpus=2, resources={"src": 1})
+    dst = cluster.add_node(num_cpus=2, resources={"dst": 1})
+    _init(cluster)
+    _wait_nodes(3)
+
+    @ray_tpu.remote(resources={"src": 1})
+    def produce(n):
+        return np.full(n, 7.0)
+
+    @ray_tpu.remote(resources={"dst": 1})
+    def consume(x):
+        return float(x[0]), float(x[-1]), x.nbytes
+
+    # warm: spawn workers + peer connections + a small transfer first so
+    # baseline HWM includes all fixed costs
+    assert ray_tpu.get(consume.remote(produce.remote(1 << 10)),
+                       timeout=120)[2] == (1 << 10) * 8
+
+    src_pid = cluster._node_procs[src].pid
+    dst_pid = cluster._node_procs[dst].pid
+    base_src = _vm_hwm_kb(src_pid)
+    base_dst = _vm_hwm_kb(dst_pid)
+
+    n = (256 << 20) // 8  # 256 MiB of float64
+    lo, hi, nbytes = ray_tpu.get(consume.remote(produce.remote(n)),
+                                 timeout=300)
+    assert (lo, hi) == (7.0, 7.0)
+    assert nbytes == 256 << 20
+
+    size_kb = (256 << 20) // 1024
+    slack_kb = (128 << 20) // 1024
+    d_src = _vm_hwm_kb(src_pid) - base_src
+    d_dst = _vm_hwm_kb(dst_pid) - base_dst
+    # serving/receiving touches the object's shm pages once (~size) plus
+    # chunk-size scratch; a whole-blob path costs 2-3x size in anon RAM
+    assert d_src < size_kb + slack_kb, f"src daemon ballooned: {d_src} kB"
+    assert d_dst < size_kb + slack_kb, f"dst daemon ballooned: {d_dst} kB"
+
+
+def test_cross_node_streaming_backpressure(cluster):
+    """Consumer acks relay to the node running the producer: a forwarded
+    backpressured generator paces to the consumer instead of parking
+    forever (or streaming unthrottled, round 2's fallback)."""
+    cluster.add_node(num_cpus=2, resources={"peer": 2})
+    _init(cluster)
+    _wait_nodes(2)
+
+    @ray_tpu.remote(resources={"peer": 1})
+    def warm():
+        return None
+
+    ray_tpu.get(warm.remote(), timeout=90)
+
+    @ray_tpu.remote(resources={"peer": 1}, num_returns="streaming",
+                    _generator_backpressure_num_objects=2)
+    def fast_gen():
+        for i in range(6):
+            yield (i, time.monotonic())
+
+    g = fast_gen.remote()
+    stamps = []
+    for ref in g:
+        stamps.append(ray_tpu.get(ref, timeout=90))
+        time.sleep(0.5)  # slow consumer
+    assert [i for i, _ in stamps] == list(range(6))
+    t = [ts for _, ts in stamps]
+    spread = t[5] - t[0]
+    assert spread > 1.0, f"producer ran ahead of backpressure: {spread:.2f}s"
+
+
+def test_locality_aware_scheduling(cluster):
+    """A task whose big arg lives on a peer schedules on that peer even
+    though the head has free CPUs: ship the task to the data (reference
+    hybrid_scheduling_policy.h:50 locality scoring; VERDICT r3 #6 done
+    criterion)."""
+    cluster.add_node(num_cpus=2, resources={"b": 2})
+    _init(cluster)
+    _wait_nodes(2)
+
+    @ray_tpu.remote(resources={"b": 1})
+    def whoami():
+        from ray_tpu.core.runtime import _get_runtime
+
+        return _get_runtime().store.session
+
+    b_session = ray_tpu.get(whoami.remote(), timeout=90)
+
+    @ray_tpu.remote(resources={"b": 1})
+    def produce():
+        return np.zeros((50 << 20) // 8)  # 50 MB, lives on daemon b
+
+    ref = produce.remote()
+    # wait for the DIRECTORY to know the location — without get()ing the
+    # object here (that would copy it to the head and erase the signal)
+    from ray_tpu.core.runtime import _get_runtime
+
+    rt = _get_runtime()
+    deadline = time.monotonic() + 90
+    while time.monotonic() < deadline:
+        st = rt.cluster.gcs.call("obj_state", ref.id.binary(), timeout=10)
+        if st is not None and st["status"] == "READY":
+            break
+        time.sleep(0.2)
+    else:
+        raise AssertionError("produce() never completed")
+
+    @ray_tpu.remote(num_cpus=1)
+    def consume(x):
+        from ray_tpu.core.runtime import _get_runtime
+
+        return float(x[0]), _get_runtime().store.session
+
+    val, sess = ray_tpu.get(consume.remote(ref), timeout=120)
+    assert val == 0.0
+    assert sess == b_session, "task did not follow its 50MB dependency"
+
+
+def test_stream_backpressure_consumer_on_third_node(cluster):
+    """Generator created on the head, producer forwarded to node B,
+    consumed by a task on node C: acks route C -> owner(head) -> B, so
+    the producer paces instead of parking 300s (review r3 finding)."""
+    cluster.add_node(num_cpus=2, resources={"prod": 1})
+    cluster.add_node(num_cpus=2, resources={"cons": 1})
+    _init(cluster)
+    _wait_nodes(3)
+
+    @ray_tpu.remote(resources={"prod": 1})
+    def warm_p():
+        return None
+
+    @ray_tpu.remote(resources={"cons": 1})
+    def warm_c():
+        return None
+
+    ray_tpu.get([warm_p.remote(), warm_c.remote()], timeout=120)
+
+    @ray_tpu.remote(resources={"prod": 1}, num_returns="streaming",
+                    _generator_backpressure_num_objects=2)
+    def gen():
+        for i in range(6):
+            yield (i, time.monotonic())
+
+    @ray_tpu.remote(resources={"cons": 1})
+    def consume(g):
+        out = []
+        for ref in g:
+            out.append(ray_tpu.get(ref, timeout=60))
+            time.sleep(0.5)  # slow consumer on node C
+        return out
+
+    stamps = ray_tpu.get(consume.remote(gen.remote()), timeout=180)
+    assert [i for i, _ in stamps] == list(range(6))
+    spread = stamps[5][1] - stamps[0][1]
+    assert spread > 1.0, f"producer ran ahead: {spread:.2f}s"
