@@ -1,0 +1,149 @@
+"""Cluster launcher: ``ray_tpu up/down <cluster.yaml>``.
+
+Role analog: the reference launcher CLI (``python/ray/scripts/scripts.py``
+``ray up`` at ``:1279``, YAML schema ``autoscaler/ray-schema.json``,
+``TPUCommandRunner`` running setup on every pod host,
+``gcp/tpu_command_runner.py``) — reduced to the path a TPU cluster needs:
+ensure the head exists, run setup + start commands over SSH on every
+host (all hosts of a TPU slice, like the reference's TPU runner), report
+the address. Provider and command runner are injectable so the flow is
+testable without a cloud.
+
+YAML shape::
+
+    cluster_name: demo
+    provider: {type: gcp, project_id: p, availability_zone: us-central2-b}
+    auth: {ssh_user: ubuntu}
+    head_node_type: head
+    available_node_types:
+      head:
+        kind: compute
+        machine_type: n2-standard-8
+        resources: {CPU: 8}
+      v5e-16:
+        kind: tpu
+        accelerator_type: v5litepod-16
+        min_workers: 0
+        max_workers: 2
+    setup_commands: [...]
+    head_start_commands: [...]
+    worker_start_commands: [...]
+"""
+
+from __future__ import annotations
+
+import subprocess
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.autoscaler.node_provider import NodeInfo, NodeProvider
+
+
+def load_config(path: str) -> Dict[str, Any]:
+    import yaml
+
+    with open(path) as f:
+        cfg = yaml.safe_load(f)
+    for key in ("cluster_name", "provider", "head_node_type",
+                "available_node_types"):
+        if key not in cfg:
+            raise ValueError(f"cluster yaml missing required key {key!r}")
+    if cfg["head_node_type"] not in cfg["available_node_types"]:
+        raise ValueError("head_node_type not in available_node_types")
+    return cfg
+
+
+def make_provider(cfg: Dict[str, Any]) -> NodeProvider:
+    p = cfg["provider"]
+    kind = p.get("type", "fake")
+    if kind == "gcp":
+        from ray_tpu.autoscaler.gcp import GcpTpuNodeProvider
+
+        return GcpTpuNodeProvider(
+            project=p["project_id"], zone=p["availability_zone"],
+            cluster_name=cfg["cluster_name"],
+            node_types=cfg["available_node_types"])
+    if kind == "fake":
+        from ray_tpu.autoscaler.fake_provider import FakeTpuNodeProvider
+
+        types = {name: spec.get("resources", {"CPU": 1})
+                 for name, spec in cfg["available_node_types"].items()}
+        return FakeTpuNodeProvider(types)
+    raise ValueError(f"unknown provider type {kind!r}")
+
+
+class SshRunner:
+    """Runs shell commands on a node over ssh (role analog
+    ``command_runner.py``/``tpu_command_runner.py``)."""
+
+    def __init__(self, user: str, opts: Optional[List[str]] = None):
+        self.user = user
+        self.opts = opts or ["-o", "StrictHostKeyChecking=no",
+                             "-o", "ConnectTimeout=15"]
+
+    def run(self, node: NodeInfo, cmd: str) -> None:
+        ip = node.tags.get("ip") or node.node_id
+        subprocess.run(["ssh", *self.opts, f"{self.user}@{ip}", cmd],
+                       check=True)
+
+
+def up(cfg: Dict[str, Any], provider: Optional[NodeProvider] = None,
+       runner=None, yes: bool = True) -> Dict[str, Any]:
+    """Idempotently bring the head up; returns a summary dict."""
+    provider = provider or make_provider(cfg)
+    runner = runner or SshRunner(cfg.get("auth", {}).get("ssh_user", "rtpu"))
+    head_type = cfg["head_node_type"]
+    live = provider.non_terminated_nodes()
+    head = next((n for n in live if n.node_type == head_type), None)
+    created = False
+    if head is None:
+        spec = cfg["available_node_types"][head_type]
+        if spec.get("kind") == "tpu":
+            head = provider.create_slice(head_type)[0]
+        else:
+            head = provider.create_nodes(head_type, 1)[0]
+        created = True
+    for cmd in cfg.get("setup_commands", []):
+        runner.run(head, cmd)
+    for cmd in cfg.get("head_start_commands", []):
+        runner.run(head, cmd)
+    # min_workers of each worker type (the autoscaler grows past this)
+    workers: List[NodeInfo] = []
+    for name, spec in cfg["available_node_types"].items():
+        if name == head_type:
+            continue
+        want = int(spec.get("min_workers", 0))
+        have = len({(n.slice_id or n.node_id) for n in live
+                    if n.node_type == name})
+        for _ in range(max(0, want - have)):
+            if spec.get("kind") == "tpu":
+                hosts = provider.create_slice(name)
+            else:
+                hosts = provider.create_nodes(name, 1)
+            workers.extend(hosts)
+            for h in hosts:  # TPU: setup runs on EVERY pod host
+                for cmd in cfg.get("setup_commands", []):
+                    runner.run(h, cmd)
+                for cmd in cfg.get("worker_start_commands", []):
+                    runner.run(h, cmd)
+    return {"head": head, "head_created": created,
+            "workers_started": workers,
+            "address": head.tags.get("ip") or head.node_id}
+
+
+def down(cfg: Dict[str, Any],
+         provider: Optional[NodeProvider] = None) -> int:
+    """Terminate every node of the cluster; returns count torn down."""
+    provider = provider or make_provider(cfg)
+    live = provider.non_terminated_nodes()
+    seen_slices = set()
+    n = 0
+    for node in live:
+        if node.slice_id is not None:
+            if node.slice_id in seen_slices:
+                continue
+            seen_slices.add(node.slice_id)
+            provider.terminate_slice(node.slice_id)
+        else:
+            provider.terminate_node(node.node_id)
+        n += 1
+    return n

@@ -159,6 +159,12 @@ def main(argv=None) -> int:
     st = sub.add_parser("stack", help="dump python stacks of live workers")
     st.add_argument("--limit", type=int, default=16)
 
+    up = sub.add_parser("up", help="launch a cluster from a yaml "
+                                   "(reference `ray up` role)")
+    up.add_argument("config")
+    down = sub.add_parser("down", help="tear a cluster down")
+    down.add_argument("config")
+
     job = sub.add_parser("job", help="job submission")
     jobsub = job.add_subparsers(dest="job_cmd", required=True)
     js = jobsub.add_parser("submit")
@@ -179,6 +185,20 @@ def main(argv=None) -> int:
         return _cmd_timeline(args)
     if args.cmd == "stack":
         return _cmd_stack(args)
+    if args.cmd == "up":
+        from ray_tpu.autoscaler import launcher
+
+        out = launcher.up(launcher.load_config(args.config))
+        print(f"head {'created' if out['head_created'] else 'alive'}: "
+              f"{out['head'].node_id} @ {out['address']}; "
+              f"{len(out['workers_started'])} worker host(s) started")
+        return 0
+    if args.cmd == "down":
+        from ray_tpu.autoscaler import launcher
+
+        n = launcher.down(launcher.load_config(args.config))
+        print(f"terminated {n} node(s)/slice(s)")
+        return 0
     if args.cmd == "job":
         if args.job_cmd == "submit":
             return _cmd_job_submit(args)

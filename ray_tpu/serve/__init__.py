@@ -26,6 +26,7 @@ from ray_tpu.serve.deployment import (
     deployment,
 )
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.grpc_proxy import GrpcProxy
 from ray_tpu.serve.proxy import HTTPProxy
 
 __all__ = [
@@ -41,6 +42,7 @@ __all__ = [
     "DeploymentHandle",
     "DeploymentResponse",
     "HTTPProxy",
+    "GrpcProxy",
     "batch",
     "multiplexed",
     "get_multiplexed_model_id",

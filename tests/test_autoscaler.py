@@ -118,3 +118,172 @@ def test_busy_nodes_survive_idle_timeout():
     time.sleep(0.05)
     asc.update(used_resources={})
     assert provider.non_terminated_nodes() == []
+
+
+# ---------------------------------------------------------------------------
+# GCP provider against a recorded API surface (reference
+# gcp/node_provider.py:75-94 behavior; no cloud, no network)
+# ---------------------------------------------------------------------------
+
+
+class _FakeGcpApi:
+    """Scripted transport: answers like the TPU/GCE REST APIs and records
+    every call for assertions."""
+
+    def __init__(self):
+        self.calls = []
+        self.tpu_nodes = {}
+        self.instances = {}
+
+    def __call__(self, method, url, body=None):
+        self.calls.append((method, url, body))
+        if "tpu.googleapis.com" in url:
+            return self._tpu(method, url, body)
+        return self._gce(method, url, body)
+
+    def _tpu(self, method, url, body):
+        if method == "POST" and "/nodes?nodeId=" in url:
+            name = url.rsplit("nodeId=", 1)[1]
+            acc = body["acceleratorType"]
+            n_hosts = {"v5litepod-16": 4, "v5litepod-4": 1}.get(acc, 1)
+            self.tpu_nodes[name] = {
+                "name": f"projects/p/locations/z/nodes/{name}",
+                "state": "READY",
+                "acceleratorType": acc,
+                "labels": body["labels"],
+                "networkEndpoints": [
+                    {"ipAddress": f"10.0.0.{i}"} for i in range(n_hosts)],
+            }
+            return {"name": f"op-{name}", "done": True}
+        if method == "GET" and "/nodes/" in url:
+            name = url.rsplit("/", 1)[1]
+            return self.tpu_nodes[name]
+        if method == "GET" and url.endswith("/nodes"):
+            return {"nodes": list(self.tpu_nodes.values())}
+        if method == "DELETE":
+            name = url.rsplit("/", 1)[1]
+            self.tpu_nodes.pop(name, None)
+            return {"name": f"op-del-{name}", "done": True}
+        raise AssertionError(f"unexpected tpu call {method} {url}")
+
+    def _gce(self, method, url, body):
+        if method == "POST" and url.endswith("/instances"):
+            self.instances[body["name"]] = {
+                "name": body["name"], "status": "RUNNING",
+                "labels": body["labels"],
+            }
+            return {"name": f"op-{body['name']}", "done": True}
+        if method == "GET" and "/instances?filter=" in url:
+            return {"items": list(self.instances.values())}
+        if method == "DELETE":
+            name = url.rsplit("/", 1)[1]
+            self.instances.pop(name, None)
+            return {"name": f"op-del-{name}", "done": True}
+        raise AssertionError(f"unexpected gce call {method} {url}")
+
+
+_NODE_TYPES = {
+    "head": {"kind": "compute", "machine_type": "n2-standard-8",
+             "resources": {"CPU": 8.0}},
+    "v5e-16": {"kind": "tpu", "accelerator_type": "v5litepod-16",
+               "runtime_version": "tpu-ubuntu2204-base"},
+}
+
+
+def _gcp_provider():
+    from ray_tpu.autoscaler.gcp import GcpTpuNodeProvider
+
+    api = _FakeGcpApi()
+    prov = GcpTpuNodeProvider(
+        project="p", zone="z", cluster_name="demo",
+        node_types=_NODE_TYPES, transport=api, poll_interval_s=0.0)
+    return prov, api
+
+
+def test_gcp_create_slice_maps_hosts_and_head_resource():
+    prov, api = _gcp_provider()
+    hosts = prov.create_slice("v5e-16")
+    assert len(hosts) == 4  # 16 chips / 4 per host
+    assert all(h.slice_id == hosts[0].slice_id for h in hosts)
+    assert hosts[0].is_slice_head
+    assert hosts[0].resources["TPU-v5litepod-16-head"] == 1.0
+    assert all(h.resources["TPU"] == 4.0 for h in hosts)
+    slice_name = hosts[0].slice_id
+    assert all(h.resources[slice_name] == 1.0 for h in hosts)
+    # the create rode the TPU API with cluster labels
+    post = next(c for c in api.calls if c[0] == "POST")
+    assert post[2]["labels"]["rtpu-cluster"] == "demo"
+
+
+def test_gcp_list_and_terminate_slice_as_unit():
+    prov, api = _gcp_provider()
+    hosts = prov.create_slice("v5e-16")
+    prov.create_nodes("head", 1)
+    live = prov.non_terminated_nodes()
+    assert len(live) == 5  # 4 slice hosts + 1 compute
+    prov.terminate_node(hosts[2].node_id)  # any host kills the slice
+    live = prov.non_terminated_nodes()
+    assert len(live) == 1 and live[0].slice_id is None
+
+
+def test_gcp_list_filters_foreign_clusters():
+    prov, api = _gcp_provider()
+    prov.create_slice("v5e-16")
+    api.tpu_nodes["other"] = {
+        "name": "projects/p/locations/z/nodes/other", "state": "READY",
+        "acceleratorType": "v5litepod-4",
+        "labels": {"rtpu-cluster": "SOMEONE-ELSE"},
+        "networkEndpoints": [{}]}
+    assert all(n.tags["rtpu-cluster"] == "demo"
+               for n in prov.non_terminated_nodes())
+
+
+class _RecordingRunner:
+    def __init__(self):
+        self.ran = []
+
+    def run(self, node, cmd):
+        self.ran.append((node.node_id, cmd))
+
+
+def test_launcher_up_down_roundtrip(tmp_path):
+    from ray_tpu.autoscaler import launcher
+
+    cfg = {
+        "cluster_name": "demo",
+        "provider": {"type": "gcp", "project_id": "p",
+                     "availability_zone": "z"},
+        "auth": {"ssh_user": "u"},
+        "head_node_type": "head",
+        "available_node_types": {
+            "head": _NODE_TYPES["head"],
+            "v5e-16": dict(_NODE_TYPES["v5e-16"], min_workers=1),
+        },
+        "setup_commands": ["pip check"],
+        "head_start_commands": ["start-head"],
+        "worker_start_commands": ["start-worker"],
+    }
+    prov, api = _gcp_provider()
+    runner = _RecordingRunner()
+    out = launcher.up(cfg, provider=prov, runner=runner)
+    assert out["head_created"]
+    # head got setup + start; every slice host got setup + worker start
+    head_cmds = [c for nid, c in runner.ran if nid == out["head"].node_id]
+    assert head_cmds == ["pip check", "start-head"]
+    worker_hosts = {nid for nid, c in runner.ran if c == "start-worker"}
+    assert len(worker_hosts) == 4  # all hosts of the v5e-16 slice
+    # idempotent: second up creates nothing new
+    out2 = launcher.up(cfg, provider=prov, runner=runner)
+    assert not out2["head_created"]
+    assert not out2["workers_started"]
+    assert launcher.down(cfg, provider=prov) == 2  # head + slice
+    assert prov.non_terminated_nodes() == []
+
+
+def test_launcher_yaml_validation(tmp_path):
+    from ray_tpu.autoscaler import launcher
+
+    p = tmp_path / "c.yaml"
+    p.write_text("cluster_name: x\nprovider: {type: fake}\n")
+    with pytest.raises(ValueError):
+        launcher.load_config(str(p))
