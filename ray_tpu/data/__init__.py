@@ -9,7 +9,7 @@ mesh-sharded ``jax.Array`` batches (the ingest path of JaxTrainer).
 
 from ray_tpu.data.block import Block, BlockMetadata
 from ray_tpu.data.dataset import Dataset
-from ray_tpu.data.execution import ExecutionOptions
+from ray_tpu.data.execution import ActorPoolStrategy, ExecutionOptions
 from ray_tpu.data.grouped import GroupedData
 from ray_tpu.data.iterator import DataIterator
 from ray_tpu.data.read_api import (
@@ -33,6 +33,7 @@ __all__ = [
     "Dataset",
     "DataIterator",
     "ExecutionOptions",
+    "ActorPoolStrategy",
     "GroupedData",
     "from_arrow",
     "from_items",

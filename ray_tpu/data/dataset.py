@@ -26,14 +26,13 @@ from ray_tpu.data.block import (
     concat_blocks,
 )
 from ray_tpu.data.execution import (
+    ActorPoolStrategy,
     AllToAllOp,
     ExecutionOptions,
     LimitOp,
     MapOp,
+    ShuffleOp,
     execute_streaming,
-    repartition_fn,
-    shuffle_fn,
-    sort_fn,
 )
 
 
@@ -56,11 +55,22 @@ class Dataset:
         batch_size: Optional[int] = None,
         batch_format: str = "numpy",
         fn_kwargs: Optional[Dict[str, Any]] = None,
+        compute: Optional["ActorPoolStrategy"] = None,
+        fn_constructor_args: tuple = (),
         **_ignored,
     ) -> "Dataset":
         kwargs = fn_kwargs or {}
+        is_class = isinstance(fn, type)
+        if is_class and compute is None:
+            compute = ActorPoolStrategy()  # classes imply actor compute
 
-        def _map(block: Block) -> List[Block]:
+        def _map(block: Block, _state: Dict[str, Any] = {}) -> List[Block]:
+            call = fn
+            if is_class:
+                # per-actor (or per-task) stateful callable: construct once
+                if "obj" not in _state:
+                    _state["obj"] = fn(*fn_constructor_args)
+                call = _state["obj"]
             out: List[Block] = []
             n = block_num_rows(block)
             size = batch_size or n or 1
@@ -68,11 +78,12 @@ class Dataset:
                 piece = block_slice(block, i, min(i + size, n))
                 if block_num_rows(piece) == 0 and n > 0:
                     continue
-                res = fn(block_to_batch(piece, batch_format), **kwargs)
+                res = call(block_to_batch(piece, batch_format), **kwargs)
                 out.append(batch_to_block(res))
             return out
 
-        return self._with_op(MapOp(name="map_batches", fn=_map))
+        return self._with_op(MapOp(name="map_batches", fn=_map,
+                                   compute=compute))
 
     def map(self, fn: Callable[[Dict[str, Any]], Dict[str, Any]]) -> "Dataset":
         def _map(block: Block) -> List[Block]:
@@ -125,14 +136,17 @@ class Dataset:
         return self._with_op(MapOp(name="rename_columns", fn=_map))
 
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
-        return self._with_op(AllToAllOp("random_shuffle", shuffle_fn(seed)))
+        return self._with_op(ShuffleOp("random_shuffle", "random_shuffle",
+                                       {"seed": seed}))
 
     def repartition(self, num_blocks: int) -> "Dataset":
-        return self._with_op(AllToAllOp("repartition",
-                                        repartition_fn(num_blocks)))
+        return self._with_op(ShuffleOp("repartition", "repartition",
+                                       {"num_blocks": num_blocks}))
 
     def sort(self, key: str, descending: bool = False) -> "Dataset":
-        return self._with_op(AllToAllOp("sort", sort_fn(key, descending)))
+        return self._with_op(ShuffleOp("sort", "sort",
+                                       {"key": key,
+                                        "descending": descending}))
 
     def limit(self, n: int) -> "Dataset":
         return self._with_op(LimitOp("limit", n))
