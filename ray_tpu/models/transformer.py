@@ -208,15 +208,14 @@ def forward(
     if positions is None:
         positions = jnp.arange(l)[None, :]
 
-    # Embedding lookup in two sharding steps: first pin the gather's
-    # OUTPUT to its natural sharding (model dim follows the table's
-    # "embed" axis), then reshard the plain tensor to activation layout.
-    # Forcing (batch, seq, None) directly onto the gather op makes the
-    # SPMD partitioner fully rematerialize (replicate) the embedding
-    # activations — the MULTICHIP_r02 "Involuntary full rematerialization"
-    # warnings; a reshard on an ordinary tensor lowers to all-to-all.
-    x = params["embed"].astype(dt)[tokens]
-    x = constrain(x, (None, None, "embed"))
+    # Embedding lookup. STORAGE is (vocab:tp, embed:fsdp) — ZeRO-3 — but
+    # the lookup runs against a (vocab:tp, replicated-D) view: a D:fsdp
+    # gather output cannot be resharded to (batch, seq) activation layout
+    # without the SPMD partitioner's involuntary full rematerialization
+    # (the MULTICHIP warnings); all-gathering the table's D axis first is
+    # one clean collective and the standard TPU embedding layout.
+    tbl = constrain(params["embed"].astype(dt), ("vocab", None))
+    x = tbl[tokens]
     if c.positions == "learned":
         x = x + params["pos_embed"].astype(dt)[positions[0]][None]
     x = constrain(x, ("batch", "seq", None))

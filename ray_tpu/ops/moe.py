@@ -15,6 +15,8 @@ from typing import NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 
+from ray_tpu.parallel.sharding import constrain
+
 
 class RouterOutput(NamedTuple):
     dispatch: jax.Array      # [tokens, experts, capacity] one-hot-ish f32
@@ -85,7 +87,15 @@ def moe_layer_dense(
     """
     b, l, d = x.shape
     e = w_gate.shape[0]
-    xt = x.reshape(b * l, d)
+    # Pin the flattened token dim to "tokens" = (dp, fsdp, sp). Without
+    # this, the combine output inherits D:fsdp from w_down and the caller's
+    # activation-layout constraint forces the SPMD partitioner into an
+    # involuntary full rematerialization (MULTICHIP_r02). The layout
+    # matches (batch, seq) exactly when sp == 1 or the per-device batch
+    # block is 1; otherwise entry/exit cost one all-to-all — still far
+    # cheaper than replicating the tensor, and of a piece with the
+    # all-to-alls MoE dispatch does anyway under real expert parallelism.
+    xt = constrain(x.reshape(b * l, d), ("tokens", None))
     logits = xt.astype(jnp.float32) @ router_w.astype(jnp.float32)  # [T, E]
     route = top_k_router(logits, num_experts=e, k=k, capacity_factor=capacity_factor)
     # [T, E, C] x [T, D] -> [E, C, D]
@@ -94,4 +104,5 @@ def moe_layer_dense(
     up = jnp.einsum("ecd,edf->ecf", expert_in, w_up.astype(jnp.float32))
     expert_out = jnp.einsum("ecf,efd->ecd", gate * up, w_down.astype(jnp.float32))
     out = jnp.einsum("tec,ecd->td", route.combine, expert_out)
+    out = constrain(out, ("tokens", None))
     return out.reshape(b, l, d).astype(x.dtype), route.aux_loss
