@@ -101,6 +101,7 @@ class ClusterAdapter:
         # stream-consumed relays / node-down handling / state queries.
         self._pull_io = ThreadPoolExecutor(max_workers=PULL_CONCURRENCY,
                                            thread_name_prefix="cluster-pull")
+        self._task_ev_cursor = 0  # next local task event to ship to GCS
         # (size, locations) cache for dependency-locality scoring: fan-outs
         # of one big ref to N tasks pay one directory lookup, not N.
         # _obj_info_down_until: circuit breaker — while the GCS is not
@@ -183,6 +184,17 @@ class ClusterAdapter:
                     # a restarted GCS lost the (non-durable) node table:
                     # re-register + re-subscribe (GCS FT path)
                     self._register()
+                # ship NEW task events (reference TaskEventBuffer flush,
+                # task_event_buffer.h:206 role): batched + bounded, so
+                # the cluster state API sees every node's tasks. Acked
+                # call, not cast: the cursor only advances on receipt
+                evs = self.rt.timeline_events
+                cur = self._task_ev_cursor
+                if len(evs) > cur:
+                    batch = evs[cur:cur + 1000]
+                    if self.gcs.call("task_events", self.node_id, batch,
+                                     timeout=5):
+                        self._task_ev_cursor = cur + len(batch)
             except Exception:
                 pass
 
@@ -194,6 +206,9 @@ class ClusterAdapter:
                       self.rt.resources("total"), self.is_scheduler,
                       timeout=10)
         self._node_view_ts = 0.0
+        # a (re)registered GCS starts with an empty task-event store:
+        # reship our full local history
+        self._task_ev_cursor = 0
 
     def _on_gcs_reconnect(self):
         try:

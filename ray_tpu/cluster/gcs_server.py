@@ -82,6 +82,11 @@ class GcsService:
         self.free_grace_s = float(os.environ.get(
             "RTPU_GCS_FREE_GRACE_S", "10"))
         self._free_candidates: Dict[bytes, float] = {}
+        # cluster-wide task events (reference GcsTaskManager store)
+        from collections import deque
+
+        self.task_events = deque(maxlen=int(os.environ.get(
+            "RTPU_GCS_MAX_TASK_EVENTS", "50000")))
         self.kv: Dict[str, Dict[str, bytes]] = {}
         self.functions: Dict[str, bytes] = {}
         # named/global actor registry: actor_id -> record dict
@@ -362,6 +367,27 @@ class GcsService:
         for oid, locations in freed:
             self._publish("objects", {"oid": oid, "freed": True,
                                       "locations": locations})
+
+    def rpc_task_events(self, ctx, node_id: bytes, events):
+        """Batched task events from a node runtime (reference
+        TaskEventBuffer -> GcsTaskManager pipeline,
+        ``core_worker/task_event_buffer.h:206`` role): bounded store
+        feeding the cluster-wide state API and timeline."""
+        with self.lock:
+            nid = node_id.hex()[:8]
+            for ev in events:
+                ev = dict(ev)
+                ev["node"] = nid
+                self.task_events.append(ev)
+        return True
+
+    def rpc_task_events_get(self, ctx, limit: int = 10000):
+        limit = int(limit)
+        if limit <= 0:
+            return []
+        with self.lock:
+            evs = list(self.task_events)
+        return evs[-limit:]
 
     def rpc_obj_info(self, ctx, oids):
         """Batch (size, locations) for READY segment objects — the

@@ -33,6 +33,28 @@ _lock = threading.Lock()
 _COORD_PREFIX = "rtpu_collective_coord:"
 
 
+def _routable_host() -> str:
+    """An address OTHER hosts can reach (rendezvous coordinator binding).
+    ``gethostbyname(gethostname())`` maps to loopback on common
+    /etc/hosts layouts, which would break cross-host groups; the UDP
+    connect trick reads the outbound interface without sending a packet.
+    Override with RTPU_COORDINATOR_HOST."""
+    import os
+    import socket
+
+    env = os.environ.get("RTPU_COORDINATOR_HOST")
+    if env:
+        return env
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("8.8.8.8", 80))
+        return s.getsockname()[0]
+    except Exception:
+        return socket.gethostbyname(socket.gethostname())
+    finally:
+        s.close()
+
+
 def _get_or_create_coordinator(group_name: str, world_size: int):
     """Get or create the named coordinator actor. Returns (handle, created)."""
     import ray_tpu
@@ -297,6 +319,106 @@ class XlaGroup(BaseGroup):
                         for _ in range(len(self.mesh.devices.flat))])
 
 
+class XlaDistributedGroup(XlaGroup):
+    """XLA collectives ACROSS MEMBER PROCESSES over one global mesh.
+
+    Role analog: the reference NCCLGroup
+    (``collective_group/nccl_collective_group.py:128``): the named
+    coordinator actor fills the NCCL-unique-id rendezvous role (it carries
+    the jax coordinator address), the communicator state is
+    ``jax.distributed``, and every verb compiles to an XLA collective
+    executed collectively by all member processes — gloo across CPU hosts,
+    ICI/DCN on TPU. ``world_size``/``rank`` are PROCESS world/rank (one
+    actor per process, the reference model); tensor arguments stay
+    per-LOCAL-device lists like :class:`XlaGroup`.
+
+    All members must call each verb in the same order (the NCCL contract);
+    each call is one jitted ``shard_map`` program over the global mesh.
+    """
+
+    def __init__(self, world_size: int, rank: int, group_name: str):
+        BaseGroup.__init__(self, world_size, rank, group_name)
+        import jax
+        from jax.sharding import Mesh
+
+        self._ensure_distributed(jax)
+        if jax.process_count() != world_size:
+            raise ValueError(
+                f"jax.distributed world has {jax.process_count()} processes;"
+                f" group declared {world_size}")
+        devs = np.asarray(jax.devices(), dtype=object)  # every process's
+        self.mesh = Mesh(devs, axis_names=("x",))
+        self._cache: Dict[tuple, Any] = {}
+
+    def _ensure_distributed(self, jax) -> None:
+        """Join the group's jax.distributed world (idempotent: a process
+        already in one — e.g. a Train worker — reuses it)."""
+        from jax._src import distributed as _dist
+
+        if getattr(_dist.global_state, "client", None) is not None:
+            return
+        # cross-process collectives on the CPU backend ride gloo; set
+        # unconditionally (no-op for TPU) — probing the backend here would
+        # initialize XLA and break jax.distributed.initialize
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass
+        import ray_tpu
+
+        coord, _ = _get_or_create_coordinator(self.group_name,
+                                              self.world_size)
+        key = "jax_coordinator"
+        if self.rank == 0:
+            from ray_tpu.cluster.rpc import free_port
+
+            addr = f"{_routable_host()}:{free_port()}"
+            ray_tpu.get(coord.set_meta.remote(key, addr))
+        else:
+            # freshness gate (coordinator's OWN clock, no cross-host
+            # skew): a stale address left by a crashed previous
+            # incarnation of this group must not be trusted
+            addr = None
+            deadline = time.monotonic() + 120
+            while addr is None and time.monotonic() < deadline:
+                addr = ray_tpu.get(
+                    coord.get_meta_fresh.remote(key, 120.0))
+                if addr is None:
+                    time.sleep(0.2)
+            if addr is None:
+                raise TimeoutError(
+                    "rank 0 never published the jax coordinator address")
+        jax.distributed.initialize(coordinator_address=addr,
+                                   num_processes=self.world_size,
+                                   process_id=self.rank)
+
+    def destroy(self):
+        # clear the rendezvous address so a future incarnation of this
+        # group name cannot latch onto a dead coordinator
+        try:
+            import ray_tpu
+
+            coord, _ = _get_or_create_coordinator(self.group_name,
+                                                  self.world_size)
+            ray_tpu.get(coord.set_meta.remote("jax_coordinator", None))
+        except Exception:
+            pass
+
+    def _sharded(self, tensors: List[Any]):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        local = np.stack([np.asarray(t) for t in tensors], axis=0)
+        sharding = NamedSharding(self.mesh, P("x"))
+        return jax.make_array_from_process_local_data(sharding, local)
+
+    def barrier(self):
+        import jax
+
+        self.allreduce([np.zeros((8, 128), np.float32)
+                        for _ in range(jax.local_device_count())])
+
+
 def init_collective_group(world_size: int, rank: int,
                           backend=Backend.STORE,
                           group_name: str = "default") -> BaseGroup:
@@ -307,6 +429,8 @@ def init_collective_group(world_size: int, rank: int,
             raise RuntimeError(f"collective group {group_name!r} already initialized")
         if backend == Backend.STORE:
             g = StoreGroup(world_size, rank, group_name)
+        elif backend == Backend.XLA_DISTRIBUTED:
+            g = XlaDistributedGroup(world_size, rank, group_name)
         else:
             g = XlaGroup(world_size, rank, group_name)
         _groups[group_name] = g

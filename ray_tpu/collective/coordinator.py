@@ -47,15 +47,30 @@ class CollectiveCoordinator:
         self._results: Dict[Tuple[str, int], Tuple[Any, set]] = {}
         self._p2p: Dict[Tuple[int, int, int], Any] = {}
         self._meta: Dict[str, Any] = {}
+        self._meta_ts: Dict[str, float] = {}
 
     def world(self) -> int:
         return self.world_size
 
     # -- metadata / rendezvous ------------------------------------------------
     def set_meta(self, key: str, value: Any) -> None:
+        import time
+
         self._meta[key] = value
+        self._meta_ts[key] = time.monotonic()
 
     def get_meta(self, key: str) -> Any:
+        return self._meta.get(key)
+
+    def get_meta_fresh(self, key: str, max_age_s: float) -> Any:
+        """Value only if set within ``max_age_s`` by THIS actor's clock —
+        rendezvous readers use it to reject addresses left behind by a
+        crashed previous incarnation of the group."""
+        import time
+
+        ts = self._meta_ts.get(key)
+        if ts is None or time.monotonic() - ts > max_age_s:
+            return None
         return self._meta.get(key)
 
     # -- collectives ----------------------------------------------------------

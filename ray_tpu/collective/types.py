@@ -17,6 +17,9 @@ from dataclasses import dataclass
 class Backend(str, enum.Enum):
     XLA = "xla"
     STORE = "store"
+    # one group spanning MULTIPLE member processes, backed by
+    # jax.distributed (the reference NCCLGroup's role)
+    XLA_DISTRIBUTED = "xla_distributed"
 
     @classmethod
     def parse(cls, value) -> "Backend":
@@ -25,6 +28,9 @@ class Backend(str, enum.Enum):
         v = str(value).lower()
         if v in ("xla", "tpu", "ici"):
             return cls.XLA
+        if v in ("xla_distributed", "jax_distributed", "distributed",
+                 "multiprocess"):
+            return cls.XLA_DISTRIBUTED
         if v in ("store", "cpu", "gloo"):
             return cls.STORE
         if v in ("nccl", "mpi"):

@@ -324,25 +324,26 @@ def _mha_bwd_rule(causal, scale, q_block, kv_block, use_pallas, res, dout):
     q, k, v, out, lse = res
     b, lk, hk, d = k.shape
     lq, h = q.shape[1], q.shape[2]
-    # GQA: expand kv transiently, then group-sum the grads back (matches
-    # jnp.repeat's [k0,k0,...,k1,k1,...] layout). Backward impl follows
-    # the forward: hand-tiled Pallas kernels (FA2 dKV/dQ sweeps) on TPU,
-    # blockwise XLA elsewhere — O(L) residuals either way.
-    kx, vx = _repeat_kv(k, h), _repeat_kv(v, h)
+    # Backward impl follows the forward: hand-tiled Pallas kernels (FA2
+    # dKV/dQ sweeps) on TPU, blockwise XLA elsewhere — O(L) residuals
+    # either way. The Pallas kernels are GQA-NATIVE (per-group index maps
+    # + in-kernel group accumulation, ADVICE r2 #5); only the XLA fallback
+    # expands kv transiently and group-sums the grads back.
     if (use_pallas and lq % min(q_block, lq) == 0
             and lk % min(kv_block, lk) == 0):
         from ray_tpu.ops.flash_pallas import flash_attention_pallas_bwd
 
         dq, dk, dv = flash_attention_pallas_bwd(
-            q, kx, vx, out, lse, dout, causal=causal, scale=scale,
+            q, k, v, out, lse, dout, causal=causal, scale=scale,
             block_q=q_block, block_k=kv_block)
     else:
+        kx, vx = _repeat_kv(k, h), _repeat_kv(v, h)
         dq, dk, dv = _mha_bwd_blockwise(causal, scale, q_block, kv_block,
                                         q, kx, vx, out, lse, dout)
-    if hk != h:
-        group = h // hk
-        dk = dk.reshape(b, lk, hk, group, d).sum(axis=3)
-        dv = dv.reshape(b, lk, hk, group, d).sum(axis=3)
+        if hk != h:
+            group = h // hk
+            dk = dk.reshape(b, lk, hk, group, d).sum(axis=3)
+            dv = dv.reshape(b, lk, hk, group, d).sum(axis=3)
     return dq, dk, dv
 
 

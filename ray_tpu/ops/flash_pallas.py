@@ -194,12 +194,17 @@ def flash_attention_pallas(
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           dk_ref, dv_ref, dk_acc, dv_acc,
                           *, causal: bool, scale: float,
-                          block_q: int, block_k: int):
+                          block_q: int, block_k: int, nq: int):
+    """dK/dV sweep at NATIVE kv-head count: the sequential grid dim walks
+    (group, q_block) pairs — ``t = g * nq + qi`` — so each kv head's
+    gradients accumulate over every q head of its group without ever
+    materializing group-expanded K/V or dK/dV (ADVICE r2 #5)."""
     ki = pl.program_id(2)
-    qi = pl.program_id(3)
-    nq = pl.num_programs(3)
+    t = pl.program_id(3)
+    nt = pl.num_programs(3)
+    qi = t % nq
 
-    @pl.when(qi == 0)
+    @pl.when(t == 0)
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
@@ -236,7 +241,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)       # ds^T q: [bk, d]
 
-    @pl.when(qi == nq - 1)
+    @pl.when(t == nt - 1)
     def _finish():
         dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
@@ -305,17 +310,23 @@ def flash_attention_pallas_bwd(
     block_k: int = 512,
     interpret: bool = False,
 ):
-    """Backward pass. All tensors [B, L, H, D] (kv heads already expanded);
-    ``lse`` [B, H, L]. Returns (dq, dk, dv) in the inputs' dtypes."""
+    """Backward pass. ``q``/``out``/``dout``: [B, Lq, H, D]; ``k``/``v``
+    may stay at their NATIVE (possibly fewer, GQA) head count [B, Lk, Hk,
+    D] — dk/dv come back at that count with the per-group accumulation
+    done in-kernel, so GQA pays no group-factor HBM for transients
+    (ADVICE r2 #5). ``lse``: [B, H, Lq]. Returns (dq, dk, dv)."""
     b, lq, h, d = q.shape
-    lk = k.shape[1]
+    lk, hk = k.shape[1], k.shape[2]
+    if h % hk:
+        raise ValueError(f"q heads {h} not divisible by kv heads {hk}")
+    group = h // hk
     scale = scale if scale is not None else d ** -0.5
     block_q = min(block_q, lq)
     block_k = min(block_k, lk)
     nq, nk = lq // block_q, lk // block_k
 
     qt = q.transpose(0, 2, 1, 3)      # [B, H, L, D]
-    kt = k.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)      # [B, Hk, L, D]
     vt = v.transpose(0, 2, 1, 3)
     dot = dout.transpose(0, 2, 1, 3)
     outt = out.transpose(0, 2, 1, 3)
@@ -324,27 +335,33 @@ def flash_attention_pallas_bwd(
     lse_b = jnp.broadcast_to(lse[..., None], (*lse.shape, LANES))
     delta_b = jnp.broadcast_to(delta[..., None], (*delta.shape, LANES))
 
+    # dK/dV at native kv heads: grid dim 1 walks kv heads, the sequential
+    # dim walks (group, q_block) pairs t = g*nq + qi; q-side tensors index
+    # the q head h_*group + t//nq
+    def _qside(b_, h_, ki, t):
+        return (b_, h_ * group + t // nq, t % nq, 0)
+
     dkv_kernel = functools.partial(
         _flash_bwd_dkv_kernel, causal=causal, scale=scale,
-        block_q=block_q, block_k=block_k)
+        block_q=block_q, block_k=block_k, nq=nq)
     dk_t, dv_t = pl.pallas_call(
         dkv_kernel,
-        grid=(b, h, nk, nq),
+        grid=(b, hk, nk, nq * group),
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, ki, qi: (b_, h_, qi, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, ki, qi: (b_, h_, ki, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, ki, qi: (b_, h_, ki, 0)),
-            pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, ki, qi: (b_, h_, qi, 0)),
-            pl.BlockSpec((1, 1, block_q, LANES), lambda b_, h_, ki, qi: (b_, h_, qi, 0)),
-            pl.BlockSpec((1, 1, block_q, LANES), lambda b_, h_, ki, qi: (b_, h_, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, d), _qside),
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, ki, t: (b_, h_, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, ki, t: (b_, h_, ki, 0)),
+            pl.BlockSpec((1, 1, block_q, d), _qside),
+            pl.BlockSpec((1, 1, block_q, LANES), _qside),
+            pl.BlockSpec((1, 1, block_q, LANES), _qside),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, ki, qi: (b_, h_, ki, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, ki, qi: (b_, h_, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, ki, t: (b_, h_, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, ki, t: (b_, h_, ki, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, lk, d), k.dtype),
-            jax.ShapeDtypeStruct((b, h, lk, d), v.dtype),
+            jax.ShapeDtypeStruct((b, hk, lk, d), k.dtype),
+            jax.ShapeDtypeStruct((b, hk, lk, d), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -365,8 +382,9 @@ def flash_attention_pallas_bwd(
         grid=(b, h, nq, nk),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, qi, ki: (b_, h_, ki, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, qi, ki: (b_, h_, ki, 0)),
+            # GQA: q head h_ reads kv head h_//group (forward's index-map trick)
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, qi, ki: (b_, h_ // group, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, qi, ki: (b_, h_ // group, ki, 0)),
             pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
             pl.BlockSpec((1, 1, block_q, LANES), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
             pl.BlockSpec((1, 1, block_q, LANES), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),

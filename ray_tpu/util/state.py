@@ -40,17 +40,32 @@ def list_actors(filters: Optional[List] = None) -> List[Dict[str, Any]]:
     return _apply_filters(out, filters)
 
 
+def _all_task_events(rt) -> List[Dict[str, Any]]:
+    """Cluster-wide task events when a GCS is attached (nodes flush their
+    buffers there — reference TaskEventBuffer -> GcsTaskManager pipeline),
+    else this driver's local buffer."""
+    if rt.cluster is not None:
+        try:
+            evs = rt.cluster.gcs.call("task_events_get", 50000, timeout=10)
+            if evs:
+                return evs
+        except Exception:
+            pass
+    return rt.timeline()
+
+
 def list_tasks(filters: Optional[List] = None) -> List[Dict[str, Any]]:
-    """Finished-task records from the driver's timeline buffer (reference
-    GcsTaskManager's task-event store)."""
+    """Finished-task records — cluster-wide in cluster mode (every node
+    ships its events to the GCS), driver-local otherwise."""
     rt = _gcs()
     out = []
-    for ev in rt.timeline():
+    for ev in _all_task_events(rt):
         out.append({
             "name": ev.get("name"),
             "state": "FINISHED",
             "duration_ms": ev.get("dur", 0) / 1e3,
             "worker": ev.get("tid"),
+            "node": ev.get("node"),
         })
     return _apply_filters(out, filters)
 

@@ -752,3 +752,41 @@ def test_stream_backpressure_consumer_on_third_node(cluster):
     assert [i for i, _ in stamps] == list(range(6))
     spread = stamps[5][1] - stamps[0][1]
     assert spread > 1.0, f"producer ran ahead: {spread:.2f}s"
+
+
+def test_task_events_ship_to_gcs_cluster_wide(cluster):
+    """Task events from EVERY node land in the GCS store: the state API
+    lists tasks that ran on peer daemons too (reference TaskEventBuffer ->
+    GcsTaskManager pipeline; VERDICT missing #8)."""
+    cluster.add_node(num_cpus=2, resources={"peer": 2})
+    _init(cluster)
+    _wait_nodes(2)
+
+    @ray_tpu.remote(resources={"peer": 1})
+    def remote_side():
+        return 1
+
+    @ray_tpu.remote(num_cpus=1)
+    def local_side():
+        return 2
+
+    assert ray_tpu.get([remote_side.remote() for _ in range(3)]
+                       + [local_side.remote()], timeout=120) == [1, 1, 1, 2]
+
+    from ray_tpu.util.state import list_tasks, summarize_tasks
+
+    deadline = time.monotonic() + 20  # events flush on the heartbeat
+    names = {}
+    while time.monotonic() < deadline:
+        tasks = list_tasks()
+        names = {}
+        for t in tasks:
+            names.setdefault(t["name"], set()).add(t["node"])
+        if (len(names.get("remote_side", ())) >= 1
+                and len(names.get("local_side", ())) >= 1):
+            break
+        time.sleep(0.5)
+    assert "remote_side" in names and "local_side" in names
+    # the two task kinds executed on DIFFERENT nodes
+    assert names["remote_side"] != names["local_side"]
+    assert summarize_tasks()["remote_side"]["FINISHED"] >= 3

@@ -123,3 +123,82 @@ def test_xla_group_full_verb_matrix():
     # existing verbs still in place
     out = g.allreduce(tensors, op=ReduceOp.MEAN)
     np.testing.assert_allclose(out[0], np.full((4,), (n + 1) / 2))
+
+
+def test_xla_distributed_group_two_processes(rt_module):
+    """Verb matrix across TWO actor PROCESSES x 4 virtual CPU devices each,
+    in-XLA over one global jax.distributed mesh (VERDICT r3 #7 done
+    criterion; reference NCCLGroup role). Rendezvous rides the named
+    coordinator actor."""
+    rt = rt_module
+    from ray_tpu.collective import create_collective_group
+
+    class Member:
+        def __init__(self, rank, world):
+            self.rank, self.world = rank, world
+
+        def setup(self):
+            import jax
+
+            from ray_tpu.collective.collective import init_collective_group
+
+            g = init_collective_group(self.world, self.rank,
+                                      "xla_distributed", "gd1")
+            return (jax.process_count(), jax.device_count(),
+                    jax.local_device_count())
+
+        def verbs(self):
+            import numpy as np
+
+            from ray_tpu.collective.collective import get_collective_group
+            from ray_tpu.collective.types import ReduceOp
+
+            g = get_collective_group("gd1")
+            nloc = 4
+            base = self.rank * nloc
+            mine = [np.full((2,), float(base + i)) for i in range(nloc)]
+            out = {}
+            out["allreduce"] = g.allreduce(mine)  # sum over 8 global devs
+            out["allgather"] = g.allgather(mine)
+            out["bcast"] = g.broadcast(mine, root_rank=5)
+            out["reduce"] = g.reduce(mine, root_rank=2)
+            out["rscatter"] = g.reducescatter(
+                [np.arange(8, dtype=np.float64) for _ in range(nloc)])
+            chunks = [[np.full((1,), float(base + i) * 10 + j)
+                       for j in range(8)] for i in range(nloc)]
+            out["alltoall"] = g.alltoall(chunks)
+            g.barrier()
+            return out
+
+    world = 2
+    create_collective_group([], world, [0, 1], "xla_distributed", "gd1")
+    env = {"XLA_FLAGS": "--xla_force_host_platform_device_count=4"}
+    members = [
+        rt.remote(Member).options(
+            runtime_env={"env_vars": env}).remote(r, world)
+        for r in range(world)
+    ]
+    infos = rt.get([m.setup.remote() for m in members], timeout=180)
+    assert infos == [(2, 8, 4), (2, 8, 4)]
+
+    outs = rt.get([m.verbs.remote() for m in members], timeout=180)
+    total = sum(range(8))  # device d holds value d
+    for rank, out in enumerate(outs):
+        base = rank * 4
+        for arr in out["allreduce"]:
+            np.testing.assert_allclose(arr, np.full((2,), float(total)))
+        for arr in out["allgather"]:
+            np.testing.assert_allclose(
+                arr, np.repeat(np.arange(8.0), 2).reshape(8, 2)
+                .reshape(-1))
+        for arr in out["bcast"]:
+            np.testing.assert_allclose(arr, np.full((2,), 5.0))
+        for i, arr in enumerate(out["reduce"]):
+            want = float(total) if base + i == 2 else float(base + i)
+            np.testing.assert_allclose(arr, np.full((2,), want))
+        for i, arr in enumerate(out["rscatter"]):
+            np.testing.assert_allclose(arr, [float(base + i) * 8])
+        for i, got_chunks in enumerate(out["alltoall"]):
+            want = [float(s) * 10 + (base + i) for s in range(8)]
+            np.testing.assert_allclose(
+                np.concatenate(got_chunks), want)

@@ -243,3 +243,31 @@ def test_pallas_bwd_kernels_match_naive_grads(causal):
         block_q=128, block_k=128, interpret=True)
     for r, g in zip(ref, got):
         np.testing.assert_allclose(g, r, atol=5e-5, rtol=5e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_pallas_bwd_gqa_native_heads(causal):
+    """GQA backward at NATIVE kv-head count (no group expand, ADVICE r2
+    #5): dk/dv come back [B, Lk, Hk, D] and match naive autodiff."""
+    from ray_tpu.ops.attention import _mha_fwd_blockwise, _repeat_kv
+    from ray_tpu.ops.flash_pallas import flash_attention_pallas_bwd
+
+    h, hk = 4, 2
+    q, _, _ = _rand_qkv(jax.random.PRNGKey(12), b=1, lq=256, lk=256, h=h,
+                        d=64)
+    _, k, v = _rand_qkv(jax.random.PRNGKey(13), b=1, lq=256, lk=256, h=hk,
+                        d=64)
+    tang = jax.random.normal(jax.random.PRNGKey(14), q.shape, q.dtype)
+
+    def loss_ref(q, k, v):
+        return (naive_attention(q, k, v, causal=causal) * tang).sum()
+
+    ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    out, lse = _mha_fwd_blockwise(q, _repeat_kv(k, h), _repeat_kv(v, h),
+                                  causal, 64 ** -0.5, 128, 128)
+    got = flash_attention_pallas_bwd(
+        q, k, v, out, lse, tang, causal=causal,
+        block_q=128, block_k=128, interpret=True)
+    assert got[1].shape == k.shape and got[2].shape == v.shape
+    for r, g in zip(ref, got):
+        np.testing.assert_allclose(g, r, atol=5e-5, rtol=5e-5)
