@@ -496,6 +496,27 @@ def _core_microbench() -> dict:
             ray_tpu.get(r)
         out["get_gb_per_s"] = round(
             16 * nbytes / (time.perf_counter() - t0) / 1e9, 2)
+
+        # scalability-envelope analogs (reference
+        # release/benchmarks/single_node.json: 10k get / wait / many
+        # actors), scaled to this box so the numbers are comparable
+        # across rounds
+        refs1k = [ray_tpu.put(i) for i in range(1000)]
+        t0 = time.perf_counter()
+        ready, _ = ray_tpu.wait(refs1k, num_returns=1000, timeout=120)
+        out["wait_1k_refs_s"] = round(time.perf_counter() - t0, 3)
+        refs10k = [ray_tpu.put(i) for i in range(10000)]
+        t0 = time.perf_counter()
+        vals = ray_tpu.get(refs10k)
+        out["get_10k_s"] = round(time.perf_counter() - t0, 3)
+        assert vals[9999] == 9999
+        t0 = time.perf_counter()
+        actors = [A.options(num_cpus=0).remote() for _ in range(16)]
+        ray_tpu.get([x.f.remote() for x in actors])
+        out["actors_launched_per_s"] = round(
+            16 / (time.perf_counter() - t0), 2)
+        for x in actors:
+            ray_tpu.kill(x)
     except Exception as e:  # bench must never fail on the micro side
         out["error"] = str(e)
     finally:

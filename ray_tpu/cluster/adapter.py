@@ -36,7 +36,9 @@ from ray_tpu.core.ids import ActorID, ObjectID
 logger = logging.getLogger(__name__)
 
 HEARTBEAT_S = 0.5
-NODE_VIEW_TTL_S = 0.5
+# resource-gossip pushes keep the node view fresh between polls; the TTL
+# is only the staleness bound when pushes are lost (reconnect windows)
+NODE_VIEW_TTL_S = 3.0
 
 # sentinel: "could not reach the GCS" — distinct from "GCS says gone"
 GCS_UNAVAILABLE = object()
@@ -348,6 +350,15 @@ class ClusterAdapter:
             if interested:
                 self._io.submit(self._initial_query, b)
         elif channel == "nodes":
+            if payload.get("event") == "resources":
+                # ray_syncer-style gossip: patch the cached view in place
+                # (no node_list round-trip on the scheduling path)
+                nid = payload["node_id"]
+                for n in self._node_view:
+                    if n["node_id"] == nid:
+                        n["avail"] = dict(payload["avail"])
+                        break
+                return
             if payload.get("event") == "down":
                 self._io.submit(self._node_down, payload)
             elif payload.get("event") == "up":

@@ -179,10 +179,19 @@ class GcsService:
             ent = self.nodes.get(node_id)
             if ent is None:
                 return False
+            changed = ent.avail != avail
             ent.avail = dict(avail)
             ent.last_seen = time.monotonic()
             if not ent.alive:
                 ent.alive = True
+        if changed:
+            # streaming resource gossip (reference ray_syncer,
+            # ray_syncer.h:88 role): subscribers patch their node views
+            # from these deltas instead of re-polling node_list
+            self._publish("nodes", {"event": "resources",
+                                    "node_id": node_id,
+                                    "avail": dict(avail),
+                                    "depth": queue_depth})
         return True
 
     def rpc_node_list(self, ctx):
