@@ -101,7 +101,9 @@ def test_spilling_to_disk(monkeypatch):
     try:
         oid = ObjectID.from_random()
         data = np.arange(50_000, dtype=np.float64)
-        assert client.put(oid, data) is None
+        inline, size = client.put(oid, data)
+        assert inline is None                         # too big to inline
+        assert size >= data.nbytes
         assert client.contains_spilled(oid)           # landed on disk
         assert not os.path.exists(
             f"/dev/shm/rtpu-{session}-{oid.hex()}")

@@ -122,8 +122,9 @@ def test_store_client_uses_arena_for_big_objects():
     try:
         oid = ObjectID.from_random()
         big = np.arange(100_000, dtype=np.float64)
-        inline = client.put(oid, big)
+        inline, size = client.put(oid, big)
         assert inline is None             # went to shm, not inline
+        assert size >= big.nbytes
         assert client._arena.stats()["num_objects"] == 1
         back = client.get(oid)
         np.testing.assert_array_equal(back, big)
