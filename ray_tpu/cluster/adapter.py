@@ -28,6 +28,7 @@ from typing import Any, Dict, List, Optional, Set
 
 import cloudpickle
 
+from ray_tpu import config
 from ray_tpu.cluster.rpc import RpcClient, RpcServer
 from ray_tpu.core import task_spec as ts
 from ray_tpu.core.exceptions import ActorDiedError, WorkerCrashedError
@@ -47,20 +48,17 @@ GCS_UNAVAILABLE = object()
 # roles): objects above PULL_CHUNK_BYTES stream in chunks straight into a
 # preallocated segment — neither end ever materializes the whole blob —
 # and at most PULL_CONCURRENCY big pulls run at once (pull admission).
-PULL_CHUNK_BYTES = int(os.environ.get("RTPU_PULL_CHUNK_BYTES",
-                                      str(4 << 20)))
-PULL_CONCURRENCY = int(os.environ.get("RTPU_PULL_CONCURRENCY", "2"))
+PULL_CHUNK_BYTES = int(config.get("pull_chunk_bytes"))
+PULL_CONCURRENCY = int(config.get("pull_concurrency"))
 
 # dependency-locality scheduling (reference hybrid_scheduling_policy.h:50
 # + scorer.h roles): ship the task to its data when the data is big.
 # Below this many dependency bytes, moving the data is cheaper than
 # disturbing placement.
-LOCALITY_MIN_BYTES = int(os.environ.get("RTPU_LOCALITY_MIN_BYTES",
-                                        str(1 << 20)))
+LOCALITY_MIN_BYTES = int(config.get("locality_min_bytes"))
 # hybrid pack/spread: pack onto busier feasible nodes while their CPU
 # utilization is below this, then spread to the least-loaded
-HYBRID_PACK_THRESHOLD = float(os.environ.get("RTPU_HYBRID_THRESHOLD",
-                                             "0.5"))
+HYBRID_PACK_THRESHOLD = float(config.get("hybrid_threshold"))
 
 
 class ClusterAdapter:
@@ -344,6 +342,13 @@ class ClusterAdapter:
                 # reference's owner-driven object free)
                 if self.node_id in (payload.get("locations") or ()):
                     self._io.submit(self._free_local_copy, b)
+                # and release any pins this owner held for refs nested in
+                # the freed object's bytes (their lifetime was tied to it)
+                self._io.submit(self.rt._release_result_ref_pins, b)
+                # freed objects must stop attracting dependency-locality
+                # placement (advisor r3: stale cache forwarded tasks to
+                # nodes that no longer hold the data)
+                self._obj_info.pop(b, None)
                 return
             with self._watch_lock:
                 interested = b in self._watched
@@ -1310,6 +1315,17 @@ class ClusterAdapter:
 
     def _node_down(self, payload: dict):
         node_id = payload["node_id"]
+        # locality entries naming the dead node would keep steering tasks
+        # at it (advisor r3); drop any whose location set includes it.
+        # Whole block guarded: _obj_info is mutated unlocked by the
+        # scheduler thread, and a surprise here must not abort the peer
+        # close / forwarded-task retry cleanup below.
+        try:
+            for b, inf in list(self._obj_info.items()):
+                if inf and node_id in (inf[1] or ()):
+                    self._obj_info.pop(b, None)
+        except Exception:
+            pass
         with self._peers_lock:
             peer = self._peers.pop(node_id, None)
             self._peer_addrs.pop(node_id, None)

@@ -20,6 +20,7 @@ import threading
 import time
 from typing import Any, Dict, Optional, Set
 
+from ray_tpu import config
 from ray_tpu.cluster.rpc import RpcServer, ServerConn
 
 DEFAULT_HEARTBEAT_S = 1.0
@@ -70,23 +71,22 @@ class GcsService:
         self.lock = threading.RLock()
         self.nodes: Dict[bytes, _NodeEntry] = {}
         self.objects: Dict[bytes, _GlobalObject] = {}
-        self.max_objects = int(os.environ.get("RTPU_GCS_MAX_OBJECTS",
-                                              "200000"))
-        self.evict_min_age_s = float(os.environ.get(
-            "RTPU_GCS_EVICT_MIN_AGE_S", "30"))
+        self.max_objects = int(config.get("gcs_max_objects"))
+        self.evict_min_age_s = float(config.get("gcs_evict_min_age_s"))
         # refcount-zero objects are freed after a GRACE, not inline: a
         # consumer's pin cast rides a different connection than the
         # producer's obj_ready, so "no pins right now" can be an in-flight
         # pin (freeing inline deleted entries a consumer was about to
         # watch, hanging its get forever)
-        self.free_grace_s = float(os.environ.get(
-            "RTPU_GCS_FREE_GRACE_S", "10"))
+        self.free_grace_s = float(config.get("gcs_free_grace_s"))
         self._free_candidates: Dict[bytes, float] = {}
+        # oids swept by the free path: a late pin on one of these gets a
+        # terminal ObjectLostError entry instead of a silent empty PENDING
+        self._freed_tombstones: Dict[bytes, float] = {}
         # cluster-wide task events (reference GcsTaskManager store)
         from collections import deque
 
-        self.task_events = deque(maxlen=int(os.environ.get(
-            "RTPU_GCS_MAX_TASK_EVENTS", "50000")))
+        self.task_events = deque(maxlen=int(config.get("gcs_max_task_events")))
         self.kv: Dict[str, Dict[str, bytes]] = {}
         self.functions: Dict[str, bytes] = {}
         # named/global actor registry: actor_id -> record dict
@@ -328,15 +328,47 @@ class GcsService:
                 drop.append(oid)
                 if len(self.objects) - len(drop) <= self.max_objects * 0.9:
                     break
+        now2 = time.monotonic()
         for oid in drop:
             del self.objects[oid]
+            # same tombstone as the free sweep: a late pin on an evicted
+            # entry must surface ObjectLostError, not resurrect a silent
+            # empty PENDING that hangs the pinner's get()
+            self._freed_tombstones[oid] = now2
+        while len(self._freed_tombstones) > 20000:
+            self._freed_tombstones.pop(next(iter(self._freed_tombstones)))
 
     def rpc_obj_pin(self, ctx, oid: bytes, node_id: bytes):
+        lost = False
         with self.lock:
-            o = self._obj(oid)
-            o.pins.add(node_id)
-            o.was_pinned = True
-            self._free_candidates.pop(oid, None)
+            if oid not in self.objects and oid in self._freed_tombstones:
+                # late pin on a SWEPT object (advisor r3): silently
+                # resurrecting an empty PENDING entry would hang the
+                # pinner's get() forever. Recreate it terminal-with-error
+                # so waiters surface ObjectLostError (or kick lineage
+                # reconstruction) instead.
+                import cloudpickle
+
+                from ray_tpu.core.exceptions import ObjectLostError
+
+                o = self._obj(oid)
+                o.status = ERROR
+                o.error = cloudpickle.dumps(ObjectLostError(
+                    f"object {oid.hex()[:16]} was freed (refcount reached "
+                    f"zero) before this reference arrived"))
+                o.t_terminal = time.monotonic()
+                o.pins.add(node_id)
+                o.was_pinned = True
+                lost = True
+            else:
+                o = self._obj(oid)
+                o.pins.add(node_id)
+                o.was_pinned = True
+                self._free_candidates.pop(oid, None)
+        if lost:
+            # the ERROR publish is the pinner's signal (obj_pin arrives as
+            # a fire-and-forget cast; a return value would go unseen)
+            self._publish("objects", {"oid": oid, "status": ERROR})
         return True
 
     def rpc_obj_unpin(self, ctx, oid: bytes, node_id: bytes):
@@ -373,6 +405,12 @@ class GcsService:
                     continue
                 freed.append((oid, list(o.locations)))
                 del self.objects[oid]
+                # bounded tombstone: lets a LATE pin distinguish "swept"
+                # from "not yet created" (advisor r3)
+                self._freed_tombstones[oid] = now
+            while len(self._freed_tombstones) > 20000:
+                self._freed_tombstones.pop(
+                    next(iter(self._freed_tombstones)))
         for oid, locations in freed:
             self._publish("objects", {"oid": oid, "freed": True,
                                       "locations": locations})

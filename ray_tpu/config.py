@@ -1,0 +1,176 @@
+"""Central configuration registry — every runtime knob in ONE table.
+
+Role analog: reference ``src/ray/common/ray_config_def.h`` (217
+``RAY_CONFIG(type, name, default)`` entries, each overridable via a
+``RAY_<name>`` env var, parsed in ``ray_config.h``). Here every knob is
+registered with its type, default, and doc; the value is resolved from the
+``RTPU_<NAME>`` environment variable LAZILY on each access, so tests that
+``monkeypatch.setenv`` before booting a subsystem keep working and
+subprocess workers inherit overrides through the environment — the same
+property the reference gets from parsing env vars at RayConfig init in
+every process.
+
+Usage::
+
+    from ray_tpu import config
+    grace = config.get("gcs_free_grace_s")      # float, env-overridable
+    rows  = config.describe()                    # table for CLI / docs
+
+CLI: ``ray_tpu config`` prints the table with any non-default values
+highlighted.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, List, NamedTuple
+
+
+class Knob(NamedTuple):
+    name: str           # registry key; env var is RTPU_<NAME.upper()>
+    type: Callable      # parser applied to the env string
+    default: Any
+    doc: str
+    where: str          # module that consumes it
+
+
+def _bool(s: str) -> bool:
+    return s.strip().lower() not in ("0", "false", "no", "off", "")
+
+
+_REGISTRY: Dict[str, Knob] = {}
+
+
+def _knob(name: str, type_: Callable, default: Any, doc: str,
+          where: str) -> None:
+    assert name not in _REGISTRY, f"duplicate knob {name}"
+    _REGISTRY[name] = Knob(name, type_, default, doc, where)
+
+
+# -- core runtime -----------------------------------------------------------
+_knob("worker_start_timeout", float, 120.0,
+      "seconds to wait for a spawned worker to dial back before declaring "
+      "it failed", "train/backend_executor.py")
+_knob("log_to_driver", _bool, True,
+      "stream worker stdout/stderr lines to the driver's console",
+      "core/runtime.py")
+_knob("memory_monitor", _bool, True,
+      "enable the host-RAM OOM monitor (kills retriable tasks first; "
+      "reference MemoryMonitor + worker-killing policies)",
+      "core/runtime.py")
+_knob("memory_usage_threshold", float, 0.95,
+      "host memory fraction above which the OOM policy starts killing",
+      "core/runtime.py")
+_knob("lineage_max", int, 100_000,
+      "max task specs retained for object reconstruction (reference "
+      "lineage cap role)", "core/runtime.py")
+_knob("lineage_max_bytes", int, 512 << 20,
+      "byte bound on retained lineage (inlined args dominate; reference "
+      "RAY_max_lineage_bytes)", "core/runtime.py")
+
+# -- object store -----------------------------------------------------------
+_knob("native_store", _bool, True,
+      "use the C++ shm arena (falls back to file-per-object segments)",
+      "core/object_store.py")
+_knob("store_capacity", int, 1 << 30,
+      "shm arena capacity in bytes per node", "core/object_store.py")
+_knob("spill_threshold", int, 4 << 30,
+      "total shm bytes after which big objects spill to disk",
+      "core/object_store.py")
+_knob("store_prefault_bytes", str, str(256 << 20),
+      "arena head bytes prefaulted in the background at boot (first-touch "
+      "page faults 10x cold writes); '0' disables, 'all' populates the "
+      "whole arena", "_native/__init__.py")
+
+# -- cluster ----------------------------------------------------------------
+_knob("gcs_max_objects", int, 200_000,
+      "directory entry cap; terminal unpinned entries past it are evicted",
+      "cluster/gcs_server.py")
+_knob("gcs_evict_min_age_s", float, 30.0,
+      "min seconds after terminal before an unpinned entry may be evicted",
+      "cluster/gcs_server.py")
+_knob("gcs_free_grace_s", float, 10.0,
+      "grace between refcount-zero and freeing (an in-flight pin on "
+      "another connection may still land)", "cluster/gcs_server.py")
+_knob("gcs_max_task_events", int, 50_000,
+      "cluster-wide task event buffer size (reference GcsTaskManager "
+      "store)", "cluster/gcs_server.py")
+_knob("pull_chunk_bytes", int, 4 << 20,
+      "chunk size for node-to-node object transfer",
+      "cluster/adapter.py")
+_knob("pull_concurrency", int, 2,
+      "max concurrent big-object pulls per node (admission control, "
+      "reference PullManager role)", "cluster/adapter.py")
+_knob("locality_min_bytes", int, 1 << 20,
+      "objects at least this big attract dependency-locality placement",
+      "cluster/adapter.py")
+_knob("hybrid_threshold", float, 0.5,
+      "hybrid scheduling: pack until a node passes this utilization, then "
+      "spread (reference hybrid_scheduling_policy.h)",
+      "cluster/adapter.py")
+
+# -- ops / models -----------------------------------------------------------
+_knob("attn_impl", str, "",
+      "force the attention kernel: pallas | xla | naive (empty = auto)",
+      "ops/attention.py")
+
+# -- serve ------------------------------------------------------------------
+_knob("serve_max_body", int, 64 << 20,
+      "max HTTP request body bytes accepted by the serve proxy",
+      "serve/proxy.py")
+
+# -- bench / watch ----------------------------------------------------------
+_knob("bench_child_timeout", float, 420.0,
+      "per-attempt timeout for the bench train-step child", "bench.py")
+_knob("bench_retries", int, 3, "bench train-step attempts", "bench.py")
+_knob("bench_budget", float, 700.0, "total bench wall-clock budget",
+      "bench.py")
+_knob("watch_interval", float, 600.0,
+      "TPU tunnel probe cadence for `ray_tpu bench --watch`",
+      "util/tpu_watch.py")
+_knob("watch_refresh", float, 7200.0,
+      "re-run the on-chip bench when the cached result is older than this",
+      "util/tpu_watch.py")
+
+# Internal coordination values (not tuning knobs, listed for completeness;
+# set by the runtime itself): RTPU_WORKER (worker dial-back address),
+# RTPU_CLUSTER_AUTHKEY (cluster auth secret), RTPU_COORDINATOR_HOST
+# (collective rendezvous), RTPU_WATCH_LOG, RTPU_NUMERICS_SMALL,
+# RTPU_EXPERIMENTAL_NOSET_TPU_VISIBLE_CHIPS (reference
+# RAY_EXPERIMENTAL_NOSET_* analog).
+
+
+def env_name(name: str) -> str:
+    return "RTPU_" + name.upper()
+
+
+def get(name: str) -> Any:
+    """Resolve a knob: env override if set (parsed to the knob's type,
+    falling back to the default on a parse error), else the default."""
+    k = _REGISTRY[name]
+    raw = os.environ.get(env_name(name))
+    if raw is None:
+        return k.default
+    try:
+        return k.type(raw)
+    except (ValueError, TypeError):
+        return k.default
+
+
+def describe() -> List[dict]:
+    """Table rows for the CLI/docs: name, env, type, default, current,
+    overridden, doc."""
+    rows = []
+    for k in _REGISTRY.values():
+        cur = get(k.name)
+        rows.append({
+            "name": k.name,
+            "env": env_name(k.name),
+            "type": getattr(k.type, "__name__", str(k.type)),
+            "default": k.default,
+            "current": cur,
+            "overridden": cur != k.default,
+            "where": k.where,
+            "doc": k.doc,
+        })
+    return rows

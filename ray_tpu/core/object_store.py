@@ -20,6 +20,7 @@ import os
 import threading
 from typing import Any, Dict, Optional
 
+from ray_tpu import config
 from ray_tpu.core import serialization
 from ray_tpu.core.ids import ObjectID
 
@@ -72,12 +73,11 @@ class StoreClient:
         self._pins: Dict[ObjectID, _Pinned] = {}
         self._lock = threading.Lock()
         self._arena = None
-        if os.environ.get("RTPU_NATIVE_STORE", "1") != "0":
+        if config.get("native_store"):
             try:
                 from ray_tpu._native import NativeArena
 
-                capacity = int(os.environ.get(
-                    "RTPU_STORE_CAPACITY", str(1 << 30)))
+                capacity = int(config.get("store_capacity"))
                 self._arena = NativeArena(session, capacity)
             except Exception as e:
                 # Loud fallback: a process silently diverging to the file
@@ -89,8 +89,7 @@ class StoreClient:
                     "native object store unavailable (%s); "
                     "falling back to file-per-object segments", e)
                 self._arena = None
-        self._spill_threshold = int(os.environ.get(
-            "RTPU_SPILL_THRESHOLD", str(4 << 30)))
+        self._spill_threshold = int(config.get("spill_threshold"))
         # Running total of THIS client's file-segment bytes: the spill
         # check must be O(1), not a /dev/shm scan per put (store_bytes()
         # stays the accurate cross-process accounting API).

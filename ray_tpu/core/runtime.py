@@ -25,6 +25,7 @@ from typing import Any, Dict, List, Optional
 
 import cloudpickle
 
+from ray_tpu import config
 from ray_tpu.core import serialization, task_spec as ts
 from ray_tpu.core.exceptions import (
     ActorDiedError,
@@ -195,19 +196,37 @@ class DriverRuntime:
         self._ref_lock = threading.Lock()
         self._pin_total: Dict[bytes, int] = {}
         self._arg_pins: Dict[bytes, List[bytes]] = {}
+        # GC-safety (advisor r3): ObjectRef.__del__ can fire at ANY
+        # allocation point — including on a thread that already holds
+        # _ref_lock (a dict mutation inside _pin_delta triggering cycle
+        # collection) or an rpc send lock. The __del__ hook therefore only
+        # appends to a deque; normal code paths and a small janitor thread
+        # drain it, and directory pin/unpin casts are queued under the lock
+        # (preserving transition order) but shipped outside it. Shared
+        # machinery: ray_tpu/core/refqueue.py.
+        from ray_tpu.core.refqueue import DeferredDrops, OrderedCastFlusher
+
+        self._cast_flusher = OrderedCastFlusher(self._send_pin_cast)
+        self._deferred_unpins = DeferredDrops(
+            self._ref_lock, lambda b: self._apply_pin_locked(b, -1),
+            self._flush_ref_casts)
+        # outer object id -> ids of refs nested in its stored bytes, pinned
+        # by THIS owner until the outer object is freed
+        self._result_ref_pins: Dict[bytes, set] = {}
         from ray_tpu.core import object_ref as _object_ref
 
         _object_ref.set_ref_hook(
             lambda b: self._pin_delta(b, 1),
-            lambda b: self._pin_delta(b, -1))
+            self._deferred_unpins.append)
         self.gcs.on_terminal = self._release_arg_pins
+        threading.Thread(target=self._ref_janitor_loop, daemon=True,
+                         name="rtpu-ref-janitor").start()
 
         self._lineage: Dict[bytes, dict] = {}
-        self._lineage_cap = int(os.environ.get("RTPU_LINEAGE_MAX", "100000"))
+        self._lineage_cap = int(config.get("lineage_max"))
         # byte bound too (reference RAY_max_lineage_bytes role): specs keep
         # inlined serialized args alive, so count alone can hold GBs
-        self._lineage_max_bytes = int(os.environ.get(
-            "RTPU_LINEAGE_MAX_BYTES", str(512 << 20)))
+        self._lineage_max_bytes = int(config.get("lineage_max_bytes"))
         self._lineage_bytes = 0
         self._lineage_sizes: Dict[bytes, int] = {}
         self._reconstructing: Dict[bytes, threading.Event] = {}
@@ -228,7 +247,7 @@ class DriverRuntime:
         # worker log files and echo new lines to the driver's stdout with
         # a worker prefix.
         self._log_monitor_stop = threading.Event()
-        if log_to_driver and os.environ.get("RTPU_LOG_TO_DRIVER", "1") != "0":
+        if log_to_driver and config.get("log_to_driver"):
             threading.Thread(target=self._log_monitor_loop, daemon=True,
                              name="rtpu-log-monitor").start()
 
@@ -236,12 +255,11 @@ class DriverRuntime:
         # kill the newest retriable task under host-RAM pressure. Killed
         # workers re-enter the normal death path, which retries the task.
         self._memory_monitor = None
-        if os.environ.get("RTPU_MEMORY_MONITOR", "1") != "0":
+        if config.get("memory_monitor"):
             from ray_tpu.core.memory_monitor import (MemoryMonitor,
                                                      kill_retriable_policy)
 
-            threshold = float(os.environ.get(
-                "RTPU_MEMORY_USAGE_THRESHOLD", "0.95"))
+            threshold = float(config.get("memory_usage_threshold"))
             self._memory_monitor = MemoryMonitor(
                 usage_threshold=threshold,
                 on_pressure=kill_retriable_policy(self),
@@ -512,8 +530,14 @@ class DriverRuntime:
             logger.warning("dropping done for unknown task %s from worker %s",
                            task_id_b.hex()[:8], ws.worker_id.hex()[:8])
             return
-        for rid, rkind, payload in results:
+        for entry in results:
+            rid, rkind, payload = entry[0], entry[1], entry[2]
             oid = ObjectID(rid)
+            # refs nested in the RESULT: pin them against the return
+            # object's lifetime BEFORE marking ready (a consumer must
+            # never observe the outer ready while inner refs are freeable)
+            if len(entry) > 3 and entry[3]:
+                self._pin_result_refs(rid, entry[3])
             if rkind == "i":
                 self.gcs.mark_ready(oid, inline=payload)
             elif rkind == "s":
@@ -584,6 +608,10 @@ class DriverRuntime:
             oid = ObjectID(args[0])
             # size rides the message (worker had it in hand at write time)
             size = args[2] if len(args) > 2 and args[1] is None else 0
+            if len(args) > 3 and args[3]:
+                # refs nested in the stored value: owner-pinned until the
+                # outer object is freed
+                self._pin_result_refs(args[0], args[3])
             self.gcs.mark_ready(oid, inline=args[1], size=size)
         elif op == "submit":
             if self.cluster is not None:
@@ -725,20 +753,85 @@ class DriverRuntime:
         if self._shutdown:
             return
         with self._ref_lock:
-            before = self._pin_total.get(oid_b, 0)
-            after = before + d
-            if after > 0:
-                self._pin_total[oid_b] = after
-            else:
-                self._pin_total.pop(oid_b, None)
-            # notify INSIDE the lock: pin/unpin casts must reach the
-            # directory in transition order or a 1->0->1 race could leave
-            # a live object unpinned remotely
-            if self.cluster is not None:
-                if before == 0 and after > 0:
-                    self.cluster.pin_object(oid_b)
-                elif before > 0 and after <= 0:
-                    self.cluster.unpin_object(oid_b)
+            self._apply_pin_locked(oid_b, d)
+        self._flush_ref_casts()
+        self._drain_deferred_unpins()
+
+    def _apply_pin_locked(self, oid_b: bytes, d: int) -> None:
+        before = self._pin_total.get(oid_b, 0)
+        after = before + d
+        if after > 0:
+            self._pin_total[oid_b] = after
+        else:
+            self._pin_total.pop(oid_b, None)
+        # record the transition INSIDE the lock (pin/unpin casts must reach
+        # the directory in transition order or a 1->0->1 race could leave a
+        # live object unpinned remotely); the network cast itself happens
+        # outside via _flush_ref_casts — rpc IO under _ref_lock widened the
+        # GC self-deadlock window (advisor r3)
+        if self.cluster is not None:
+            if before == 0 and after > 0:
+                self._cast_flusher.append((oid_b, 1))
+            elif before > 0 and after <= 0:
+                self._cast_flusher.append((oid_b, -1))
+
+    def _pin_result_refs(self, outer_b: bytes, nested) -> None:
+        """Pin refs nested inside a stored value against the OUTER object's
+        lifetime (reference borrowed-refs-in-returned-values role): without
+        this, the producer dropping its local ObjectRefs lets the global
+        refcount hit zero and the free-grace sweep deletes the inner object
+        before a late consumer deserializes. Released on the outer's
+        'freed' publication (or never, in local mode, where no pin-driven
+        freeing exists). Idempotent per (outer, inner): a lineage re-run
+        re-ships the same nested list."""
+        # record AND pin in ONE critical section: releasing between them
+        # lets a concurrent _release_result_ref_pins (freed publication)
+        # pop the set before the +1 lands, leaking a permanent pin
+        with self._ref_lock:
+            have = self._result_ref_pins.setdefault(outer_b, set())
+            fresh = [b for b in nested if b not in have]
+            have.update(fresh)
+            for b in fresh:
+                self._apply_pin_locked(b, 1)
+        self._flush_ref_casts()
+        self._drain_deferred_unpins()
+
+    def _release_result_ref_pins(self, outer_b: bytes) -> None:
+        with self._ref_lock:
+            nested = self._result_ref_pins.pop(outer_b, None)
+            for b in nested or ():
+                self._apply_pin_locked(b, -1)
+        if nested:
+            self._flush_ref_casts()
+
+    def _drain_deferred_unpins(self) -> None:
+        """Apply unpins queued by ObjectRef.__del__ (which must not lock)."""
+        if not self._shutdown:
+            self._deferred_unpins.drain()
+
+    def _send_pin_cast(self, item) -> None:
+        oid_b, op = item
+        if op > 0:
+            self.cluster.pin_object(oid_b)
+        else:
+            self.cluster.unpin_object(oid_b)
+
+    def _flush_ref_casts(self) -> None:
+        """Ship queued pin/unpin transitions to the directory, in order."""
+        if self.cluster is None:
+            self._cast_flusher.clear()
+            return
+        self._cast_flusher.flush()
+
+    def _ref_janitor_loop(self) -> None:
+        """Bound unpin staleness on an otherwise-idle driver: __del__ only
+        queues; this drains every couple of seconds."""
+        while not self._shutdown:
+            time.sleep(2.0)
+            try:
+                self._drain_deferred_unpins()
+            except Exception:
+                pass
 
     def _pin_args(self, spec: dict) -> None:
         """Pin a spec's argument objects until its first return is
@@ -1376,11 +1469,18 @@ class DriverRuntime:
 
     def put(self, value: Any) -> ObjectRef:
         oid = ObjectID.from_random()
-        inline, size = self.store.put(oid, value)
+        from ray_tpu.core.object_ref import collect_serialized_refs
+
+        with collect_serialized_refs() as nested:
+            inline, size = self.store.put(oid, value)
         # ref BEFORE publishing ready: the pin cast precedes obj_ready on
         # the same connection, so the directory never sees this entry
         # terminal-and-unpinned
         ref = ObjectRef(oid)
+        if nested:
+            # nested refs live as long as the outer object (the caller may
+            # drop its own ObjectRefs right after this put)
+            self._pin_result_refs(oid.binary(), nested)
         self.gcs.mark_ready(oid, inline=inline,
                             size=0 if inline is not None else size)
         return ref

@@ -24,7 +24,7 @@ from ray_tpu.core.exceptions import (
     TaskError,
 )
 from ray_tpu.core.ids import ActorID, ObjectID, TaskID, WorkerID
-from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.object_ref import ObjectRef, collect_serialized_refs
 from ray_tpu.core.object_store import INLINE_THRESHOLD, StoreClient
 
 # sentinel for request() timeouts (None is a legitimate reply payload)
@@ -65,9 +65,23 @@ class WorkerRuntime:
         # the cluster directory). Only 0<->1 transitions cross the pipe.
         self._refs_lock = threading.Lock()
         self._ref_counts: Dict[bytes, int] = {}
+        # GC-safety (advisor r3): the __del__ hook may fire at any
+        # allocation point, including on a thread already holding
+        # _refs_lock or _send_lock — it must take no locks and do no IO.
+        # It only appends (deque.append is atomic); normal code paths
+        # drain. Pin casts are queued under _refs_lock (order-preserving)
+        # and shipped outside it. Shared machinery: core/refqueue.py.
+        from ray_tpu.core.refqueue import DeferredDrops, OrderedCastFlusher
+
+        self._ref_casts = OrderedCastFlusher(
+            lambda item: self.cast("refpin", item[0], item[1]))
+        self._deferred_ref_drops = DeferredDrops(
+            self._refs_lock, self._apply_ref_drop_locked,
+            self._ref_casts.flush)
         from ray_tpu.core import object_ref as _object_ref
 
-        _object_ref.set_ref_hook(self._ref_added, self._ref_removed)
+        _object_ref.set_ref_hook(self._ref_added,
+                                 self._deferred_ref_drops.append)
         # Demuxed transport: exactly ONE thread reads the pipe and routes
         # replies to the issuing thread. This lets ANY thread in the worker
         # (the task thread, a train-session thread, a user thread) make
@@ -113,23 +127,22 @@ class WorkerRuntime:
             before = self._ref_counts.get(oid_b, 0)
             self._ref_counts[oid_b] = before + 1
             if before == 0:
-                try:
-                    self.cast("refpin", oid_b, 1)
-                except Exception:
-                    pass
+                self._ref_casts.append((oid_b, 1))
+        self._ref_casts.flush()
+        self._drain_ref_drops()
 
-    def _ref_removed(self, oid_b: bytes) -> None:
-        with self._refs_lock:
-            n = self._ref_counts.get(oid_b, 0) - 1
-            if n > 0:
-                self._ref_counts[oid_b] = n
-                return
-            self._ref_counts.pop(oid_b, None)
+    def _apply_ref_drop_locked(self, b: bytes) -> None:
+        n = self._ref_counts.get(b, 0) - 1
+        if n > 0:
+            self._ref_counts[b] = n
+        else:
+            self._ref_counts.pop(b, None)
             if n == 0:
-                try:
-                    self.cast("refpin", oid_b, -1)
-                except Exception:
-                    pass
+                self._ref_casts.append((b, -1))
+
+    def _drain_ref_drops(self) -> None:
+        """Apply ref drops queued by ObjectRef.__del__ (which cannot lock)."""
+        self._deferred_ref_drops.drain()
 
     def _start_receiver(self):
         if self._recv_started:
@@ -201,8 +214,14 @@ class WorkerRuntime:
 
     def put(self, value: Any) -> ObjectRef:
         obj_id = ObjectID.from_random()
-        inline, size = self.store.put(obj_id, value)
-        self.cast("put", obj_id.binary(), inline, size)
+        # refs nested inside the value transfer to the stored object's
+        # lifetime (owner pins them until the outer object is freed) — a
+        # borrower dropping its local refs must not strand the consumer
+        # (advisor r3: results/puts previously leaked this pin)
+        with collect_serialized_refs() as nested:
+            inline, size = self.store.put(obj_id, value)
+        self.cast("put", obj_id.binary(), inline, size,
+                  list(nested) or None)
         return ObjectRef(obj_id)
 
     def put_parts(self, data: bytes, buffers) -> ObjectRef:
@@ -376,14 +395,23 @@ class WorkerRuntime:
         results = []
         for rid_b, v in zip(rids, values):
             oid = ObjectID(rid_b)
-            inline, size = self.store.put(oid, v)
+            # collect refs nested in the RESULT (not just args): the owner
+            # pins them against the return object's lifetime, so a consumer
+            # deserializing after this worker's local refs are GC'd still
+            # finds them live (advisor r3, reference borrowed-refs-in-
+            # returned-values semantics)
+            with collect_serialized_refs() as nested:
+                inline, size = self.store.put(oid, v)
             if inline is not None:
-                results.append((rid_b, "i", inline))
+                entry = (rid_b, "i", inline)
             else:
                 # payload = segment size: the runtime records it in the
                 # directory so peers can plan chunked pulls (re-statting
                 # on the demux thread would tax every result)
-                results.append((rid_b, "s", size))
+                entry = (rid_b, "s", size)
+            if nested:
+                entry = entry + (list(nested),)
+            results.append(entry)
         return results
 
     def _apply_runtime_env(self, spec: dict):
@@ -485,8 +513,9 @@ class WorkerRuntime:
 
     def _emit_stream_item(self, spec: dict, count: int, item) -> None:
         oid = ObjectID(ts.streaming_return_id(spec["task_id"], count))
-        inline, size = self.store.put(oid, item)
-        self.cast("put", oid.binary(), inline, size)
+        with collect_serialized_refs() as nested:
+            inline, size = self.store.put(oid, item)
+        self.cast("put", oid.binary(), inline, size, list(nested) or None)
 
     def stream_consumed(self, task_id: bytes, n: int, owner=None) -> None:
         self.cast("stream_consumed", task_id, n, owner)
@@ -691,8 +720,16 @@ class WorkerRuntime:
     def main_loop(self):
         self._start_receiver()
         self._send(("ready",))
+        import queue as _queue
+
         while True:
-            spec = self._exec_queue.get()
+            try:
+                spec = self._exec_queue.get(timeout=2.0)
+            except _queue.Empty:
+                # idle: bounded staleness for __del__-deferred ref drops
+                self._drain_ref_drops()
+                continue
+            self._drain_ref_drops()
             conc = (self.actor_concurrency.get(spec.get("actor_id", b""), 1)
                     if spec["type"] == ts.ACTOR_METHOD else 1)
             if (spec["type"] == ts.ACTOR_METHOD

@@ -45,7 +45,9 @@ def resolve_attention_impl() -> str:
     """Concrete impl for this process/backend: "pallas" | "xla" | "naive"."""
     import os
 
-    impl = _ATTN_IMPL or os.environ.get("RTPU_ATTN_IMPL") or "auto"
+    from ray_tpu import config
+
+    impl = _ATTN_IMPL or config.get("attn_impl") or "auto"
     if impl == "auto":
         from ray_tpu.util.tpu_info import is_tpu_backend
 

@@ -71,6 +71,14 @@ def _cmd_timeline(args) -> int:
 
 
 def _cmd_bench(args) -> int:
+    if getattr(args, "watch", False):
+        from ray_tpu.util import tpu_watch
+
+        # only forward an explicit --interval; otherwise tpu_watch.main
+        # resolves the watch_interval knob (RTPU_WATCH_INTERVAL) itself
+        argv = ([] if args.interval is None
+                else ["--interval", str(args.interval)])
+        return tpu_watch.main(argv)
     import runpy
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -150,8 +158,15 @@ def main(argv=None) -> int:
     sub = p.add_subparsers(dest="cmd", required=True)
 
     sub.add_parser("status", help="show local shm sessions/arenas")
+    sub.add_parser("config", help="print every runtime knob (name, env "
+                                  "var, default, current value)")
     sub.add_parser("clean", help="remove leftover rtpu shm segments")
-    sub.add_parser("bench", help="run the flagship benchmark")
+    bench = sub.add_parser("bench", help="run the flagship benchmark")
+    bench.add_argument("--watch", action="store_true",
+                       help="daemon mode: probe the TPU tunnel all round; "
+                            "on first success run the on-chip bench + "
+                            "Pallas numerics check and cache the result")
+    bench.add_argument("--interval", type=float, default=None)
 
     tl = sub.add_parser("timeline", help="export chrome trace")
     tl.add_argument("--output", "-o", default=None)
@@ -177,6 +192,17 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     if args.cmd == "status":
         return _cmd_status(args)
+    if args.cmd == "config":
+        from ray_tpu import config as _config
+
+        rows = _config.describe()
+        w = max(len(r["env"]) for r in rows)
+        for r in rows:
+            mark = " *" if r["overridden"] else "  "
+            print(f"{r['env']:<{w}}{mark} {r['current']!r:>14}  "
+                  f"(default {r['default']!r}) — {r['doc']}")
+        print("\n(* = overridden via environment)")
+        return 0
     if args.cmd == "clean":
         return _cmd_clean(args)
     if args.cmd == "bench":

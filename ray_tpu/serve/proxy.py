@@ -17,9 +17,10 @@ import os
 import threading
 from typing import Any, Dict, Optional
 
+from ray_tpu import config
 from ray_tpu.serve.handle import DeploymentHandle
 
-MAX_BODY = int(os.environ.get("RTPU_SERVE_MAX_BODY", str(64 << 20)))
+MAX_BODY = int(config.get("serve_max_body"))
 ALLOWED_METHODS = {"GET", "POST", "PUT", "DELETE", "HEAD"}
 
 

@@ -14,6 +14,7 @@ import logging
 import os
 from typing import Any, Callable, Dict, List, Optional
 
+from ray_tpu import config
 import ray_tpu
 from ray_tpu.train.backend import BackendConfig, JaxConfig
 from ray_tpu.train.config import ScalingConfig
@@ -64,7 +65,7 @@ class BackendExecutor:
         # Readiness barrier with a deadline: an infeasible resource demand
         # (e.g. slice-mode bundles on a host that can't fit them) must fail
         # loudly, not hang the driver forever.
-        timeout = float(os.environ.get("RTPU_WORKER_START_TIMEOUT", "120"))
+        timeout = float(config.get("worker_start_timeout"))
         env = {k: v for k, v in os.environ.items()
                if k in ("JAX_PLATFORMS", "XLA_FLAGS", "TPU_VISIBLE_CHIPS")}
         try:

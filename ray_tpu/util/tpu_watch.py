@@ -1,0 +1,298 @@
+"""Opportunistic TPU bench watcher — catch the chip whenever the tunnel is up.
+
+The axon tunnel on this box is intermittent (down for hours at a time;
+rounds 1-3 never recorded a real-TPU number because the bench only ran at
+end-of-round).  This daemon inverts the bet: it probes the tunnel with a
+cheap child-process device query every ``--interval`` seconds for the whole
+round, and the first time the probe succeeds it
+
+  1. runs an on-chip Pallas flash-attention numerics check (fwd AND bwd,
+     kernel vs blockwise-XLA reference, plus a long-sequence bwd that would
+     OOM without the memory-efficient custom VJP), and
+  2. runs the full ``bench.py`` measurement on the chip,
+
+caching both to ``BENCH_TPU_LAST_GOOD.json``.  ``bench.py`` consults that
+cache when its own end-of-round probe finds the tunnel down, so one window
+of tunnel uptime anywhere in the round produces the real MFU number.
+
+Every probe attempt is appended to ``TPU_WATCH_LOG.jsonl`` — if the tunnel
+never comes up, the log is the proof that we watched all round.
+
+The parent process NEVER imports jax (a bare device query on the axon
+backend can hang for minutes); all chip contact happens in child processes
+with hard timeouts.  Role analog: none in the reference — this is
+infrastructure for the intermittent-tunnel dev box.
+
+Run: ``ray_tpu bench --watch`` or ``python -m ray_tpu.util.tpu_watch``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+DEFAULT_LOG = os.path.join(_REPO, "TPU_WATCH_LOG.jsonl")
+DEFAULT_CACHE = os.path.join(_REPO, "BENCH_TPU_LAST_GOOD.json")
+
+
+def _now_iso() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).isoformat(
+        timespec="seconds")
+
+
+def _append_log(log_path: str, record: dict) -> None:
+    record = {"ts": round(time.time(), 1), "iso": _now_iso(), **record}
+    with open(log_path, "a") as f:
+        f.write(json.dumps(record) + "\n")
+
+
+def probe(timeout: float = 25.0) -> dict:
+    """Cheap child-process device query (cold runtime start ~7s healthy)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; jax.config.update('jax_platforms', 'axon'); "
+             "d = jax.devices(); print('NDEV', len(d), getattr(d[0], 'device_kind', '?'))"],
+            capture_output=True, text=True, timeout=timeout,
+            env=dict(os.environ))
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "detail": f"device query hung {timeout:.0f}s"}
+    except Exception as e:  # pragma: no cover - spawn failure
+        return {"ok": False, "detail": f"probe spawn failed: {e}"}
+    ok = proc.returncode == 0 and "NDEV" in proc.stdout
+    tail = (proc.stdout if ok else (proc.stderr or proc.stdout))[-300:]
+    return {"ok": ok, "detail": tail.strip()}
+
+
+def run_numerics_child(timeout: float = 420.0) -> dict:
+    """On-chip Pallas kernel correctness: fwd+bwd vs XLA reference."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "axon"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "ray_tpu.util.tpu_watch", "--numerics"],
+            capture_output=True, text=True, timeout=timeout, env=env,
+            cwd=_REPO)
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "error": f"numerics child timed out {timeout:.0f}s"}
+    except Exception as e:  # pragma: no cover
+        return {"ok": False, "error": f"spawn failed: {e}"}
+    for line in reversed(proc.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return {"ok": False,
+            "error": f"rc={proc.returncode}: {(proc.stderr or '')[-800:]}"}
+
+
+def run_bench_child(timeout: float = 900.0) -> dict:
+    """Full bench.py on the chip; parse its single JSON line."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "axon"
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "bench.py")],
+            capture_output=True, text=True, timeout=timeout, env=env,
+            cwd=_REPO)
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "error": f"bench child timed out {timeout:.0f}s"}
+    except Exception as e:  # pragma: no cover
+        return {"ok": False, "error": f"spawn failed: {e}"}
+    for line in reversed(proc.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return {"ok": True, "result": json.loads(line)}
+            except json.JSONDecodeError:
+                continue
+    return {"ok": False,
+            "error": f"rc={proc.returncode}: {(proc.stderr or '')[-800:]}"}
+
+
+def _bench_is_real_tpu(result: dict) -> bool:
+    detail = result.get("detail", {})
+    return (result.get("metric") == "llama_train_mfu"
+            and result.get("value", 0) > 0
+            and "error" not in result
+            and detail.get("backend") in ("axon", "tpu")
+            # a result carrying tpu_cache is bench.py ECHOING this very
+            # cache (its tunnel-down fallback) — re-caching it would
+            # launder a stale number with a fresh timestamp
+            and "tpu_cache" not in detail)
+
+
+def load_cache(cache_path: str = DEFAULT_CACHE) -> dict | None:
+    """Last good on-chip measurement, or None. Used by bench.py fallback."""
+    try:
+        with open(cache_path) as f:
+            cached = json.load(f)
+        if _bench_is_real_tpu(cached.get("bench", {})):
+            return cached
+    except Exception:
+        pass
+    return None
+
+
+def watch(interval: float, log_path: str, cache_path: str,
+          refresh_s: float, max_iterations: int | None = None) -> None:
+    _append_log(log_path, {"event": "watch_start", "pid": os.getpid(),
+                           "interval_s": interval})
+    i = 0
+    while max_iterations is None or i < max_iterations:
+        i += 1
+        p = probe()
+        rec = {"event": "probe", "ok": p["ok"], "detail": p["detail"]}
+        cached = load_cache(cache_path)
+        cache_age = (time.time() - cached["ts"]) if cached else None
+        if p["ok"] and (cache_age is None or cache_age > refresh_s):
+            _append_log(log_path, rec)
+            _append_log(log_path, {"event": "bench_start"})
+            numerics = run_numerics_child()
+            _append_log(log_path, {"event": "numerics_done", **numerics})
+            bench = run_bench_child()
+            if bench.get("ok") and _bench_is_real_tpu(bench["result"]):
+                payload = {"ts": round(time.time(), 1), "iso": _now_iso(),
+                           "bench": bench["result"], "numerics": numerics}
+                tmp = cache_path + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(payload, f, indent=1)
+                os.replace(tmp, cache_path)
+                _append_log(log_path, {"event": "bench_cached",
+                                       "mfu": bench["result"].get("value")})
+            else:
+                _append_log(log_path, {
+                    "event": "bench_failed",
+                    "error": bench.get("error",
+                                       json.dumps(bench.get("result"))[:500])})
+        else:
+            if cache_age is not None:
+                rec["cache_age_s"] = round(cache_age)
+            _append_log(log_path, rec)
+        time.sleep(interval)
+
+
+# ---------------------------------------------------------------------------
+# --numerics child: jax lives here.
+# ---------------------------------------------------------------------------
+
+def numerics_child() -> None:
+    """Pallas flash kernel vs blockwise-XLA reference, on the real chip.
+
+    Compares forward outputs and dq/dk/dv grads (GQA shapes, causal) in
+    bf16, then proves the memory-efficient custom VJP sustains a long
+    sequence whose naive probability residuals would not fit HBM.
+    """
+    sys.path.insert(0, _REPO)
+    from ray_tpu.util.tpu_info import honor_jax_platform_env
+
+    honor_jax_platform_env()  # the axon sitecustomize ignores the env var
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.ops.attention import flash_attention
+
+    out: dict = {"ok": False, "backend": None}
+    out["backend"] = jax.default_backend()
+    out["device_kind"] = getattr(jax.devices()[0], "device_kind", "?")
+
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv, kw = jax.random.split(key, 4)
+    small = os.environ.get("RTPU_NUMERICS_SMALL") == "1"  # CPU smoke test
+    B, S, HQ, HKV, D = (1, 256, 4, 2, 64) if small else (2, 1024, 8, 2, 128)
+    q = jax.random.normal(kq, (B, S, HQ, D), jnp.bfloat16)
+    k = jax.random.normal(kk, (B, S, HKV, D), jnp.bfloat16)
+    v = jax.random.normal(kv, (B, S, HKV, D), jnp.bfloat16)
+    w = jax.random.normal(kw, (B, S, HQ, D), jnp.bfloat16)
+
+    def loss(q, k, v, impl):
+        o = flash_attention(q, k, v, causal=True, impl=impl)
+        return (o.astype(jnp.float32) * w.astype(jnp.float32)).sum()
+
+    def eval_impl(impl):
+        val, grads = jax.jit(
+            jax.value_and_grad(loss, argnums=(0, 1, 2)),
+            static_argnames=("impl",))(q, k, v, impl=impl)
+        return jax.device_get(val), jax.device_get(grads)
+
+    def max_err(a, b):
+        import numpy as np
+        a = np.asarray(a, dtype="float32")
+        b = np.asarray(b, dtype="float32")
+        denom = max(1.0, float(abs(b).max()))
+        return round(float(abs(a - b).max()) / denom, 6)
+
+    # independent reference: plain softmax attention (no custom VJP, no
+    # tiling) — both tiled impls must agree with it. The CPU smoke run
+    # skips pallas (non-interpret Mosaic needs a real TPU).
+    val_n, grads_n = eval_impl("naive")
+    tol = 0.03  # bf16 accumulation-order differences
+    impl_ok = {}
+    for impl in (("xla",) if small else ("pallas", "xla")):
+        t0 = time.perf_counter()
+        val_i, grads_i = eval_impl(impl)
+        out[f"{impl}_compile_run_s"] = round(time.perf_counter() - t0, 1)
+        errs = {
+            f"{impl}_fwd_rel_err": max_err(val_i, val_n),
+            f"{impl}_dq_rel_err": max_err(grads_i[0], grads_n[0]),
+            f"{impl}_dk_rel_err": max_err(grads_i[1], grads_n[1]),
+            f"{impl}_dv_rel_err": max_err(grads_i[2], grads_n[2]),
+        }
+        out.update(errs)
+        impl_ok[impl] = all(e < tol for e in errs.values())
+
+    # Long-seq bwd: at S=16384, B=4, H=8 the naive per-layer probability
+    # residual alone is B*H*S^2*4B = 32 GiB — over the 16 GiB HBM. The
+    # memory-efficient VJP must sustain it.
+    S2 = 512 if small else 16384
+    ql = jax.random.normal(kq, (1 if small else 4, S2, 8, D), jnp.bfloat16)
+    kl = jax.random.normal(kk, (1 if small else 4, S2, 2, D), jnp.bfloat16)
+    try:
+        t0 = time.perf_counter()
+        g = jax.jit(jax.grad(
+            lambda q, k, v: flash_attention(
+                q, k, v, causal=True).astype(jnp.float32).sum()))(ql, kl, kl)
+        float(jax.device_get(g.astype(jnp.float32).sum()))
+        out["longseq_16k_bwd_s"] = round(time.perf_counter() - t0, 1)
+        out["longseq_16k_bwd_ok"] = True
+    except Exception as e:
+        out["longseq_16k_bwd_ok"] = False
+        out["longseq_16k_bwd_error"] = str(e)[-400:]
+
+    out["ok"] = (all(impl_ok.values())
+                 and out.get("longseq_16k_bwd_ok", False))
+    print(json.dumps(out))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    from ray_tpu import config
+
+    ap.add_argument("--interval", type=float,
+                    default=float(config.get("watch_interval")))
+    ap.add_argument("--log", default=os.environ.get("RTPU_WATCH_LOG", DEFAULT_LOG))
+    ap.add_argument("--cache", default=DEFAULT_CACHE)
+    ap.add_argument("--refresh", type=float,
+                    default=float(config.get("watch_refresh")),
+                    help="re-run the on-chip bench if the cache is older than this")
+    ap.add_argument("--iterations", type=int, default=None)
+    ap.add_argument("--numerics", action="store_true",
+                    help="(child mode) run the on-chip numerics check")
+    args = ap.parse_args(argv)
+    if args.numerics:
+        numerics_child()
+        return 0
+    watch(args.interval, args.log, args.cache, args.refresh, args.iterations)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -790,3 +790,35 @@ def test_task_events_ship_to_gcs_cluster_wide(cluster):
     # the two task kinds executed on DIFFERENT nodes
     assert names["remote_side"] != names["local_side"]
     assert summarize_tasks()["remote_side"]["FINISHED"] >= 3
+
+
+def test_refs_nested_in_results_survive_producer_exit(monkeypatch):
+    """A ref nested in a task's RETURN value is pinned by the owner against
+    the return object's lifetime (advisor r3): after the producing worker
+    exits and its local refs are GC'd, a consumer that deserializes the
+    result well past the free grace must still fetch the inner object."""
+    monkeypatch.setenv("RTPU_GCS_FREE_GRACE_S", "1.0")
+    c = Cluster()
+    try:
+        c.add_node(num_cpus=2)
+        ray_tpu.init(address=c.address, cluster_authkey=c.authkey,
+                     num_cpus=2)
+
+        @ray_tpu.remote
+        def produce():
+            inner = ray_tpu.put(np.arange(30_000, dtype=np.float64))
+            return {"inner": inner}
+
+        out_ref = produce.remote()
+        # wait for completion WITHOUT deserializing (deserializing would
+        # create a local borrow pin and mask the bug)
+        ready, _ = ray_tpu.wait([out_ref], num_returns=1, timeout=90)
+        assert ready
+        time.sleep(4.0)  # > free grace + sweep tick: unpinned would sweep
+        out = ray_tpu.get(out_ref, timeout=30)
+        inner_val = ray_tpu.get(out["inner"], timeout=30)
+        np.testing.assert_array_equal(
+            inner_val, np.arange(30_000, dtype=np.float64))
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
