@@ -204,7 +204,7 @@ class ClusterAdapter:
         self.gcs.call("subscribe", "pgs", timeout=10)
         self.gcs.call("node_register", self.node_id, self.server.addr,
                       self.rt.resources("total"), self.is_scheduler,
-                      timeout=10)
+                      dict(getattr(self.rt, "labels", {})), timeout=10)
         self._node_view_ts = 0.0
         # a (re)registered GCS starts with an empty task-event store:
         # reship our full local history
@@ -231,6 +231,11 @@ class ClusterAdapter:
             return self._serve_pull(args[0])
         if method == "pull_chunk":
             return self._serve_pull_chunk(args[0], args[1], args[2])
+        if method == "bcast_fetch":
+            # relay work must not block the peer-RPC thread
+            self._pull_io.submit(self._bcast_fetch, args[0], args[1],
+                                 args[2], args[3])
+            return True
         if method == "stream_consumed":
             self.rt.stream_consumed(args[0], args[1])
             return True
@@ -502,6 +507,106 @@ class ClusterAdapter:
         with self._watch_lock:
             self._watched.pop(oid_b, None)
 
+    # ------------------------------------------------------------------
+    # push-based broadcast (reference PushManager, push_manager.h:30 role)
+    # ------------------------------------------------------------------
+
+    def broadcast_object(self, oid_b: bytes,
+                         node_ids: Optional[List[bytes]] = None) -> int:
+        """Proactively replicate an object to ``node_ids`` (default: every
+        alive node not already holding it) via a BINARY RELAY TREE: this
+        node seeds two branch roots; each receiver re-relays to its own
+        subtree after sealing. Every node uploads to at most two others, so
+        a 1-object-to-N broadcast moves N copies in O(log N) rounds instead
+        of N serial pulls off one owner (the reference's chunked
+        PushManager fan-out, receiver-driven here because the chunk
+        machinery already streams puller-side with bounded memory).
+
+        Returns the number of target nodes. The caller typically holds a
+        live ref; replicas advertise themselves in the directory and are
+        freed by the normal refcount path."""
+        st = self.gcs.call("obj_state", oid_b, timeout=30)
+        if st is None or st["status"] != "READY":
+            raise ValueError("broadcast: object not READY in the directory")
+        if st.get("inline") is not None:
+            return 0  # inline values ride the directory itself
+        size = int(st.get("size") or 0)
+        holders = set(st.get("locations") or ())
+        if node_ids is None:
+            targets = [n["node_id"] for n in self._nodes()
+                       if n["alive"] and n["node_id"] not in holders
+                       and n["node_id"] != self.node_id]
+        else:
+            targets = [b for b in node_ids
+                       if b not in holders and b != self.node_id]
+        if not targets:
+            return 0
+        src = (self.node_id if self.node_id in holders
+               else next(iter(holders)))
+        self._relay_bcast(oid_b, size, src, targets)
+        return len(targets)
+
+    def _relay_bcast(self, oid_b: bytes, size: int, from_node: bytes,
+                     targets: List[bytes]) -> None:
+        """Seed up to two subtree roots with the rest of their branch."""
+        if not targets:
+            return
+        mid = (len(targets) + 1) // 2
+        for branch in (targets[:mid], targets[mid:]):
+            if not branch:
+                continue
+            root, rest = branch[0], branch[1:]
+            peer = self._peer(root)
+            if peer is None:
+                # unreachable root: promote the rest of its branch
+                self._relay_bcast(oid_b, size, from_node, rest)
+                continue
+            try:
+                peer.cast("bcast_fetch", oid_b, size, from_node, rest)
+            except Exception:
+                self._relay_bcast(oid_b, size, from_node, rest)
+
+    def _bcast_fetch(self, oid_b: bytes, size: int, from_node: bytes,
+                     targets: List[bytes]) -> None:
+        """Receiver side: fetch from the designated source (falling back
+        to any directory location), then relay to our subtree — WE are the
+        source for our children, which is what makes the tree scale."""
+        oid = ObjectID(oid_b)
+        have = (self.rt.store.contains(oid)
+                or (self.rt.gcs.object_state(oid) or
+                    type("s", (), {"status": ""})).status == "READY")
+        if not have:
+            ok = False
+            peer = self._peer(from_node)
+            if peer is not None:
+                if size > PULL_CHUNK_BYTES:
+                    ok = self._fetch_chunked(oid, peer, size)
+                else:
+                    try:
+                        payload = peer.call("pull_object", oid_b,
+                                            timeout=60)
+                        if payload and payload[0] == "s":
+                            if not self.rt.store.contains(oid):
+                                self.rt.store.put_serialized(oid,
+                                                             payload[1])
+                            self.rt.gcs.mark_ready(oid, size=len(payload[1]))
+                            ok = True
+                    except Exception:
+                        ok = False
+            if not ok:
+                st = self.gcs.call("obj_state", oid_b, timeout=30)
+                if st and st["status"] == "READY":
+                    self._fetch(oid, st)
+                ok = self.rt.store.contains(oid)
+            if not ok:
+                logger.warning("bcast_fetch of %s failed; subtree of %d "
+                               "nodes falls back to owner pulls",
+                               oid.hex()[:8], len(targets))
+                # children can still pull from the original holders
+                self._relay_bcast(oid_b, size, from_node, targets)
+                return
+        self._relay_bcast(oid_b, size, self.node_id, targets)
+
     def _free_local_copy(self, oid_b: bytes):
         oid = ObjectID(oid_b)
         try:
@@ -546,6 +651,10 @@ class ClusterAdapter:
                 out = self._place_node_affinity(spec, strat[1], strat[2])
                 if out is not None:
                     return out
+            if strat is not None and strat[0] == "node_labels":
+                out = self._place_node_labels(spec, strat[1], strat[2])
+                if out is not None:
+                    return out
             return self._spill_if_infeasible(spec)
         res = spec.get("resources") or {}
         strat = spec.get("strategy")
@@ -554,6 +663,10 @@ class ClusterAdapter:
             if out is not None:
                 return out
             # soft affinity to a dead node: fall through to normal placement
+        elif strat is not None and strat[0] == "node_labels":
+            out = self._place_node_labels(spec, strat[1], strat[2])
+            if out is not None:
+                return out
         elif strat is not None and strat[0] == "spread":
             return self._place_spread(spec, res)
         with self.rt.lock:
@@ -689,6 +802,67 @@ class ClusterAdapter:
         if not picks:
             return False  # nowhere feasible: queue locally (matches head)
         return self._forward_to_best(picks, res, spec)
+
+    @staticmethod
+    def _labels_match(labels: Dict[str, str], preds) -> bool:
+        for key, op, vals in preds:
+            v = labels.get(key)
+            if op == "in":
+                ok = v in vals
+            elif op == "not_in":
+                ok = v is not None and v not in vals
+            elif op == "exists":
+                ok = v is not None
+            elif op == "does_not_exist":
+                ok = v is None
+            else:
+                ok = False
+            if not ok:
+                return False
+        return True
+
+    def _place_node_labels(self, spec: dict, hard, soft):
+        """NodeLabelSchedulingStrategy (reference
+        node_label_scheduling_policy.h role): hard predicates filter the
+        candidate set (no match anywhere -> fail the task loudly); soft
+        predicates rank it. Returns False to run/queue locally, True when
+        handled (forwarded or failed) — never None: falling through to
+        generic placement could forward to a node violating the hard
+        predicates."""
+        res = spec.get("resources") or {}
+        my_labels = dict(getattr(self.rt, "labels", {}))
+        nodes = [n for n in self._nodes() if n["alive"]]
+        candidates = [
+            n for n in nodes
+            if self._labels_match(n.get("labels", {}), hard)
+            and all(n["resources"].get(k, 0.0) >= v for k, v in res.items())
+        ]
+        local_ok = (self._labels_match(my_labels, hard)
+                    and all(self.rt.total.get(k, 0.0) >= v
+                            for k, v in res.items()))
+        if not candidates and not local_ok:
+            self._fail_returns(spec, ValueError(
+                f"no alive node matches label predicates {hard} with "
+                f"resources {res}"))
+            return True
+        if soft:
+            preferred = [n for n in candidates
+                         if self._labels_match(n.get("labels", {}), soft)]
+            local_preferred = local_ok and self._labels_match(my_labels,
+                                                              soft)
+            if preferred and not local_preferred:
+                if self._forward_to_best(preferred, res, spec):
+                    return True
+            if local_preferred:
+                return False  # run locally (soft + hard match here)
+        if local_ok:
+            return False  # run locally (hard match here)
+        others = [n for n in candidates if n["node_id"] != self.node_id]
+        if others and self._forward_to_best(others, res, spec):
+            return True
+        self._fail_returns(spec, ValueError(
+            f"no reachable node matches label predicates {hard}"))
+        return True
 
     def _place_node_affinity(self, spec: dict, node_id: bytes, soft: bool):
         """Pin to a node (reference NodeAffinitySchedulingStrategy). Hard

@@ -95,6 +95,7 @@ class Cluster:
 
     def add_node(self, *, num_cpus: float = 2,
                  resources: Optional[Dict[str, float]] = None,
+                 labels: Optional[Dict[str, str]] = None,
                  wait: bool = True) -> int:
         """Boot a node daemon subprocess; returns a handle id for kill_node."""
         import json
@@ -105,7 +106,8 @@ class Cluster:
             [sys.executable, "-m", "ray_tpu.cluster.node_daemon",
              "--gcs", self.address, "--authkey", self.authkey,
              "--num-cpus", str(num_cpus),
-             "--resources", json.dumps(resources or {})],
+             "--resources", json.dumps(resources or {}),
+             "--labels", json.dumps(labels or {})],
             env=self._env(), stdout=subprocess.DEVNULL,
             stderr=subprocess.STDOUT,
         )

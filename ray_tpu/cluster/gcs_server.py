@@ -50,10 +50,10 @@ class _GlobalObject:
 
 class _NodeEntry:
     __slots__ = ("node_id", "addr", "resources", "avail", "last_seen",
-                 "alive", "is_head")
+                 "alive", "is_head", "labels")
 
     def __init__(self, node_id: bytes, addr: str, resources: Dict[str, float],
-                 is_head: bool):
+                 is_head: bool, labels: Optional[Dict[str, str]] = None):
         self.node_id = node_id
         self.addr = addr  # node daemon RPC address ("" for the driver/head)
         self.resources = dict(resources)
@@ -61,6 +61,9 @@ class _NodeEntry:
         self.last_seen = time.monotonic()
         self.alive = True
         self.is_head = is_head
+        # static key=value node labels (reference NodeLabels): TPU
+        # generation / slice type / user labels, set at node start
+        self.labels = dict(labels or {})
 
 
 class GcsService:
@@ -163,14 +166,16 @@ class GcsService:
     # -- nodes ----------------------------------------------------------
 
     def rpc_node_register(self, ctx, node_id: bytes, addr: str,
-                          resources: Dict[str, float], is_head: bool):
+                          resources: Dict[str, float], is_head: bool,
+                          labels: Optional[Dict[str, str]] = None):
         with self.lock:
             self.nodes[node_id] = _NodeEntry(node_id, addr, resources,
-                                             is_head)
+                                             is_head, labels)
         ctx.meta["node_id"] = node_id
         ctx.on_close = self._conn_closed
         self._publish("nodes", {"event": "up", "node_id": node_id,
-                                "addr": addr, "resources": dict(resources)})
+                                "addr": addr, "resources": dict(resources),
+                                "labels": dict(labels or {})})
         return True
 
     def rpc_node_heartbeat(self, ctx, node_id: bytes,
@@ -199,7 +204,7 @@ class GcsService:
             return [
                 {"node_id": e.node_id, "addr": e.addr, "alive": e.alive,
                  "resources": dict(e.resources), "avail": dict(e.avail),
-                 "is_head": e.is_head}
+                 "is_head": e.is_head, "labels": dict(e.labels)}
                 for e in self.nodes.values()
             ]
 

@@ -28,6 +28,9 @@ def main(argv=None):
     p.add_argument("--num-cpus", type=float, default=None)
     p.add_argument("--resources", default="{}",
                    help="extra resources as JSON, e.g. '{\"worker\": 1}'")
+    p.add_argument("--labels", default="{}",
+                   help="node labels as JSON, e.g. "
+                        "'{\"tpu-generation\": \"v5e\"}'")
     p.add_argument("--listen-host", default="127.0.0.1")
     args = p.parse_args(argv)
 
@@ -39,6 +42,7 @@ def main(argv=None):
         num_tpus=0,
         resources=json.loads(args.resources),
         log_to_driver=False,  # daemon stdout goes nowhere useful
+        labels=json.loads(args.labels),
     )
     adapter = ClusterAdapter(args.gcs, args.authkey.encode(),
                              is_scheduler=False,

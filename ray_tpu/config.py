@@ -68,6 +68,11 @@ _knob("lineage_max_bytes", int, 512 << 20,
       "byte bound on retained lineage (inlined args dominate; reference "
       "RAY_max_lineage_bytes)", "core/runtime.py")
 
+_knob("worker_zygote", _bool, True,
+      "spawn workers by forking a pre-warmed single-threaded fork-server "
+      "(~5ms) instead of exec'ing a fresh interpreter (~0.15s); the "
+      "fork-server never imports jax or user code", "core/runtime.py")
+
 # -- object store -----------------------------------------------------------
 _knob("native_store", _bool, True,
       "use the C++ shm arena (falls back to file-per-object segments)",

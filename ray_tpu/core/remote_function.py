@@ -55,6 +55,13 @@ def _strategy_spec(opts: Dict[str, Any]):
             node_id = bytes.fromhex(node_id)
         return ("node_affinity", node_id, bool(getattr(strategy, "soft",
                                                        False)))
+    if hasattr(strategy, "hard") and hasattr(strategy, "soft"):
+        def enc(preds):
+            return tuple((str(k), getattr(op, "op", "in"),
+                          tuple(getattr(op, "values", ())))
+                         for k, op in preds.items())
+
+        return ("node_labels", enc(strategy.hard), enc(strategy.soft))
     return None
 
 

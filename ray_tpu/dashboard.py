@@ -22,6 +22,8 @@ class _Handler(BaseHTTPRequestHandler):
         from ray_tpu.util import state as st
         from ray_tpu.util.metrics import prometheus_text
 
+        from ray_tpu.serve import config_api as serve_rest
+
         routes = {
             "/api/nodes": st.list_nodes,
             "/api/actors": st.list_actors,
@@ -32,6 +34,8 @@ class _Handler(BaseHTTPRequestHandler):
             "/api/summary/tasks": st.summarize_tasks,
             "/api/summary/actors": st.summarize_actors,
             "/api/summary/objects": st.summarize_objects,
+            # serve REST (reference dashboard/modules/serve role)
+            "/api/serve/applications": serve_rest.serve_rest_get,
         }
         try:
             if self.path == "/metrics":
@@ -63,6 +67,42 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+
+
+    def _json_reply(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload, default=str).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_PUT(self):  # noqa: N802 — declarative serve deploy (REST)
+        if self.path != "/api/serve/applications":
+            self.send_response(404)
+            self.end_headers()
+            return
+        try:
+            from ray_tpu.serve import config_api as serve_rest
+
+            n = int(self.headers.get("Content-Length", 0) or 0)
+            cfg = json.loads(self.rfile.read(n) or b"{}")
+            self._json_reply(200, {"result": serve_rest.serve_rest_put(cfg)})
+        except Exception as e:  # noqa: BLE001
+            self._json_reply(500, {"error": str(e)})
+
+    def do_DELETE(self):  # noqa: N802 — serve shutdown (REST)
+        if self.path != "/api/serve/applications":
+            self.send_response(404)
+            self.end_headers()
+            return
+        try:
+            from ray_tpu.serve import config_api as serve_rest
+
+            self._json_reply(200,
+                             {"result": serve_rest.serve_rest_delete()})
+        except Exception as e:  # noqa: BLE001
+            self._json_reply(500, {"error": str(e)})
 
 
 class Dashboard:

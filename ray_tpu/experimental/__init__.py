@@ -1,4 +1,4 @@
-"""Experimental: mutable channels (compiled-DAG data plane)."""
+"""Experimental: mutable channels (compiled-DAG data plane) + broadcast."""
 
 from ray_tpu.experimental.channel import (
     Channel,
@@ -6,4 +6,20 @@ from ray_tpu.experimental.channel import (
     ChannelTimeoutError,
 )
 
-__all__ = ["Channel", "ChannelFullError", "ChannelTimeoutError"]
+
+def broadcast_object(ref, node_ids=None) -> int:
+    """Proactively replicate ``ref``'s object to cluster nodes via a relay
+    tree (reference PushManager role, ``push_manager.h:30``): each receiver
+    re-serves its subtree, so no single owner uploads N copies. Default
+    targets: every alive node not already holding the object. Returns the
+    number of nodes targeted; 0 in local mode or for inline objects."""
+    from ray_tpu.core.runtime import _get_runtime
+
+    rt = _get_runtime()
+    if rt.cluster is None:
+        return 0
+    return rt.cluster.broadcast_object(ref.id.binary(), node_ids)
+
+
+__all__ = ["Channel", "ChannelFullError", "ChannelTimeoutError",
+           "broadcast_object"]

@@ -45,6 +45,11 @@ class TransformerConfig:
     expert_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
 
+    # pipeline parallelism: microbatch count for the GPipe schedule when
+    # the ambient mesh has pp > 1 (0 => 2 * pp, the usual bubble/memory
+    # compromise); batch size must divide by it
+    pp_microbatches: int = 0
+
     # numerics / memory
     dtype: str = "bfloat16"            # activation/param compute dtype
     param_dtype: str = "float32"       # master param dtype

@@ -166,6 +166,16 @@ def _sp_axis_size() -> int:
     return mesh.shape["sp"]
 
 
+def _pp_axis_size() -> int:
+    """Size of the ambient mesh's pipeline axis (1 if absent)."""
+    from jax.sharding import get_abstract_mesh
+
+    mesh = get_abstract_mesh()
+    if mesh is None or mesh.empty or "pp" not in mesh.axis_names:
+        return 1
+    return mesh.shape["pp"]
+
+
 def _attention(q, k, v, config: TransformerConfig):
     """Training attention: ring over sp when sequence-parallel, else flash."""
     sp = _sp_axis_size()
@@ -192,6 +202,65 @@ def _attention(q, k, v, config: TransformerConfig):
     # it the backward of a scanned-layer model OOMs HBM at long context.
     return flash_attention(q, k, v, causal=True,
                            impl=resolve_attention_impl())
+
+
+def _layers_pipelined(layer_params, x, layer_fn, c, pp, cos, sin):
+    """Run the layer stack as a GPipe pipeline over the ``pp`` mesh axis.
+
+    The stacked layer dim is sharded over pp (``"layers": "pp"`` rule), so
+    each stage holds L/pp layers; activations rotate stage-to-stage inside
+    :func:`ray_tpu.train.pipeline.pipeline_apply` (``lax.ppermute`` over
+    ICI). ``shard_map`` is manual ONLY over pp (``axis_names={"pp"}``) —
+    fsdp/tp shardings inside each block stay GSPMD-auto, so pp composes
+    with the other axes. MoE layers are excluded (their aux-loss carry
+    doesn't thread through the pipeline state; use ep for MoE scale-out).
+    Pipeline parallel is absent from the reference (SURVEY §2.4).
+    """
+    from jax.sharding import PartitionSpec as P, get_abstract_mesh
+
+    from ray_tpu.train.pipeline import (merge_microbatches, pipeline_apply,
+                                        split_microbatches)
+
+    if c.num_experts:
+        raise NotImplementedError(
+            "pipeline parallelism excludes MoE layers (aux loss does not "
+            "thread through the pipeline carry); shard experts over ep")
+    num_micro = c.pp_microbatches or 2 * pp
+    b = x.shape[0]
+    if b % num_micro:
+        raise ValueError(
+            f"batch {b} not divisible by pp microbatches {num_micro}")
+    micro = split_microbatches(x, num_micro)  # [M, mb, L, D]
+    lspecs = jax.tree.map(lambda _: P("pp"), layer_params)
+    # rope tables ride as explicit replicated args (shard_map must not
+    # close over traced arrays)
+    extras = () if cos is None else (cos, sin)
+    especs = () if cos is None else (P(), P())
+
+    def run(lps, m, *extra):
+        cs, sn = (extra + (None, None))[:2]
+
+        def block(lp, h):
+            h2, _aux = layer_fn(h, lp, cs, sn)
+            return h2
+
+        blk = jax.checkpoint(block) if c.remat else block
+        return pipeline_apply(blk, lps, m, axis="pp")
+
+    out = jax.shard_map(
+        run,
+        mesh=get_abstract_mesh(),
+        in_specs=(lspecs, P()) + especs,
+        out_specs=P(),
+        axis_names={"pp"},
+        # VMA checking off: scans INSIDE the stage compute (blockwise
+        # attention) init fresh zeros (unvarying) and combine them with
+        # pp-varying activations, which the checker rejects at every such
+        # site; replication of the final output holds by construction
+        # (pipeline_apply broadcasts the last stage's result)
+        check_vma=False,
+    )(layer_params, micro, *extras)
+    return merge_microbatches(out), jnp.zeros((), jnp.float32)
 
 
 def forward(
@@ -225,7 +294,7 @@ def forward(
     else:
         cos = sin = None
 
-    def layer(x, lp):
+    def layer(x, lp, cos=cos, sin=sin):
         h = _norm(x, lp["attn_norm"], lp.get("attn_norm_b"), c.norm)
         q = jnp.einsum("bld,dhk->blhk", h, lp["wq"].astype(dt))
         k = jnp.einsum("bld,dhk->blhk", h, lp["wk"].astype(dt))
@@ -263,13 +332,19 @@ def forward(
 
     body = jax.checkpoint(layer) if c.remat else layer
 
-    def scan_step(carry, lp):
-        x, aux_sum = carry
-        x, aux = body(x, lp)
-        return (x, aux_sum + aux), None
+    pp = _pp_axis_size()
+    if pp > 1:
+        x, moe_aux = _layers_pipelined(params["layers"], x, layer, c, pp,
+                                       cos, sin)
+    else:
+        def scan_step(carry, lp):
+            x, aux_sum = carry
+            x, aux = body(x, lp)
+            return (x, aux_sum + aux), None
 
-    (x, moe_aux), _ = lax.scan(scan_step, (x, jnp.zeros((), jnp.float32)),
-                               params["layers"])
+        (x, moe_aux), _ = lax.scan(scan_step,
+                                   (x, jnp.zeros((), jnp.float32)),
+                                   params["layers"])
 
     x = _norm(x, params["final_norm"], params.get("final_norm_b"), c.norm)
     head = (params["embed"].T if c.tie_embeddings else params["lm_head"]).astype(dt)

@@ -60,6 +60,10 @@ DEFAULT_RULES = ShardingRules({
     "vocab": "tp",
     "expert": "ep",
     "stage": "pp",
+    # the stacked layer dim shards over pp: each pipeline stage holds
+    # L/pp layers (the pipelined forward routes through
+    # train.pipeline.pipeline_apply; with pp == 1 this is a no-op)
+    "layers": "pp",
     "norm": None,
 })
 

@@ -34,6 +34,16 @@ from ray_tpu.rllib.offline import (
     record_episodes,
     train_bc,
 )
+from ray_tpu.rllib.multi_agent import (
+    DebugCooperativeMatch,
+    MultiAgentEnv,
+    MultiAgentEnvRunner,
+    MultiAgentPPO,
+    MultiAgentPPOConfig,
+    MultiAgentPPOLearner,
+    MultiAgentRLModule,
+    MultiAgentRLModuleSpec,
+)
 from ray_tpu.rllib.rl_module import JaxRLModule, RLModuleSpec
 
 __all__ = [
@@ -51,6 +61,14 @@ __all__ = [
     "LearnerGroup",
     "JaxRLModule",
     "RLModuleSpec",
+    "MultiAgentEnv",
+    "MultiAgentEnvRunner",
+    "MultiAgentPPO",
+    "MultiAgentPPOConfig",
+    "MultiAgentPPOLearner",
+    "MultiAgentRLModule",
+    "MultiAgentRLModuleSpec",
+    "DebugCooperativeMatch",
     "PPO",
     "PPOConfig",
     "PPOLearner",

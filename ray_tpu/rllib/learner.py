@@ -47,11 +47,8 @@ class JaxLearner:
         import optax
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-        from ray_tpu.rllib.rl_module import RLModuleSpec
-
         self.config = dict(config or {})
-        self.spec = RLModuleSpec(**module_spec_dict)
-        self.module = self.spec.build()
+        self._build_module(module_spec_dict)
 
         # Mesh over this process's devices, one "dp" axis: RL modules are
         # small, so params replicate and the batch shards — the grad psum
@@ -76,7 +73,16 @@ class JaxLearner:
         self._grad_fn = jax.jit(self._grad_step)
         self._apply_fn = jax.jit(self._apply_step)
 
-    # -- override point ---------------------------------------------------
+    # -- override points --------------------------------------------------
+
+    def _build_module(self, module_spec_dict: Dict[str, Any]) -> None:
+        """Construct ``self.spec`` / ``self.module`` from the spec dict.
+        Multi-agent learners override this to build a module PER policy
+        (reference MultiAgentRLModule role)."""
+        from ray_tpu.rllib.rl_module import RLModuleSpec
+
+        self.spec = RLModuleSpec(**module_spec_dict)
+        self.module = self.spec.build()
 
     def compute_loss(self, params, batch: Dict[str, Any]):
         """Return (loss, metrics_dict). Pure; jitted by the learner."""

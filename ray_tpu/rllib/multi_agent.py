@@ -1,0 +1,460 @@
+"""Multi-agent RL: env API, env runner, MARL module, multi-agent PPO.
+
+Role analogs in the reference:
+
+- ``rllib/env/multi_agent_env.py`` — the :class:`MultiAgentEnv` dict API
+  (``reset -> (obs_dict, info)``, ``step(actions_dict) -> (obs, rew, term,
+  trunc, info)`` with the ``"__all__"`` termination key);
+- ``rllib/core/rl_module/marl_module.py`` — :class:`MultiAgentRLModuleSpec`
+  / :class:`MultiAgentRLModule` (one sub-module per policy id, params =
+  ``{module_id: sub_params}``);
+- ``rllib/env/multi_agent_env_runner.py`` — :class:`MultiAgentEnvRunner`
+  (maps agents to modules via the policy-mapping fn, batches per module);
+- multi-agent PPO = reference PPO's multi-agent path (per-module loss sum,
+  ``compute_loss_for_module`` over the shared GAE pipeline).
+
+TPU-native stance: identical to the single-agent stack — sampling on CPU
+actors, ONE jitted update over all policy modules at once (the summed loss
+differentiates through every sub-module in a single XLA program, instead
+of the reference's per-policy optimizer loop).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm
+from ray_tpu.rllib.learner import JaxLearner, LearnerGroup
+from ray_tpu.rllib.ppo import PPOConfig, compute_gae, ppo_loss
+
+
+# ---------------------------------------------------------------------------
+# Env API
+# ---------------------------------------------------------------------------
+
+class MultiAgentEnv:
+    """Dict-keyed multi-agent env (reference MultiAgentEnv API).
+
+    Subclasses define ``agents`` (tuple of agent ids), ``observation_dim``
+    and ``action_dim`` per agent (via :meth:`spaces`), and implement
+    :meth:`reset` / :meth:`step`. All agents act every step (simultaneous
+    game); ``step`` returns per-agent dicts plus ``terminateds["__all__"]``.
+    """
+
+    agents: Tuple[str, ...] = ()
+
+    def spaces(self, agent_id: str) -> Dict[str, Any]:
+        """{"observation_dim": int, "action_dim": int, "discrete": bool}"""
+        raise NotImplementedError
+
+    def reset(self, seed: Optional[int] = None
+              ) -> Tuple[Dict[str, np.ndarray], Dict]:
+        raise NotImplementedError
+
+    def step(self, actions: Dict[str, Any]):
+        raise NotImplementedError
+
+
+class DebugCooperativeMatch(MultiAgentEnv):
+    """Toy 2-agent contextual game for tests/examples: each agent sees a
+    one-hot context and earns +1 for choosing the matching action, with a
+    small shared bonus when BOTH match (cooperative coupling, so the task
+    is multi-agent, not two independent bandits)."""
+
+    agents = ("agent_0", "agent_1")
+
+    def __init__(self, n_contexts: int = 4, episode_len: int = 16,
+                 seed: int = 0):
+        self.n = n_contexts
+        self.episode_len = episode_len
+        self._rng = np.random.default_rng(seed)
+        self._t = 0
+        self._ctx = {}
+
+    def spaces(self, agent_id: str) -> Dict[str, Any]:
+        return {"observation_dim": self.n, "action_dim": self.n,
+                "discrete": True}
+
+    def _obs(self) -> Dict[str, np.ndarray]:
+        out = {}
+        for a in self.agents:
+            o = np.zeros(self.n, np.float32)
+            o[self._ctx[a]] = 1.0
+            out[a] = o
+        return out
+
+    def reset(self, seed: Optional[int] = None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._t = 0
+        self._ctx = {a: int(self._rng.integers(self.n)) for a in self.agents}
+        return self._obs(), {}
+
+    def step(self, actions: Dict[str, Any]):
+        hits = {a: float(int(actions[a]) == self._ctx[a])
+                for a in self.agents}
+        both = all(hits.values())
+        rewards = {a: hits[a] + (0.5 if both else 0.0) for a in self.agents}
+        self._t += 1
+        done = self._t >= self.episode_len
+        self._ctx = {a: int(self._rng.integers(self.n)) for a in self.agents}
+        obs = self._obs()
+        terminateds = {a: done for a in self.agents}
+        terminateds["__all__"] = done
+        truncateds = {a: False for a in self.agents}
+        truncateds["__all__"] = False
+        return obs, rewards, terminateds, truncateds, {}
+
+
+# ---------------------------------------------------------------------------
+# MARL module
+# ---------------------------------------------------------------------------
+
+class MultiAgentRLModuleSpec:
+    """``module_specs``: module_id -> RLModuleSpec kwargs dict
+    (reference ``MultiAgentRLModuleSpec`` role)."""
+
+    def __init__(self, module_specs: Dict[str, Dict[str, Any]]):
+        self.module_specs = dict(module_specs)
+
+    def build(self) -> "MultiAgentRLModule":
+        return MultiAgentRLModule(self)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"multi_agent": True, "module_specs": self.module_specs}
+
+
+class MultiAgentRLModule:
+    """One sub-module per policy id; params = {module_id: sub_params}."""
+
+    def __init__(self, spec: MultiAgentRLModuleSpec):
+        from ray_tpu.rllib.rl_module import RLModuleSpec
+
+        self.spec = spec
+        self.modules = {
+            mid: RLModuleSpec(**kw).build()
+            for mid, kw in spec.module_specs.items()
+        }
+
+    def __getitem__(self, module_id: str):
+        return self.modules[module_id]
+
+    def init(self, rng) -> Dict[str, Any]:
+        import jax
+
+        keys = jax.random.split(rng, len(self.modules))
+        return {mid: m.init(k)
+                for (mid, m), k in zip(sorted(self.modules.items()), keys)}
+
+    def forward_train(self, params, obs_by_module: Dict[str, Any]):
+        return {mid: self.modules[mid].forward_train(params[mid], obs)
+                for mid, obs in obs_by_module.items()}
+
+
+# ---------------------------------------------------------------------------
+# Env runner
+# ---------------------------------------------------------------------------
+
+class MultiAgentEnvRunner:
+    """Steps one multi-agent env; emits per-MODULE batches of [T, A_m]
+    arrays (A_m = number of agents mapped to that module). Reference:
+    ``multi_agent_env_runner.py`` + agent-to-module mapping fn."""
+
+    def __init__(self, env_maker: Callable[..., MultiAgentEnv],
+                 module_specs: Optional[Dict[str, Dict[str, Any]]] = None,
+                 agent_to_module: Optional[Callable[[str], str]] = None,
+                 seed: int = 0, env_config: Optional[Dict[str, Any]] = None):
+        import jax
+
+        self.env = env_maker(**(env_config or {}))
+        self.agents = tuple(self.env.agents)
+        self.a2m = agent_to_module or (lambda aid: aid)
+        # module id -> its agents, in stable order
+        self.module_agents: Dict[str, List[str]] = {}
+        for a in self.agents:
+            self.module_agents.setdefault(self.a2m(a), []).append(a)
+        if module_specs is None:
+            module_specs = {}
+            for mid, ags in self.module_agents.items():
+                module_specs[mid] = dict(self.env.spaces(ags[0]),
+                                         hidden=(32, 32))
+        self.ma_spec = MultiAgentRLModuleSpec(module_specs)
+        self.module = self.ma_spec.build()
+        self.params = self.module.init(jax.random.PRNGKey(seed))
+        self._rng = jax.random.PRNGKey(seed + 1)
+        self._explore = {
+            mid: jax.jit(m.forward_exploration)
+            for mid, m in self.module.modules.items()}
+        self._obs, _ = self.env.reset(seed=seed)
+        self._ep_return = 0.0
+        self._completed: List[float] = []
+
+    def get_spec(self) -> Dict[str, Any]:
+        return self.ma_spec.to_dict()
+
+    def set_weights(self, params) -> None:
+        self.params = params
+
+    def sample(self, num_steps: int = 200) -> Dict[str, Dict[str, np.ndarray]]:
+        import jax
+
+        cols: Dict[str, Dict[str, List]] = {
+            mid: {k: [] for k in ("obs", "actions", "action_logp",
+                                  "vf_preds", "rewards", "terminateds",
+                                  "truncateds")}
+            for mid in self.module_agents}
+        for _ in range(num_steps):
+            actions_env: Dict[str, Any] = {}
+            per_mid_step: Dict[str, Dict[str, np.ndarray]] = {}
+            for mid, ags in self.module_agents.items():
+                obs = np.stack([self._obs[a] for a in ags])
+                self._rng, sub = jax.random.split(self._rng)
+                out = self._explore[mid](self.params[mid], obs, sub)
+                acts = np.asarray(out["actions"])
+                per_mid_step[mid] = {
+                    "obs": obs,
+                    "actions": acts,
+                    "action_logp": np.asarray(out["action_logp"]),
+                    "vf_preds": np.asarray(out["vf_preds"]),
+                }
+                for a, act in zip(ags, acts):
+                    actions_env[a] = act
+            obs, rew, term, trunc, _ = self.env.step(actions_env)
+            self._ep_return += float(sum(rew.values()))
+            for mid, ags in self.module_agents.items():
+                c = cols[mid]
+                s = per_mid_step[mid]
+                c["obs"].append(s["obs"])
+                c["actions"].append(s["actions"])
+                c["action_logp"].append(s["action_logp"])
+                c["vf_preds"].append(s["vf_preds"])
+                c["rewards"].append(
+                    np.asarray([rew[a] for a in ags], np.float32))
+                c["terminateds"].append(
+                    np.asarray([term.get(a, False) for a in ags]))
+                c["truncateds"].append(
+                    np.asarray([trunc.get(a, False) for a in ags]))
+            if term.get("__all__") or trunc.get("__all__"):
+                self._completed.append(self._ep_return)
+                self._ep_return = 0.0
+                obs, _ = self.env.reset()
+            self._obs = obs
+        out: Dict[str, Dict[str, np.ndarray]] = {}
+        for mid, ags in self.module_agents.items():
+            b = {k: np.stack(v) for k, v in cols[mid].items()}
+            b["next_obs"] = np.stack([self._obs[a] for a in ags])
+            out[mid] = b
+        return out
+
+    def get_metrics(self) -> Dict[str, Any]:
+        if not self._completed:
+            return {"episode_return_mean": 0.0, "num_episodes": 0}
+        recent = self._completed[-100:]
+        return {"episode_return_mean": float(np.mean(recent)),
+                "num_episodes": len(self._completed)}
+
+    def ping(self) -> bool:
+        return True
+
+    def stop(self) -> None:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Learner + algorithm
+# ---------------------------------------------------------------------------
+
+class MultiAgentPPOLearner(JaxLearner):
+    """Sums the PPO loss over every policy module in ONE jitted update —
+    all sub-modules differentiate in a single XLA program (the reference
+    loops per-policy optimizers; one fused program is the TPU-native
+    shape)."""
+
+    def _build_module(self, module_spec_dict: Dict[str, Any]) -> None:
+        self.spec = MultiAgentRLModuleSpec(module_spec_dict["module_specs"])
+        self.module = self.spec.build()
+
+    def compute_loss(self, params, batch):
+        total = None
+        metrics: Dict[str, Any] = {}
+        for mid in sorted(self.module.modules):
+            loss, m = ppo_loss(self.module[mid], self.config,
+                               params[mid], batch[mid])
+            total = loss if total is None else total + loss
+            for k, v in m.items():
+                metrics[f"{mid}/{k}"] = v
+        return total, metrics
+
+    def _pad_to_devices(self, batch):
+        return {mid: super(MultiAgentPPOLearner, self)._pad_to_devices(b)
+                for mid, b in batch.items()}
+
+    def update(self, batch: Dict[str, Dict[str, np.ndarray]],
+               minibatch_size: Optional[int] = None,
+               num_epochs: int = 1) -> Dict[str, float]:
+        import jax
+
+        rng = np.random.default_rng(0)
+        ns = {mid: len(next(iter(b.values()))) for mid, b in batch.items()}
+        n_max = max(ns.values())
+        mb = minibatch_size or n_max
+        num_mb = max(1, -(-n_max // mb))
+        last: Dict[str, float] = {}
+        for _ in range(num_epochs):
+            perms = {mid: rng.permutation(n) for mid, n in ns.items()}
+            for i in range(num_mb):
+                shard = {}
+                for mid, b in batch.items():
+                    # fixed per-module minibatch size (wraparound slicing)
+                    # so jit sees ONE batch signature across steps
+                    size = min(mb, ns[mid])
+                    idx = np.take(perms[mid],
+                                  np.arange(i * size, (i + 1) * size),
+                                  mode="wrap")
+                    shard[mid] = {k: v[idx] for k, v in b.items()}
+                placed = self._place_batch(self._pad_to_devices(shard))
+                with jax.set_mesh(self.mesh):
+                    self.params, self.opt_state, metrics = self._update_fn(
+                        self.params, self.opt_state, placed)
+                last = {k: float(jax.device_get(v))
+                        for k, v in metrics.items()}
+        return last
+
+
+class MultiAgentPPOConfig(PPOConfig):
+    """PPO config with the reference's ``.multi_agent(policies=...,
+    policy_mapping_fn=...)`` surface. ``environment`` takes the env MAKER
+    (a callable), not a gym id."""
+
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or MultiAgentPPO)
+        self.env_maker: Optional[Callable] = None
+        self.policies: Optional[Dict[str, Optional[Dict]]] = None
+        self.policy_mapping_fn: Optional[Callable[[str], str]] = None
+
+    def environment(self, env, *, env_config: Optional[Dict] = None):
+        if callable(env):
+            self.env_maker = env
+            if env_config:
+                self.env_config = env_config
+            return self
+        return super().environment(env, env_config=env_config)
+
+    def multi_agent(self, *, policies: Optional[Dict] = None,
+                    policy_mapping_fn: Optional[Callable] = None
+                    ) -> "MultiAgentPPOConfig":
+        if policies is not None:
+            self.policies = policies
+        if policy_mapping_fn is not None:
+            self.policy_mapping_fn = policy_mapping_fn
+        return self
+
+
+class MultiAgentPPO(Algorithm):
+    config_cls = MultiAgentPPOConfig
+
+    def _setup_algo(self):
+        cfg = self.algo_config
+        assert cfg.env_maker is not None, \
+            "MultiAgentPPO needs .environment(env_maker)"
+        a2m = cfg.policy_mapping_fn or (lambda aid: aid)
+        probe = MultiAgentEnvRunner(cfg.env_maker, None, a2m, cfg.seed,
+                                    cfg.env_config)
+        specs = probe.get_spec()["module_specs"]
+        if cfg.policies:
+            for mid, override in cfg.policies.items():
+                if override:
+                    specs.setdefault(mid, {}).update(override)
+        self.module_spec = {"multi_agent": True, "module_specs": specs}
+        self._a2m = a2m
+        probe.stop()
+
+        if cfg.num_env_runners > 0:
+            import ray_tpu
+
+            runner_cls = ray_tpu.remote(MultiAgentEnvRunner)
+
+            def make_runner(i: int):
+                return runner_cls.options(num_cpus=1).remote(
+                    cfg.env_maker, specs, a2m,
+                    cfg.seed + i * 1000 + 1, cfg.env_config)
+
+            from ray_tpu.rllib.actor_manager import FaultTolerantActorManager
+
+            self.env_runner_group = FaultTolerantActorManager(
+                make_runner, cfg.num_env_runners)
+            self.local_runner = None
+        else:
+            self.env_runner_group = None
+            self.local_runner = MultiAgentEnvRunner(
+                cfg.env_maker, specs, a2m, cfg.seed + 1, cfg.env_config)
+
+        self.learner_group = self._make_learner_group()
+        self._iteration = 0
+
+    def _make_learner_group(self):
+        cfg = self.algo_config
+        if cfg.num_learners > 0:
+            raise NotImplementedError(
+                "multi-agent PPO currently runs a local learner "
+                "(num_learners=0); scale sampling with num_env_runners")
+        learner_cfg = {
+            "lr": cfg.lr, "grad_clip": cfg.grad_clip,
+            "clip_param": cfg.clip_param,
+            "vf_clip_param": cfg.vf_clip_param,
+            "vf_loss_coeff": cfg.vf_loss_coeff,
+            "entropy_coeff": cfg.entropy_coeff,
+        }
+        return LearnerGroup(MultiAgentPPOLearner, self.module_spec,
+                            learner_cfg, num_learners=0, seed=cfg.seed)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        batches = self._sample(cfg.rollout_fragment_length)
+        train_batch = self._postprocess_ma(batches)
+        metrics = self.learner_group.update(
+            train_batch, minibatch_size=cfg.minibatch_size,
+            num_epochs=cfg.num_epochs)
+        self._sync_runner_weights()
+        self._iteration += 1
+        metrics["num_env_steps_sampled"] = int(sum(
+            len(b["obs"]) for b in train_batch.values()))
+        return metrics
+
+    def _postprocess_ma(self, batches: List[Dict[str, Dict[str, np.ndarray]]]
+                        ) -> Dict[str, Dict[str, np.ndarray]]:
+        weights = self.learner_group.get_weights()
+        module = (self.local_runner.module if self.local_runner is not None
+                  else MultiAgentRLModuleSpec(
+                      self.module_spec["module_specs"]).build())
+        out: Dict[str, List[Dict[str, np.ndarray]]] = {}
+        for ma_b in batches:
+            for mid, b in ma_b.items():
+                last_out = module[mid].forward_train(weights[mid],
+                                                     b["next_obs"])
+                last_values = np.asarray(last_out["vf_preds"])
+                adv, ret = compute_gae(
+                    b["rewards"], b["vf_preds"], b["terminateds"],
+                    b["truncateds"], last_values, self.algo_config.gamma,
+                    self.algo_config.lam)
+                t_len, n = b["rewards"].shape
+                flat = {
+                    "obs": b["obs"].reshape(t_len * n, -1),
+                    "actions": b["actions"].reshape(
+                        t_len * n, *b["actions"].shape[2:]),
+                    "action_logp": b["action_logp"].reshape(-1),
+                    "vf_preds": b["vf_preds"].reshape(-1),
+                    "advantages": adv.reshape(-1),
+                    "value_targets": ret.reshape(-1),
+                }
+                out.setdefault(mid, []).append(flat)
+        merged: Dict[str, Dict[str, np.ndarray]] = {}
+        for mid, parts in out.items():
+            m = {k: np.concatenate([p[k] for p in parts]) for k in parts[0]}
+            a = m["advantages"]
+            m["advantages"] = ((a - a.mean()) / max(a.std(), 1e-6)
+                               ).astype(np.float32)
+            merged[mid] = m
+        return merged

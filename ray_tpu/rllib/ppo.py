@@ -49,42 +49,50 @@ def compute_gae(rewards, values, dones, truncateds, last_values,
     return adv, returns
 
 
+def ppo_loss(module, cfg: Dict, params, batch):
+    """The clipped-surrogate PPO loss for ONE module on its flat batch.
+
+    Factored out of the learner so the multi-agent learner can sum it per
+    policy module (reference loss math: ppo_torch_learner
+    ``compute_loss_for_module`` role)."""
+    import jax.numpy as jnp
+
+    clip = cfg.get("clip_param", 0.2)
+    vf_clip = cfg.get("vf_clip_param", 10.0)
+    vf_coeff = cfg.get("vf_loss_coeff", 0.5)
+    ent_coeff = cfg.get("entropy_coeff", 0.0)
+
+    mask = batch.get("loss_mask")
+    out = module.forward_train(params, batch["obs"])
+    logp, entropy = module.logp_entropy(out, batch["actions"])
+    ratio = jnp.exp(logp - batch["action_logp"])
+    adv = batch["advantages"]
+    surr = jnp.minimum(
+        ratio * adv,
+        jnp.clip(ratio, 1 - clip, 1 + clip) * adv)
+    policy_loss = -masked_mean(surr, mask)
+
+    vf = out["vf_preds"]
+    vf_err = jnp.square(vf - batch["value_targets"])
+    vf_clipped = batch["vf_preds"] + jnp.clip(
+        vf - batch["vf_preds"], -vf_clip, vf_clip)
+    vf_err_clipped = jnp.square(vf_clipped - batch["value_targets"])
+    vf_loss = masked_mean(jnp.maximum(vf_err, vf_err_clipped), mask)
+
+    ent = masked_mean(entropy, mask)
+    loss = policy_loss + vf_coeff * vf_loss - ent_coeff * ent
+    kl = masked_mean(batch["action_logp"] - logp, mask)
+    return loss, {
+        "policy_loss": policy_loss,
+        "vf_loss": vf_loss,
+        "entropy": ent,
+        "kl": kl,
+    }
+
+
 class PPOLearner(JaxLearner):
     def compute_loss(self, params, batch):
-        import jax.numpy as jnp
-
-        cfg = self.config
-        clip = cfg.get("clip_param", 0.2)
-        vf_clip = cfg.get("vf_clip_param", 10.0)
-        vf_coeff = cfg.get("vf_loss_coeff", 0.5)
-        ent_coeff = cfg.get("entropy_coeff", 0.0)
-
-        mask = batch.get("loss_mask")
-        out = self.module.forward_train(params, batch["obs"])
-        logp, entropy = self.module.logp_entropy(out, batch["actions"])
-        ratio = jnp.exp(logp - batch["action_logp"])
-        adv = batch["advantages"]
-        surr = jnp.minimum(
-            ratio * adv,
-            jnp.clip(ratio, 1 - clip, 1 + clip) * adv)
-        policy_loss = -masked_mean(surr, mask)
-
-        vf = out["vf_preds"]
-        vf_err = jnp.square(vf - batch["value_targets"])
-        vf_clipped = batch["vf_preds"] + jnp.clip(
-            vf - batch["vf_preds"], -vf_clip, vf_clip)
-        vf_err_clipped = jnp.square(vf_clipped - batch["value_targets"])
-        vf_loss = masked_mean(jnp.maximum(vf_err, vf_err_clipped), mask)
-
-        ent = masked_mean(entropy, mask)
-        loss = policy_loss + vf_coeff * vf_loss - ent_coeff * ent
-        kl = masked_mean(batch["action_logp"] - logp, mask)
-        return loss, {
-            "policy_loss": policy_loss,
-            "vf_loss": vf_loss,
-            "entropy": ent,
-            "kl": kl,
-        }
+        return ppo_loss(self.module, self.config, params, batch)
 
 
 class PPOConfig(AlgorithmConfig):

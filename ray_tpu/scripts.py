@@ -87,6 +87,63 @@ def _cmd_bench(args) -> int:
     return 0
 
 
+def _cmd_serve(args) -> int:
+    """`ray_tpu serve run/deploy/status/shutdown` (reference serve CLI,
+    ``python/ray/serve/scripts.py`` role). `run` hosts in-process; the
+    others talk REST to a running instance's dashboard."""
+    import json as _json
+    import urllib.request
+
+    def rest(method: str, url: str, payload=None):
+        data = _json.dumps(payload).encode() if payload is not None else None
+        req = urllib.request.Request(
+            url + "/api/serve/applications", data=data, method=method,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return _json.loads(resp.read())
+
+    if args.serve_cmd == "run":
+        import ray_tpu
+        from ray_tpu import serve
+        from ray_tpu.dashboard import start_dashboard
+        from ray_tpu.serve.config_api import (deploy_config, import_attr,
+                                              load_config)
+
+        ray_tpu.init(ignore_reinit_error=True)
+        start_dashboard()
+        if args.target.endswith((".yaml", ".yml", ".json")):
+            names = deploy_config(load_config(args.target))
+        else:
+            app = import_attr(args.target)
+            serve.run(app)
+            names = ["default"]
+        proxy = serve.start_http_proxy(port=args.http_port)
+        print(f"serving {names} on http://127.0.0.1:{proxy.port} "
+              f"(Ctrl-C to stop)", flush=True)
+        try:
+            import time as _time
+
+            while True:
+                _time.sleep(1)
+        except KeyboardInterrupt:
+            serve.shutdown()
+            ray_tpu.shutdown()
+        return 0
+    if args.serve_cmd == "deploy":
+        from ray_tpu.serve.config_api import load_config
+
+        print(_json.dumps(rest("PUT", args.dashboard_url,
+                               load_config(args.config)), indent=1))
+        return 0
+    if args.serve_cmd == "status":
+        print(_json.dumps(rest("GET", args.dashboard_url), indent=1))
+        return 0
+    if args.serve_cmd == "shutdown":
+        print(_json.dumps(rest("DELETE", args.dashboard_url), indent=1))
+        return 0
+    return 1
+
+
 def _cmd_stack(args) -> int:
     """Dump python stacks of every live ray_tpu worker (reference
     ``ray stack``, scripts.py:1830 — py-spy there, SIGUSR1+faulthandler
@@ -106,7 +163,11 @@ def _cmd_stack(args) -> int:
                                                                "replace")
         except OSError:
             continue
-        if "ray_tpu.core.worker" in cmdline:
+        # zygote-forked workers inherit the fork-server's cmdline
+        # (ray_tpu.core.zygote); the zygote parent itself ignores SIGUSR1,
+        # so signaling every match is safe and reaches all workers
+        if ("ray_tpu.core.worker" in cmdline
+                or "ray_tpu.core.zygote" in cmdline):
             try:
                 os.kill(pid, signal.SIGUSR1)
                 signaled.append(pid)
@@ -180,6 +241,22 @@ def main(argv=None) -> int:
     down = sub.add_parser("down", help="tear a cluster down")
     down.add_argument("config")
 
+    srv = sub.add_parser("serve", help="serve deploy/run/status/shutdown "
+                                       "(reference `serve` CLI role)")
+    srvsub = srv.add_subparsers(dest="serve_cmd", required=True)
+    sr = srvsub.add_parser("run", help="deploy a config or app and block")
+    sr.add_argument("target", help="config.yaml OR module:app import path")
+    sr.add_argument("--http-port", type=int, default=8000)
+    sd = srvsub.add_parser("deploy",
+                           help="PUT a config to a running instance's "
+                                "dashboard REST endpoint")
+    sd.add_argument("config")
+    sd.add_argument("--dashboard-url", default="http://127.0.0.1:8265")
+    ss = srvsub.add_parser("status")
+    ss.add_argument("--dashboard-url", default="http://127.0.0.1:8265")
+    sx = srvsub.add_parser("shutdown")
+    sx.add_argument("--dashboard-url", default="http://127.0.0.1:8265")
+
     job = sub.add_parser("job", help="job submission")
     jobsub = job.add_subparsers(dest="job_cmd", required=True)
     js = jobsub.add_parser("submit")
@@ -225,6 +302,8 @@ def main(argv=None) -> int:
         n = launcher.down(launcher.load_config(args.config))
         print(f"terminated {n} node(s)/slice(s)")
         return 0
+    if args.cmd == "serve":
+        return _cmd_serve(args)
     if args.cmd == "job":
         if args.job_cmd == "submit":
             return _cmd_job_submit(args)

@@ -15,6 +15,7 @@ from ray_tpu.serve.api import (
     multiplexed,
     run,
     shutdown,
+    start_http_proxy,
     status,
 )
 from ray_tpu.serve.batching import batch
@@ -31,6 +32,7 @@ from ray_tpu.serve.proxy import HTTPProxy
 
 __all__ = [
     "run",
+    "start_http_proxy",
     "shutdown",
     "delete",
     "status",

@@ -58,6 +58,10 @@ def _spec_for(app: Application) -> Dict[str, Any]:
     }
 
 
+# app name -> (route, entry handle): feeds start_http_proxy / the CLI
+_deployed_apps: Dict[str, tuple] = {}
+
+
 def run(app: Application, *, name: str = "default",
         route_prefix: Optional[str] = None) -> DeploymentHandle:
     """Deploy the application; returns a handle to its entry deployment."""
@@ -68,7 +72,22 @@ def run(app: Application, *, name: str = "default",
     ray_tpu.get(controller.deploy_application.remote(specs))
     handle = DeploymentHandle(app.deployment.name, controller)
     handle._refresh(force=True)
+    route = (route_prefix or app.deployment.name).strip("/")
+    _deployed_apps[name] = (route, handle)
     return handle
+
+
+def start_http_proxy(port: int = 8000, host: str = "127.0.0.1"):
+    """Start the HTTP proxy with every deployed application's route
+    registered (reference: per-node ProxyActor wiring routes from the
+    controller's long-poll; here routes come from this process's deploys)."""
+    from ray_tpu.serve.proxy import HTTPProxy
+
+    proxy = HTTPProxy(host=host, port=port)
+    for route, handle in _deployed_apps.values():
+        proxy.register(route, handle)
+    proxy.start()
+    return proxy
 
 
 def get_deployment_handle(deployment_name: str,
@@ -79,6 +98,10 @@ def get_deployment_handle(deployment_name: str,
 def delete(name: str) -> None:
     controller = _get_or_create_controller()
     ray_tpu.get(controller.delete_deployment.remote(name))
+    # prune proxy-route entries whose entry deployment just went away
+    for app, (_route, handle) in list(_deployed_apps.items()):
+        if handle.deployment_name == name:
+            _deployed_apps.pop(app, None)
 
 
 def status() -> Dict[str, Any]:
@@ -87,6 +110,7 @@ def status() -> Dict[str, Any]:
 
 
 def shutdown() -> None:
+    _deployed_apps.clear()  # stale handles must not outlive the controller
     try:
         controller = ray_tpu.get_actor(CONTROLLER_NAME)
     except Exception:

@@ -1,0 +1,100 @@
+"""Declarative serve config: YAML/JSON schema + deploy + REST surface.
+
+Role analog: the reference's serve config pipeline — ``serve build`` /
+``serve deploy`` CLI (``python/ray/serve/scripts.py``), the pydantic
+config schema (``serve/schema.py``), and the dashboard serve REST API
+(``dashboard/modules/serve``). Schema (YAML or JSON)::
+
+    applications:
+      - name: default            # optional (default "default")
+        import_path: mypkg.app:app   # module:attr -> Application/Deployment
+        route_prefix: /app           # optional (default = deployment name)
+        deployments:                 # optional per-deployment overrides
+          - name: Model
+            num_replicas: 2
+            max_ongoing_requests: 8
+
+``deploy_config`` applies it against the in-process serve instance; the
+dashboard exposes GET/PUT ``/api/serve/applications`` so a remote
+``ray_tpu serve deploy/status`` works against a live cluster head.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any, Dict, List, Optional
+
+
+def load_config(path: str) -> Dict[str, Any]:
+    import yaml
+
+    with open(path) as f:
+        cfg = yaml.safe_load(f)
+    if not isinstance(cfg, dict) or "applications" not in cfg:
+        raise ValueError(
+            "serve config must be a mapping with an 'applications' list")
+    return cfg
+
+
+def import_attr(import_path: str):
+    """``module.sub:attr`` -> the attribute (reference import_attr role)."""
+    if ":" not in import_path:
+        raise ValueError(
+            f"import_path {import_path!r} must look like 'module:attr'")
+    mod_name, _, attr = import_path.partition(":")
+    mod = importlib.import_module(mod_name)
+    obj = mod
+    for part in attr.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+def deploy_config(cfg: Dict[str, Any]) -> List[str]:
+    """Deploy every application in ``cfg`` in-process; returns app names."""
+    from ray_tpu import serve
+    from ray_tpu.serve.deployment import Application, Deployment
+
+    deployed = []
+    for app_cfg in cfg.get("applications", []):
+        app = import_attr(app_cfg["import_path"])
+        if isinstance(app, Deployment):
+            app = app.bind()
+        if not isinstance(app, Application):
+            raise TypeError(
+                f"{app_cfg['import_path']} resolved to {type(app).__name__};"
+                " expected an Application or Deployment")
+        overrides = {d["name"]: {k: v for k, v in d.items() if k != "name"}
+                     for d in app_cfg.get("deployments", [])}
+        if overrides:
+            for node in app.flatten().values():
+                dep = node.deployment
+                opts = overrides.get(dep.name)
+                if opts:
+                    node.deployment = dep.options(**opts)
+        name = app_cfg.get("name", "default")
+        serve.run(app, name=name,
+                  route_prefix=app_cfg.get("route_prefix"))
+        deployed.append(name)
+    return deployed
+
+
+def serve_rest_get() -> Dict[str, Any]:
+    """GET /api/serve/applications payload."""
+    from ray_tpu import serve
+
+    try:
+        return {"applications": serve.status()}
+    except Exception as e:
+        return {"applications": {}, "error": str(e)}
+
+
+def serve_rest_put(cfg: Dict[str, Any]) -> Dict[str, Any]:
+    """PUT /api/serve/applications: declarative (re)deploy."""
+    return {"deployed": deploy_config(cfg)}
+
+
+def serve_rest_delete() -> Dict[str, Any]:
+    from ray_tpu import serve
+
+    serve.shutdown()
+    return {"shutdown": True}

@@ -59,6 +59,14 @@ def pipeline_apply(
     mb_shape = microbatches.shape[1:]
     state = jnp.zeros(mb_shape, microbatches.dtype)      # in-flight act
     outputs = jnp.zeros((num_micro,) + mb_shape, microbatches.dtype)
+    # the carry is per-stage data from the first rotation on: mark it
+    # varying over the pipeline axis up front or the scan's VMA check
+    # rejects the unvarying->varying promotion (partial-auto shard_map)
+    try:
+        state = lax.pcast(state, (axis,), to="varying")
+        outputs = lax.pcast(outputs, (axis,), to="varying")
+    except (AttributeError, TypeError):  # older jax: no pcast / check_rep
+        pass
 
     fwd_perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
 
@@ -70,16 +78,17 @@ def pipeline_apply(
         x = jnp.where(stage_id == 0,
                       jnp.where(t < num_micro, ingest, state), state)
         y = stage_compute(x)
-        # last stage emits microbatch (t - (S-1)) when it's valid
+        # last stage emits microbatch (t - (S-1)) when it's valid. A
+        # where-gated unconditional update, not lax.cond: both are
+        # correct, but cond+dynamic_update in a partial-auto shard_map
+        # scan tripped an XLA CPU lowering CHECK ("invalid binary
+        # instruction opcode copy"); the select formulation lowers clean
+        # and costs one masked write per tick.
         emit_idx = t - (num_stages - 1)
         valid = jnp.logical_and(stage_id == num_stages - 1, emit_idx >= 0)
-        outputs = lax.cond(
-            valid,
-            lambda o: lax.dynamic_update_index_in_dim(
-                o, y, jnp.maximum(emit_idx, 0), 0),
-            lambda o: o,
-            outputs,
-        )
+        updated = lax.dynamic_update_index_in_dim(
+            outputs, y, jnp.maximum(emit_idx, 0), 0)
+        outputs = jnp.where(valid, updated, outputs)
         # rotate activations to the next stage
         state = lax.ppermute(y, axis, fwd_perm)
         return (state, outputs), None

@@ -21,3 +21,46 @@ class NodeAffinitySchedulingStrategy:
     def __init__(self, node_id: str, soft: bool = False):
         self.node_id = node_id
         self.soft = soft
+
+
+# Label match operators (reference ``util/scheduling_strategies.py``
+# In/NotIn/Exists/DoesNotExist). Labels are set at node start
+# (``init(labels=...)``, ``--labels`` on the daemon, RTPU_NODE_LABELS env)
+# and are the TPU-targeting story for heterogeneous clusters: e.g.
+# {"tpu-generation": "v5e", "slice-type": "pod"}.
+
+class In:
+    op = "in"
+
+    def __init__(self, *values: str):
+        self.values = tuple(str(v) for v in values)
+
+
+class NotIn:
+    op = "not_in"
+
+    def __init__(self, *values: str):
+        self.values = tuple(str(v) for v in values)
+
+
+class Exists:
+    op = "exists"
+    values = ()
+
+
+class DoesNotExist:
+    op = "does_not_exist"
+    values = ()
+
+
+class NodeLabelSchedulingStrategy:
+    """Schedule onto nodes matching label predicates (reference
+    ``node_label_scheduling_policy.h`` role). ``hard`` predicates are
+    requirements (no matching node -> the task fails with a scheduling
+    error); ``soft`` predicates are preferences (matching nodes win ties,
+    but any hard-matching node may run the task)."""
+
+    def __init__(self, hard: Optional[dict] = None,
+                 soft: Optional[dict] = None):
+        self.hard = dict(hard or {})
+        self.soft = dict(soft or {})

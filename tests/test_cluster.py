@@ -822,3 +822,82 @@ def test_refs_nested_in_results_survive_producer_exit(monkeypatch):
     finally:
         ray_tpu.shutdown()
         c.shutdown()
+
+
+def test_node_label_scheduling(cluster):
+    """NodeLabelSchedulingStrategy routes to label-matching nodes; a task
+    with unsatisfiable hard predicates fails loudly (reference
+    node_label_scheduling_policy.h role)."""
+    from ray_tpu.util.scheduling_strategies import (
+        DoesNotExist, In, NodeLabelSchedulingStrategy)
+
+    cluster.add_node(num_cpus=2, labels={"tpu-generation": "v5e"})
+    cluster.add_node(num_cpus=2, labels={"tpu-generation": "v6e"})
+    _init(cluster)
+    _wait_nodes(2)
+
+    @ray_tpu.remote(num_cpus=1)
+    def whoami():
+        from ray_tpu.core.runtime import _get_runtime
+
+        return dict(_get_runtime().labels)
+
+    v5 = NodeLabelSchedulingStrategy(hard={"tpu-generation": In("v5e")})
+    out = ray_tpu.get([whoami.options(scheduling_strategy=v5).remote()
+                       for _ in range(3)], timeout=90)
+    assert all(o == {"tpu-generation": "v5e"} for o in out), out
+
+    v6 = NodeLabelSchedulingStrategy(hard={"tpu-generation": In("v6e")})
+    assert ray_tpu.get(whoami.options(scheduling_strategy=v6).remote(),
+                       timeout=90) == {"tpu-generation": "v6e"}
+
+    # soft preference: prefer v6e, but any hard-matching node is allowed
+    soft = NodeLabelSchedulingStrategy(
+        hard={"tpu-generation": In("v5e", "v6e")},
+        soft={"tpu-generation": In("v6e")})
+    assert ray_tpu.get(whoami.options(scheduling_strategy=soft).remote(),
+                       timeout=90)["tpu-generation"] == "v6e"
+
+    # unlabeled head only: DoesNotExist matches the head node
+    head_only = NodeLabelSchedulingStrategy(
+        hard={"tpu-generation": DoesNotExist()})
+    assert ray_tpu.get(
+        whoami.options(scheduling_strategy=head_only).remote(),
+        timeout=90) == {}
+
+    # unsatisfiable hard predicate fails fast, not a silent hang
+    never = NodeLabelSchedulingStrategy(hard={"tpu-generation": In("v99")})
+    with pytest.raises(Exception):
+        ray_tpu.get(whoami.options(scheduling_strategy=never).remote(),
+                    timeout=30)
+
+
+def test_broadcast_replicates_via_relay_tree(cluster):
+    """Explicit broadcast pushes the object to every node through the
+    relay tree (reference PushManager role): all daemons end up holding a
+    copy, advertised in the directory."""
+    cluster.add_node(num_cpus=1)
+    cluster.add_node(num_cpus=1)
+    cluster.add_node(num_cpus=1)
+    _init(cluster)
+    _wait_nodes(3)
+
+    import ray_tpu.experimental as rexp
+
+    blob = np.random.default_rng(0).standard_normal(1 << 20)  # 8 MiB
+    ref = ray_tpu.put(blob)
+    n = rexp.broadcast_object(ref)
+    assert n == 3
+
+    from ray_tpu.core.runtime import _get_runtime
+
+    rt = _get_runtime()
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        st = rt.cluster.gcs.call("obj_state", ref.id.binary(), timeout=10)
+        if st and len(st.get("locations") or ()) >= 4:  # head + 3 daemons
+            break
+        time.sleep(0.3)
+    assert st and len(st["locations"]) >= 4, st
+    # broadcast again: everyone already holds it -> no targets
+    assert rexp.broadcast_object(ref) == 0

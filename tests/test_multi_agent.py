@@ -1,0 +1,107 @@
+"""Multi-agent RLlib: MARL module, env runner batching, PPO learning curve.
+
+Reference roles: rllib/core/rl_module/marl_module.py,
+rllib/env/multi_agent_env_runner.py, multi-agent PPO.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def rt_rl():
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_marl_module_per_policy_params():
+    import jax
+
+    from ray_tpu.rllib import MultiAgentRLModuleSpec
+
+    spec = MultiAgentRLModuleSpec({
+        "p0": {"observation_dim": 4, "action_dim": 3, "discrete": True,
+               "hidden": (16,)},
+        "p1": {"observation_dim": 6, "action_dim": 2, "discrete": True,
+               "hidden": (16,)},
+    })
+    mod = spec.build()
+    params = mod.init(jax.random.PRNGKey(0))
+    assert set(params) == {"p0", "p1"}
+    out = mod["p0"].forward_train(params["p0"],
+                                  np.zeros((5, 4), np.float32))
+    assert out["vf_preds"].shape == (5,)
+
+
+def test_multi_agent_env_runner_batches_per_module():
+    from ray_tpu.rllib import DebugCooperativeMatch, MultiAgentEnvRunner
+
+    runner = MultiAgentEnvRunner(DebugCooperativeMatch, seed=0)
+    batches = runner.sample(num_steps=40)
+    # default mapping: one module per agent
+    assert set(batches) == {"agent_0", "agent_1"}
+    b = batches["agent_0"]
+    assert b["obs"].shape == (40, 1, 4)
+    assert b["rewards"].shape == (40, 1)
+    assert b["next_obs"].shape == (1, 4)
+    # shared-policy mapping: both agents ride one module -> [T, 2] arrays
+    shared = MultiAgentEnvRunner(DebugCooperativeMatch,
+                                 agent_to_module=lambda aid: "shared",
+                                 seed=0)
+    sb = shared.sample(num_steps=10)["shared"]
+    assert sb["obs"].shape == (10, 2, 4)
+    m = shared.get_metrics()
+    assert "episode_return_mean" in m
+
+
+def test_multi_agent_ppo_learns_cooperative_match(rt_rl):
+    from ray_tpu.rllib import DebugCooperativeMatch, MultiAgentPPOConfig
+
+    config = (MultiAgentPPOConfig()
+              .environment(DebugCooperativeMatch)
+              .multi_agent(policy_mapping_fn=lambda aid: aid)
+              .env_runners(rollout_fragment_length=256)
+              .training(lr=3e-3, minibatch_size=128, num_epochs=4,
+                        entropy_coeff=0.01, gamma=0.0)
+              .debugging(seed=0))
+    algo = config.build()
+    returns = []
+    for _ in range(12):
+        result = algo.train()
+        returns.append(result.get("episode_return_mean", 0.0))
+    algo.cleanup()
+    # random play: P(hit) = 1/4 per agent -> ep return ~= 16*(0.5+0.125*1)
+    # ~= 10; perfect play = 16*(1+0.5)*2 = 48. Require clear learning.
+    assert max(returns[-4:]) > 24, f"MA-PPO failed to learn: {returns}"
+
+
+def test_multi_agent_ppo_remote_runners_and_checkpoint(rt_rl, tmp_path):
+    from ray_tpu.rllib import DebugCooperativeMatch, MultiAgentPPOConfig
+
+    config = (MultiAgentPPOConfig()
+              .environment(DebugCooperativeMatch)
+              .multi_agent(policy_mapping_fn=lambda aid: "shared")
+              .env_runners(num_env_runners=2, rollout_fragment_length=64)
+              .training(minibatch_size=64, num_epochs=1)
+              .debugging(seed=0))
+    algo = config.build()
+    result = algo.train()
+    assert result["num_env_steps_sampled"] == 64 * 2 * 2  # 2 runners x 2 agents
+    assert "shared/policy_loss" in result
+    state = algo.save_checkpoint(str(tmp_path))
+    algo2 = (MultiAgentPPOConfig()
+             .environment(DebugCooperativeMatch)
+             .multi_agent(policy_mapping_fn=lambda aid: "shared")
+             .training(minibatch_size=64, num_epochs=1)
+             .debugging(seed=0)).build()
+    algo2.load_checkpoint(state, str(tmp_path))
+    w1 = algo.learner_group.get_weights()
+    w2 = algo2.learner_group.get_weights()
+    import jax
+
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(a, b), w1, w2)
+    algo.cleanup()
+    algo2.cleanup()

