@@ -35,6 +35,7 @@ from ray_tpu.core.runtime import (
     cancel,
     get_actor,
     available_resources,
+    object_store_memory,
     cluster_resources,
     nodes,
     method,
@@ -58,6 +59,7 @@ __all__ = [
     "get_actor",
     "get_runtime_context",
     "available_resources",
+    "object_store_memory",
     "cluster_resources",
     "nodes",
     "method",

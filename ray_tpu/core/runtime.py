@@ -2214,6 +2214,16 @@ def get_actor(name: str, namespace: Optional[str] = None):
     return ActorHandle(ActorID(aid))
 
 
+def object_store_memory() -> Dict[str, int]:
+    """Local object-store usage (public API so libraries never reach into
+    store internals): {"used_bytes", "capacity_bytes"}."""
+    from ray_tpu import config
+
+    rt = _get_runtime()
+    return {"used_bytes": int(rt.store.store_bytes()),
+            "capacity_bytes": int(config.get("store_capacity"))}
+
+
 def available_resources() -> Dict[str, float]:
     return _get_runtime().resources("avail")
 

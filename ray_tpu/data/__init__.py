@@ -9,7 +9,14 @@ mesh-sharded ``jax.Array`` batches (the ingest path of JaxTrainer).
 
 from ray_tpu.data.block import Block, BlockMetadata
 from ray_tpu.data.dataset import Dataset
-from ray_tpu.data.execution import ActorPoolStrategy, ExecutionOptions
+from ray_tpu.data.execution import (ActorPoolStrategy,
+                                    BackpressurePolicy,
+                                    ConcurrencyCapBackpressurePolicy,
+                                    ExecutionOptions,
+                                    StoreMemoryBackpressurePolicy)
+from ray_tpu.data.optimizer import (DEFAULT_RULES, EliminateRedundantShuffles,
+                                    FuseLimits, OperatorFusionRule, Optimizer,
+                                    Rule, plan_summary)
 from ray_tpu.data.grouped import GroupedData
 from ray_tpu.data.iterator import DataIterator
 from ray_tpu.data.read_api import (
@@ -34,6 +41,16 @@ __all__ = [
     "DataIterator",
     "ExecutionOptions",
     "ActorPoolStrategy",
+    "BackpressurePolicy",
+    "ConcurrencyCapBackpressurePolicy",
+    "StoreMemoryBackpressurePolicy",
+    "Optimizer",
+    "Rule",
+    "DEFAULT_RULES",
+    "OperatorFusionRule",
+    "EliminateRedundantShuffles",
+    "FuseLimits",
+    "plan_summary",
     "GroupedData",
     "from_arrow",
     "from_items",

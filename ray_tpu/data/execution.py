@@ -115,10 +115,80 @@ def _apply_map(fn_blob_fn, block: Block) -> List[Block]:
     return fn_blob_fn(block)
 
 
+class BackpressurePolicy:
+    """Caps a map stage's concurrency (reference
+    ``_internal/execution/backpressure_policy/`` role). Policies compose:
+    the effective window is the MIN over all policies and the base
+    ``max_in_flight``."""
+
+    def max_in_flight(self, op: "MapOp", base: int) -> int:
+        raise NotImplementedError
+
+
+class ConcurrencyCapBackpressurePolicy(BackpressurePolicy):
+    """Hard cap on concurrent tasks per stage (reference
+    ``concurrency_cap_backpressure_policy.py``)."""
+
+    def __init__(self, cap: int):
+        self.cap = int(cap)
+
+    def max_in_flight(self, op: "MapOp", base: int) -> int:
+        return self.cap
+
+
+class StoreMemoryBackpressurePolicy(BackpressurePolicy):
+    """Shrinks the window while the local object store is above a
+    utilization threshold — in-flight blocks pin store memory, so the
+    stage must not outrun the consumer when the store is tight."""
+
+    def __init__(self, threshold: float = 0.8, ttl_s: float = 0.5):
+        self.threshold = threshold
+        self.ttl_s = ttl_s
+        self._cached = (0.0, 0.0)  # (monotonic ts, utilization)
+
+    def _utilization(self) -> float:
+        # store_bytes() scans /dev/shm — far too heavy for the per-dispatch
+        # window check (object_store.py's own O(1)-per-put rule); sample it
+        # on a short TTL instead
+        import time as _time
+
+        ts, util = self._cached
+        now = _time.monotonic()
+        if now - ts < self.ttl_s:
+            return util
+        util = 0.0
+        try:
+            # public API only (CLAUDE.md seam: ML libraries never touch
+            # runtime/store internals)
+            import ray_tpu
+
+            mem = ray_tpu.object_store_memory()
+            if mem["capacity_bytes"]:
+                util = mem["used_bytes"] / mem["capacity_bytes"]
+        except Exception:
+            pass
+        self._cached = (now, util)
+        return util
+
+    def max_in_flight(self, op: "MapOp", base: int) -> int:
+        if self._utilization() > self.threshold:
+            return max(1, base // 4)
+        return base
+
+
 @dataclass
 class ExecutionOptions:
     max_in_flight: int = 8       # per map stage (backpressure window)
     preserve_order: bool = True
+    # None -> the default rule-based optimizer (data/optimizer.py)
+    optimizer: Optional[Any] = None
+    backpressure_policies: Tuple[BackpressurePolicy, ...] = ()
+
+    def effective_in_flight(self, op: "MapOp") -> int:
+        out = self.max_in_flight
+        for p in self.backpressure_policies:
+            out = min(out, p.max_in_flight(op, self.max_in_flight))
+        return max(1, out)
 
 
 def execute_streaming(
@@ -128,7 +198,12 @@ def execute_streaming(
 ) -> Iterator[Any]:
     """Run the plan, yielding ObjectRefs of output blocks as they're ready."""
     options = options or ExecutionOptions()
-    ops = fuse_ops(ops)
+    if options.optimizer is None:
+        from ray_tpu.data.optimizer import Optimizer
+
+        ops = Optimizer().optimize(ops)
+    else:
+        ops = options.optimizer.optimize(ops)
     stream: Iterator[Any] = (_ensure_ref(x) for x in source)
     for op in ops:
         if isinstance(op, MapOp):
@@ -171,7 +246,9 @@ def _run_map_stage(stream: Iterator[Any], op: MapOp,
 
     for ref in stream:
         in_flight.append(remote_fn.remote(ref))
-        while len(in_flight) >= options.max_in_flight:
+        # the window is re-evaluated per dispatch: memory-aware policies
+        # tighten it dynamically (reference backpressure_policy loop)
+        while len(in_flight) >= options.effective_in_flight(op):
             yield from in_flight.pop(0)
     for gen in in_flight:
         yield from gen
@@ -353,10 +430,13 @@ def _run_actor_map_stage(stream: Iterator[Any], op: MapOp,
             num_returns="streaming").remote(ref)
         in_flight.append((idx, gen))
 
-    cap = max(1, strat.max_size * strat.max_tasks_in_flight_per_actor)
+    pool_cap = max(1, strat.max_size * strat.max_tasks_in_flight_per_actor)
     try:
         for ref in stream:
             dispatch(ref)
+            # backpressure policies bound actor stages too (same MIN
+            # contract as task stages); re-evaluated per dispatch
+            cap = min(pool_cap, options.effective_in_flight(op))
             while len(in_flight) >= cap:
                 idx, gen = in_flight.pop(0)
                 yield from gen

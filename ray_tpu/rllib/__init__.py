@@ -9,6 +9,7 @@ V-trace) ship first; replay buffers cover the off-policy family.
 
 from ray_tpu.rllib.actor_manager import FaultTolerantActorManager
 from ray_tpu.rllib.anakin import AnakinPPO
+from ray_tpu.rllib.appo import APPO, APPOConfig, APPOLearner
 from ray_tpu.rllib.dqn import DQN, DQNConfig, DQNLearner
 from ray_tpu.rllib.jax_env import CartPoleJax, make_jax_env
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
@@ -49,6 +50,9 @@ from ray_tpu.rllib.rl_module import JaxRLModule, RLModuleSpec
 __all__ = [
     "Algorithm",
     "AnakinPPO",
+    "APPO",
+    "APPOConfig",
+    "APPOLearner",
     "DQN",
     "DQNConfig",
     "DQNLearner",

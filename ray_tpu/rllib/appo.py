@@ -1,0 +1,111 @@
+"""APPO: asynchronous PPO — IMPALA's pipeline with PPO's clipped loss.
+
+Role analog: ``rllib/algorithms/appo/appo.py`` — the throughput RL family:
+async sampling + v-trace off-policy correction (inherited wholesale from
+the IMPALA machinery here), but the policy gradient is PPO's clipped
+surrogate against the BEHAVIOR policy, optionally with an adaptive KL
+penalty (reference ``use_kl_loss`` / ``kl_coeff`` / ``kl_target``).
+
+TPU-native stance: identical to IMPALA's — CPU env-runner actors sample
+asynchronously; ONE jitted learner update on the device mesh; v-trace on
+the host/aggregators. The adaptive KL coefficient updates on the driver
+between steps (a scalar; no recompile — it rides the batch).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, ImpalaLearner
+from ray_tpu.rllib.learner import LearnerGroup, masked_mean
+
+
+class APPOLearner(ImpalaLearner):
+    def compute_loss(self, params, batch):
+        import jax.numpy as jnp
+
+        cfg = self.config
+        clip = cfg.get("clip_param", 0.2)
+        vf_coeff = cfg.get("vf_loss_coeff", 0.5)
+        ent_coeff = cfg.get("entropy_coeff", 0.01)
+        use_kl = cfg.get("use_kl_loss", False)
+
+        mask = batch.get("loss_mask")
+        out = self.module.forward_train(params, batch["obs"])
+        logp, entropy = self.module.logp_entropy(out, batch["actions"])
+        # clipped surrogate vs the BEHAVIOR policy, advantages already
+        # v-trace-corrected (reference appo loss shape)
+        ratio = jnp.exp(logp - batch["action_logp"])
+        adv = batch["pg_advantages"]
+        surr = jnp.minimum(ratio * adv,
+                           jnp.clip(ratio, 1 - clip, 1 + clip) * adv)
+        pg_loss = -masked_mean(surr, mask)
+        vf_loss = masked_mean(jnp.square(out["vf_preds"] - batch["vs"]),
+                              mask)
+        ent = masked_mean(entropy, mask)
+        kl = masked_mean(batch["action_logp"] - logp, mask)
+        loss = pg_loss + vf_coeff * vf_loss - ent_coeff * ent
+        if use_kl:
+            # kl_coeff rides the BATCH, not the jitted constants: the
+            # driver's adaptive update must not trigger a recompile
+            loss = loss + batch["kl_coeff"][0] * kl
+        return loss, {"policy_loss": pg_loss, "vf_loss": vf_loss,
+                      "entropy": ent, "kl": kl}
+
+
+class APPOConfig(IMPALAConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or APPO)
+        self.clip_param = 0.2
+        self.use_kl_loss = False
+        self.kl_coeff = 0.2
+        self.kl_target = 0.01
+        self.lr = 5e-4
+        # unlike IMPALA's single pass, the clipped surrogate tolerates
+        # minibatch re-use (reference APPO num_sgd_iter role)
+        self.num_epochs = 2
+        self.minibatch_size = 128
+
+
+class APPO(IMPALA):
+    config_cls = APPOConfig
+
+    def _setup_algo(self):
+        super()._setup_algo()
+        self._kl_coeff = float(getattr(self.algo_config, "kl_coeff", 0.2))
+
+    def _make_learner_group(self):
+        cfg = self.algo_config
+        learner_cfg = {
+            "lr": cfg.lr, "grad_clip": cfg.grad_clip,
+            "clip_param": cfg.clip_param,
+            "vf_loss_coeff": cfg.vf_loss_coeff,
+            "entropy_coeff": cfg.entropy_coeff,
+            "use_kl_loss": cfg.use_kl_loss,
+        }
+        return LearnerGroup(APPOLearner, self.module_spec, learner_cfg,
+                            num_learners=cfg.num_learners, seed=cfg.seed)
+
+    def _postprocess(self, batches) -> Dict[str, np.ndarray]:
+        out = super()._postprocess(batches)
+        if getattr(self.algo_config, "use_kl_loss", False):
+            n = len(out["obs"])
+            out["kl_coeff"] = np.full(n, self._kl_coeff, np.float32)
+        return out
+
+    def training_step(self) -> Dict[str, Any]:
+        metrics = super().training_step()
+        # adaptive KL (reference appo update_kl): double/halve toward the
+        # target measured on this step's update
+        if getattr(self.algo_config, "use_kl_loss", False) \
+                and "kl" in metrics:
+            target = float(getattr(self.algo_config, "kl_target", 0.01))
+            kl = abs(float(metrics["kl"]))
+            if kl > 2.0 * target:
+                self._kl_coeff *= 1.5
+            elif kl < 0.5 * target:
+                self._kl_coeff *= 0.5
+            metrics["kl_coeff"] = self._kl_coeff
+        return metrics

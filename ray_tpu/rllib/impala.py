@@ -106,6 +106,10 @@ def _vtrace_postprocess(module, weights, b, cfg: Dict[str, Any]):
             t_len * n, *b["actions"].shape[2:])[mask],
         "pg_advantages": pg_adv.reshape(-1).astype(np.float32)[mask],
         "vs": vs.reshape(-1).astype(np.float32)[mask],
+        # behavior-policy logp rides along for APPO's clipped surrogate
+        # (IMPALA's plain pg loss ignores it)
+        "action_logp": b["action_logp"].reshape(-1).astype(
+            np.float32)[mask],
     }
 
 
@@ -118,6 +122,10 @@ class IMPALAConfig(AlgorithmConfig):
         self.clip_c = 1.0
         self.lr = 5e-4
         self.num_epochs = 1          # off-policy: single pass
+        # whole-batch update (one optimizer step per training_step): the
+        # unclipped pg loss is not safe to re-step on stale data; APPO
+        # overrides with real minibatching
+        self.minibatch_size = None
         self.num_aggregation_workers = 0  # reference impala.py:676-696
 
     def copy(self):
@@ -197,7 +205,12 @@ class IMPALA(Algorithm):
                 return {"num_env_steps_sampled": 0}
 
         train_batch = self._postprocess(batches)
-        metrics = self.learner_group.update(train_batch, num_epochs=1)
+        # IMPALA defaults to a single pass; APPO's clipped surrogate makes
+        # multi-epoch minibatch reuse safe (its config raises num_epochs)
+        metrics = self.learner_group.update(
+            train_batch,
+            minibatch_size=getattr(cfg, "minibatch_size", None),
+            num_epochs=getattr(cfg, "num_epochs", 1))
         self._sync_runner_weights()
         self._iteration += 1
         metrics["num_env_steps_sampled"] = len(train_batch["obs"])

@@ -10,6 +10,7 @@ reference shares it too — function trainables run in a ``_TrainSession``).
 from ray_tpu.train.session import get_checkpoint, get_context, report
 from ray_tpu.tune.schedulers import (
     ASHAScheduler,
+    HyperBandScheduler,
     FIFOScheduler,
     MedianStoppingRule,
     PopulationBasedTraining,
@@ -19,6 +20,8 @@ from ray_tpu.tune.search import (
     BasicVariantGenerator,
     Searcher,
     SimpleBayesSearch,
+    TPESearch,
+    BOHBSearch,
     choice,
     grid_search,
     loguniform,
@@ -51,6 +54,7 @@ __all__ = [
     "get_checkpoint",
     "get_context",
     "ASHAScheduler",
+    "HyperBandScheduler",
     "FIFOScheduler",
     "MedianStoppingRule",
     "PopulationBasedTraining",
@@ -58,6 +62,8 @@ __all__ = [
     "BasicVariantGenerator",
     "Searcher",
     "SimpleBayesSearch",
+    "TPESearch",
+    "BOHBSearch",
     "choice",
     "grid_search",
     "loguniform",

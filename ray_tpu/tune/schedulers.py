@@ -201,3 +201,73 @@ class PopulationBasedTraining(TrialScheduler):
                 elif isinstance(spec, list):
                     new[key] = self.rng.choice(spec)
         return new
+
+
+class HyperBandScheduler(TrialScheduler):
+    """HyperBand with BRACKET diversity (reference
+    ``tune/schedulers/hyperband.py:42``): incoming trials round-robin over
+    s_max+1 brackets; bracket s starts trials at grace
+    ``max_t * eta**-s`` and successively halves at rungs
+    ``r0 * eta**k``, so aggressive brackets kill early on little evidence
+    while conservative ones let slow starters mature — the hedge that
+    distinguishes HyperBand from plain successive halving. Decisions are
+    asynchronous (stop-on-milestone-crossing, like ASHA) because the
+    controller has no pause/resume; the bracket structure is what adds
+    value over ASHAScheduler above.
+    """
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 max_t: int = 81, reduction_factor: float = 3,
+                 time_attr: str = "training_iteration"):
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.eta = reduction_factor
+        self.time_attr = time_attr
+        self.s_max = max(1, int(math.log(max_t) / math.log(reduction_factor)))
+        self._next_bracket = 0
+        self._bracket_of: Dict[str, int] = {}
+        # (bracket, rung level) -> recorded metric values
+        self.rungs: Dict[Any, List[float]] = {}
+        self._credited: Dict[str, int] = {}
+
+    def _levels(self, s: int) -> List[int]:
+        r0 = max(1, int(round(self.max_t * self.eta ** -s)))
+        out = []
+        t = r0
+        while t < self.max_t:
+            out.append(int(t))
+            t *= self.eta
+        return out
+
+    def on_trial_add(self, trial) -> None:
+        tid = getattr(trial, "trial_id", str(id(trial)))
+        self._bracket_of[tid] = self._next_bracket % (self.s_max + 1)
+        self._next_bracket += 1
+
+    def _better(self, a: float, b: float) -> bool:
+        return a < b if self.mode == "min" else a > b
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> str:
+        t = result.get(self.time_attr, 0)
+        val = result.get(self.metric)
+        if val is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP
+        tid = getattr(trial, "trial_id", str(id(trial)))
+        s = self._bracket_of.setdefault(tid, 0)
+        last = self._credited.get(tid, 0)
+        for level in reversed(self._levels(s)):
+            if t >= level and level > last:
+                self._credited[tid] = level
+                recorded = self.rungs.setdefault((s, level), [])
+                recorded.append(float(val))
+                k = max(1, int(len(recorded) / self.eta))
+                top = sorted(recorded, reverse=(self.mode == "max"))[:k]
+                worst_top = top[-1]
+                if not self._better(float(val), worst_top) and \
+                        float(val) != worst_top:
+                    return STOP
+                break
+        return CONTINUE

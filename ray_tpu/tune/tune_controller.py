@@ -55,6 +55,8 @@ class TuneController:
         resources_per_trial: Optional[Dict[str, float]] = None,
         max_failures_per_trial: int = 0,
         checkpoint_at_end: bool = False,
+        config_source: Optional[Callable[[str], Optional[Dict[str, Any]]]] = None,
+        total_trials: Optional[int] = None,
     ):
         self.trainable_cls = trainable_cls
         self.run_config = run_config or RunConfig()
@@ -71,14 +73,40 @@ class TuneController:
             self.run_config.resolved_storage_path(), name)
         os.makedirs(self.exp_dir, exist_ok=True)
 
+        # lazy suggestion mode (model-based searchers like BOHB): trials
+        # are created one at a time as slots free, so each suggest() sees
+        # every result reported so far — upfront generation would make the
+        # model inert within a run
+        self._config_source = config_source
+        self._total_trials = (total_trials if total_trials is not None
+                              else len(param_configs))
+
         self.trials: List[Trial] = []
-        for i, cfg in enumerate(param_configs):
-            tid = f"{i:05d}"
-            tdir = os.path.join(self.exp_dir, f"trial_{tid}")
-            os.makedirs(tdir, exist_ok=True)
-            t = Trial(tid, cfg, tdir)
-            self.trials.append(t)
-            self.scheduler.on_trial_add(t)
+        for cfg in param_configs:
+            self._add_trial(cfg)
+
+    def _add_trial(self, cfg: Dict[str, Any]) -> "Trial":
+        tid = f"{len(self.trials):05d}"
+        tdir = os.path.join(self.exp_dir, f"trial_{tid}")
+        os.makedirs(tdir, exist_ok=True)
+        t = Trial(tid, cfg, tdir)
+        self.trials.append(t)
+        self.scheduler.on_trial_add(t)
+        return t
+
+    def _maybe_suggest_trial(self) -> Optional["Trial"]:
+        if (self._config_source is None
+                or len(self.trials) >= self._total_trials):
+            return None
+        cfg = self._config_source(f"{len(self.trials):05d}")
+        if cfg is None:
+            self._total_trials = len(self.trials)  # searcher exhausted
+            if not self.trials:
+                # never return an empty experiment: one default trial
+                # (matches the eager path's `configs = [{}]` fallback)
+                return self._add_trial({})
+            return None
+        return self._add_trial(cfg)
 
     # -- actor management -------------------------------------------------
 
@@ -145,17 +173,21 @@ class TuneController:
 
     def _launch_pending(self):
         running = sum(1 for t in self.trials if t.status == "RUNNING")
-        limit = self.max_concurrent or len(self.trials)
-        for t in self.trials:
-            if running >= limit:
-                break
-            if t.status == "PENDING":
-                try:
-                    self._start_trial(t, restore_from=t.checkpoint_dir)
-                    running += 1
-                except Exception as e:  # resource exhaustion etc.
-                    t.error = e
-                    t.status = "ERROR"
+        limit = self.max_concurrent or max(len(self.trials),
+                                           self._total_trials)
+        while running < limit:
+            t = next((t for t in self.trials if t.status == "PENDING"),
+                     None)
+            if t is None:
+                t = self._maybe_suggest_trial()
+                if t is None:
+                    break
+            try:
+                self._start_trial(t, restore_from=t.checkpoint_dir)
+                running += 1
+            except Exception as e:  # resource exhaustion etc.
+                t.error = e
+                t.status = "ERROR"
 
     def _process_result(self, trial: Trial, ref):
         try:
@@ -198,6 +230,16 @@ class TuneController:
         if self.stopper and self.stopper(trial.trial_id, result):
             self._finalize_and_stop(trial)
             return
+
+        # model-based searchers (BOHB) also learn from PARTIAL results;
+        # Tuner.fit attaches the search_alg here when one is configured
+        hook = getattr(getattr(self, "searcher", None),
+                       "on_trial_result", None)
+        if hook is not None:
+            try:
+                hook(trial.trial_id, {**result, "config": trial.config})
+            except Exception:
+                pass
 
         decision = self.scheduler.on_trial_result(trial, result)
         if decision == STOP:

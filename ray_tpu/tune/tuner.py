@@ -59,18 +59,21 @@ class Tuner:
 
     def fit(self) -> ResultGrid:
         tc = self.tune_config
+        lazy_source = None
+        total = None
         if tc.search_alg is not None:
+            # LAZY suggestion: the controller asks for each config when a
+            # slot frees, so a model-based searcher (TPE/BOHB) conditions
+            # every suggestion on all results reported so far — drawing
+            # them upfront would leave the model permanently empty
             configs = []
-            for i in range(tc.num_samples):
-                cfg = tc.search_alg.suggest(f"{i:05d}")
-                if cfg is None:
-                    break
-                configs.append(cfg)
+            lazy_source = tc.search_alg.suggest
+            total = tc.num_samples
         else:
             configs = list(generate_variants(
                 self.param_space, tc.num_samples, tc.seed))
-        if not configs:
-            configs = [{}]
+            if not configs:
+                configs = [{}]
 
         controller = TuneController(
             self.trainable_cls,
@@ -82,9 +85,13 @@ class Tuner:
             resources_per_trial=tc.resources_per_trial,
             max_failures_per_trial=self.run_config.failure_config.max_failures,
             checkpoint_at_end=tc.checkpoint_at_end,
+            config_source=lazy_source,
+            total_trials=total,
         )
-        # let model-based searchers observe completions
+        # let model-based searchers observe completions (and partial
+        # results — BOHB's estimator uses rung evaluations too)
         if tc.search_alg is not None:
+            controller.searcher = tc.search_alg
             orig = controller.scheduler.on_trial_complete
 
             def observe(trial, result, _orig=orig):

@@ -37,7 +37,40 @@ class PlacementGroup:
         return _get_runtime().put(True)
 
     def wait(self, timeout_seconds: Optional[float] = None) -> bool:
-        return True
+        """True once every bundle holds a reservation.
+
+        Local mode: creation was a synchronous reserve, so the group is
+        ready by construction. Cluster mode: bundles can be PENDING
+        re-placement after a node death (the creator adapter reschedules
+        them) — poll the directory until every bundle has an assigned
+        node (VERDICT r3: an unconditional True would silently lie the
+        moment reservation became async)."""
+        import time
+
+        from ray_tpu.core.runtime import _get_runtime
+
+        rt = _get_runtime()
+        if rt.cluster is None:
+            return self.id.binary() in rt.pgs
+        deadline = (None if timeout_seconds is None
+                    else time.monotonic() + timeout_seconds)
+        while True:
+            rpc_timeout = 10.0
+            if deadline is not None:
+                rpc_timeout = max(0.1, min(10.0,
+                                           deadline - time.monotonic()))
+            try:
+                rec = rt.cluster.gcs.call("pg_get", self.id.binary(),
+                                          timeout=rpc_timeout)
+            except Exception:
+                rec = None
+            if rec is not None:
+                assignments = rec.get("assignments") or []
+                if assignments and all(a is not None for a in assignments):
+                    return True
+            if deadline is not None and time.monotonic() >= deadline:
+                return False
+            time.sleep(0.1)
 
     def __reduce__(self):
         return (PlacementGroup, (self.id, self.bundles, self.strategy))

@@ -330,6 +330,14 @@ def test_pg_strict_spread_across_nodes(cluster):
 
     pg = placement_group([{"CPU": 1, "slot": 1}] * 3,
                          strategy="STRICT_SPREAD")
+    # wait() verifies every bundle holds an assignment (not a stub True)
+    assert pg.wait(timeout_seconds=30)
+    from ray_tpu.core.ids import PlacementGroupID
+    from ray_tpu.util.placement_group import PlacementGroup
+
+    ghost = PlacementGroup(PlacementGroupID.from_random(),
+                           [{"CPU": 1}], "PACK")
+    assert not ghost.wait(timeout_seconds=0.5)  # unknown group: False
 
     @ray_tpu.remote
     def where():

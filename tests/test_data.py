@@ -281,3 +281,82 @@ def test_groupby_string_keys_stable_across_workers(rt_data):
     out = rd.from_items(rows).groupby("name").count().take_all()
     assert sorted(r["name"] for r in out) == sorted(names)
     assert all(r["count()"] == 100 for r in out)
+
+
+def test_optimizer_golden_plans():
+    """Rule-based plan rewrites (reference golden-plan optimizer tests):
+    redundant shuffles drop, limits fuse, map chains fuse — and the rules
+    compose across passes."""
+    from ray_tpu.data import (EliminateRedundantShuffles, FuseLimits,
+                              Optimizer, plan_summary)
+    from ray_tpu.data.execution import LimitOp, MapOp, ShuffleOp
+
+    def m(name):
+        return MapOp(name=name, fn=lambda b: [b])
+
+    plan = [
+        m("a"),
+        ShuffleOp(name="s1", kind="random_shuffle"),
+        ShuffleOp(name="s2", kind="repartition", args={"n": 4}),
+        m("b"),
+        m("c"),
+        LimitOp(name="l1", limit=100),
+        LimitOp(name="l2", limit=10),
+    ]
+    out = Optimizer().optimize(plan)
+    # rs->repartition must NOT collapse (a repartition is order-preserving
+    # and cannot stand in for a shuffle)
+    assert plan_summary(out) == [
+        "map:a", "shuffle:random_shuffle", "shuffle:repartition",
+        "map:b->c", "limit:10"], plan_summary(out)
+
+    # same-kind exchanges DO collapse: rep->rep keeps the last
+    rep2 = [ShuffleOp(name="r1", kind="repartition", args={"n": 8}),
+            ShuffleOp(name="r2", kind="repartition", args={"n": 2})]
+    assert plan_summary(Optimizer().optimize(rep2)) == [
+        "shuffle:repartition"]
+    # a SEEDED trailing shuffle keeps its predecessor (deterministic
+    # output depends on the full chain)
+    seeded = [ShuffleOp(name="s1", kind="random_shuffle"),
+              ShuffleOp(name="s2", kind="random_shuffle",
+                        args={"seed": 7})]
+    assert len(Optimizer().optimize(seeded)) == 2
+
+    # composition: dropping the middle shuffle exposes maps to fusion
+    plan2 = [m("x"), ShuffleOp(name="s", kind="random_shuffle"),
+             ShuffleOp(name="s2", kind="random_shuffle")]
+    out2 = Optimizer().optimize(plan2)
+    assert plan_summary(out2) == ["map:x", "shuffle:random_shuffle"]
+
+    # custom rule list is honored (no fusion)
+    out3 = Optimizer(rules=[FuseLimits()]).optimize(plan)
+    assert plan_summary(out3)[-1] == "limit:10"
+    assert "map:b" in plan_summary(out3)  # maps NOT fused
+
+    # an empty rule set is the identity
+    assert plan_summary(Optimizer(rules=[]).optimize(plan)) == \
+        plan_summary(plan)
+    assert EliminateRedundantShuffles().name == "EliminateRedundantShuffles"
+
+
+def test_backpressure_policies_bound_concurrency(rt_data):
+    """A ConcurrencyCap policy bounds a map stage's in-flight tasks; the
+    pipeline still completes correctly (reference backpressure_policy)."""
+    import ray_tpu.data as rd
+    from ray_tpu.data import ConcurrencyCapBackpressurePolicy, ExecutionOptions
+
+    ds = rd.range(40, parallelism=8).map(lambda r: {"v": r["id"] * 2})
+    ds._options = ExecutionOptions(
+        max_in_flight=8,
+        backpressure_policies=(ConcurrencyCapBackpressurePolicy(2),))
+    vals = sorted(r["v"] for r in ds.iter_rows())
+    assert vals == [i * 2 for i in range(40)]
+
+
+def test_redundant_shuffle_dropped_end_to_end(rt_data):
+    """The optimizer rewrite holds under real execution: double shuffle
+    produces the same multiset of rows as one."""
+    import ray_tpu.data as rd
+
+    ds = rd.range(30, parallelism=4).random_shuffle().random_shuffle()
+    assert sorted(r["id"] for r in ds.iter_rows()) == list(range(30))

@@ -274,3 +274,86 @@ def test_asha_credits_rungs_on_crossing():
     assert sched.on_trial_result(
         good, {"loss": 0.4, "training_iteration": 45}) == CONTINUE
     assert sched.rungs[40] == [0.4] and 20 not in sched.rungs
+
+
+def test_hyperband_brackets_and_stops():
+    """HyperBand assigns trials to brackets with different grace periods
+    and stops bottom performers at rung milestones."""
+    from ray_tpu.tune import HyperBandScheduler
+
+    class T:
+        def __init__(self, tid):
+            self.trial_id = tid
+
+    sched = HyperBandScheduler(metric="score", mode="max", max_t=27,
+                               reduction_factor=3)
+    trials = [T(f"t{i}") for i in range(8)]
+    for t in trials:
+        sched.on_trial_add(t)
+    brackets = {sched._bracket_of[t.trial_id] for t in trials}
+    assert len(brackets) >= 2  # bracket diversity is the point
+    # bracket 0 is the conservative run-to-completion bracket: no rungs
+    assert sched._levels(0) == []
+    # an aggressive bracket halves early
+    assert sched._levels(3) == [1, 3, 9]
+    # same bracket with rungs, different scores: the worse one stops
+    s2 = [t for t in trials if sched._bracket_of[t.trial_id] == 2]
+    assert len(s2) >= 2
+    a, b = s2[0], s2[1]
+    level = sched._levels(2)[0]
+    assert sched.on_trial_result(a, {"training_iteration": level,
+                                     "score": 10.0}) == "CONTINUE"
+    assert sched.on_trial_result(b, {"training_iteration": level,
+                                     "score": 1.0}) == "STOP"
+    # past max_t everything stops
+    assert sched.on_trial_result(a, {"training_iteration": 27,
+                                     "score": 99.0}) == "STOP"
+
+
+def test_tpe_search_concentrates_on_optimum():
+    """TPE proposals after warmup concentrate near the best region of a
+    quadratic objective (vs the uniform prior)."""
+    import random as _random
+
+    from ray_tpu.tune.search import TPESearch, Uniform
+
+    space = {"x": Uniform(0.0, 10.0)}
+    tpe = TPESearch(space, metric="loss", mode="min", n_initial=10,
+                    n_candidates=16, seed=0)
+    rng = _random.Random(0)
+    # seed observations: loss = (x-2)^2
+    for i in range(30):
+        cfg = tpe.suggest(f"w{i}")
+        loss = (cfg["x"] - 2.0) ** 2
+        tpe.on_trial_complete(f"w{i}", {"loss": loss, "config": cfg})
+    proposals = [tpe.suggest(f"p{i}")["x"] for i in range(20)]
+    near = sum(1 for x in proposals if abs(x - 2.0) < 2.5)
+    assert near >= 14, proposals  # uniform would give ~10
+
+
+def test_bohb_search_with_hyperband_e2e(rt_tune):
+    """BOHB = TPESearch feeding on partial results + HyperBandScheduler,
+    end to end through the Tuner."""
+    from ray_tpu import tune
+    from ray_tpu.tune import BOHBSearch, HyperBandScheduler
+    from ray_tpu.tune.search import Uniform
+
+    def objective(config):
+        for i in range(9):
+            tune.report({"loss": (config["x"] - 3.0) ** 2 + 1.0 / (i + 1)})
+
+    search = BOHBSearch({"x": Uniform(0.0, 10.0)}, metric="loss",
+                        mode="min", n_initial=4, seed=0)
+    tuner = tune.Tuner(
+        objective,
+        tune_config=tune.TuneConfig(
+            num_samples=8, search_alg=search,
+            scheduler=HyperBandScheduler(metric="loss", mode="min",
+                                         max_t=9, reduction_factor=3),
+            max_concurrent_trials=4),
+    )
+    grid = tuner.fit()
+    best = grid.get_best_result(metric="loss", mode="min")
+    assert best.metrics["loss"] < 30.0
+    # partial results reached the model (rung evaluations feed BOHB)
+    assert len(search.observations) > 8
