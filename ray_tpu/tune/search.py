@@ -370,16 +370,27 @@ class BOHBSearch(TPESearch):
     """BOHB's searcher half (reference ``bohb_search.py:49``): TPE
     suggestions, designed to pair with :class:`HyperBandScheduler` — the
     scheduler allocates budgets in brackets, this model proposes configs.
-    Intermediate results at rung budgets also feed the model
-    (``on_trial_result``), matching BOHB's use of partial evaluations."""
+    Partial results feed the model (``on_trial_result``), ONE observation
+    per trial (its LATEST metric, i.e. the highest budget it reached) so
+    a long-lived trial cannot dominate the good/bad split with hundreds
+    of duplicate entries."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._obs_index: Dict[str, int] = {}  # trial_id -> observations idx
 
     def on_trial_result(self, trial_id: str, result: Dict[str, Any]):
-        if result and self.metric in result and "config" in result:
-            self.observations.append(
-                (result["config"], float(result[self.metric])))
+        if not (result and self.metric in result and "config" in result):
+            return
+        entry = (result["config"], float(result[self.metric]))
+        idx = self._obs_index.get(trial_id)
+        if idx is None:
+            self._obs_index[trial_id] = len(self.observations)
+            self.observations.append(entry)
+        else:
+            self.observations[idx] = entry  # latest budget wins
 
     def on_trial_complete(self, trial_id, result=None):
-        # no-op: every rung evaluation (including the final one) already
-        # arrived via on_trial_result — recording the completion too would
-        # double-weight trial endpoints in the good/bad split
+        # no-op: the trial's final evaluation already arrived (and
+        # replaced its slot) via on_trial_result
         pass

@@ -287,3 +287,50 @@ def test_launcher_yaml_validation(tmp_path):
     p.write_text("cluster_name: x\nprovider: {type: fake}\n")
     with pytest.raises(ValueError):
         launcher.load_config(str(p))
+
+
+def test_v2_instance_manager_lifecycle():
+    """Autoscaler v2 (reference autoscaler/v2/instance_manager role):
+    explicit state machine, idempotent reconcile, cloud-death adoption."""
+    from ray_tpu.autoscaler.fake_provider import FakeTpuNodeProvider
+    from ray_tpu.autoscaler.v2 import (ALLOCATED, InstanceManager, QUEUED,
+                                       RAY_RUNNING, TERMINATED)
+
+    provider = FakeTpuNodeProvider({"v5e-8": {"CPU": 8, "TPU": 8}})
+    im = InstanceManager(provider)
+    ids = im.launch("v5e-8", count=2)
+    assert [im.instances[i].status for i in ids] == [QUEUED, QUEUED]
+
+    im.reconcile()
+    assert all(im.instances[i].status == ALLOCATED for i in ids)
+    cloud_ids = [im.instances[i].cloud_id for i in ids]
+    assert all(cloud_ids)
+    # reconcile is idempotent: no duplicate launches
+    im.reconcile()
+    assert len(provider.non_terminated_nodes()) == 2
+
+    # GCS observes one node alive -> RAY_RUNNING binding
+    im.reconcile(alive_node_ids={cloud_ids[0]})
+    assert im.instances[ids[0]].status == RAY_RUNNING
+    assert im.instances[ids[1]].status == ALLOCATED
+
+    # cloud kills the other VM behind our back -> TERMINATING -> TERMINATED
+    provider.terminate_node(cloud_ids[1])
+    im.reconcile(alive_node_ids={cloud_ids[0]})
+    assert im.instances[ids[1]].status == TERMINATED
+
+    # explicit terminate of the running one
+    im.terminate(ids[0])
+    im.reconcile()
+    assert im.instances[ids[0]].status == TERMINATED
+    assert len(provider.non_terminated_nodes()) == 0
+    assert im.summary()[TERMINATED] == 2
+
+    # invalid transitions raise loudly
+    import pytest as _pytest
+
+    from ray_tpu.autoscaler.v2 import Instance
+
+    inst = Instance("x", "t")
+    with _pytest.raises(ValueError):
+        inst.transition(RAY_RUNNING)

@@ -92,6 +92,14 @@ def test_dashboard_endpoints(rt_plat):
         conn.request("GET", "/metrics")
         resp = conn.getresponse()
         assert resp.status == 200
+
+        # "/" serves the single-page UI (reference dashboard client role)
+        conn = http.client.HTTPConnection("127.0.0.1", dash.port, timeout=10)
+        conn.request("GET", "/")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        body = resp.read().decode()
+        assert "<html" in body and "/api/nodes" in body
     finally:
         dash.stop()
 

@@ -355,5 +355,9 @@ def test_bohb_search_with_hyperband_e2e(rt_tune):
     grid = tuner.fit()
     best = grid.get_best_result(metric="loss", mode="min")
     assert best.metrics["loss"] < 30.0
-    # partial results reached the model (rung evaluations feed BOHB)
-    assert len(search.observations) > 8
+    # partial results reached the model: ONE observation per trial,
+    # holding that trial's LATEST (highest-budget) metric
+    assert len(search.observations) == 8
+    for cfg, loss in search.observations:
+        first_iter = (cfg["x"] - 3.0) ** 2 + 1.0
+        assert loss <= first_iter + 1e-9
