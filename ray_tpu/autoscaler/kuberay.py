@@ -1,0 +1,140 @@
+"""KubeRay-style integration: scale by patching a RayCluster custom resource.
+
+Role analog: ``python/ray/autoscaler/_private/kuberay/node_provider.py`` —
+on Kubernetes the autoscaler does NOT create VMs; it patches the
+``workerGroupSpecs[*].replicas`` field of the RayCluster CR and lets the
+operator reconcile pods. This provider speaks that protocol against a
+pluggable API client (anything with ``get(path)`` / ``patch(path, body)``
+— the real cluster uses the kubelet service-account HTTP client; tests
+use a fake), so the scaling logic is unit-testable without a cluster.
+
+TPU notes: worker groups map 1:1 to TPU slice topologies (a
+``numOfHosts > 1`` group is one multi-host slice, the KubeRay TPU
+pattern), so ``create_nodes(group, k)`` bumps replicas and the operator
+brings up whole slices atomically.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.autoscaler.node_provider import NodeInfo, NodeProvider
+
+
+class KubeRayNodeProvider(NodeProvider):
+    """Scales workerGroup replicas on a RayCluster CR."""
+
+    def __init__(self, api_client, namespace: str, cluster_name: str):
+        self.api = api_client
+        self.ns = namespace
+        self.name = cluster_name
+
+    @property
+    def _path(self) -> str:
+        return (f"/apis/ray.io/v1/namespaces/{self.ns}"
+                f"/rayclusters/{self.name}")
+
+    def _cr(self) -> Dict[str, Any]:
+        return self.api.get(self._path)
+
+    def _groups(self, cr) -> List[Dict[str, Any]]:
+        return cr["spec"].get("workerGroupSpecs", [])
+
+    # -- NodeProvider surface ------------------------------------------
+
+    def create_nodes(self, node_type: str, count: int) -> List[NodeInfo]:
+        """node_type == workerGroup name; bumps replicas by ``count``.
+        ONE read feeds the patch (no second GET whose staleness could
+        stomp a concurrent scale-up)."""
+        cr = self._cr()
+        for i, g in enumerate(self._groups(cr)):
+            if g["groupName"] == node_type:
+                new = int(g.get("replicas", 0)) + count
+                self.api.patch(self._path, [
+                    {"op": "replace",
+                     "path": f"/spec/workerGroupSpecs/{i}/replicas",
+                     "value": new}])
+                # pods materialize asynchronously via the operator; report
+                # the REQUESTED identities (group/index) — they become
+                # live in non_terminated_nodes once the operator acts
+                res = self._group_resources(g)
+                return [NodeInfo(f"{node_type}-{new - count + j}",
+                                 node_type, None, dict(res))
+                        for j in range(count)]
+        raise ValueError(f"unknown worker group {node_type!r}")
+
+    def terminate_node(self, node_id: str) -> None:
+        """Scale the node's group down by one and mark the pod for
+        deletion via the KubeRay ``workersToDelete`` protocol (the
+        operator removes exactly that pod, not an arbitrary one).
+        Appends to any pending workersToDelete so back-to-back
+        terminations within one reconcile window all survive."""
+        group = node_id.rsplit("-", 1)[0]
+        cr = self._cr()
+        for i, g in enumerate(self._groups(cr)):
+            if g["groupName"] == group:
+                replicas = max(0, int(g.get("replicas", 0)) - 1)
+                pending = list((g.get("scaleStrategy") or {})
+                               .get("workersToDelete") or [])
+                if node_id not in pending:
+                    pending.append(node_id)
+                self.api.patch(self._path, [
+                    {"op": "replace",
+                     "path": f"/spec/workerGroupSpecs/{i}/replicas",
+                     "value": replicas},
+                    {"op": "add",
+                     "path": (f"/spec/workerGroupSpecs/{i}/scaleStrategy"),
+                     "value": {"workersToDelete": pending}},
+                ])
+                return
+        raise ValueError(f"unknown group for node {node_id!r}")
+
+    def non_terminated_nodes(self) -> List[NodeInfo]:
+        cr = self._cr()
+        out = []
+        for g in self._groups(cr):
+            res = self._group_resources(g)
+            for i in range(int(g.get("replicas", 0))):
+                out.append(NodeInfo(f"{g['groupName']}-{i}",
+                                    g["groupName"], None, dict(res)))
+        return out
+
+    @staticmethod
+    def _group_resources(g: Dict[str, Any]) -> Dict[str, float]:
+        """Resources from rayStartParams (the KubeRay convention)."""
+        params = g.get("rayStartParams", {})
+        out: Dict[str, float] = {}
+        if "num-cpus" in params:
+            out["CPU"] = float(params["num-cpus"])
+        if "num-tpus" in params:
+            out["TPU"] = float(params["num-tpus"])
+        extra = params.get("resources")
+        if extra:
+            out.update(json.loads(extra) if isinstance(extra, str)
+                       else extra)
+        return out
+
+
+class FakeKubeApi:
+    """In-memory stand-in for the k8s API server (tests/docs): stores one
+    RayCluster CR and applies JSON-patch replace/add ops."""
+
+    def __init__(self, cr: Dict[str, Any]):
+        self.cr = cr
+        self.patches: List[Any] = []
+
+    def get(self, path: str) -> Dict[str, Any]:
+        return json.loads(json.dumps(self.cr))  # deep copy
+
+    def patch(self, path: str, ops: List[Dict[str, Any]]) -> None:
+        self.patches.append(ops)
+        for op in ops:
+            parts = [p for p in op["path"].split("/") if p]
+            tgt: Any = self.cr
+            for p in parts[:-1]:
+                tgt = tgt[int(p)] if isinstance(tgt, list) else tgt[p]
+            key: Any = parts[-1]
+            if isinstance(tgt, list):
+                key = int(key)
+            tgt[key] = op["value"]

@@ -1566,6 +1566,7 @@ class DriverRuntime:
 
     def submit_spec(self, spec: dict) -> List[ObjectRef]:
         tid = TaskID(spec["task_id"])
+        self._trace_submit(spec)
         deps = ts.arg_refs(spec["args"], spec["kwargs"])
         self._pin_args(spec)
         if self.cluster is not None and self.cluster.maybe_forward_task(spec):
@@ -1898,7 +1899,20 @@ class DriverRuntime:
     def create_actor(self, spec: dict):
         self.submit_spec(spec)
 
+    def _trace_submit(self, spec: dict) -> None:
+        """Record a submit span + propagate W3C context in the spec
+        (reference tracing_helper role); near-zero cost when disabled."""
+        from ray_tpu.util import tracing
+
+        if not tracing.tracing_enabled() or spec.get("trace_ctx"):
+            return  # worker-side submit already stamped + spanned it
+        name = spec.get("name") or spec.get("method") or "task"
+        with tracing.span(f"submit::{name}",
+                          {"task_id": spec["task_id"].hex()}) as tp:
+            spec["trace_ctx"] = tp
+
     def submit_actor_task(self, spec: dict) -> List[ObjectRef]:
+        self._trace_submit(spec)
         return self._submit_actor_spec(spec)
 
     def ensure_fn(self, h: str, blob: bytes):
@@ -2167,6 +2181,12 @@ def init(
             adapter.attach(rt)
         _runtime = rt
         atexit.register(_atexit_shutdown)
+        try:
+            from ray_tpu.usage_stats import write_usage_report
+
+            write_usage_report(rt)
+        except Exception:
+            pass
         return rt
 
 

@@ -273,7 +273,23 @@ class WorkerRuntime:
             self.cast("fn_put", h, blob)
             self.registered_fns.add(h)
 
+    def _stamp_trace(self, spec: dict, kind: str) -> None:
+        """Nested submissions join the ENCLOSING task's trace: the spec
+        carries this worker's active span context so the driver-side
+        handling and the eventual execute span parent here, not in a
+        fresh trace (reference tracing_helper nested-call propagation)."""
+        from ray_tpu.util import tracing
+
+        if not tracing.tracing_enabled():
+            return
+        name = spec.get("name") or spec.get("method") or "task"
+        with tracing.span(f"submit::{name}",
+                          {"task_id": spec["task_id"].hex(),
+                           "nested": True}) as tp:
+            spec["trace_ctx"] = tp
+
     def submit(self, spec: dict) -> List[ObjectRef]:
+        self._stamp_trace(spec, "task")
         self.cast("submit", spec)
         tid = TaskID(spec["task_id"])
         return [ObjectRef(ObjectID(b), task_id=tid) for b in spec["return_ids"]]
@@ -282,6 +298,7 @@ class WorkerRuntime:
         self.request("actor_create", spec)
 
     def submit_actor_task(self, spec: dict) -> List[ObjectRef]:
+        self._stamp_trace(spec, "actor_call")
         self.cast("actor_call", spec)
         return [ObjectRef(ObjectID(b)) for b in spec["return_ids"]]
 
@@ -619,6 +636,18 @@ class WorkerRuntime:
         self._send(("done", spec["task_id"], results))
 
     def execute(self, spec: dict):
+        from ray_tpu.util import tracing
+
+        if tracing.tracing_enabled():
+            name = spec.get("name") or spec.get("method") or "task"
+            with tracing.span(f"execute::{name}",
+                              {"task_id": spec["task_id"].hex(),
+                               "worker_id": self.worker_id.hex()},
+                              parent=spec.get("trace_ctx")):
+                return self._execute_inner(spec)
+        return self._execute_inner(spec)
+
+    def _execute_inner(self, spec: dict):
         ttype = spec["type"]
         self.current_task_id = TaskID(spec["task_id"])
         undo_env = lambda: None  # noqa: E731

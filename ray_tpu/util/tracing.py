@@ -1,0 +1,207 @@
+"""Distributed tracing: W3C-propagated spans for tasks and actor calls.
+
+Role analog: ``python/ray/util/tracing/tracing_helper.py`` — the reference
+wraps task submission/execution in OpenTelemetry spans and propagates the
+context inside the task spec (``_DictPropagator``). This image ships only
+the ``opentelemetry`` API (no SDK), so spans are recorded natively in the
+OTLP-compatible shape (trace_id/span_id/parent hex ids, epoch-nano
+timestamps, attributes) and written as JSON lines to
+``<session_dir>/traces.jsonl``; the W3C ``traceparent`` string rides the
+task spec, so worker-side execute spans join the driver's trace across
+process boundaries. When a full OTel SDK IS installed, the same spans are
+mirrored through ``opentelemetry.trace`` so any configured exporter
+receives them.
+
+Enable: ``ray_tpu.util.tracing.enable_tracing()`` on the driver (workers
+inherit via ``RTPU_TRACING``), or the env var alone.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import secrets
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, Optional
+
+_lock = threading.Lock()
+_state = {"enabled": None, "path": None, "fd": None}
+_ctx = threading.local()  # current (trace_id, span_id)
+
+
+def _resolve() -> bool:
+    with _lock:
+        if _state["enabled"] is None:
+            _state["enabled"] = os.environ.get("RTPU_TRACING", "0") == "1"
+            if _state["enabled"]:
+                _state["path"] = os.environ.get("RTPU_TRACE_FILE", "")
+        return _state["enabled"]
+
+
+def enable_tracing(trace_file: Optional[str] = None) -> None:
+    """Turn on span recording in THIS process and (via env) in workers
+    spawned after this call. If the zygote fork-server is already up its
+    env snapshot predates this call, so it is retired here — the next
+    spawn relaunches it with tracing env (otherwise forked workers would
+    silently never record)."""
+    os.environ["RTPU_TRACING"] = "1"
+    if trace_file:
+        os.environ["RTPU_TRACE_FILE"] = trace_file
+    with _lock:
+        _state["enabled"] = True
+        _state["path"] = os.environ.get("RTPU_TRACE_FILE", "")
+        _state["fd"] = None
+    try:
+        from ray_tpu.core import runtime as _rt_mod
+
+        rt = _rt_mod._runtime
+        if rt is not None:
+            with rt._zygote_lock:
+                if rt._zygote_obj is not None:
+                    rt._zygote_obj.close()
+                    rt._zygote_obj = None
+    except Exception:
+        pass
+
+
+def tracing_enabled() -> bool:
+    return bool(_resolve())
+
+
+def _trace_path() -> str:
+    if _state["path"]:
+        return _state["path"]
+    # default: the session dir when a runtime is up, else /tmp
+    try:
+        from ray_tpu.core.runtime import _get_runtime
+
+        rt = _get_runtime()
+        base = getattr(rt, "session_dir", None) or f"/tmp/rtpu-{rt.session}"
+    except Exception:
+        base = "/tmp"
+    return os.path.join(base, "traces.jsonl")
+
+
+def _emit(rec: Dict[str, Any]) -> None:
+    line = json.dumps(rec) + "\n"
+    try:
+        with _lock:
+            fd = _state["fd"]
+            if fd is None:
+                fd = os.open(_trace_path(),
+                             os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+                _state["fd"] = fd
+        os.write(fd, line.encode())  # O_APPEND: atomic for short lines
+    except Exception:
+        pass
+
+
+def current_traceparent() -> Optional[str]:
+    """W3C traceparent for the active span ('00-<trace>-<span>-01')."""
+    cur = getattr(_ctx, "ids", None)
+    if not cur:
+        return None
+    return f"00-{cur[0]}-{cur[1]}-01"
+
+
+def _parse_traceparent(tp: Optional[str]):
+    if not tp:
+        return None, None
+    parts = tp.split("-")
+    if len(parts) != 4:
+        return None, None
+    return parts[1], parts[2]
+
+
+@contextmanager
+def span(name: str, attributes: Optional[Dict[str, Any]] = None,
+         parent: Optional[str] = None):
+    """Record one span. ``parent``: a traceparent string from another
+    process (task spec propagation); defaults to this thread's active
+    span. Yields the span's traceparent for manual propagation."""
+    if not _resolve():
+        yield None
+        return
+    if parent is not None:
+        trace_id, parent_span = _parse_traceparent(parent)
+    else:
+        cur = getattr(_ctx, "ids", None)
+        trace_id, parent_span = (cur if cur else (None, None))
+    if trace_id is None:
+        trace_id = secrets.token_hex(16)
+    span_id = secrets.token_hex(8)
+    prev = getattr(_ctx, "ids", None)
+    _ctx.ids = (trace_id, span_id)
+    start = time.time_ns()
+    err = None
+    try:
+        yield f"00-{trace_id}-{span_id}-01"
+    except BaseException as e:
+        err = repr(e)
+        raise
+    finally:
+        _ctx.ids = prev
+        rec = {
+            "name": name,
+            "trace_id": trace_id,
+            "span_id": span_id,
+            "parent_span_id": parent_span,
+            "start_time_unix_nano": start,
+            "end_time_unix_nano": time.time_ns(),
+            "attributes": {**(attributes or {}),
+                           "process.pid": os.getpid()},
+        }
+        if err:
+            rec["status"] = {"code": "ERROR", "message": err[:300]}
+        _emit(rec)
+        _mirror_to_otel(name, rec)
+
+
+_otel_tracer: Any = None  # None = unresolved; False = unavailable/no-op
+
+
+def _mirror_to_otel(name: str, rec: Dict[str, Any]) -> None:
+    """If a real OTel SDK is configured in this process, replay the span
+    (with the REAL timestamps) so external exporters see the same data.
+    The tracer is resolved once — a failed import must not tax every span."""
+    global _otel_tracer
+    if _otel_tracer is False:
+        return
+    if _otel_tracer is None:
+        try:
+            from opentelemetry import trace as ot
+
+            tracer = ot.get_tracer("ray_tpu")
+            # API-without-SDK yields NonRecording spans: disable the mirror
+            probe = tracer.start_span("rtpu-probe")
+            recording = probe.is_recording()
+            probe.end()
+            _otel_tracer = tracer if recording else False
+        except Exception:
+            _otel_tracer = False
+        if _otel_tracer is False:
+            return
+    try:
+        s = _otel_tracer.start_span(
+            name, start_time=rec["start_time_unix_nano"])
+        for k, v in rec["attributes"].items():
+            s.set_attribute(k, v)
+        s.end(end_time=rec["end_time_unix_nano"])
+    except Exception:
+        pass
+
+
+def read_trace_file(path: Optional[str] = None) -> list:
+    out = []
+    try:
+        with open(path or _trace_path()) as f:
+            for line in f:
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+    except OSError:
+        pass
+    return out

@@ -334,3 +334,63 @@ def test_v2_instance_manager_lifecycle():
     inst = Instance("x", "t")
     with _pytest.raises(ValueError):
         inst.transition(RAY_RUNNING)
+
+
+def test_kuberay_provider_patches_raycluster():
+    """KubeRay integration (reference kuberay/node_provider.py role):
+    scaling patches workerGroup replicas + workersToDelete on the CR."""
+    from ray_tpu.autoscaler.kuberay import FakeKubeApi, KubeRayNodeProvider
+
+    cr = {"spec": {"workerGroupSpecs": [
+        {"groupName": "tpu-v5e-8", "replicas": 1, "numOfHosts": 1,
+         "rayStartParams": {"num-cpus": "8", "num-tpus": "8"}},
+        {"groupName": "cpu", "replicas": 0,
+         "rayStartParams": {"num-cpus": "4"}},
+    ]}}
+    api = FakeKubeApi(cr)
+    provider = KubeRayNodeProvider(api, "ray-ns", "demo")
+
+    created = provider.create_nodes("tpu-v5e-8", 2)
+    assert len(created) == 2
+    assert created[0].resources == {"CPU": 8.0, "TPU": 8.0}
+    assert api.cr["spec"]["workerGroupSpecs"][0]["replicas"] == 3
+
+    nodes = provider.non_terminated_nodes()
+    assert sum(1 for n in nodes if n.node_type == "tpu-v5e-8") == 3
+    assert sum(1 for n in nodes if n.node_type == "cpu") == 0
+
+    provider.terminate_node("tpu-v5e-8-2")
+    g = api.cr["spec"]["workerGroupSpecs"][0]
+    assert g["replicas"] == 2
+    assert g["scaleStrategy"]["workersToDelete"] == ["tpu-v5e-8-2"]
+
+    import pytest as _p
+
+    with _p.raises(ValueError):
+        provider.create_nodes("nope", 1)
+
+
+def test_usage_stats_report(monkeypatch, tmp_path):
+    from ray_tpu.usage_stats import (collect_usage, usage_stats_enabled,
+                                     write_usage_report)
+
+    class FakeRt:
+        session = "abc123"
+        session_dir = str(tmp_path)
+        total = {"CPU": 4.0}
+        cluster = None
+
+        class gcs:
+            actors = {}
+
+    rec = collect_usage(FakeRt())
+    assert rec["ray_tpu_version"] and rec["num_nodes"] == 1
+    path = write_usage_report(FakeRt())
+    assert path and "usage_stats.json" in path
+    import json as _json
+
+    assert _json.load(open(path))["session_id"] == "abc123"
+
+    monkeypatch.setenv("RTPU_USAGE_STATS_ENABLED", "0")
+    assert not usage_stats_enabled()
+    assert write_usage_report(FakeRt()) == ""

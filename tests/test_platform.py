@@ -197,3 +197,78 @@ def test_cli_stack_dumps_worker_stacks(rt_plat):
     assert "Current thread" in out  # a real stack dump was captured
     assert "sleeper" in out or "time.sleep" in out or "execute" in out
     ray_tpu.get(refs, timeout=30)
+
+
+def test_tracing_spans_propagate_to_workers(tmp_path):
+    """W3C-propagated task spans (reference tracing_helper role): driver
+    submit spans and worker execute spans share one trace id across the
+    process boundary; actor calls traced too."""
+    import ray_tpu
+    from ray_tpu.util import tracing
+
+    trace_file = str(tmp_path / "traces.jsonl")
+    tracing.enable_tracing(trace_file)
+    try:
+        ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+
+        @ray_tpu.remote
+        def traced_task(x):
+            return x + 1
+
+        assert ray_tpu.get(traced_task.remote(1), timeout=60) == 2
+
+        @ray_tpu.remote
+        class TracedActor:
+            def m(self):
+                return "ok"
+
+        a = TracedActor.remote()
+        assert ray_tpu.get(a.m.remote(), timeout=60) == "ok"
+
+        deadline = time.time() + 30
+        spans = []
+        while time.time() < deadline:
+            spans = tracing.read_trace_file(trace_file)
+            if (any(s["name"] == "execute::traced_task" for s in spans)
+                    and any(s["name"] == "execute::m" for s in spans)):
+                break
+            time.sleep(0.3)
+        submit = next(s for s in spans if s["name"] == "submit::traced_task")
+        execute = next(s for s in spans
+                       if s["name"] == "execute::traced_task")
+        assert execute["trace_id"] == submit["trace_id"]
+        assert execute["parent_span_id"] == submit["span_id"]
+        assert execute["attributes"]["process.pid"] != \
+            submit["attributes"]["process.pid"]
+        assert any(s["name"] == "submit::m" for s in spans)
+
+        # nested submissions join the ENCLOSING task's trace
+        @ray_tpu.remote
+        def inner(x):
+            return x * 10
+
+        @ray_tpu.remote
+        def outer():
+            return ray_tpu.get(inner.remote(4))
+
+        assert ray_tpu.get(outer.remote(), timeout=60) == 40
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            spans = tracing.read_trace_file(trace_file)
+            if any(s["name"] == "execute::inner" for s in spans):
+                break
+            time.sleep(0.3)
+        outer_exec = next(s for s in spans if s["name"] == "execute::outer")
+        inner_sub = next(s for s in spans if s["name"] == "submit::inner")
+        inner_exec = next(s for s in spans if s["name"] == "execute::inner")
+        assert inner_sub["trace_id"] == outer_exec["trace_id"]
+        assert inner_sub["parent_span_id"] == outer_exec["span_id"]
+        assert inner_exec["trace_id"] == outer_exec["trace_id"]
+    finally:
+        import os as _os
+
+        _os.environ.pop("RTPU_TRACING", None)
+        _os.environ.pop("RTPU_TRACE_FILE", None)
+        tracing._state["enabled"] = None
+        tracing._state["fd"] = None
+        ray_tpu.shutdown()
