@@ -518,9 +518,27 @@ def _core_microbench() -> dict:
 
         a = A.remote()
         ray_tpu.get(a.f.remote())
+        # reference 1_1_actor_calls_sync: one call at a time
+        t0 = time.perf_counter()
+        for _ in range(100):
+            ray_tpu.get(a.f.remote())
+        out["actor_calls_sync_per_s"] = round(
+            100 / (time.perf_counter() - t0), 1)
+        # reference 1_1_actor_calls_async: burst submit, then drain
         t0 = time.perf_counter()
         ray_tpu.get([a.f.remote() for _ in range(n)])
         out["actor_calls_per_s"] = round(n / (time.perf_counter() - t0), 1)
+
+        # reference placement_group_create/removal rate
+        from ray_tpu.util.placement_group import (placement_group,
+                                                  remove_placement_group)
+
+        t0 = time.perf_counter()
+        for _ in range(50):
+            pg = placement_group([{"CPU": 1}], strategy="PACK")
+            remove_placement_group(pg)
+        out["pg_create_remove_per_s"] = round(
+            50 / (time.perf_counter() - t0), 1)
 
         # numpy payload rides the zero-copy out-of-band buffer path (the
         # realistic ML case; raw bytes pickle in-band)
