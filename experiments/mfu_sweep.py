@@ -1,0 +1,132 @@
+"""On-chip MFU sweep: find the best (remat, batch, attention) config.
+
+Round-4 context: the first real-TPU bench (batch 4, remat off, blockwise
+XLA fallback after the batch-16 no-remat program OOMed 31G/15.75G HBM)
+measured 0.143 MFU. This sweep runs each candidate config in a fresh
+child process (OOM isolation + clean backend claim) and prints one JSON
+line per config, so bench.py's defaults can be set from measurements
+instead of guesses.
+
+Usage:  JAX_PLATFORMS=axon python experiments/mfu_sweep.py            # all
+        JAX_PLATFORMS=axon python experiments/mfu_sweep.py --child '{...}'
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CONFIGS = [
+    # (name, remat, remat_policy, batch, attn_impl)
+    ("remat_full_b16_pallas", True, "full", 16, "pallas"),
+    ("remat_attn_b16_pallas", True, "save_attn", 16, "pallas"),
+    ("remat_full_b32_pallas", True, "full", 32, "pallas"),
+    ("remat_attn_b8_pallas", True, "save_attn", 8, "pallas"),
+    ("noremat_b8_pallas", False, "full", 8, "pallas"),
+    ("remat_full_b16_xla", True, "full", 16, "xla"),
+    ("noremat_b4_pallas", False, "full", 4, "pallas"),
+]
+
+
+def child(cfg: dict) -> None:
+    sys.path.insert(0, _REPO)
+    from ray_tpu.util.tpu_info import honor_jax_platform_env
+
+    honor_jax_platform_env()
+    import jax
+    import numpy as np
+    import optax
+
+    from ray_tpu import models
+    from ray_tpu.ops.attention import set_default_attention_impl
+    from ray_tpu.parallel import MeshConfig
+    from ray_tpu.train import TrainLoopHelper
+    from ray_tpu.util.tpu_info import peak_flops_per_chip
+
+    out = {"name": cfg["name"], "ok": False}
+    try:
+        set_default_attention_impl(cfg["attn"])
+        config = models.llama_250m().replace(
+            remat=cfg["remat"], remat_policy=cfg["policy"])
+        seq, batch_size = 2048, cfg["batch"]
+        helper = TrainLoopHelper.create(
+            lambda: models.init_params(jax.random.PRNGKey(0), config),
+            models.param_axes(config),
+            lambda p, b: models.loss_and_metrics(p, b, config),
+            optax.adamw(1e-4),
+            mesh_config=MeshConfig(dp=1, fsdp=-1, tp=1, sp=1),
+        )
+        rng = np.random.default_rng(0)
+        toks = rng.integers(0, config.vocab_size, size=(batch_size, seq + 1),
+                            dtype=np.int32)
+        batch = {"inputs": toks[:, :-1], "targets": toks[:, 1:]}
+        t0 = time.perf_counter()
+        for _ in range(3):
+            m = helper.run_step(batch)
+            float(jax.device_get(m["loss"]))
+        out["compile_warmup_s"] = round(time.perf_counter() - t0, 1)
+        iters = 10
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            m = helper.run_step(batch)
+            float(jax.device_get(m["loss"]))
+        dt = (time.perf_counter() - t0) / iters
+        tokens_per_sec = batch_size * seq / dt
+        flops_token = config.flops_per_token() + (
+            6 * config.n_layers * config.hdim * config.n_heads * seq)
+        mfu = flops_token * tokens_per_sec / peak_flops_per_chip()
+        out.update(ok=True, step_ms=round(dt * 1e3, 2),
+                   tokens_per_sec=round(tokens_per_sec, 1),
+                   mfu=round(mfu, 4),
+                   backend=jax.default_backend())
+    except Exception as e:
+        out["error"] = f"{type(e).__name__}: {str(e)[:300]}"
+    print(json.dumps(out))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--child", default=None)
+    ap.add_argument("--only", default=None,
+                    help="comma-separated config-name filter")
+    args = ap.parse_args()
+    if args.child:
+        child(json.loads(args.child))
+        return 0
+    results = []
+    for (name, remat, policy, batch, attn) in CONFIGS:
+        if args.only and name not in args.only.split(","):
+            continue
+        cfg = {"name": name, "remat": remat, "policy": policy,
+               "batch": batch, "attn": attn}
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "axon"
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--child", json.dumps(cfg)],
+                capture_output=True, text=True, timeout=900, env=env,
+                cwd=_REPO)
+            line = next((ln for ln in reversed(proc.stdout.splitlines())
+                         if ln.startswith("{")), None)
+            rec = (json.loads(line) if line else
+                   {"name": name, "ok": False,
+                    "error": f"rc={proc.returncode}: {proc.stderr[-400:]}"})
+        except subprocess.TimeoutExpired:
+            rec = {"name": name, "ok": False, "error": "timeout 900s"}
+        results.append(rec)
+        print(json.dumps(rec), flush=True)
+    best = max((r for r in results if r.get("ok")),
+               key=lambda r: r.get("mfu", 0), default=None)
+    print(json.dumps({"best": best}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -669,6 +669,8 @@ class ClusterAdapter:
                 return out
         elif strat is not None and strat[0] == "spread":
             return self._place_spread(spec, res)
+        elif strat is not None and strat[0] == "random":
+            return self._place_random(spec, res)
         with self.rt.lock:
             local_total_ok = all(
                 self.rt.total.get(k, 0.0) >= v for k, v in res.items())
@@ -880,20 +882,41 @@ class ClusterAdapter:
             return True
         return self._forward(node_id, spec)
 
-    def _place_spread(self, spec: dict, res: Dict[str, float]) -> bool:
-        """Round-robin over feasible nodes including this one (reference
-        SPREAD strategy)."""
+    def _feasible_slots(self, res: Dict[str, float]) -> List[dict]:
+        """Candidate slot list for spread/random placement: this node first
+        (when feasible by total), then every alive feasible peer."""
         feasible = [n for n in self._nodes() if n["alive"] and all(
             n["resources"].get(k, 0.0) >= v for k, v in res.items())]
         with self.rt.lock:
             local_ok = all(self.rt.total.get(k, 0.0) >= v
                            for k, v in res.items())
-        slots = ([{"node_id": self.node_id}] if local_ok else []) + [
+        return ([{"node_id": self.node_id}] if local_ok else []) + [
             n for n in feasible if n["node_id"] != self.node_id]
+
+    def _place_spread(self, spec: dict, res: Dict[str, float]) -> bool:
+        """Round-robin over feasible nodes including this one (reference
+        SPREAD strategy)."""
+        slots = self._feasible_slots(res)
         if not slots:
             return False
         pick = slots[self._spread_rr % len(slots)]
         self._spread_rr += 1
+        if pick["node_id"] == self.node_id:
+            return False
+        return self._forward(pick["node_id"], spec)
+
+    def _place_random(self, spec: dict, res: Dict[str, float]) -> bool:
+        """Uniform over feasible nodes including this one (reference
+        ``random_scheduling_policy.h`` role; together with the strategy
+        dispatch in ``maybe_forward_task`` — hybrid default, spread,
+        node-affinity, node-label — this completes the reference's
+        ``composite_scheduling_policy.h`` policy set)."""
+        import random as _random
+
+        slots = self._feasible_slots(res)
+        if not slots:
+            return False
+        pick = _random.choice(slots)
         if pick["node_id"] == self.node_id:
             return False
         return self._forward(pick["node_id"], spec)

@@ -48,7 +48,15 @@ def _strategy_spec(opts: Dict[str, Any]):
     if strategy is None or hasattr(strategy, "placement_group"):
         return None
     if isinstance(strategy, str):
-        return ("spread",) if strategy.upper() == "SPREAD" else None
+        up = strategy.upper()
+        if up == "SPREAD":
+            return ("spread",)
+        if up == "RANDOM":
+            # reference random_scheduling_policy.h: uniform over feasible
+            # nodes (useful for load smoke-spreading without the hybrid
+            # policy's utilization scoring)
+            return ("random",)
+        return None
     if hasattr(strategy, "node_id"):
         node_id = strategy.node_id
         if isinstance(node_id, str):

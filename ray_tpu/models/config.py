@@ -54,6 +54,11 @@ class TransformerConfig:
     dtype: str = "bfloat16"            # activation/param compute dtype
     param_dtype: str = "float32"       # master param dtype
     remat: bool = True                 # jax.checkpoint each layer (HBM <-> FLOPs)
+    # "full" recomputes the whole layer in backward; "save_attn" saves the
+    # attention block's output (named checkpoint) so backward recomputes
+    # only norms + QKV/FFN matmuls — attention (the expensive recompute:
+    # its custom VJP already re-tiles the O(L^2) blocks) runs once
+    remat_policy: str = "full"
     logits_softcap: float = 0.0        # tanh soft-capping (0 = off)
     z_loss: float = 0.0                # output z-loss weight
 
@@ -74,6 +79,12 @@ class TransformerConfig:
             raw = int(8 * self.d_model / 3)
             return (raw + 255) // 256 * 256
         return 4 * self.d_model
+
+    def __post_init__(self):
+        if self.remat_policy not in ("full", "save_attn"):
+            raise ValueError(
+                f"unknown remat_policy {self.remat_policy!r}; "
+                "expected 'full' or 'save_attn'")
 
     def replace(self, **kw) -> "TransformerConfig":
         return dataclasses.replace(self, **kw)

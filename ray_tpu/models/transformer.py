@@ -244,7 +244,7 @@ def _layers_pipelined(layer_params, x, layer_fn, c, pp, cos, sin):
             h2, _aux = layer_fn(h, lp, cs, sn)
             return h2
 
-        blk = jax.checkpoint(block) if c.remat else block
+        blk = _remat_wrap(block, c)
         return pipeline_apply(blk, lps, m, axis="pp")
 
     out = jax.shard_map(
@@ -261,6 +261,22 @@ def _layers_pipelined(layer_params, x, layer_fn, c, pp, cos, sin):
         check_vma=False,
     )(layer_params, micro, *extras)
     return merge_microbatches(out), jnp.zeros((), jnp.float32)
+
+
+def _remat_wrap(layer_fn, c: "TransformerConfig"):
+    """Apply the config's rematerialization choice to the layer body.
+
+    ``remat_policy="save_attn"`` keeps the named ``attn_out`` residual
+    (bf16 [B,L,H,K] per layer) so the backward pass recomputes norms and
+    matmuls but NOT attention — attention recompute is the costly part
+    (the flash custom VJP re-tiles O(L^2) blocks a second time under full
+    remat)."""
+    if not c.remat:
+        return layer_fn
+    if c.remat_policy == "save_attn":
+        policy = jax.checkpoint_policies.save_only_these_names("attn_out")
+        return jax.checkpoint(layer_fn, policy=policy)
+    return jax.checkpoint(layer_fn)
 
 
 def forward(
@@ -305,6 +321,9 @@ def forward(
         q = constrain(q, ("batch", "seq", "heads", None))
         k = constrain(k, ("batch", "seq", "kv_heads", None))
         o = _attention(q, k, v, c)
+        from jax.ad_checkpoint import checkpoint_name
+
+        o = checkpoint_name(o, "attn_out")  # no-op unless a policy saves it
         o = jnp.einsum("blhk,hkd->bld", o, lp["wo"].astype(dt))
         x = constrain(x + o, ("batch", "seq", None))
 
@@ -330,7 +349,7 @@ def forward(
         x = constrain(x + m, ("batch", "seq", None))
         return x, aux
 
-    body = jax.checkpoint(layer) if c.remat else layer
+    body = _remat_wrap(layer, c)
 
     pp = _pp_axis_size()
     if pp > 1:

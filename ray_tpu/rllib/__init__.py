@@ -8,6 +8,8 @@ V-trace) ship first; replay buffers cover the off-policy family.
 """
 
 from ray_tpu.rllib.actor_manager import FaultTolerantActorManager
+from ray_tpu.rllib.catalog import (ATARI_FILTERS, Catalog, CNNEncoderConfig,
+                                   LSTMEncoderConfig, MLPEncoderConfig)
 from ray_tpu.rllib.anakin import AnakinPPO
 from ray_tpu.rllib.appo import APPO, APPOConfig, APPOLearner
 from ray_tpu.rllib.dqn import DQN, DQNConfig, DQNLearner
@@ -64,6 +66,11 @@ __all__ = [
     "JaxLearner",
     "LearnerGroup",
     "JaxRLModule",
+    "Catalog",
+    "CNNEncoderConfig",
+    "MLPEncoderConfig",
+    "LSTMEncoderConfig",
+    "ATARI_FILTERS",
     "RLModuleSpec",
     "MultiAgentEnv",
     "MultiAgentEnvRunner",

@@ -1,0 +1,227 @@
+"""Model catalog: encoder/head selection from gym spaces.
+
+Role analog: ``rllib/core/models/catalog.py`` (the reference's Catalog
+builds encoder + pi/vf head configs per framework from observation and
+action spaces). Here the catalog is TPU-native: every component is a pure
+``(init, apply)`` function pair over a param pytree, so modules jit,
+shard, and donate like any other JAX state — no framework classes.
+
+Encoders:
+  - ``MLPEncoderConfig``  — vector observations.
+  - ``CNNEncoderConfig``  — image observations (NHWC, lowered to
+    ``lax.conv_general_dilated`` so XLA tiles it onto the MXU; bf16-safe).
+  - ``LSTMEncoderConfig`` — recurrent trunk over a ``lax.scan`` (static
+    shapes, compiler-friendly; reference uses framework RNN modules).
+
+The catalog's space→config logic mirrors the reference defaults: 3D
+uint8/float boxes get the Atari conv stack, flat boxes get an MLP;
+Discrete action spaces get a categorical head, Box actions a
+diag-Gaussian head.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# (out_channels, kernel, stride) — the classic Atari stack, same defaults
+# the reference catalog applies to 64x64..96x96 images.
+ATARI_FILTERS: Tuple[Tuple[int, int, int], ...] = (
+    (16, 8, 4), (32, 4, 2), (64, 3, 1))
+
+
+def _act(name: str):
+    return {"tanh": jnp.tanh, "relu": jax.nn.relu, "gelu": jax.nn.gelu,
+            "silu": jax.nn.silu}[name]
+
+
+def _dense_init(key, fan_in: int, fan_out: int) -> Dict[str, Any]:
+    w = jax.random.normal(key, (fan_in, fan_out), jnp.float32)
+    return {"w": w * np.sqrt(2.0 / fan_in),
+            "b": jnp.zeros((fan_out,), jnp.float32)}
+
+
+# ---------------------------------------------------------------------------
+# Encoder configs
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class MLPEncoderConfig:
+    input_dim: int
+    hidden: Tuple[int, ...] = (64, 64)
+    activation: str = "tanh"
+
+    @property
+    def output_dim(self) -> int:
+        return self.hidden[-1] if self.hidden else self.input_dim
+
+    def init(self, key) -> Dict[str, Any]:
+        sizes = (self.input_dim, *self.hidden)
+        keys = jax.random.split(key, max(1, len(sizes) - 1))
+        return {"layers": [
+            _dense_init(k, i, o)
+            for k, i, o in zip(keys, sizes[:-1], sizes[1:])]}
+
+    def apply(self, params, x):
+        act = _act(self.activation)
+        x = x.reshape(x.shape[0], -1)
+        for lyr in params["layers"]:
+            x = act(x @ lyr["w"] + lyr["b"])
+        return x
+
+
+@dataclass(frozen=True)
+class CNNEncoderConfig:
+    """NHWC conv trunk + flatten + one dense projection."""
+
+    obs_shape: Tuple[int, int, int]  # (H, W, C)
+    filters: Tuple[Tuple[int, int, int], ...] = ATARI_FILTERS
+    activation: str = "relu"
+    dense: int = 256
+
+    @property
+    def output_dim(self) -> int:
+        return self.dense
+
+    def _conv_shapes(self):
+        h, w, c = self.obs_shape
+        shapes = []
+        for (out_c, k, s) in self.filters:
+            shapes.append((k, k, c, out_c))
+            h = -(-h // s)  # SAME padding: ceil
+            w = -(-w // s)
+            c = out_c
+        return shapes, h * w * c
+
+    def init(self, key) -> Dict[str, Any]:
+        shapes, flat = self._conv_shapes()
+        keys = jax.random.split(key, len(shapes) + 1)
+        convs = []
+        for k, shp in zip(keys[:-1], shapes):
+            fan_in = shp[0] * shp[1] * shp[2]
+            w = jax.random.normal(k, shp, jnp.float32) * np.sqrt(2.0 / fan_in)
+            convs.append({"w": w, "b": jnp.zeros((shp[-1],), jnp.float32)})
+        return {"convs": convs, "proj": _dense_init(keys[-1], flat, self.dense)}
+
+    def apply(self, params, x):
+        act = _act(self.activation)
+        # runners ship flat float obs; restore NHWC (batch, H, W, C)
+        x = x.reshape(x.shape[0], *self.obs_shape)
+        for (out_c, k, s), lyr in zip(self.filters, params["convs"]):
+            x = jax.lax.conv_general_dilated(
+                x, lyr["w"], window_strides=(s, s), padding="SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            x = act(x + lyr["b"])
+        x = x.reshape(x.shape[0], -1)
+        proj = params["proj"]
+        return act(x @ proj["w"] + proj["b"])
+
+
+@dataclass(frozen=True)
+class LSTMEncoderConfig:
+    """Single-layer LSTM over a ``lax.scan`` (time-major inside the scan).
+
+    ``apply`` takes ``(params, x, carry)`` with x of shape (B, T, D) and
+    returns ``(features (B, T, cell), new_carry)``; ``initial_carry``
+    builds zeros. Static shapes end to end — XLA unrolls nothing.
+    """
+
+    input_dim: int
+    cell_size: int = 128
+
+    @property
+    def output_dim(self) -> int:
+        return self.cell_size
+
+    def init(self, key) -> Dict[str, Any]:
+        k1, k2 = jax.random.split(key)
+        n = self.cell_size
+        return {"wx": _dense_init(k1, self.input_dim, 4 * n),
+                "wh": _dense_init(k2, n, 4 * n)}
+
+    def initial_carry(self, batch: int):
+        z = jnp.zeros((batch, self.cell_size), jnp.float32)
+        return (z, z)
+
+    def apply(self, params, x, carry=None):
+        if carry is None:
+            carry = self.initial_carry(x.shape[0])
+        wx, wh = params["wx"], params["wh"]
+
+        def step(c, xt):
+            h, cell = c
+            gates = xt @ wx["w"] + wx["b"] + h @ wh["w"] + wh["b"]
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            cell = jax.nn.sigmoid(f + 1.0) * cell + jax.nn.sigmoid(i) * jnp.tanh(g)
+            h = jax.nn.sigmoid(o) * jnp.tanh(cell)
+            return (h, cell), h
+
+        carry, ys = jax.lax.scan(step, carry, x.swapaxes(0, 1))
+        return ys.swapaxes(0, 1), carry
+
+
+# ---------------------------------------------------------------------------
+# Catalog
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Catalog:
+    """Space-driven component factory (reference ``Catalog`` role).
+
+    ``from_spaces`` picks the encoder family from the observation space
+    and the head family from the action space; ``to_module_spec`` folds
+    the choice into an ``RLModuleSpec`` so it rides the existing
+    dict-serialized spec plumbing across actor boundaries.
+    """
+
+    encoder: Any
+    action_dim: int
+    discrete: bool
+    head_hidden: Tuple[int, ...] = ()
+
+    @classmethod
+    def from_spaces(cls, obs_space, act_space,
+                    hidden: Tuple[int, ...] = (64, 64),
+                    activation: str = "tanh") -> "Catalog":
+        import gymnasium as gym
+
+        shape = tuple(obs_space.shape or ())
+        if len(shape) == 3:
+            enc = CNNEncoderConfig(obs_shape=shape)
+        else:
+            enc = MLPEncoderConfig(input_dim=int(np.prod(shape) or 1),
+                                   hidden=hidden, activation=activation)
+        if isinstance(act_space, gym.spaces.Discrete):
+            return cls(encoder=enc, action_dim=int(act_space.n), discrete=True)
+        return cls(encoder=enc,
+                   action_dim=int(np.prod(act_space.shape)), discrete=False)
+
+    # -- component builders (init, apply) --------------------------------
+
+    def build_encoder(self):
+        return self.encoder
+
+    def build_pi_head(self, key):
+        return _dense_init(key, self.encoder.output_dim, self.action_dim)
+
+    def build_vf_head(self, key):
+        return _dense_init(key, self.encoder.output_dim, 1)
+
+    def to_module_spec(self):
+        from ray_tpu.rllib.rl_module import RLModuleSpec
+
+        if isinstance(self.encoder, CNNEncoderConfig):
+            return RLModuleSpec(
+                observation_dim=int(np.prod(self.encoder.obs_shape)),
+                action_dim=self.action_dim, discrete=self.discrete,
+                conv_filters=self.encoder.filters,
+                obs_shape=self.encoder.obs_shape,
+                activation=self.encoder.activation)
+        return RLModuleSpec(
+            observation_dim=self.encoder.input_dim,
+            action_dim=self.action_dim, discrete=self.discrete,
+            hidden=self.encoder.hidden, activation=self.encoder.activation)

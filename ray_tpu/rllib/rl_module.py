@@ -20,13 +20,21 @@ import numpy as np
 
 @dataclass(frozen=True)
 class RLModuleSpec:
-    """Construction-from-config (reference ``SingleAgentRLModuleSpec``)."""
+    """Construction-from-config (reference ``SingleAgentRLModuleSpec``).
+
+    When ``conv_filters``/``obs_shape`` are set (catalog-selected for
+    image observations), the module runs a shared CNN encoder trunk with
+    dense pi/vf heads; otherwise separate MLP trunks (the reference's
+    default non-shared encoder layout for vector obs).
+    """
 
     observation_dim: int
     action_dim: int
     discrete: bool = True
     hidden: Tuple[int, ...] = (64, 64)
     activation: str = "tanh"
+    conv_filters: Optional[Tuple[Tuple[int, int, int], ...]] = None
+    obs_shape: Optional[Tuple[int, ...]] = None
 
     def build(self) -> "JaxRLModule":
         return JaxRLModule(self)
@@ -63,15 +71,32 @@ class JaxRLModule:
     def __init__(self, spec: RLModuleSpec):
         self.spec = spec
 
+    def __post_init_encoder(self):
+        from ray_tpu.rllib.catalog import CNNEncoderConfig
+
+        return CNNEncoderConfig(
+            obs_shape=tuple(self.spec.obs_shape),
+            filters=tuple(tuple(f) for f in self.spec.conv_filters),
+            activation=self.spec.activation)
+
     def init(self, rng: jax.Array) -> Dict[str, Any]:
         k_pi, k_vf, k_logstd = jax.random.split(rng, 3)
         out_dim = self.spec.action_dim
-        params = {
-            "pi": _mlp_init(k_pi, (self.spec.observation_dim,
-                                   *self.spec.hidden, out_dim)),
-            "vf": _mlp_init(k_vf, (self.spec.observation_dim,
-                                   *self.spec.hidden, 1)),
-        }
+        if self.spec.conv_filters is not None:
+            enc = self.__post_init_encoder()
+            k_enc, k_pi, k_vf = jax.random.split(k_pi, 3)
+            params = {
+                "enc": enc.init(k_enc),
+                "pi": _mlp_init(k_pi, (enc.output_dim, out_dim)),
+                "vf": _mlp_init(k_vf, (enc.output_dim, 1)),
+            }
+        else:
+            params = {
+                "pi": _mlp_init(k_pi, (self.spec.observation_dim,
+                                       *self.spec.hidden, out_dim)),
+                "vf": _mlp_init(k_vf, (self.spec.observation_dim,
+                                       *self.spec.hidden, 1)),
+            }
         if not self.spec.discrete:
             params["log_std"] = jnp.zeros((out_dim,), jnp.float32)
         return params
@@ -79,8 +104,13 @@ class JaxRLModule:
     # -- forward modes ----------------------------------------------------
 
     def forward_train(self, params, obs) -> Dict[str, jax.Array]:
-        logits = _mlp_apply(params["pi"], obs, self.spec.activation)
-        vf = _mlp_apply(params["vf"], obs, self.spec.activation)[..., 0]
+        if self.spec.conv_filters is not None:
+            feats = self.__post_init_encoder().apply(params["enc"], obs)
+            logits = _mlp_apply(params["pi"], feats, self.spec.activation)
+            vf = _mlp_apply(params["vf"], feats, self.spec.activation)[..., 0]
+        else:
+            logits = _mlp_apply(params["pi"], obs, self.spec.activation)
+            vf = _mlp_apply(params["vf"], obs, self.spec.activation)[..., 0]
         out = {"action_dist_inputs": logits, "vf_preds": vf}
         if not self.spec.discrete:
             out["log_std"] = params["log_std"]
@@ -135,16 +165,12 @@ def _diag_gaussian_logp(x, mean, log_std):
 
 
 def spec_for_env(env) -> RLModuleSpec:
-    import gymnasium as gym
+    """Space→spec via the model catalog: image obs (3D boxes) get the
+    CNN encoder stack, vector obs the MLP default."""
+    from ray_tpu.rllib.catalog import Catalog
 
     obs_space = env.single_observation_space if hasattr(
         env, "single_observation_space") else env.observation_space
     act_space = env.single_action_space if hasattr(
         env, "single_action_space") else env.action_space
-    obs_dim = int(np.prod(obs_space.shape))
-    if isinstance(act_space, gym.spaces.Discrete):
-        return RLModuleSpec(observation_dim=obs_dim,
-                            action_dim=int(act_space.n), discrete=True)
-    return RLModuleSpec(observation_dim=obs_dim,
-                        action_dim=int(np.prod(act_space.shape)),
-                        discrete=False)
+    return Catalog.from_spaces(obs_space, act_space).to_module_spec()

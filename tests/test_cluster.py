@@ -214,6 +214,24 @@ def test_spread_strategy(cluster):
     assert len(sessions) == 3, sessions
 
 
+def test_random_strategy(cluster):
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+    _init(cluster)
+
+    @ray_tpu.remote
+    def where():
+        from ray_tpu.core.runtime import _get_runtime
+
+        return _get_runtime().store.session
+
+    sessions = set(ray_tpu.get(
+        [where.options(scheduling_strategy="RANDOM").remote()
+         for _ in range(12)], timeout=90))
+    # uniform over 3 feasible nodes: all-12-on-one-node has p ~ 2e-5
+    assert len(sessions) >= 2, sessions
+
+
 def test_gcs_restart_fault_tolerance(tmp_path):
     """Kill + restart the GCS: durable tables (KV, named actors) survive
     via the snapshot; node daemons re-register via heartbeat NACK; new

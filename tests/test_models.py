@@ -151,3 +151,30 @@ def test_sharded_train_step_ring_attention_sp():
 
         sp_loss = step(params_sharded)
     np.testing.assert_allclose(float(sp_loss), float(ref_loss), atol=2e-2, rtol=2e-2)
+
+
+def test_remat_policies_grad_equivalent():
+    """save_attn remat must produce the same loss AND grads as full remat
+    (it only changes what backward recomputes); unknown policies fail loudly."""
+    base = models.llama_debug()
+    toks = np.asarray(
+        np.random.default_rng(0).integers(0, base.vocab_size, (2, 33)),
+        dtype=np.int32)
+    batch = {"tokens": toks}
+
+    def grads_for(policy):
+        c = base.replace(remat=True, remat_policy=policy)
+        params = init_params(jax.random.PRNGKey(0), c)
+        return jax.jit(jax.value_and_grad(
+            lambda p: loss_and_metrics(p, batch, c)[0]))(params)
+
+    loss_full, g_full = grads_for("full")
+    loss_attn, g_attn = grads_for("save_attn")
+    np.testing.assert_allclose(float(loss_full), float(loss_attn), rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4),
+        g_full, g_attn)
+
+    with pytest.raises(ValueError, match="remat_policy"):
+        base.replace(remat_policy="save-attention")
