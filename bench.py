@@ -321,8 +321,8 @@ def train_step_child() -> None:
                     msg = str(e2)
             if "RESOURCE_EXHAUSTED" not in msg and "Allocation" not in msg:
                 raise
-            # HBM OOM: shrink the batch and retry (remat is off, so the
-            # activation footprint scales linearly with batch)
+            # HBM OOM: shrink the batch and retry (activation residuals
+            # scale linearly with batch even under remat)
     if result is None:
         raise last_exc
     result["detail"]["attention_impl"] = attn_note
@@ -413,16 +413,19 @@ def _measure(jax, on_tpu: bool, batch_size: int = 16) -> dict:
     from ray_tpu.util.tpu_info import peak_flops_per_chip
 
     if on_tpu:
-        # remat off: MFU accounting is 6N-based and remat's recompute burns
-        # ~1/3 extra uncredited FLOPs; the caller shrinks batch_size on OOM
-        # instead (activations scale linearly with batch)
-        config = models.llama_250m().replace(remat=False)
+        # remat ON (full-layer): the round-4 on-chip sweep
+        # (experiments/mfu_sweep.py) measured remat+batch16+pallas at
+        # 0.203 MFU vs 0.143 for the old no-remat path (which OOMed past
+        # batch 4 — 31G of scanned-layer residuals vs 15.75G HBM). The 6N
+        # MFU accounting stays conservative: remat's recompute FLOPs are
+        # real work the credit ignores.
+        config = models.llama_250m()
         seq = 2048
-        warmup, iters = 3, 10
+        iters = 10
     else:
         config = models.llama_debug()
         batch_size, seq = 4, 128
-        warmup, iters = 2, 5
+        iters = 5
 
     n_dev = jax.device_count()
     helper = TrainLoopHelper.create(
@@ -438,21 +441,22 @@ def _measure(jax, on_tpu: bool, batch_size: int = 16) -> dict:
                         dtype=np.int32)
     batch = {"inputs": toks[:, :-1], "targets": toks[:, 1:]}
 
-    # Force a VALUE TRANSFER (device_get) every step, not just
-    # block_until_ready: on the tunneled axon backend block_until_ready
-    # acks long before execution completes, which round-1 measurements
-    # showed as a physically impossible ~70x-peak "MFU". Pulling the
-    # scalar loss to the host is the only wait that provably spans the
-    # step's execution; its round-trip cost is amortized into dt (noted
-    # in detail as timing_mode).
-    for _ in range(warmup):
-        metrics = helper.run_step(batch)
-        float(jax.device_get(metrics["loss"]))
+    # Timing discipline for the tunneled axon backend: block_until_ready
+    # acks long before execution completes (round-1 measured an impossible
+    # ~70x-peak "MFU" with it), so the wait must be a VALUE TRANSFER
+    # (device_get) of something data-dependent on the work. The inner loop
+    # is a single scanned n-step program (TrainLoopHelper.run_steps) — the
+    # idiomatic TPU loop: one dispatch + one device_get per n steps, and
+    # the returned loss chains through every step's params, so the get
+    # provably spans all n steps.
+    # one warmup call compiles the scanned program AND warms the chip;
+    # the single-step program is never timed, so never compile it
+    metrics = helper.run_steps(batch, iters)
+    float(jax.device_get(metrics["loss"]))
 
     t0 = time.perf_counter()
-    for _ in range(iters):
-        metrics = helper.run_step(batch)
-        float(jax.device_get(metrics["loss"]))
+    metrics = helper.run_steps(batch, iters)
+    float(jax.device_get(metrics["loss"]))
     dt = (time.perf_counter() - t0) / iters
 
     tokens_per_step = batch_size * seq
@@ -477,7 +481,8 @@ def _measure(jax, on_tpu: bool, batch_size: int = 16) -> dict:
             "devices": n_dev,
             "backend": jax.default_backend(),
             "device_kind": getattr(jax.devices()[0], "device_kind", "unknown"),
-            "timing_mode": "per-step device_get (tunnel-safe)",
+            "timing_mode": ("scanned n-step program, single dependent "
+                            "device_get (tunnel-safe)"),
             "loss": float(jax.device_get(metrics["loss"])),
         },
     }

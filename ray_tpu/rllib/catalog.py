@@ -21,22 +21,19 @@ diag-Gaussian head.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from typing import Any, Dict, Optional, Sequence, Tuple
+from dataclasses import dataclass
+from typing import Any, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ray_tpu.rllib.rl_module import _act
+
 # (out_channels, kernel, stride) — the classic Atari stack, same defaults
 # the reference catalog applies to 64x64..96x96 images.
 ATARI_FILTERS: Tuple[Tuple[int, int, int], ...] = (
     (16, 8, 4), (32, 4, 2), (64, 3, 1))
-
-
-def _act(name: str):
-    return {"tanh": jnp.tanh, "relu": jax.nn.relu, "gelu": jax.nn.gelu,
-            "silu": jax.nn.silu}[name]
 
 
 def _dense_init(key, fan_in: int, fan_out: int) -> Dict[str, Any]:

@@ -42,7 +42,7 @@ class RLModuleSpec:
 
 def _act(name: str):
     return {"tanh": jnp.tanh, "relu": jax.nn.relu,
-            "gelu": jax.nn.gelu}[name]
+            "gelu": jax.nn.gelu, "silu": jax.nn.silu}[name]
 
 
 def _mlp_init(key, sizes: Sequence[int]) -> Dict[str, Any]:
