@@ -23,14 +23,15 @@ import time
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 CONFIGS = [
-    # (name, remat, remat_policy, batch, attn_impl)
-    ("remat_full_b16_pallas", True, "full", 16, "pallas"),
-    ("remat_attn_b16_pallas", True, "save_attn", 16, "pallas"),
-    ("remat_full_b32_pallas", True, "full", 32, "pallas"),
-    ("remat_attn_b8_pallas", True, "save_attn", 8, "pallas"),
-    ("noremat_b8_pallas", False, "full", 8, "pallas"),
-    ("remat_full_b16_xla", True, "full", 16, "xla"),
-    ("noremat_b4_pallas", False, "full", 4, "pallas"),
+    # (name, remat, remat_policy, batch, attn_impl, loss_chunk)
+    # round-4 sweep 1 results (no loss_chunk): remat_full_b16_pallas
+    # 0.2027 MFU / remat_attn_b16 0.1968 / remat_attn_b8 0.1947 /
+    # remat_full_b16_xla 0.1078; b32 and no-remat b8 OOMed.
+    ("remat_full_b32_chunk512", True, "full", 32, "pallas", 512),
+    ("remat_full_b16_chunk512", True, "full", 16, "pallas", 512),
+    ("remat_attn_b32_chunk512", True, "save_attn", 32, "pallas", 512),
+    ("remat_full_b64_chunk512", True, "full", 64, "pallas", 512),
+    ("remat_full_b16_pallas", True, "full", 16, "pallas", 0),
 ]
 
 
@@ -53,7 +54,8 @@ def child(cfg: dict) -> None:
     try:
         set_default_attention_impl(cfg["attn"])
         config = models.llama_250m().replace(
-            remat=cfg["remat"], remat_policy=cfg["policy"])
+            remat=cfg["remat"], remat_policy=cfg["policy"],
+            loss_chunk=cfg.get("loss_chunk", 0))
         seq, batch_size = 2048, cfg["batch"]
         helper = TrainLoopHelper.create(
             lambda: models.init_params(jax.random.PRNGKey(0), config),
@@ -66,16 +68,14 @@ def child(cfg: dict) -> None:
         toks = rng.integers(0, config.vocab_size, size=(batch_size, seq + 1),
                             dtype=np.int32)
         batch = {"inputs": toks[:, :-1], "targets": toks[:, 1:]}
-        t0 = time.perf_counter()
-        for _ in range(3):
-            m = helper.run_step(batch)
-            float(jax.device_get(m["loss"]))
-        out["compile_warmup_s"] = round(time.perf_counter() - t0, 1)
         iters = 10
         t0 = time.perf_counter()
-        for _ in range(iters):
-            m = helper.run_step(batch)
-            float(jax.device_get(m["loss"]))
+        m = helper.run_steps(batch, iters)  # compile + warm
+        float(jax.device_get(m["loss"]))
+        out["compile_warmup_s"] = round(time.perf_counter() - t0, 1)
+        t0 = time.perf_counter()
+        m = helper.run_steps(batch, iters)
+        float(jax.device_get(m["loss"]))
         dt = (time.perf_counter() - t0) / iters
         tokens_per_sec = batch_size * seq / dt
         flops_token = config.flops_per_token() + (
@@ -100,11 +100,11 @@ def main() -> int:
         child(json.loads(args.child))
         return 0
     results = []
-    for (name, remat, policy, batch, attn) in CONFIGS:
+    for (name, remat, policy, batch, attn, loss_chunk) in CONFIGS:
         if args.only and name not in args.only.split(","):
             continue
         cfg = {"name": name, "remat": remat, "policy": policy,
-               "batch": batch, "attn": attn}
+               "batch": batch, "attn": attn, "loss_chunk": loss_chunk}
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "axon"
         try:

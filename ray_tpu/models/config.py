@@ -61,6 +61,10 @@ class TransformerConfig:
     remat_policy: str = "full"
     logits_softcap: float = 0.0        # tanh soft-capping (0 = off)
     z_loss: float = 0.0                # output z-loss weight
+    # blockwise LM-head + cross entropy over C-token chunks (0 = off):
+    # avoids materializing the [B, L, V] f32 logits (the largest single
+    # train-step buffer); backward recomputes each chunk under remat
+    loss_chunk: int = 0
 
     @property
     def kv_heads(self) -> int:

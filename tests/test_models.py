@@ -178,3 +178,42 @@ def test_remat_policies_grad_equivalent():
 
     with pytest.raises(ValueError, match="remat_policy"):
         base.replace(remat_policy="save-attention")
+
+
+def test_chunked_xent_matches_dense():
+    """loss_chunk must not change the loss (exact) or grads (beyond bf16
+    accumulation-order noise) — it only changes what backward keeps live."""
+    base = models.llama_debug().replace(z_loss=1e-4, logits_softcap=30.0)
+    toks = np.asarray(np.random.default_rng(0).integers(
+        0, base.vocab_size, (2, 65)), dtype=np.int32)
+    batch = {"inputs": toks[:, :-1], "targets": toks[:, 1:]}
+
+    def loss_grads(cfg):
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        return jax.jit(jax.value_and_grad(
+            lambda p: loss_and_metrics(p, batch, cfg)[0]))(params)
+
+    l_dense, g_dense = loss_grads(base)
+    l_chunk, g_chunk = loss_grads(base.replace(loss_chunk=16))
+    np.testing.assert_allclose(float(l_dense), float(l_chunk), rtol=1e-5)
+
+    def close(a, b):
+        a, b = np.asarray(a, "float32"), np.asarray(b, "float32")
+        denom = max(1e-3, float(abs(b).max()))
+        assert abs(a - b).max() / denom < 5e-3
+
+    jax.tree.map(close, g_dense, g_chunk)
+
+
+def test_chunked_xent_pads_non_divisible_seq():
+    """L not divisible by loss_chunk pads with mask-0 — never a silent
+    dense fallback."""
+    base = models.llama_debug()
+    toks = np.asarray(np.random.default_rng(1).integers(
+        0, base.vocab_size, (2, 65)), dtype=np.int32)
+    batch = {"inputs": toks[:, :-1], "targets": toks[:, 1:]}
+    params = init_params(jax.random.PRNGKey(0), base)
+    l_dense = float(loss_and_metrics(params, batch, base)[0])
+    l_pad = float(loss_and_metrics(
+        params, batch, base.replace(loss_chunk=24))[0])
+    np.testing.assert_allclose(l_dense, l_pad, rtol=1e-5)
