@@ -14,6 +14,15 @@ Each server connection gets a reader thread; request handlers run on a
 shared thread pool so a blocking handler (e.g. a directory wait) never
 stalls the connection. TCP (AF_INET) so the same code carries multi-host;
 tests run everything on localhost.
+
+Wire versioning (reference role: the protobuf schema in
+``src/ray/protobuf/`` gives every message a versioned contract): the
+client's FIRST message is ``("hello", (major, minor))``; the server
+replies ``("hello_ack", (major, minor))``. A major mismatch refuses the
+connection with :class:`WireVersionError` — a clear error at connect
+time instead of an unpickling crash mid-conversation when heterogeneous
+node versions meet. Minor bumps are additive (new methods/fields) and
+interoperate.
 """
 
 from __future__ import annotations
@@ -26,6 +35,17 @@ from concurrent.futures import ThreadPoolExecutor
 from multiprocessing.connection import Client as _MpClient
 from multiprocessing.connection import Listener as _MpListener
 from typing import Any, Callable, Dict, Optional, Tuple
+
+WIRE_VERSION: Tuple[int, int] = (1, 0)
+
+
+class WireVersionError(ConnectionError):
+    """Peer speaks an incompatible wire major version (terminal)."""
+
+
+class WireHandshakeTimeout(ConnectionError):
+    """No handshake ack in time — transient (loaded box, restart herd),
+    NOT a version mismatch; reconnect paths must keep retrying."""
 
 
 def free_port() -> int:
@@ -114,6 +134,33 @@ class ServerConn:
         self.on_close: Optional[Callable[["ServerConn"], None]] = None
 
     def reader_loop(self):
+        # handshake: first message must be a compatible hello
+        try:
+            first = self.raw.recv()
+        except (EOFError, OSError, TypeError, ValueError):
+            first = None
+        try:
+            ok_shape = (isinstance(first, tuple) and len(first) >= 2
+                        and first[0] == "hello")
+            peer_version = tuple(first[1]) if ok_shape else ()
+            ok_shape = ok_shape and len(peer_version) >= 1 and all(
+                isinstance(v, int) for v in peer_version)
+        except TypeError:
+            ok_shape, peer_version = False, ()
+        if not ok_shape:
+            self._send(("hello_nack", WIRE_VERSION,
+                        "expected hello as first message"))
+            self.close()
+            self.server._drop_conn(self)
+            return
+        if peer_version[0] != WIRE_VERSION[0]:
+            self._send(("hello_nack", WIRE_VERSION,
+                        f"wire major {peer_version[0]} != {WIRE_VERSION[0]}"))
+            self.close()
+            self.server._drop_conn(self)
+            return
+        self.meta["wire_version"] = peer_version
+        self._send(("hello_ack", WIRE_VERSION))
         while True:
             try:
                 msg = self.raw.recv()
@@ -160,6 +207,23 @@ class ServerConn:
             pass
 
 
+def _client_handshake(conn, addr: str, timeout: float = 10.0):
+    """Exchange hello/hello_ack; raise :class:`WireVersionError` when the
+    server refuses (major mismatch) or doesn't speak the handshake."""
+    conn.send(("hello", WIRE_VERSION))
+    if not conn.poll(timeout):
+        raise WireHandshakeTimeout(
+            f"server at {addr} sent no handshake ack within {timeout}s")
+    reply = conn.recv()
+    if (not isinstance(reply, tuple) or not reply
+            or reply[0] != "hello_ack"):
+        detail = (reply[2] if isinstance(reply, tuple) and len(reply) > 2
+                  else reply)
+        raise WireVersionError(
+            f"server at {addr} refused wire version {WIRE_VERSION}: {detail}")
+    return tuple(reply[1])
+
+
 class RpcClient:
     """Client with one reader thread demuxing replies and pushes.
 
@@ -180,6 +244,7 @@ class RpcClient:
         self._authkey = authkey
         self._conn = _MpClient((host, port), family="AF_INET",
                                authkey=authkey)
+        self.server_wire_version = _client_handshake(self._conn, addr)
         self._send_lock = threading.Lock()
         self._pending: Dict[int, tuple] = {}  # id -> (event, box)
         self._pending_lock = threading.Lock()
@@ -252,6 +317,15 @@ class RpcClient:
             try:
                 conn = _MpClient(self._hostport, family="AF_INET",
                                  authkey=self._authkey)
+                try:
+                    _client_handshake(conn, self.addr)
+                except WireVersionError:
+                    # a major mismatch won't heal by retrying
+                    try:
+                        conn.close()
+                    except Exception:
+                        pass
+                    return False
                 with self._send_lock:
                     # calls that raced the outage and sent into the dying
                     # socket would otherwise wait out their full timeout

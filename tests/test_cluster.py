@@ -927,3 +927,71 @@ def test_broadcast_replicates_via_relay_tree(cluster):
     assert st and len(st["locations"]) >= 4, st
     # broadcast again: everyone already holds it -> no targets
     assert rexp.broadcast_object(ref) == 0
+
+
+def test_rpc_wire_version_handshake():
+    """Versioned wire contract (reference protobuf schema role): matching
+    majors connect and carry calls; a major mismatch is refused with a
+    clear WireVersionError at connect time."""
+    import threading
+
+    from multiprocessing.connection import Client as MpClient
+    from multiprocessing.connection import Listener
+
+    from ray_tpu.cluster.rpc import (RpcClient, RpcServer, WIRE_VERSION,
+                                     WireVersionError, parse_addr)
+
+    server = RpcServer("127.0.0.1", 0, b"k", lambda m, a, c: ("ok", m, a))
+    try:
+        # happy path: handshake succeeds, calls flow
+        cli = RpcClient(server.addr, b"k")
+        assert cli.server_wire_version == WIRE_VERSION
+        assert cli.call("ping", 1, timeout=10) == ("ok", "ping", (1,))
+        cli.close()
+
+        # server refuses a future-major client with a nack
+        conn = MpClient(parse_addr(server.addr), family="AF_INET",
+                        authkey=b"k")
+        conn.send(("hello", (WIRE_VERSION[0] + 1, 0)))
+        assert conn.poll(10)
+        reply = conn.recv()
+        assert reply[0] == "hello_nack" and "wire major" in reply[2]
+        conn.close()
+    finally:
+        server.close()
+
+    # client raises WireVersionError when the server nacks
+    lst = Listener(("127.0.0.1", 0), family="AF_INET", authkey=b"k")
+
+    def fake_server():
+        c = lst.accept()
+        c.recv()
+        c.send(("hello_nack", (9, 0), "wire major 1 != 9"))
+
+    threading.Thread(target=fake_server, daemon=True).start()
+    try:
+        with pytest.raises(WireVersionError, match="refused"):
+            RpcClient(f"127.0.0.1:{lst.address[1]}", b"k")
+    finally:
+        lst.close()
+
+
+def test_rpc_handshake_malformed_hello_nacked():
+    """('hello', 5) and non-hello first messages get a clean nack — the
+    reader thread must not die with an uncaught TypeError (that leaks the
+    conn and times the peer out with a misleading error)."""
+    from multiprocessing.connection import Client as MpClient
+
+    from ray_tpu.cluster.rpc import RpcServer, parse_addr
+
+    server = RpcServer("127.0.0.1", 0, b"k", lambda m, a, c: None)
+    try:
+        for bad in (("hello", 5), ("hello", ()), ("req", 1, "x", ())):
+            conn = MpClient(parse_addr(server.addr), family="AF_INET",
+                            authkey=b"k")
+            conn.send(bad)
+            assert conn.poll(10)
+            assert conn.recv()[0] == "hello_nack"
+            conn.close()
+    finally:
+        server.close()
