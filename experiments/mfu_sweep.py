@@ -23,15 +23,22 @@ import time
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 CONFIGS = [
-    # (name, remat, remat_policy, batch, attn_impl, loss_chunk)
+    # (name, remat, remat_policy, batch, attn_impl, loss_chunk, env)
     # round-4 sweep 1 results (no loss_chunk): remat_full_b16_pallas
     # 0.2027 MFU / remat_attn_b16 0.1968 / remat_attn_b8 0.1947 /
     # remat_full_b16_xla 0.1078; b32 and no-remat b8 OOMed.
-    ("remat_full_b32_chunk512", True, "full", 32, "pallas", 512),
-    ("remat_full_b16_chunk512", True, "full", 16, "pallas", 512),
-    ("remat_attn_b32_chunk512", True, "save_attn", 32, "pallas", 512),
-    ("remat_full_b64_chunk512", True, "full", 64, "pallas", 512),
-    ("remat_full_b16_pallas", True, "full", 16, "pallas", 0),
+    ("remat_full_b32_chunk512", True, "full", 32, "pallas", 512, {}),
+    ("remat_full_b16_chunk512", True, "full", 16, "pallas", 512, {}),
+    ("remat_attn_b32_chunk512", True, "save_attn", 32, "pallas", 512, {}),
+    ("remat_full_b64_chunk512", True, "full", 64, "pallas", 512, {}),
+    ("remat_full_b16_pallas", True, "full", 16, "pallas", 0, {}),
+    # flash tile sweep (at the best batch/chunk point)
+    ("b32_chunk_blk256", True, "full", 32, "pallas", 512,
+     {"RTPU_ATTN_BLOCK_Q": "256", "RTPU_ATTN_BLOCK_K": "256"}),
+    ("b32_chunk_blk1024", True, "full", 32, "pallas", 512,
+     {"RTPU_ATTN_BLOCK_Q": "1024", "RTPU_ATTN_BLOCK_K": "1024"}),
+    ("b32_chunk_blkq1024k512", True, "full", 32, "pallas", 512,
+     {"RTPU_ATTN_BLOCK_Q": "1024", "RTPU_ATTN_BLOCK_K": "512"}),
 ]
 
 
@@ -100,12 +107,13 @@ def main() -> int:
         child(json.loads(args.child))
         return 0
     results = []
-    for (name, remat, policy, batch, attn, loss_chunk) in CONFIGS:
+    for (name, remat, policy, batch, attn, loss_chunk, extra_env) in CONFIGS:
         if args.only and name not in args.only.split(","):
             continue
         cfg = {"name": name, "remat": remat, "policy": policy,
                "batch": batch, "attn": attn, "loss_chunk": loss_chunk}
         env = dict(os.environ)
+        env.update(extra_env)
         env["JAX_PLATFORMS"] = "axon"
         try:
             proc = subprocess.run(

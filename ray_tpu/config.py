@@ -126,6 +126,12 @@ _knob("serve_max_body", int, 64 << 20,
       "serve/proxy.py")
 
 # -- bench / watch ----------------------------------------------------------
+_knob("attn_block_q", int, 512,
+      "flash-attention query tile (rows per MXU block)",
+      "ray_tpu/models/transformer.py")
+_knob("attn_block_k", int, 512,
+      "flash-attention key/value tile (cols per MXU block)",
+      "ray_tpu/models/transformer.py")
 _knob("bench_child_timeout", float, 420.0,
       "per-attempt timeout for the bench train-step child", "bench.py")
 _knob("bench_retries", int, 3, "bench train-step attempts", "bench.py")

@@ -195,13 +195,18 @@ def _attention(q, k, v, config: TransformerConfig):
             check_vma=False,
         )
         return fn(q, k, v)
+    from ray_tpu import config
     from ray_tpu.ops.attention import flash_attention, resolve_attention_impl
 
     # flash_attention carries the memory-efficient custom VJP: O(L)
     # residuals (out + lse) instead of O(L^2) probability blocks — without
     # it the backward of a scanned-layer model OOMs HBM at long context.
+    # Tile sizes are config knobs (RTPU_ATTN_BLOCK_Q/K) so on-chip sweeps
+    # can tune them without code edits.
     return flash_attention(q, k, v, causal=True,
-                           impl=resolve_attention_impl())
+                           impl=resolve_attention_impl(),
+                           q_block=int(config.get("attn_block_q")),
+                           kv_block=int(config.get("attn_block_k")))
 
 
 def _layers_pipelined(layer_params, x, layer_fn, c, pp, cos, sin):
