@@ -30,16 +30,35 @@ class Cluster:
     def __init__(self, node_timeout_s: float = 3.0,
                  gcs_snapshot: Optional[str] = None):
         self.authkey = uuid.uuid4().hex[:16]
-        self._port = free_port()
-        self.address = f"127.0.0.1:{self._port}"
         self._node_timeout_s = node_timeout_s
         self._gcs_snapshot = gcs_snapshot
         self._procs: List[subprocess.Popen] = []
         self._node_procs: Dict[int, subprocess.Popen] = {}
         self._next_node = 0
-        self._gcs_proc = self._spawn_gcs()
-        self._wait_for_gcs()
-        self._client = RpcClient(self.address, self.authkey.encode())
+        # free_port() is inherently TOCTOU: under a loaded test suite the
+        # chosen port can be grabbed (or still be held by a dying server
+        # from a previous cluster) before our GCS binds it, and the first
+        # client then talks to a foreign listener (observed as OSError
+        # "bad message length" during the auth challenge). First boot has
+        # no published address yet, so just retry on a fresh port.
+        last = None
+        for attempt in range(3):
+            self._port = free_port()
+            self.address = f"127.0.0.1:{self._port}"
+            self._gcs_proc = self._spawn_gcs()
+            try:
+                self._wait_for_gcs()
+                self._client = RpcClient(self.address, self.authkey.encode())
+                return
+            except Exception as e:
+                last = e
+                try:
+                    self._gcs_proc.kill()
+                    self._gcs_proc.wait(timeout=10)
+                except Exception:
+                    pass
+                self._procs.remove(self._gcs_proc)
+        raise RuntimeError(f"cluster GCS failed to boot after 3 ports: {last}")
 
     def _spawn_gcs(self) -> subprocess.Popen:
         cmd = [sys.executable, "-m", "ray_tpu.cluster.gcs_server",

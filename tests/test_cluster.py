@@ -654,7 +654,9 @@ def test_chunked_transfer_bounded_memory(cluster):
     assert nbytes == 256 << 20
 
     size_kb = (256 << 20) // 1024
-    slack_kb = (128 << 20) // 1024
+    # 0.75x slack: the bound catches a whole-blob (2-3x) path, not page
+    # accounting jitter — the suite under load once missed 0.5x by 0.4%
+    slack_kb = (192 << 20) // 1024
     d_src = _vm_hwm_kb(src_pid) - base_src
     d_dst = _vm_hwm_kb(dst_pid) - base_dst
     # serving/receiving touches the object's shm pages once (~size) plus
