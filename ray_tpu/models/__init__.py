@@ -18,6 +18,8 @@ from ray_tpu.models.config import (
     llama_1b,
     llama_250m,
     llama_debug,
+    mistral_7b,
+    mistral_debug,
     gpt2_small,
     gpt2_debug,
     moe_debug,
@@ -41,6 +43,8 @@ __all__ = [
     "llama_1b",
     "llama_250m",
     "llama_debug",
+    "mistral_7b",
+    "mistral_debug",
     "gpt2_small",
     "gpt2_debug",
     "moe_debug",

@@ -45,6 +45,10 @@ class TransformerConfig:
     expert_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
 
+    # sliding-window (local) attention: each token attends to its last N
+    # keys only (0 = full causal). Mistral-style; applies to every layer.
+    sliding_window: int = 0
+
     # pipeline parallelism: microbatch count for the GPipe schedule when
     # the ambient mesh has pp > 1 (0 => 2 * pp, the usual bubble/memory
     # compromise); batch size must divide by it
@@ -178,6 +182,22 @@ def gpt2_debug() -> TransformerConfig:
     )
 
 
+def mistral_7b() -> TransformerConfig:
+    """Mistral-7B-family shape: GQA + 4096-token sliding-window attention."""
+    return TransformerConfig(
+        vocab_size=32000, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+        d_ff=14336, max_seq_len=8192, sliding_window=4096,
+    )
+
+
+def mistral_debug() -> TransformerConfig:
+    """Tiny sliding-window config for tests."""
+    return TransformerConfig(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=128, sliding_window=24, remat=False,
+    )
+
+
 def moe_debug() -> TransformerConfig:
     return TransformerConfig(
         vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=4,
@@ -193,6 +213,8 @@ PRESETS = {
     "llama-debug": llama_debug,
     "gpt2-small": gpt2_small,
     "gpt2-debug": gpt2_debug,
+    "mistral-7b": mistral_7b,
+    "mistral-debug": mistral_debug,
     "moe-debug": moe_debug,
 }
 

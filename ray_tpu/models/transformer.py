@@ -179,6 +179,10 @@ def _pp_axis_size() -> int:
 def _attention(q, k, v, config: TransformerConfig):
     """Training attention: ring over sp when sequence-parallel, else flash."""
     sp = _sp_axis_size()
+    if config.sliding_window and sp > 1:
+        raise NotImplementedError(
+            "sliding_window + sequence-parallel ring attention is not "
+            "supported yet; shard long-window models over fsdp/tp instead")
     if sp > 1 and q.shape[1] % sp == 0 and k.shape[1] % sp == 0:
         from jax import shard_map
         from jax.sharding import PartitionSpec as P
@@ -195,7 +199,7 @@ def _attention(q, k, v, config: TransformerConfig):
             check_vma=False,
         )
         return fn(q, k, v)
-    from ray_tpu import config
+    from ray_tpu import config as _knobs
     from ray_tpu.ops.attention import flash_attention, resolve_attention_impl
 
     # flash_attention carries the memory-efficient custom VJP: O(L)
@@ -205,8 +209,9 @@ def _attention(q, k, v, config: TransformerConfig):
     # can tune them without code edits.
     return flash_attention(q, k, v, causal=True,
                            impl=resolve_attention_impl(),
-                           q_block=int(config.get("attn_block_q")),
-                           kv_block=int(config.get("attn_block_k")))
+                           q_block=int(_knobs.get("attn_block_q")),
+                           kv_block=int(_knobs.get("attn_block_k")),
+                           window=config.sliding_window or None)
 
 
 def _layers_pipelined(layer_params, x, layer_fn, c, pp, cos, sin):
@@ -543,7 +548,8 @@ def decode_step(
             k = apply_rotary(k, cos, sin)
         kc = lax.dynamic_update_slice(kc, k.astype(kc.dtype), (0, pos0, 0, 0))
         vc = lax.dynamic_update_slice(vc, v.astype(vc.dtype), (0, pos0, 0, 0))
-        o = naive_attention(q, kc, vc, causal=True, q_offset=pos0)
+        o = naive_attention(q, kc, vc, causal=True, q_offset=pos0,
+                            window=c.sliding_window or None)
         o = jnp.einsum("blhk,hkd->bld", o, lp["wo"].astype(dt))
         x = x + o
         h = _norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"), c.norm)

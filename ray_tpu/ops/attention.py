@@ -55,6 +55,20 @@ def resolve_attention_impl() -> str:
     return impl
 
 
+def _band_mask(qpos, kpos, causal, window):
+    """[qb, kb] visibility mask for the causal/sliding-window band, or None.
+
+    The ONE definition shared by naive/blockwise/backward paths — forward
+    and backward must never disagree on masking.
+    """
+    if not (causal or window):
+        return None
+    mask = qpos >= kpos
+    if window:
+        mask &= (qpos - kpos) < window
+    return mask
+
+
 def _repeat_kv(k: jax.Array, num_q_heads: int) -> jax.Array:
     """Expand kv heads to match q heads for GQA."""
     kv_heads = k.shape[2]
@@ -73,23 +87,27 @@ def naive_attention(
     causal: bool = True,
     scale: Optional[float] = None,
     q_offset: int = 0,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Materialized-scores attention; numerical reference for tests.
 
     ``q_offset`` shifts q's global positions (used for decode where q is a
-    suffix of the kv sequence).
+    suffix of the kv sequence). ``window`` limits each query to the last
+    ``window`` keys (sliding-window / Mistral-style local attention;
+    requires ``causal``).
     """
+    if window and not causal:
+        raise ValueError("window requires causal attention")
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     k = _repeat_kv(k, q.shape[2])
     v = _repeat_kv(v, q.shape[2])
     # [B, H, Lq, Lk]
     scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
     scores = scores * scale
-    if causal:
+    if causal or window:
         lq, lk = q.shape[1], k.shape[1]
-        q_pos = jnp.arange(lq) + q_offset
-        k_pos = jnp.arange(lk)
-        mask = q_pos[:, None] >= k_pos[None, :]
+        mask = _band_mask(jnp.arange(lq)[:, None] + q_offset,
+                          jnp.arange(lk)[None, :], causal, window)
         scores = jnp.where(mask[None, None], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
@@ -125,12 +143,16 @@ def blockwise_attention(
     q_block: int = 512,
     kv_block: int = 512,
     q_offset: int = 0,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Flash-style attention with online softmax, pure XLA.
 
     Memory is O(q_block * kv_block) per head rather than O(Lq * Lk). Blocks
-    are static so XLA tiles cleanly onto the MXU.
+    are static so XLA tiles cleanly onto the MXU. ``window`` masks each
+    query to its last ``window`` keys (sliding-window attention).
     """
+    if window and not causal:
+        raise ValueError("window requires causal attention")
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     b, lq, h, d = q.shape
     lk = k.shape[1]
@@ -140,7 +162,8 @@ def blockwise_attention(
     kv_block = min(kv_block, lk)
     if lq % q_block or lk % kv_block:
         # Fall back for ragged lengths; decode paths use naive anyway.
-        return naive_attention(q, k, v, causal=causal, scale=scale, q_offset=q_offset)
+        return naive_attention(q, k, v, causal=causal, scale=scale,
+                               q_offset=q_offset, window=window)
     nq, nk = lq // q_block, lk // kv_block
 
     qf = q.astype(jnp.float32).reshape(b, nq, q_block, h, d)
@@ -159,12 +182,8 @@ def blockwise_attention(
         def kv_step(carry, inp):
             m, l, o = carry
             ki, kb, vb = inp
-            if causal:
-                qpos = qi * q_block + q_ids[:, None] + q_offset
-                kpos = ki * kv_block + k_ids[None, :]
-                mask = qpos >= kpos
-            else:
-                mask = None
+            mask = _band_mask(qi * q_block + q_ids[:, None] + q_offset,
+                              ki * kv_block + k_ids[None, :], causal, window)
             m, l, o = _attend_block(qb, kb, vb, m, l, o, mask, scale)
             return (m, l, o), None
 
@@ -200,10 +219,44 @@ def blockwise_attention(
 # jnp.repeat first, whose autodiff sums gradients back over the group.
 
 
-def _mha_fwd_blockwise(q, k, v, causal, scale, q_block, kv_block):
+def _n_live_kv_blocks(nk: int, q_block: int, kv_block: int,
+                      window) -> int:
+    """Static count of kv blocks a q block can see under the window band.
+
+    The visible columns for q block qi span ``q_block + window - 1``
+    positions, which cross at most that many // kv_block + 2 block
+    boundaries. Without a window every block is live.
+    """
+    if not window:
+        return nk
+    return min(nk, (q_block + window - 2) // kv_block + 2)
+
+
+def _live_kv_start(qi, nk: int, n_live: int, q_block: int, kv_block: int,
+                   window):
+    """First live kv block for q block ``qi`` (traced), clamped so the
+    static-length slice stays in range. Clamping only ever EXTENDS
+    coverage (earlier blocks get window-masked; later ones causal-masked),
+    never drops a live block."""
+    if not window:
+        return jnp.int32(0)
+    start = (qi * q_block - (window - 1)) // kv_block
+    return jnp.clip(start, 0, nk - n_live).astype(jnp.int32)
+
+
+def _mha_fwd_blockwise(q, k, v, causal, scale, q_block, kv_block,
+                       window=None):
     """Blockwise forward returning (out, lse). Heads already expanded.
 
     Causal rows always see at least the diagonal key, so lse is finite.
+    With ``window``, only the O(window/kv_block) live kv blocks per q block
+    are scanned (static count, dynamic start) — the SWA FLOP win. A scanned
+    block can still be fully masked for SOME rows: those rows accumulate
+    exp(NEG_INF - NEG_INF) = 1 fake mass per key, which the online-softmax
+    rescale alpha = exp(NEG_INF - m_finite) annihilates to exactly 0 at the
+    first in-band block (every row's diagonal block IS in range). This
+    relies on NEG_INF being a large FINITE negative — -inf would make the
+    rescale exp(-inf - (-inf)) = NaN.
     """
     b, lq, h, d = q.shape
     lk = k.shape[1]
@@ -211,8 +264,10 @@ def _mha_fwd_blockwise(q, k, v, causal, scale, q_block, kv_block):
     qf = q.astype(jnp.float32).reshape(b, nq, q_block, h, d)
     kf = k.astype(jnp.float32).reshape(b, nk, kv_block, h, d)
     vf = v.astype(jnp.float32).reshape(b, nk, kv_block, h, d)
+    kf_s, vf_s = kf.swapaxes(0, 1), vf.swapaxes(0, 1)  # [nk, B, kb, H, D]
     q_ids = jnp.arange(q_block)
     k_ids = jnp.arange(kv_block)
+    n_live = _n_live_kv_blocks(nk, q_block, kv_block, window)
 
     def per_q_block(qi, qb):
         m0 = jnp.full((b, h, q_block), NEG_INF, jnp.float32)
@@ -222,17 +277,16 @@ def _mha_fwd_blockwise(q, k, v, causal, scale, q_block, kv_block):
         def kv_step(carry, inp):
             m, l, o = carry
             ki, kb, vb = inp
-            if causal:
-                mask = (qi * q_block + q_ids[:, None]) >= (
-                    ki * kv_block + k_ids[None, :])
-            else:
-                mask = None
+            mask = _band_mask(qi * q_block + q_ids[:, None],
+                              ki * kv_block + k_ids[None, :], causal, window)
             m, l, o = _attend_block(qb, kb, vb, m, l, o, mask, scale)
             return (m, l, o), None
 
-        (m, l, o), _ = lax.scan(
-            kv_step, (m0, l0, o0),
-            (jnp.arange(nk), kf.swapaxes(0, 1), vf.swapaxes(0, 1)))
+        start = _live_kv_start(qi, nk, n_live, q_block, kv_block, window)
+        idx = start + jnp.arange(n_live)
+        ks = lax.dynamic_slice_in_dim(kf_s, start, n_live, axis=0)
+        vs = lax.dynamic_slice_in_dim(vf_s, start, n_live, axis=0)
+        (m, l, o), _ = lax.scan(kv_step, (m0, l0, o0), (idx, ks, vs))
         lse = m + jnp.log(jnp.maximum(l, 1e-30))        # [B, H, qb]
         return o / l.transpose(0, 2, 1)[..., None], lse
 
@@ -245,7 +299,7 @@ def _mha_fwd_blockwise(q, k, v, causal, scale, q_block, kv_block):
 
 
 def _mha_bwd_blockwise(causal, scale, q_block, kv_block,
-                       q, k, v, out, lse, dout):
+                       q, k, v, out, lse, dout, window=None):
     """Blocked backward; recomputes p per (q-block, kv-block) pair."""
     b, lq, h, d = q.shape
     lk = k.shape[1]
@@ -259,6 +313,8 @@ def _mha_bwd_blockwise(causal, scale, q_block, kv_block,
     q_ids = jnp.arange(q_block)
     k_ids = jnp.arange(kv_block)
 
+    n_live = _n_live_kv_blocks(nk, q_block, kv_block, window)
+
     def q_step(carry, inp):
         dk_acc, dv_acc = carry                     # [nk, B, kb, H, D]
         qi, qb, dob, ob, lseb = inp
@@ -267,10 +323,12 @@ def _mha_bwd_blockwise(causal, scale, q_block, kv_block,
         def kv_step(_, kin):
             ki, kb, vb = kin
             s = jnp.einsum("bqhd,bkhd->bhqk", qb, kb) * scale
-            if causal:
-                mask = (qi * q_block + q_ids[:, None]) >= (
-                    ki * kv_block + k_ids[None, :])
+            mask = _band_mask(qi * q_block + q_ids[:, None],
+                              ki * kv_block + k_ids[None, :], causal, window)
+            if mask is not None:
                 s = jnp.where(mask[None, None], s, NEG_INF)
+            # out-of-band keys: s = NEG_INF, lse finite -> p underflows to
+            # exactly 0 (NEG_INF must stay a finite float for this)
             p = jnp.exp(s - lseb[..., None])       # [B, H, qb, kb]
             dp = jnp.einsum("bqhd,bkhd->bhqk", dob, vb)
             ds = p * (dp - dvec[..., None])
@@ -279,9 +337,24 @@ def _mha_bwd_blockwise(causal, scale, q_block, kv_block,
             dv_c = jnp.einsum("bhqk,bqhd->bkhd", p, dob)
             return None, (dq_c, dk_c, dv_c)
 
-        _, (dq_cs, dk_cs, dv_cs) = lax.scan(
-            kv_step, None, (jnp.arange(nk), kf, vf))
-        return (dk_acc + dk_cs, dv_acc + dv_cs), dq_cs.sum(0)
+        start = _live_kv_start(qi, nk, n_live, q_block, kv_block, window)
+        idx = start + jnp.arange(n_live)
+        ks = lax.dynamic_slice_in_dim(kf, start, n_live, axis=0)
+        vs = lax.dynamic_slice_in_dim(vf, start, n_live, axis=0)
+        _, (dq_cs, dk_cs, dv_cs) = lax.scan(kv_step, None, (idx, ks, vs))
+        if n_live == nk:
+            dk_acc = dk_acc + dk_cs
+            dv_acc = dv_acc + dv_cs
+        else:
+            dk_acc = lax.dynamic_update_slice_in_dim(
+                dk_acc,
+                lax.dynamic_slice_in_dim(dk_acc, start, n_live, 0) + dk_cs,
+                start, 0)
+            dv_acc = lax.dynamic_update_slice_in_dim(
+                dv_acc,
+                lax.dynamic_slice_in_dim(dv_acc, start, n_live, 0) + dv_cs,
+                start, 0)
+        return (dk_acc, dv_acc), dq_cs.sum(0)
 
     zeros_kv = jnp.zeros((nk, b, kv_block, h, d), jnp.float32)
     (dk, dv), dq_blocks = lax.scan(
@@ -293,13 +366,15 @@ def _mha_bwd_blockwise(causal, scale, q_block, kv_block,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _mha(q, k, v, causal, scale, q_block, kv_block, use_pallas):
-    out, _ = _mha_fwd(q, k, v, causal, scale, q_block, kv_block, use_pallas)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _mha(q, k, v, causal, scale, q_block, kv_block, use_pallas, window=None):
+    out, _ = _mha_fwd(q, k, v, causal, scale, q_block, kv_block, use_pallas,
+                      window)
     return out
 
 
-def _mha_fwd(q, k, v, causal, scale, q_block, kv_block, use_pallas):
+def _mha_fwd(q, k, v, causal, scale, q_block, kv_block, use_pallas,
+             window=None):
     """k/v stay at their native (possibly fewer, GQA) head count in the
     residuals — expanding before the VJP would multiply residual HBM by the
     group factor, eroding the O(L) memory win this VJP exists for."""
@@ -313,16 +388,20 @@ def _mha_fwd(q, k, v, causal, scale, q_block, kv_block, use_pallas):
     else:
         h = q.shape[2]
         out, lse = _mha_fwd_blockwise(q, _repeat_kv(k, h), _repeat_kv(v, h),
-                                      causal, scale, q_block, kv_block)
+                                      causal, scale, q_block, kv_block,
+                                      window)
     return out, (q, k, v, out, lse)
 
 
-def _mha_fwd_rule(q, k, v, causal, scale, q_block, kv_block, use_pallas):
-    out, res = _mha_fwd(q, k, v, causal, scale, q_block, kv_block, use_pallas)
+def _mha_fwd_rule(q, k, v, causal, scale, q_block, kv_block, use_pallas,
+                  window=None):
+    out, res = _mha_fwd(q, k, v, causal, scale, q_block, kv_block, use_pallas,
+                        window)
     return out, res
 
 
-def _mha_bwd_rule(causal, scale, q_block, kv_block, use_pallas, res, dout):
+def _mha_bwd_rule(causal, scale, q_block, kv_block, use_pallas, window,
+                  res, dout):
     q, k, v, out, lse = res
     b, lk, hk, d = k.shape
     lq, h = q.shape[1], q.shape[2]
@@ -341,7 +420,7 @@ def _mha_bwd_rule(causal, scale, q_block, kv_block, use_pallas, res, dout):
     else:
         kx, vx = _repeat_kv(k, h), _repeat_kv(v, h)
         dq, dk, dv = _mha_bwd_blockwise(causal, scale, q_block, kv_block,
-                                        q, kx, vx, out, lse, dout)
+                                        q, kx, vx, out, lse, dout, window)
         if hk != h:
             group = h // hk
             dk = dk.reshape(b, lk, hk, group, d).sum(axis=3)
@@ -361,6 +440,7 @@ def flash_attention(
     impl: str = "auto",
     q_block: int = 512,
     kv_block: int = 512,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Dispatching entry point: Pallas kernel on TPU, blockwise XLA elsewhere.
 
@@ -368,22 +448,34 @@ def flash_attention(
     xla run through the memory-efficient custom VJP above, so this is safe
     to differentiate at long context (no O(L^2) residuals).
 
+    ``window`` enables sliding-window (Mistral-style local) attention:
+    each query sees only its last ``window`` keys. Requires ``causal``.
+    Windowed calls run the blockwise-XLA custom-VJP path (the Pallas
+    kernel's block-liveness predicate is causal-only today).
+
     Deliberately NOT jitted here: "auto" must resolve at every trace so a
     later ``set_default_attention_impl`` (e.g. a preflight pinning "xla"
     after Mosaic rejects the kernel) is honored — a jit cache keyed on the
     literal "auto" would replay the stale choice. Callers jit the enclosing
     computation; eager use still compiles the Pallas/blockwise internals.
     """
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal attention")
+        if window <= 0:
+            raise ValueError(f"window must be positive, got {window}")
     if impl == "auto":
         impl = resolve_attention_impl()
     if impl == "naive":
-        return naive_attention(q, k, v, causal=causal)
+        return naive_attention(q, k, v, causal=causal, window=window)
     b, lq, h, d = q.shape
     lk, hk = k.shape[1], k.shape[2]
     q_block = min(q_block, lq)
     kv_block = min(kv_block, lk)
     if lq % q_block or lk % kv_block:
         # ragged lengths: decode paths use naive anyway
-        return naive_attention(q, k, v, causal=causal)
+        return naive_attention(q, k, v, causal=causal, window=window)
     scale = d ** -0.5
-    return _mha(q, k, v, causal, scale, q_block, kv_block, impl == "pallas")
+    use_pallas = impl == "pallas" and window is None
+    return _mha(q, k, v, causal, scale, q_block, kv_block, use_pallas,
+                window)
