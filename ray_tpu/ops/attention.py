@@ -384,7 +384,7 @@ def _mha_fwd(q, k, v, causal, scale, q_block, kv_block, use_pallas,
         # the Pallas kernel handles GQA natively (kv block reuse per group)
         out, lse = flash_attention_pallas_fwd(
             q, k, v, causal=causal, scale=scale,
-            block_q=q_block, block_k=kv_block)
+            block_q=q_block, block_k=kv_block, window=window)
     else:
         h = q.shape[2]
         out, lse = _mha_fwd_blockwise(q, _repeat_kv(k, h), _repeat_kv(v, h),
@@ -416,7 +416,7 @@ def _mha_bwd_rule(causal, scale, q_block, kv_block, use_pallas, window,
 
         dq, dk, dv = flash_attention_pallas_bwd(
             q, k, v, out, lse, dout, causal=causal, scale=scale,
-            block_q=q_block, block_k=kv_block)
+            block_q=q_block, block_k=kv_block, window=window)
     else:
         kx, vx = _repeat_kv(k, h), _repeat_kv(v, h)
         dq, dk, dv = _mha_bwd_blockwise(causal, scale, q_block, kv_block,
@@ -450,8 +450,9 @@ def flash_attention(
 
     ``window`` enables sliding-window (Mistral-style local) attention:
     each query sees only its last ``window`` keys. Requires ``causal``.
-    Windowed calls run the blockwise-XLA custom-VJP path (the Pallas
-    kernel's block-liveness predicate is causal-only today).
+    Both the Pallas kernels (banded block-liveness predicates) and the
+    blockwise-XLA path (live kv-block slicing) skip out-of-band blocks,
+    so SWA costs O(L * window), not O(L^2).
 
     Deliberately NOT jitted here: "auto" must resolve at every trace so a
     later ``set_default_attention_impl`` (e.g. a preflight pinning "xla"
@@ -476,6 +477,5 @@ def flash_attention(
         # ragged lengths: decode paths use naive anyway
         return naive_attention(q, k, v, causal=causal, window=window)
     scale = d ** -0.5
-    use_pallas = impl == "pallas" and window is None
-    return _mha(q, k, v, causal, scale, q_block, kv_block, use_pallas,
-                window)
+    return _mha(q, k, v, causal, scale, q_block, kv_block,
+                impl == "pallas", window)

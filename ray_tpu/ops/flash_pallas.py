@@ -25,8 +25,41 @@ NEG_INF = -1e30
 LANES = 128  # running max / denom stored broadcast over one lane tile
 
 
+def _block_band(qi, ki, block_q: int, block_k: int, causal: bool, window):
+    """(live, band) for one (q block, kv block) pair — the ONE in-kernel
+    definition of the causal/sliding-window band, shared by the forward
+    and both backward kernels so their masking can never diverge.
+
+    ``live``: the block intersects the band at all (predication skips the
+    whole tile otherwise). ``band``: [bq, bk] bool, or None when unmasked.
+    """
+    live = True
+    if causal:
+        live = ki * block_k <= (qi + 1) * block_q - 1
+    if window:
+        live &= qi * block_q - ((ki + 1) * block_k - 1) < window
+    if not (causal or window):
+        return live, None
+    rows = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    cols = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    band = rows >= cols
+    if window:
+        band &= rows - cols < window
+    return live, band
+
+
+def _require_causal_window(causal: bool, window) -> None:
+    if window and not causal:
+        raise ValueError("window requires causal attention")
+    if window is not None and window <= 0:
+        raise ValueError(f"window must be positive, got {window}")
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-                  *, causal: bool, scale: float, block_q: int, block_k: int):
+                  *, causal: bool, scale: float, block_q: int, block_k: int,
+                  window=None):
     qi = pl.program_id(2)
     ki = pl.program_id(3)
     nk = pl.num_programs(3)
@@ -37,11 +70,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    # Causal: the kv block is live iff its first key position is <= the last
-    # query position of this q block.
-    live = True
-    if causal:
-        live = ki * block_k <= (qi + 1) * block_q - 1
+    live, band = _block_band(qi, ki, block_q, block_k, causal, window)
 
     @pl.when(live)
     def _compute():
@@ -52,12 +81,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale                                    # [bq, bk]
-        if causal:
-            rows = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
+        if band is not None:
+            s = jnp.where(band, s, NEG_INF)
         m_prev = m_ref[:, 0:1]                       # [bq, 1]
         l_prev = l_ref[:, 0:1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)   # [bq, 1]
@@ -87,7 +112,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("causal", "scale", "block_q", "block_k", "interpret"),
+    static_argnames=("causal", "scale", "block_q", "block_k", "window",
+                     "interpret"),
 )
 def flash_attention_pallas_fwd(
     q: jax.Array,
@@ -98,6 +124,7 @@ def flash_attention_pallas_fwd(
     scale: Optional[float] = None,
     block_q: int = 512,
     block_k: int = 512,
+    window: Optional[int] = None,
     interpret: bool = False,
 ):
     """Flash attention forward returning ``(out, lse)``.
@@ -106,6 +133,7 @@ def flash_attention_pallas_fwd(
     float32 log-sum-exp per query row, consumed by the memory-efficient
     backward in :mod:`ray_tpu.ops.attention`.
     """
+    _require_causal_window(causal, window)
     b, lq, h, d = q.shape
     lk, hk = k.shape[1], k.shape[2]
     if h % hk:
@@ -118,7 +146,7 @@ def flash_attention_pallas_fwd(
         from ray_tpu.ops.attention import _mha_fwd_blockwise, _repeat_kv
 
         return _mha_fwd_blockwise(q, _repeat_kv(k, h), _repeat_kv(v, h),
-                                  causal, scale, lq, lk)
+                                  causal, scale, lq, lk, window)
     nq, nk = lq // block_q, lk // block_k
 
     qt = q.transpose(0, 2, 1, 3)  # [B, H, Lq, D]
@@ -127,7 +155,7 @@ def flash_attention_pallas_fwd(
 
     kernel = functools.partial(
         _flash_kernel, causal=causal, scale=scale,
-        block_q=block_q, block_k=block_k,
+        block_q=block_q, block_k=block_k, window=window,
     )
     out, lse = pl.pallas_call(
         kernel,
@@ -168,6 +196,7 @@ def flash_attention_pallas(
     scale: Optional[float] = None,
     block_q: int = 512,
     block_k: int = 512,
+    window: Optional[int] = None,
     interpret: bool = False,
 ) -> jax.Array:
     """Forward-only flash attention (inference paths). For training, go
@@ -175,7 +204,8 @@ def flash_attention_pallas(
     the memory-efficient custom VJP."""
     out, _ = flash_attention_pallas_fwd(
         q, k, v, causal=causal, scale=scale,
-        block_q=block_q, block_k=block_k, interpret=interpret)
+        block_q=block_q, block_k=block_k, window=window,
+        interpret=interpret)
     return out
 
 
@@ -194,7 +224,8 @@ def flash_attention_pallas(
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           dk_ref, dv_ref, dk_acc, dv_acc,
                           *, causal: bool, scale: float,
-                          block_q: int, block_k: int, nq: int):
+                          block_q: int, block_k: int, nq: int,
+                          window=None):
     """dK/dV sweep at NATIVE kv-head count: the sequential grid dim walks
     (group, q_block) pairs — ``t = g * nq + qi`` — so each kv head's
     gradients accumulate over every q head of its group without ever
@@ -209,10 +240,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    live = True
-    if causal:
-        # q block contributes iff its last row can see this kv block
-        live = (qi + 1) * block_q - 1 >= ki * block_k
+    live, band = _block_band(qi, ki, block_q, block_k, causal, window)
 
     @pl.when(live)
     def _compute():
@@ -224,12 +252,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0, 0][:, 0:1]               # [bq, 1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        if causal:
-            rows = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
+        if band is not None:
+            s = jnp.where(band, s, NEG_INF)
         p = jnp.exp(s - lse)                          # [bq, bk]
         dv_acc[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -250,7 +274,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          dq_ref, dq_acc,
                          *, causal: bool, scale: float,
-                         block_q: int, block_k: int):
+                         block_q: int, block_k: int, window=None):
     qi = pl.program_id(2)
     ki = pl.program_id(3)
     nk = pl.num_programs(3)
@@ -259,9 +283,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    live = True
-    if causal:
-        live = ki * block_k <= (qi + 1) * block_q - 1
+    live, band = _block_band(qi, ki, block_q, block_k, causal, window)
 
     @pl.when(live)
     def _compute():
@@ -273,12 +295,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0, 0][:, 0:1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        if causal:
-            rows = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
+        if band is not None:
+            s = jnp.where(band, s, NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -294,7 +312,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("causal", "scale", "block_q", "block_k", "interpret"),
+    static_argnames=("causal", "scale", "block_q", "block_k", "window",
+                     "interpret"),
 )
 def flash_attention_pallas_bwd(
     q: jax.Array,
@@ -308,6 +327,7 @@ def flash_attention_pallas_bwd(
     scale: Optional[float] = None,
     block_q: int = 512,
     block_k: int = 512,
+    window: Optional[int] = None,
     interpret: bool = False,
 ):
     """Backward pass. ``q``/``out``/``dout``: [B, Lq, H, D]; ``k``/``v``
@@ -315,6 +335,7 @@ def flash_attention_pallas_bwd(
     D] — dk/dv come back at that count with the per-group accumulation
     done in-kernel, so GQA pays no group-factor HBM for transients
     (ADVICE r2 #5). ``lse``: [B, H, Lq]. Returns (dq, dk, dv)."""
+    _require_causal_window(causal, window)
     b, lq, h, d = q.shape
     lk, hk = k.shape[1], k.shape[2]
     if h % hk:
@@ -343,7 +364,7 @@ def flash_attention_pallas_bwd(
 
     dkv_kernel = functools.partial(
         _flash_bwd_dkv_kernel, causal=causal, scale=scale,
-        block_q=block_q, block_k=block_k, nq=nq)
+        block_q=block_q, block_k=block_k, nq=nq, window=window)
     dk_t, dv_t = pl.pallas_call(
         dkv_kernel,
         grid=(b, hk, nk, nq * group),
@@ -376,7 +397,7 @@ def flash_attention_pallas_bwd(
 
     dq_kernel = functools.partial(
         _flash_bwd_dq_kernel, causal=causal, scale=scale,
-        block_q=block_q, block_k=block_k)
+        block_q=block_q, block_k=block_k, window=window)
     dq_t = pl.pallas_call(
         dq_kernel,
         grid=(b, h, nq, nk),
