@@ -249,6 +249,36 @@ def numerics_child() -> None:
         out.update(errs)
         impl_ok[impl] = all(e < tol for e in errs.values())
 
+    # Sliding-window (banded-liveness) kernels: fwd+bwd vs the same naive
+    # reference with a window mask (round-4 addition — validates the
+    # _block_band predicates on real Mosaic, not just interpret mode).
+    if not small and impl_ok.get("pallas"):
+        try:
+            def wloss(q, k, v, impl):
+                o = flash_attention(q, k, v, causal=True, impl=impl,
+                                    window=S // 4)
+                return (o.astype(jnp.float32) * w.astype(jnp.float32)).sum()
+
+            errs = {}
+            grads_ref = None
+            for impl in ("naive", "pallas"):
+                val, grads = jax.jit(
+                    jax.value_and_grad(wloss, argnums=(0, 1, 2)),
+                    static_argnames=("impl",))(q, k, v, impl=impl)
+                jax.device_get(val)
+                if grads_ref is None:
+                    grads_ref = (val, grads)
+                else:
+                    errs["window_fwd_rel_err"] = max_err(val, grads_ref[0])
+                    for name, a, b in zip(("dq", "dk", "dv"), grads,
+                                          grads_ref[1]):
+                        errs[f"window_{name}_rel_err"] = max_err(a, b)
+            out.update(errs)
+            out["window_ok"] = all(e < tol for e in errs.values())
+        except Exception as e:
+            out["window_ok"] = False
+            out["window_error"] = str(e)[-300:]
+
     # Long-seq bwd: at S=16384, B=4, H=8 the naive per-layer probability
     # residual alone is B*H*S^2*4B = 32 GiB — over the 16 GiB HBM. The
     # memory-efficient VJP must sustain it.
