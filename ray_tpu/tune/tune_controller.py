@@ -316,9 +316,13 @@ class TuneController:
 
 
 class ResultGrid:
-    def __init__(self, trials: List[Trial], exp_dir: str):
+    def __init__(self, trials: List[Trial], exp_dir: str,
+                 default_metric: Optional[str] = None,
+                 default_mode: Optional[str] = None):
         self._trials = trials
         self.experiment_path = exp_dir
+        self._default_metric = default_metric
+        self._default_mode = default_mode
 
     def __len__(self):
         return len(self._trials)
@@ -338,7 +342,15 @@ class ResultGrid:
     def errors(self) -> List[BaseException]:
         return [t.error for t in self._trials if t.error]
 
-    def get_best_result(self, metric: str, mode: str = "min") -> Result:
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> Result:
+        """Best trial by ``metric``/``mode``; both default to the values
+        set on ``TuneConfig`` (reference ``ResultGrid.get_best_result``)."""
+        metric = metric or self._default_metric
+        mode = mode or self._default_mode or "min"
+        if metric is None:
+            raise ValueError(
+                "no metric: pass one or set TuneConfig(metric=...)")
         scored = [(i, t.last_result.get(metric)) for i, t in
                   enumerate(self._trials) if metric in t.last_result]
         if not scored:

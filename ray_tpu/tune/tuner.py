@@ -101,7 +101,8 @@ class Tuner:
 
             controller.scheduler.on_trial_complete = observe
         trials = controller.run()
-        return ResultGrid(trials, controller.exp_dir)
+        return ResultGrid(trials, controller.exp_dir,
+                          default_metric=tc.metric, default_mode=tc.mode)
 
 
 def run(
