@@ -462,6 +462,24 @@ class GcsService:
             return {"status": o.status, "inline": o.inline, "error": o.error,
                     "size": o.size, "locations": list(o.locations)}
 
+    def rpc_obj_list(self, ctx, limit: int = 10000):
+        """Object-directory dump for ``ray_tpu memory`` (reference
+        ``ray memory`` refcount-dump role, ``scripts.py:1941``): per-object
+        status, size, pin count (distributed refcount holders), and
+        location count."""
+        out = []
+        with self.lock:
+            for oid, o in list(self.objects.items())[:limit]:
+                out.append({
+                    "object_id": oid.hex(),
+                    "status": o.status,
+                    "size": o.size,
+                    "inline": o.inline is not None,
+                    "pins": len(o.pins),
+                    "locations": len(o.locations),
+                })
+        return out
+
     def rpc_obj_drop(self, ctx, oid: bytes):
         with self.lock:
             self.objects.pop(oid, None)

@@ -70,6 +70,41 @@ def _cmd_timeline(args) -> int:
     return 0
 
 
+def _cmd_memory(args) -> int:
+    """Object-store refcount dump (reference ``ray memory`` role). With
+    --address, dumps the cluster GCS object directory; otherwise dumps the
+    in-process driver's view (requires an active session)."""
+    rows = None
+    if args.address:
+        from ray_tpu.cluster.rpc import RpcClient
+
+        cli = RpcClient(args.address, args.authkey.encode())
+        try:
+            rows = cli.call("obj_list", args.limit, timeout=30)
+        finally:
+            cli.close()
+    else:
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            print("no active session; pass --address <gcs> --authkey <key> "
+                  "to inspect a cluster, or run inside a driver")
+            return 1
+        from ray_tpu.util.state import list_objects
+
+        rows = [dict(r, pins="-", locations="-")
+                for r in list_objects()[:args.limit]]
+    total = sum(r["size"] or 0 for r in rows)
+    print(f"{'OBJECT_ID':34} {'STATUS':8} {'SIZE':>12} {'PINS':>5} "
+          f"{'LOCS':>5}")
+    for r in sorted(rows, key=lambda r: -(r["size"] or 0)):
+        print(f"{r['object_id'][:32]:34} {r['status']:8} "
+              f"{r['size'] or 0:>12} {r.get('pins', '-'):>5} "
+              f"{r.get('locations', '-'):>5}")
+    print(f"-- {len(rows)} objects, {total / 1e6:.1f} MB total")
+    return 0
+
+
 def _cmd_bench(args) -> int:
     if getattr(args, "watch", False):
         from ray_tpu.util import tpu_watch
@@ -232,6 +267,14 @@ def main(argv=None) -> int:
     tl = sub.add_parser("timeline", help="export chrome trace")
     tl.add_argument("--output", "-o", default=None)
 
+    mem = sub.add_parser("memory", help="object-store refcount dump "
+                                        "(reference `ray memory` role)")
+    mem.add_argument("--address", default=None,
+                     help="GCS address host:port (cluster mode)")
+    mem.add_argument("--authkey", default="",
+                     help="cluster authkey (with --address)")
+    mem.add_argument("--limit", type=int, default=10000)
+
     st = sub.add_parser("stack", help="dump python stacks of live workers")
     st.add_argument("--limit", type=int, default=16)
 
@@ -286,6 +329,8 @@ def main(argv=None) -> int:
         return _cmd_bench(args)
     if args.cmd == "timeline":
         return _cmd_timeline(args)
+    if args.cmd == "memory":
+        return _cmd_memory(args)
     if args.cmd == "stack":
         return _cmd_stack(args)
     if args.cmd == "up":

@@ -997,3 +997,21 @@ def test_rpc_handshake_malformed_hello_nacked():
             conn.close()
     finally:
         server.close()
+
+
+def test_memory_dump_lists_cluster_objects(cluster):
+    """`ray_tpu memory` / GCS obj_list: directory dump with pin counts
+    (reference `ray memory` refcount-dump role)."""
+    _init(cluster)
+    refs = [ray_tpu.put(np.ones(1 << 15)) for _ in range(3)]
+    from ray_tpu.cluster.rpc import RpcClient
+
+    cli = RpcClient(cluster.address, cluster.authkey.encode())
+    try:
+        rows = cli.call("obj_list", 100, timeout=30)
+    finally:
+        cli.close()
+    big = [r for r in rows if (r["size"] or 0) >= (1 << 15) * 8]
+    assert len(big) >= 3
+    assert all(r["pins"] >= 1 and r["status"] == "READY" for r in big)
+    del refs
