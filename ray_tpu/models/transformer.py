@@ -502,10 +502,18 @@ def _chunked_xent(params, inputs, targets, mask, c: TransformerConfig):
 # ---------------------------------------------------------------------------
 
 def init_cache(config: TransformerConfig, batch: int, max_len: int,
-               dtype=None) -> Params:
+               dtype=None, rolling: Optional[bool] = None) -> Params:
+    """KV cache. With ``sliding_window`` set and smaller than ``max_len``,
+    the cache is a RING of ``sliding_window`` slots (Mistral-style): HBM
+    stays O(window) no matter how long generation runs — the serving
+    memory win SWA exists for. ``rolling=False`` forces the full-length
+    layout (needed when a single prefill chunk exceeds the window)."""
     c = config
     dt = jnp.dtype(dtype or c.dtype)
-    shape = (c.n_layers, batch, max_len, c.kv_heads, c.hdim)
+    use_ring = (bool(c.sliding_window) and c.sliding_window < max_len
+                if rolling is None else rolling)
+    length = c.sliding_window if use_ring else max_len
+    shape = (c.n_layers, batch, length, c.kv_heads, c.hdim)
     return {
         "k": jnp.zeros(shape, dt),
         "v": jnp.zeros(shape, dt),
@@ -527,6 +535,16 @@ def decode_step(
     b, t = tokens.shape
     pos0 = cache["pos"]
     positions = pos0 + jnp.arange(t)
+    cache_len = cache["k"].shape[2]
+    # ring layout iff the cache was allocated at exactly the window size
+    # (init_cache's rolling mode); slots are kept oldest->newest by
+    # rolling, so slot j holds absolute position pos_new - cache_len + j
+    is_ring = bool(c.sliding_window) and cache_len == c.sliding_window
+    if is_ring and t > cache_len:
+        raise ValueError(
+            f"prefill chunk {t} exceeds the ring cache ({cache_len}); "
+            "feed the prompt in <=window chunks or init_cache(..., "
+            "rolling=False)")
 
     x = params["embed"].astype(dt)[tokens]
     if c.positions == "learned":
@@ -546,10 +564,52 @@ def decode_step(
         if cos is not None:
             q = apply_rotary(q, cos, sin)
             k = apply_rotary(k, cos, sin)
-        kc = lax.dynamic_update_slice(kc, k.astype(kc.dtype), (0, pos0, 0, 0))
-        vc = lax.dynamic_update_slice(vc, v.astype(vc.dtype), (0, pos0, 0, 0))
-        o = naive_attention(q, kc, vc, causal=True, q_offset=pos0,
-                            window=c.sliding_window or None)
+        if is_ring:
+            # MODULAR ring layout everywhere: position p lives in slot
+            # p % W; slot s holds the largest p ≡ s (mod W) written so
+            # far (negative = unfilled). Keys are stored already-rotated
+            # at absolute positions, and softmax is permutation-invariant
+            # over keys, so only the MASK needs positions — which
+            # naive_attention takes per-slot via ``k_positions``.
+            if t == 1:
+                # hot decode loop: ONE slot write, no roll/concat copies.
+                # The overwritten slot held pos0 - W — out-of-window for
+                # this query — so writing before attending is safe.
+                slot = pos0 % cache_len
+                kc = lax.dynamic_update_slice(kc, k.astype(kc.dtype),
+                                              (0, slot, 0, 0))
+                vc = lax.dynamic_update_slice(vc, v.astype(vc.dtype),
+                                              (0, slot, 0, 0))
+                slot_pos = pos0 - (
+                    (slot - jnp.arange(cache_len)) % cache_len)
+                o = naive_attention(q, kc, vc, causal=True, q_offset=pos0,
+                                    window=c.sliding_window,
+                                    k_positions=slot_pos)
+            else:
+                # chunked prefill: attend over old ring ++ new keys
+                # BEFORE evicting — a key evicted by the END of this
+                # chunk can still be in-window for its EARLY queries
+                prev = pos0 - 1
+                slot_pos_old = prev - (
+                    ((prev % cache_len) - jnp.arange(cache_len))
+                    % cache_len)
+                k_all = jnp.concatenate([kc, k.astype(kc.dtype)], axis=1)
+                v_all = jnp.concatenate([vc, v.astype(vc.dtype)], axis=1)
+                pos_all = jnp.concatenate([slot_pos_old, positions])
+                o = naive_attention(q, k_all, v_all, causal=True,
+                                    q_offset=pos0,
+                                    window=c.sliding_window,
+                                    k_positions=pos_all)
+                idx = positions % cache_len
+                kc = kc.at[:, idx].set(k.astype(kc.dtype))
+                vc = vc.at[:, idx].set(v.astype(vc.dtype))
+        else:
+            kc = lax.dynamic_update_slice(kc, k.astype(kc.dtype),
+                                          (0, pos0, 0, 0))
+            vc = lax.dynamic_update_slice(vc, v.astype(vc.dtype),
+                                          (0, pos0, 0, 0))
+            o = naive_attention(q, kc, vc, causal=True, q_offset=pos0,
+                                window=c.sliding_window or None)
         o = jnp.einsum("blhk,hkd->bld", o, lp["wo"].astype(dt))
         x = x + o
         h = _norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"), c.norm)
@@ -594,7 +654,18 @@ def generate(
     b, p = prompt.shape
     total = max_len or min(config.max_seq_len, p + max_new_tokens)
     cache = init_cache(config, b, total)
-    logits, cache = decode_step(params, cache, prompt, config)
+    w = config.sliding_window
+    if w and cache["k"].shape[2] == w and p > w:
+        # ring cache + long prompt: prefill in window-sized chunks so HBM
+        # stays O(window) even for prompts far beyond it (the long-context
+        # serving case SWA exists for); the tail chunk keeps its own
+        # compiled shape
+        logits = None
+        for i in range(0, p, w):
+            logits, cache = decode_step(params, cache, prompt[:, i:i + w],
+                                        config)
+    else:
+        logits, cache = decode_step(params, cache, prompt, config)
     last = logits[:, -1]
 
     def sample(logits, key):

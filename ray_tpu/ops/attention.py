@@ -88,26 +88,41 @@ def naive_attention(
     scale: Optional[float] = None,
     q_offset: int = 0,
     window: Optional[int] = None,
+    k_offset=0,
+    k_positions: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Materialized-scores attention; numerical reference for tests.
 
     ``q_offset`` shifts q's global positions (used for decode where q is a
     suffix of the kv sequence). ``window`` limits each query to the last
-    ``window`` keys (sliding-window / Mistral-style local attention;
-    requires ``causal``).
+    ``window`` keys (sliding-window / Mistral-style local attention).
+    Ring KV caches position their keys explicitly: ``k_offset`` maps slot
+    j to global position k_offset + j, or ``k_positions`` gives each slot
+    an arbitrary global position; either way negative positions mean
+    "slot not filled yet" and are masked. All three features require
+    ``causal`` (they are defined in terms of the causal band).
     """
-    if window and not causal:
-        raise ValueError("window requires causal attention")
+    has_koff = (k_positions is not None
+                or not (isinstance(k_offset, int) and k_offset == 0))
+    if (window or has_koff) and not causal:
+        raise ValueError(
+            "window / ring key positions require causal attention")
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     k = _repeat_kv(k, q.shape[2])
     v = _repeat_kv(v, q.shape[2])
     # [B, H, Lq, Lk]
     scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
     scores = scores * scale
-    if causal or window:
+    if causal or window or has_koff:
         lq, lk = q.shape[1], k.shape[1]
+        if k_positions is not None:
+            k_pos = k_positions
+        else:
+            k_pos = jnp.arange(lk) + k_offset
         mask = _band_mask(jnp.arange(lq)[:, None] + q_offset,
-                          jnp.arange(lk)[None, :], causal, window)
+                          k_pos[None, :], causal, window)
+        if has_koff:
+            mask &= (k_pos >= 0)[None, :]
         scores = jnp.where(mask[None, None], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))

@@ -233,13 +233,18 @@ def test_mistral_sliding_window_trains_and_decodes():
     assert np.isfinite(float(loss))
     assert np.isfinite(float(optax_global_norm(grads)))
 
-    # decode parity: windowed prefill+decode equals windowed full forward
+    # decode parity: windowed prefill+decode equals windowed full forward.
+    # The cache is auto-RING (24 slots for window 24), so the 48-token
+    # prompt prefills in two window-sized chunks.
     from ray_tpu.models.transformer import decode_step, forward, init_cache
 
     prompt = toks[:1, :48]
     logits_full, _ = forward(params, prompt, c)
     cache = init_cache(c, 1, 64)
-    logits_dec, cache = decode_step(params, cache, prompt, c)
+    assert cache["k"].shape[2] == c.sliding_window
+    logits_dec = None
+    for i in range(0, 48, 24):
+        logits_dec, cache = decode_step(params, cache, prompt[:, i:i + 24], c)
     np.testing.assert_allclose(
         np.asarray(logits_dec[:, -1], np.float32),
         np.asarray(logits_full[:, -1], np.float32), atol=2e-2, rtol=2e-2)
@@ -249,3 +254,63 @@ def optax_global_norm(tree):
     import optax
 
     return optax.global_norm(tree)
+
+
+def test_rolling_kv_cache_matches_full_cache():
+    """Sliding-window ring cache (O(window) HBM) must produce the same
+    logits as the full-length cache at every decode step, including far
+    past the window."""
+    from ray_tpu.models.transformer import decode_step, init_cache
+
+    c = models.mistral_debug()  # window 24
+    params = init_params(jax.random.PRNGKey(0), c)
+    prompt = jnp.asarray(np.random.default_rng(0).integers(
+        0, c.vocab_size, (2, 16)), jnp.int32)
+
+    full = init_cache(c, 2, 64, rolling=False)
+    ring = init_cache(c, 2, 64)
+    assert full["k"].shape[2] == 64 and ring["k"].shape[2] == 24
+
+    lf, full = decode_step(params, full, prompt, c)
+    lr, ring = decode_step(params, ring, prompt, c)
+    np.testing.assert_allclose(np.asarray(lf, np.float32),
+                               np.asarray(lr, np.float32),
+                               atol=1e-3, rtol=1e-2)
+    step_full = jax.jit(lambda cc, t: decode_step(params, cc, t, c))
+    step_ring = jax.jit(lambda cc, t: decode_step(params, cc, t, c))
+    tok = jnp.argmax(lf[:, -1], -1).astype(jnp.int32)[:, None]
+    for i in range(40):
+        lf, full = step_full(full, tok)
+        lr, ring = step_ring(ring, tok)
+        np.testing.assert_allclose(np.asarray(lf, np.float32),
+                                   np.asarray(lr, np.float32),
+                                   atol=1e-3, rtol=1e-2, err_msg=f"step {i}")
+        tok = jnp.argmax(lf[:, -1], -1).astype(jnp.int32)[:, None]
+
+    # a prefill chunk larger than the ring is rejected loudly
+    import pytest as _pytest
+
+    big = jnp.zeros((2, 30), jnp.int32)
+    with _pytest.raises(ValueError, match="ring cache"):
+        decode_step(params, init_cache(c, 2, 64), big, c)
+
+
+def test_generate_ring_prefill_long_prompt():
+    """generate() keeps the O(window) ring even for prompts beyond the
+    window (chunked prefill) and matches full-cache greedy decoding."""
+    from ray_tpu.models.transformer import decode_step, generate, init_cache
+
+    c = models.mistral_debug()  # window 24
+    params = init_params(jax.random.PRNGKey(0), c)
+    prompt = jnp.asarray(np.random.default_rng(0).integers(
+        0, c.vocab_size, (1, 40)), jnp.int32)
+    out_ring = generate(params, prompt, c, max_new_tokens=6)
+
+    cache = init_cache(c, 1, 64, rolling=False)
+    logits, cache = decode_step(params, cache, prompt, c)
+    toks = [int(jnp.argmax(logits[0, -1], -1))]
+    for _ in range(5):
+        nxt = jnp.asarray([[toks[-1]]], jnp.int32)
+        logits, cache = decode_step(params, cache, nxt, c)
+        toks.append(int(jnp.argmax(logits[0, -1], -1)))
+    assert list(np.asarray(out_ring)[0, 40:]) == toks
