@@ -127,7 +127,7 @@ class DataIterator:
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            data_axes = tuple(a for a in ("dp", "fsdp")
+            data_axes = tuple(a for a in ("dcn", "dp", "fsdp")
                               if a in mesh.axis_names)
             sharding = NamedSharding(mesh, P(data_axes or None))
 

@@ -191,7 +191,8 @@ def _attention(q, k, v, config: TransformerConfig):
         from ray_tpu.ops.ring_attention import ring_attention
 
         mesh = get_abstract_mesh()
-        batch = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
+        batch = tuple(a for a in ("dcn", "dp", "fsdp")
+                      if a in mesh.axis_names)
         qspec = P(batch or None, "sp", "tp" if "tp" in mesh.axis_names else None, None)
         fn = shard_map(
             functools.partial(ring_attention, axis="sp", causal=True),

@@ -44,14 +44,14 @@ class ShardingRules:
 # heads + ffn hidden over tp; the model ("embed") dim of weights over fsdp so
 # params/grads/opt-state are ZeRO-3 sharded; experts over ep.
 DEFAULT_RULES = ShardingRules({
-    "batch": ("dp", "fsdp"),
+    "batch": ("dcn", "dp", "fsdp"),
     "seq": "sp",
     # flattened batch*seq (row-major, batch outer). Matches the
     # ("batch", "seq") device layout exactly when sp == 1 or the
     # per-device batch block is 1; otherwise a reshard to/from it is one
     # all-to-all (the MoE dispatch path pays that instead of the SPMD
     # partitioner's full rematerialization)
-    "tokens": ("dp", "fsdp", "sp"),
+    "tokens": ("dcn", "dp", "fsdp", "sp"),
     "embed": "fsdp",
     "heads": "tp",
     "kv_heads": "tp",

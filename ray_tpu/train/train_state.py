@@ -159,7 +159,7 @@ class TrainLoopHelper:
         return cls(mesh=mesh, state=state, step_fn=step_fn, rules=rules)
 
     def batch_sharding(self) -> NamedSharding:
-        batch_axes = tuple(a for a in ("dp", "fsdp")
+        batch_axes = tuple(a for a in ("dcn", "dp", "fsdp")
                            if a in self.mesh.axis_names)
         return NamedSharding(self.mesh, P(batch_axes or None))
 

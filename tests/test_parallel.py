@@ -34,7 +34,8 @@ def test_make_mesh_axes():
 
 
 def test_logical_to_spec():
-    assert logical_to_spec(["batch", "seq", "embed"]) == P(("dp", "fsdp"), "sp", "fsdp")
+    assert logical_to_spec(["batch", "seq", "embed"]) == P(
+        ("dcn", "dp", "fsdp"), "sp", "fsdp")
     assert logical_to_spec(["embed", "heads", None]) == P("fsdp", "tp", None)
 
 
