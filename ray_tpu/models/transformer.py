@@ -179,23 +179,34 @@ def _pp_axis_size() -> int:
 def _attention(q, k, v, config: TransformerConfig):
     """Training attention: ring over sp when sequence-parallel, else flash."""
     sp = _sp_axis_size()
-    if config.sliding_window and sp > 1:
-        raise NotImplementedError(
-            "sliding_window + sequence-parallel ring attention is not "
-            "supported yet; shard long-window models over fsdp/tp instead")
     if sp > 1 and q.shape[1] % sp == 0 and k.shape[1] % sp == 0:
         from jax import shard_map
         from jax.sharding import PartitionSpec as P
         from jax.sharding import get_abstract_mesh
 
-        from ray_tpu.ops.ring_attention import ring_attention
+        from ray_tpu.ops.ring_attention import (ring_attention,
+                                                sliding_window_attention_sp)
 
         mesh = get_abstract_mesh()
         batch = tuple(a for a in ("dcn", "dp", "fsdp")
                       if a in mesh.axis_names)
         qspec = P(batch or None, "sp", "tp" if "tp" in mesh.axis_names else None, None)
+        if config.sliding_window:
+            # windowed + sequence-parallel: halo exchange (one ppermute of
+            # the neighbor shard) instead of the full ring — O(1) comm
+            if config.sliding_window > q.shape[1] // sp:
+                raise NotImplementedError(
+                    f"sliding_window {config.sliding_window} exceeds the "
+                    f"per-shard sequence {q.shape[1] // sp} (sp={sp}); "
+                    "lower sp or raise seq/sp")
+            inner = functools.partial(sliding_window_attention_sp,
+                                      axis="sp",
+                                      window=config.sliding_window)
+        else:
+            inner = functools.partial(ring_attention, axis="sp",
+                                      causal=True)
         fn = shard_map(
-            functools.partial(ring_attention, axis="sp", causal=True),
+            inner,
             mesh=mesh, in_specs=(qspec, qspec, qspec), out_specs=qspec,
             check_vma=False,
         )

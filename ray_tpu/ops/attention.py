@@ -248,19 +248,21 @@ def _n_live_kv_blocks(nk: int, q_block: int, kv_block: int,
 
 
 def _live_kv_start(qi, nk: int, n_live: int, q_block: int, kv_block: int,
-                   window):
+                   window, pos_delta: int = 0):
     """First live kv block for q block ``qi`` (traced), clamped so the
     static-length slice stays in range. Clamping only ever EXTENDS
     coverage (earlier blocks get window-masked; later ones causal-masked),
-    never drops a live block."""
+    never drops a live block. ``pos_delta`` = (global q position of local
+    q index 0) - (global k position of local k index 0) for the affine
+    positional path (halo SP: delta = Lloc)."""
     if not window:
         return jnp.int32(0)
-    start = (qi * q_block - (window - 1)) // kv_block
+    start = (qi * q_block + pos_delta - (window - 1)) // kv_block
     return jnp.clip(start, 0, nk - n_live).astype(jnp.int32)
 
 
 def _mha_fwd_blockwise(q, k, v, causal, scale, q_block, kv_block,
-                       window=None):
+                       window=None, qpos=None, kpos=None, pos_delta=None):
     """Blockwise forward returning (out, lse). Heads already expanded.
 
     Causal rows always see at least the diagonal key, so lse is finite.
@@ -282,7 +284,11 @@ def _mha_fwd_blockwise(q, k, v, causal, scale, q_block, kv_block,
     kf_s, vf_s = kf.swapaxes(0, 1), vf.swapaxes(0, 1)  # [nk, B, kb, H, D]
     q_ids = jnp.arange(q_block)
     k_ids = jnp.arange(kv_block)
-    n_live = _n_live_kv_blocks(nk, q_block, kv_block, window)
+    # explicit position arrays keep the windowed live-block slicing as
+    # long as the caller declares their affine delta (halo SP passes
+    # Lloc); arbitrary non-affine positions fall back to the full scan
+    n_live = (nk if (kpos is not None and pos_delta is None)
+              else _n_live_kv_blocks(nk, q_block, kv_block, window))
 
     def per_q_block(qi, qb):
         m0 = jnp.full((b, h, q_block), NEG_INF, jnp.float32)
@@ -292,12 +298,22 @@ def _mha_fwd_blockwise(q, k, v, causal, scale, q_block, kv_block,
         def kv_step(carry, inp):
             m, l, o = carry
             ki, kb, vb = inp
-            mask = _band_mask(qi * q_block + q_ids[:, None],
-                              ki * kv_block + k_ids[None, :], causal, window)
+            qp = (qi * q_block + q_ids if qpos is None
+                  else lax.dynamic_slice_in_dim(qpos, qi * q_block, q_block))
+            kp = (ki * kv_block + k_ids if kpos is None
+                  else lax.dynamic_slice_in_dim(kpos, ki * kv_block,
+                                                kv_block))
+            mask = _band_mask(qp[:, None], kp[None, :], causal, window)
+            if kpos is not None and mask is not None:
+                mask &= (kp >= 0)[None, :]
             m, l, o = _attend_block(qb, kb, vb, m, l, o, mask, scale)
             return (m, l, o), None
 
-        start = _live_kv_start(qi, nk, n_live, q_block, kv_block, window)
+        if kpos is not None and pos_delta is None:
+            start = jnp.int32(0)
+        else:
+            start = _live_kv_start(qi, nk, n_live, q_block, kv_block,
+                                   window, pos_delta or 0)
         idx = start + jnp.arange(n_live)
         ks = lax.dynamic_slice_in_dim(kf_s, start, n_live, axis=0)
         vs = lax.dynamic_slice_in_dim(vf_s, start, n_live, axis=0)
@@ -314,7 +330,8 @@ def _mha_fwd_blockwise(q, k, v, causal, scale, q_block, kv_block,
 
 
 def _mha_bwd_blockwise(causal, scale, q_block, kv_block,
-                       q, k, v, out, lse, dout, window=None):
+                       q, k, v, out, lse, dout, window=None,
+                       qpos=None, kpos=None, pos_delta=None):
     """Blocked backward; recomputes p per (q-block, kv-block) pair."""
     b, lq, h, d = q.shape
     lk = k.shape[1]
@@ -328,7 +345,8 @@ def _mha_bwd_blockwise(causal, scale, q_block, kv_block,
     q_ids = jnp.arange(q_block)
     k_ids = jnp.arange(kv_block)
 
-    n_live = _n_live_kv_blocks(nk, q_block, kv_block, window)
+    n_live = (nk if (kpos is not None and pos_delta is None)
+              else _n_live_kv_blocks(nk, q_block, kv_block, window))
 
     def q_step(carry, inp):
         dk_acc, dv_acc = carry                     # [nk, B, kb, H, D]
@@ -338,8 +356,14 @@ def _mha_bwd_blockwise(causal, scale, q_block, kv_block,
         def kv_step(_, kin):
             ki, kb, vb = kin
             s = jnp.einsum("bqhd,bkhd->bhqk", qb, kb) * scale
-            mask = _band_mask(qi * q_block + q_ids[:, None],
-                              ki * kv_block + k_ids[None, :], causal, window)
+            qp = (qi * q_block + q_ids if qpos is None
+                  else lax.dynamic_slice_in_dim(qpos, qi * q_block, q_block))
+            kp = (ki * kv_block + k_ids if kpos is None
+                  else lax.dynamic_slice_in_dim(kpos, ki * kv_block,
+                                                kv_block))
+            mask = _band_mask(qp[:, None], kp[None, :], causal, window)
+            if kpos is not None and mask is not None:
+                mask &= (kp >= 0)[None, :]
             if mask is not None:
                 s = jnp.where(mask[None, None], s, NEG_INF)
             # out-of-band keys: s = NEG_INF, lse finite -> p underflows to
@@ -352,7 +376,11 @@ def _mha_bwd_blockwise(causal, scale, q_block, kv_block,
             dv_c = jnp.einsum("bhqk,bqhd->bkhd", p, dob)
             return None, (dq_c, dk_c, dv_c)
 
-        start = _live_kv_start(qi, nk, n_live, q_block, kv_block, window)
+        if kpos is not None and pos_delta is None:
+            start = jnp.int32(0)
+        else:
+            start = _live_kv_start(qi, nk, n_live, q_block, kv_block,
+                                   window, pos_delta or 0)
         idx = start + jnp.arange(n_live)
         ks = lax.dynamic_slice_in_dim(kf, start, n_live, axis=0)
         vs = lax.dynamic_slice_in_dim(vf, start, n_live, axis=0)
@@ -444,6 +472,48 @@ def _mha_bwd_rule(causal, scale, q_block, kv_block, use_pallas, window,
 
 
 _mha.defvjp(_mha_fwd_rule, _mha_bwd_rule)
+
+
+# --- positional variant: explicit global positions per query/key ----------
+# Used by the halo-exchange sequence-parallel sliding-window path, where
+# each shard's queries/keys carry global positions (float32 so the
+# custom-vjp cotangents are well-typed zeros; negative key positions mean
+# "halo wrap garbage" and are masked). Same O(L) residuals as _mha.
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _mha_pos(q, k, v, qpos, kpos, scale, q_block, kv_block, window,
+             pos_delta=None):
+    out, _ = _mha_pos_fwd(q, k, v, qpos, kpos, scale, q_block, kv_block,
+                          window, pos_delta)
+    return out
+
+
+def _mha_pos_fwd(q, k, v, qpos, kpos, scale, q_block, kv_block, window,
+                 pos_delta=None):
+    h = q.shape[2]
+    out, lse = _mha_fwd_blockwise(q, _repeat_kv(k, h), _repeat_kv(v, h),
+                                  True, scale, q_block, kv_block, window,
+                                  qpos=qpos, kpos=kpos, pos_delta=pos_delta)
+    return out, (q, k, v, out, lse, qpos, kpos)
+
+
+def _mha_pos_bwd(scale, q_block, kv_block, window, pos_delta, res, dout):
+    q, k, v, out, lse, qpos, kpos = res
+    b, lk, hk, d = k.shape
+    h = q.shape[2]
+    kx, vx = _repeat_kv(k, h), _repeat_kv(v, h)
+    dq, dk, dv = _mha_bwd_blockwise(True, scale, q_block, kv_block,
+                                    q, kx, vx, out, lse, dout, window,
+                                    qpos=qpos, kpos=kpos,
+                                    pos_delta=pos_delta)
+    if hk != h:
+        group = h // hk
+        dk = dk.reshape(b, lk, hk, group, d).sum(axis=3)
+        dv = dv.reshape(b, lk, hk, group, d).sum(axis=3)
+    return dq, dk, dv, jnp.zeros_like(qpos), jnp.zeros_like(kpos)
+
+
+_mha_pos.defvjp(lambda *a: _mha_pos_fwd(*a), _mha_pos_bwd)
 
 
 def flash_attention(

@@ -84,3 +84,58 @@ def ring_attention(
     l = jnp.where(l == 0.0, 1.0, l)  # rows with no visible keys
     out = o / l.transpose(0, 2, 1)[..., None]
     return out.astype(q.dtype)
+
+
+def sliding_window_attention_sp(
+    q: Array,
+    k: Array,
+    v: Array,
+    *,
+    axis: str = "sp",
+    window: int,
+    scale: Optional[float] = None,
+    q_block: int = 512,
+    kv_block: int = 512,
+) -> Array:
+    """Sequence-parallel SLIDING-WINDOW attention via halo exchange.
+
+    Call inside ``shard_map`` over ``axis``. Because a window that fits in
+    one shard (``window <= Lloc``) only ever reaches into the PREVIOUS
+    shard's keys, one ``ppermute`` of the neighbor shard replaces the full
+    ring rotation ring attention needs — O(1) communication steps instead
+    of O(sp), the whole point of SWA at long context. Runs through the
+    positional memory-efficient custom VJP (O(L) residuals), so it is
+    safe to differentiate in a scanned-layer model.
+
+    Shard 0's halo arrives from the LAST shard (ppermute wraps); its keys
+    get negative global positions and are masked, never attended.
+    """
+    if window > q.shape[1]:
+        raise NotImplementedError(
+            f"window {window} > local shard length {q.shape[1]}: the halo "
+            "exchange needs multi-hop permutes; lower sp or raise seq/sp")
+    from ray_tpu.ops.attention import _mha_pos
+
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    sp = lax.axis_size(axis)
+    my = lax.axis_index(axis)
+    b, lloc, h, d = q.shape
+
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+    halo_k = lax.ppermute(k, axis, perm)   # previous shard's keys
+    halo_v = lax.ppermute(v, axis, perm)
+    k_all = jnp.concatenate([halo_k, k], axis=1)    # [B, 2*Lloc, Hk, D]
+    v_all = jnp.concatenate([halo_v, v], axis=1)
+
+    start = my * lloc
+    qpos = (start + jnp.arange(lloc)).astype(jnp.float32)
+    kpos = ((start - lloc) + jnp.arange(2 * lloc)).astype(jnp.float32)
+
+    bq = min(q_block, lloc)
+    bk = min(kv_block, lloc)  # divides both Lloc and 2*Lloc
+    if lloc % bq or lloc % bk:
+        bq = bk = lloc
+    # pos_delta = qpos[0] - kpos[0] = Lloc (STATIC): keeps the windowed
+    # live-kv-block slicing so the band costs O(Lloc*window), not dense
+    return _mha_pos(q, k_all, v_all, qpos, kpos, scale, bq, bk, window,
+                    lloc)
