@@ -30,9 +30,15 @@ def test_cluster_boots_and_lists_nodes(cluster):
     cluster.add_node(num_cpus=2)
     cluster.add_node(num_cpus=2)
     _init(cluster)
-    deadline = time.monotonic() + 10
+    deadline = time.monotonic() + 20
+    nodes = []
     while time.monotonic() < deadline:
-        nodes = [n for n in ray_tpu.nodes() if n["Alive"]]
+        try:
+            nodes = [n for n in ray_tpu.nodes() if n["Alive"]]
+        except ConnectionError:
+            # transient GCS connection drop under suite load; the client
+            # reconnects and the next poll succeeds
+            nodes = []
         if len(nodes) >= 3:
             break
         time.sleep(0.2)
