@@ -126,6 +126,10 @@ _knob("serve_max_body", int, 64 << 20,
       "serve/proxy.py")
 
 # -- bench / watch ----------------------------------------------------------
+_knob("pool_prestart", int, 4,
+      "warm pool workers kept prestarted (reference worker_pool prestart "
+      "role): actor creation and task bursts claim these instead of "
+      "cold-spawning", "ray_tpu/core/runtime.py")
 _knob("attn_block_q", int, 512,
       "flash-attention query tile (rows per MXU block)",
       "ray_tpu/models/transformer.py")

@@ -295,7 +295,7 @@ class DriverRuntime:
         worker_env: Optional[Dict[str, str]] = None,
         log_to_driver: bool = True,
         labels: Optional[Dict[str, str]] = None,
-        _pool_prestart: int = 2,
+        _pool_prestart: Optional[int] = None,
     ):
         self.session = uuid.uuid4().hex[:12]
         self.namespace = namespace
@@ -446,6 +446,8 @@ class DriverRuntime:
         self._zygote_obj = None
         self._zygote_disabled = False
         self._zygote_lock = threading.Lock()
+        if _pool_prestart is None:
+            _pool_prestart = int(config.get("pool_prestart"))
         self._prestart = min(_pool_prestart, self.pool_cap)
         for _ in range(self._prestart):
             self._spawn_worker("pool")
