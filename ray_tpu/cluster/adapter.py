@@ -192,8 +192,10 @@ class ClusterAdapter:
                 cur = self._task_ev_cursor
                 if len(evs) > cur:
                     batch = evs[cur:cur + 1000]
+                    # cursor rides along so a post-re-register rewind can
+                    # be deduped server-side (advisor r3: duplicate events)
                     if self.gcs.call("task_events", self.node_id, batch,
-                                     timeout=5):
+                                     cur, timeout=5):
                         self._task_ev_cursor = cur + len(batch)
             except Exception:
                 pass

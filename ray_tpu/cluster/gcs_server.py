@@ -90,6 +90,9 @@ class GcsService:
         from collections import deque
 
         self.task_events = deque(maxlen=int(config.get("gcs_max_task_events")))
+        # per-node high-water mark of received task-event sequence numbers
+        # (dedup for cursor rewinds after node re-registration)
+        self._task_ev_seq: Dict[bytes, int] = {}
         self.kv: Dict[str, Dict[str, bytes]] = {}
         self.functions: Dict[str, bytes] = {}
         # named/global actor registry: actor_id -> record dict
@@ -420,13 +423,28 @@ class GcsService:
             self._publish("objects", {"oid": oid, "freed": True,
                                       "locations": locations})
 
-    def rpc_task_events(self, ctx, node_id: bytes, events):
+    def rpc_task_events(self, ctx, node_id: bytes, events, start_seq=None):
         """Batched task events from a node runtime (reference
         TaskEventBuffer -> GcsTaskManager pipeline,
         ``core_worker/task_event_buffer.h:206`` role): bounded store
-        feeding the cluster-wide state API and timeline."""
+        feeding the cluster-wide state API and timeline.
+
+        ``start_seq`` is the sender's local index of events[0]. A node
+        that re-registers after a heartbeat blip rewinds its cursor to 0
+        and reships history into a GCS that often still holds the earlier
+        copies (advisor r3): events with seq below this store's per-node
+        high-water mark are dropped as duplicates. Senders that predate
+        the field (start_seq None) keep the old append-all behavior."""
         with self.lock:
             nid = node_id.hex()[:8]
+            if start_seq is not None:
+                seen = self._task_ev_seq.get(node_id, 0)
+                skip = max(0, seen - start_seq)
+                if skip >= len(events):
+                    return True
+                events = events[skip:]
+                start_seq += skip
+                self._task_ev_seq[node_id] = start_seq + len(events)
             for ev in events:
                 ev = dict(ev)
                 ev["node"] = nid

@@ -1021,3 +1021,26 @@ def test_memory_dump_lists_cluster_objects(cluster):
     assert len(big) >= 3
     assert all(r["pins"] >= 1 and r["status"] == "READY" for r in big)
     del refs
+
+
+def test_task_events_dedup_on_cursor_rewind(cluster):
+    """A node that re-registers rewinds its event cursor to 0 and reships
+    history; the GCS drops events below its per-node high-water mark
+    (advisor r3: duplicated task events in the state API)."""
+    from ray_tpu.cluster.rpc import RpcClient
+
+    cli = RpcClient(cluster.address, cluster.authkey.encode())
+    try:
+        nid = b"\x01" * 16
+        evs = [{"name": f"t{i}", "ts": i} for i in range(5)]
+        assert cli.call("task_events", nid, evs, 0, timeout=10)
+        # cursor rewind after re-register: same 5 events again from seq 0,
+        # plus 2 genuinely new ones
+        evs2 = evs + [{"name": "t5", "ts": 5}, {"name": "t6", "ts": 6}]
+        assert cli.call("task_events", nid, evs2, 0, timeout=10)
+        got = [e for e in cli.call("task_events_get", 100, timeout=10)
+               if e["node"] == nid.hex()[:8]]
+        names = [e["name"] for e in got]
+        assert names == [f"t{i}" for i in range(7)], names
+    finally:
+        cli.close()
