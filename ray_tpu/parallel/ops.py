@@ -70,3 +70,43 @@ def axis_index(axis: AxisName):
 
 def axis_size(axis: AxisName):
     return lax.axis_size(axis)
+
+
+def quantized_psum(x, axis: AxisName, *, bits: int = 8, block: int = 256):
+    """All-reduce-sum that ships int8 on the wire (EQuARX role,
+    arxiv 2506.17615: quantized AllReduce in XLA for bandwidth-bound
+    links). Designed for SMALL axes — the cross-slice ``dcn`` axis where
+    gradient sync crosses data-center network: each shard quantizes its
+    values blockwise (per-``block`` max-abs scale, symmetric int8),
+    all-gathers the int8 payload + f32 scales (the int8 tensor is what
+    rides the wire), then dequantizes and sums locally.
+
+    Wire bytes ~= n * size/4 vs a float32 ring psum's ~2*size: a win for
+    axis sizes up to ~8 (n=2: 4x less traffic; n=4: 2x). Accuracy: block
+    max-abs symmetric quantization, worst-case elementwise error
+    ``max_abs_in_block / 127`` per shard.
+    """
+    if bits != 8:
+        raise NotImplementedError("int8 is the only wire dtype today")
+    orig_shape, orig_dtype = x.shape, x.dtype
+    flat = x.astype(jnp.float32).reshape(-1)
+    pad = (-flat.shape[0]) % block
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+    blocks = flat.reshape(-1, block)
+    scale = jnp.max(jnp.abs(blocks), axis=1, keepdims=True) / 127.0
+    safe = jnp.where(scale == 0.0, 1.0, scale)
+    q = jnp.clip(jnp.round(blocks / safe), -127, 127).astype(jnp.int8)
+
+    q_all = lax.all_gather(q, axis)          # [n, nblk, block] int8 wire
+    s_all = lax.all_gather(scale, axis)      # [n, nblk, 1] f32 (tiny)
+    total = (q_all.astype(jnp.float32) * s_all).sum(axis=0)
+    total = total.reshape(-1)
+    if pad:
+        total = total[:-pad]
+    return total.reshape(orig_shape).astype(orig_dtype)
+
+
+def quantized_pmean(x, axis: AxisName, *, bits: int = 8, block: int = 256):
+    """Mean variant of :func:`quantized_psum` (gradient averaging)."""
+    return quantized_psum(x, axis, bits=bits, block=block) / axis_size(axis)

@@ -94,3 +94,40 @@ def test_constrain_inside_jit():
     with mesh:
         out = f(np.ones((8, 4), np.float32))
     assert np.all(np.asarray(out) == 2.0)
+
+
+def test_quantized_psum_matches_exact_within_quant_error():
+    """int8-on-the-wire psum (EQuARX role) for the dcn gradient sync:
+    must equal the exact psum within blockwise max-abs/127 error, and be
+    exact for values that are representable."""
+    import jax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ray_tpu.parallel.ops import quantized_pmean, quantized_psum
+
+    mesh = make_mesh(MeshConfig(dp=1, fsdp=2, tp=1, sp=1, dcn=4))
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((8, 37)).astype(np.float32)  # odd size -> pad
+
+    spec = P(("dcn", "fsdp"))
+    with jax.set_mesh(mesh):
+        out = shard_map(
+            lambda s: quantized_psum(s, "dcn"),
+            mesh=mesh, in_specs=(spec,), out_specs=spec,
+            check_vma=False)(jnp.asarray(x))
+        mean = shard_map(
+            lambda s: quantized_pmean(s, "dcn"),
+            mesh=mesh, in_specs=(spec,), out_specs=spec,
+            check_vma=False)(jnp.asarray(x))
+    # exact references: each (dcn, fsdp) shard is one row of x; psum over
+    # dcn sums rows {r, r+2, r+4, r+6} for fsdp residue r... compute via
+    # reshape: device order is (dcn, fsdp) row-major over the 8 rows
+    rows = x.reshape(4, 2, 37)  # [dcn, fsdp, cols]
+    want = rows.sum(axis=0)     # psum over dcn per fsdp shard
+    got = np.asarray(out).reshape(4, 2, 37)
+    tol = 4 * np.abs(rows).max() / 127 + 1e-6  # 4 shards' quant error
+    for d in range(4):
+        np.testing.assert_allclose(got[d], want, atol=tol)
+    got_mean = np.asarray(mean).reshape(4, 2, 37)
+    np.testing.assert_allclose(got_mean[0], want / 4, atol=tol / 4)
