@@ -242,3 +242,57 @@ def test_lineage_absent_for_put_objects(rt_rob):
     _get_runtime().store.delete(ref.id)
     with _pytest.raises((FileNotFoundError, OSError)):
         ray_tpu.get(ref, timeout=10)
+
+
+def test_chaos_random_worker_kills_under_load(rt_rob):
+    """Fault-injection soak (reference WorkerKillerActor pattern,
+    python/ray/_private/test_utils.py:1560 role): an external killer
+    SIGKILLs random busy workers while a burst of retryable tasks runs;
+    every task must still complete with the right answer."""
+    import random
+    import signal
+    import threading
+    import time as _t
+
+    from ray_tpu.core.runtime import _get_runtime
+
+    @ray_tpu.remote(max_retries=4)
+    def work(i):
+        import time as _tt
+
+        _tt.sleep(0.15)
+        return i * i
+
+    # warm the pool so the killer has victims from the start
+    ray_tpu.get([work.remote(i) for i in range(8)])
+
+    rt = _get_runtime()
+    stop = threading.Event()
+    kills = []
+
+    def killer():
+        rng = random.Random(0)
+        while not stop.is_set():
+            _t.sleep(0.4)
+            with rt.lock:
+                busy = [ws for ws in rt.workers.values()
+                        if ws.kind == "pool" and ws.status == "busy"
+                        and ws.proc.poll() is None]
+            if busy:
+                victim = rng.choice(busy)
+                try:
+                    victim.proc.kill()
+                    kills.append(victim.worker_id.hex()[:8])
+                except Exception:
+                    pass
+
+    t = threading.Thread(target=killer, daemon=True)
+    t.start()
+    try:
+        refs = [work.remote(i) for i in range(60)]
+        results = ray_tpu.get(refs, timeout=180)
+    finally:
+        stop.set()
+        t.join(timeout=5)
+    assert results == [i * i for i in range(60)]
+    assert kills, "the killer never fired; the soak proved nothing"
