@@ -27,6 +27,7 @@ from ray_tpu.data.read_api import (
     range,
     range_tensor,
     read_binary_files,
+    read_images,
     read_csv,
     read_json,
     read_numpy,
@@ -59,6 +60,7 @@ __all__ = [
     "range",
     "range_tensor",
     "read_binary_files",
+    "read_images",
     "read_csv",
     "read_json",
     "read_numpy",

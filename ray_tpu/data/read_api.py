@@ -143,6 +143,31 @@ def read_numpy(path, **kw) -> Dataset:
     return _file_dataset(_paths(path, ".npy"), parse)
 
 
+def read_images(path, *, size=None, mode: str = "RGB", **kw) -> Dataset:
+    """Image files -> tensor column (reference ``data/datasource``
+    image reader role). ``size=(H, W)`` resizes so blocks stack into one
+    [N, H, W, C] array (the TPU-ingest-friendly layout); without it each
+    image keeps its own shape in an object column."""
+    def parse(f: str) -> Block:
+        from PIL import Image
+
+        img = Image.open(f).convert(mode)
+        if size is not None:
+            img = img.resize((size[1], size[0]))
+        arr = np.asarray(img)
+        if size is not None:
+            return {"image": arr[None], "path": np.asarray([f], object)}
+        boxed = np.empty(1, object)
+        boxed[0] = arr
+        return {"image": boxed, "path": np.asarray([f], object)}
+
+    exts = (".png", ".jpg", ".jpeg", ".bmp", ".gif")
+    paths = [p for p in _paths(path, "") if p.lower().endswith(exts)]
+    if not paths:
+        raise FileNotFoundError(f"no image files under {path!r}")
+    return _file_dataset(paths, parse)
+
+
 def read_text(path, **kw) -> Dataset:
     def parse(f: str) -> Block:
         with open(f) as fh:

@@ -360,3 +360,25 @@ def test_redundant_shuffle_dropped_end_to_end(rt_data):
 
     ds = rd.range(30, parallelism=4).random_shuffle().random_shuffle()
     assert sorted(r["id"] for r in ds.iter_rows()) == list(range(30))
+
+
+def test_read_images_tensor_column(rt_data, tmp_path):
+    """read_images with size stacks into an [N, H, W, C] tensor column
+    (TPU-ingest layout); without size, per-image object arrays."""
+    from PIL import Image
+
+    for i in range(3):
+        Image.new("RGB", (10 + i, 8 + i), (i * 40, 0, 0)).save(
+            tmp_path / f"img{i}.png")
+    from ray_tpu import data
+
+    ds = data.read_images(str(tmp_path), size=(16, 12))
+    rows = ds.take_all()
+    assert len(rows) == 3
+    batch = next(iter(ds.iter_batches(batch_size=3)))
+    assert batch["image"].shape == (3, 16, 12, 3)
+    assert batch["image"].dtype == np.uint8
+
+    ds2 = data.read_images(str(tmp_path))
+    first = ds2.take_all()[0]["image"]
+    assert first.shape[-1] == 3  # native size preserved
