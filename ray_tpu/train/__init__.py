@@ -8,7 +8,9 @@ ICI (no process groups), parallelism is declared as a MeshConfig, and
 checkpoints save sharded param pytrees host-side.
 """
 
-from ray_tpu.train.checkpoint import Checkpoint, save_pytree, load_pytree
+from ray_tpu.train.checkpoint import (AsyncSave, Checkpoint,
+                                      load_pytree, save_pytree,
+                                      save_pytree_async)
 from ray_tpu.train.config import (
     CheckpointConfig,
     FailureConfig,
@@ -45,6 +47,8 @@ from ray_tpu.train.train_state import (
 __all__ = [
     "Checkpoint",
     "save_pytree",
+    "save_pytree_async",
+    "AsyncSave",
     "load_pytree",
     "CheckpointConfig",
     "FailureConfig",

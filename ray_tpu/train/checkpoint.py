@@ -99,6 +99,53 @@ def save_pytree(tree: Any, path: str, *, name: str = "state") -> None:
         pickle.dump(treedef, f)
 
 
+class AsyncSave:
+    """Handle for an in-flight background checkpoint write."""
+
+    def __init__(self, thread, errbox):
+        self._thread = thread
+        self._errbox = errbox
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            raise TimeoutError("checkpoint write still in flight")
+        if self._errbox:
+            raise self._errbox[0]
+
+    def done(self) -> bool:
+        return not self._thread.is_alive()
+
+
+def save_pytree_async(tree: Any, path: str, *, name: str = "state") -> AsyncSave:
+    """Non-blocking :func:`save_pytree` (orbax async-checkpoint role): the
+    device->host pull happens NOW (a consistent snapshot — the train loop
+    may donate/overwrite the buffers immediately after this returns), and
+    the disk write runs on a background thread. Call ``.wait()`` before
+    relying on the files (BackendExecutor does at the next report)."""
+    import threading
+
+    import jax
+
+    leaves, treedef = jax.tree.flatten(tree)
+    host = [np.asarray(jax.device_get(leaf)
+                       if hasattr(leaf, "addressable_data") else leaf)
+            for leaf in leaves]
+    snapshot = jax.tree.unflatten(treedef, host)
+    errbox: list = []
+
+    def write():
+        try:
+            save_pytree(snapshot, path, name=name)
+        except BaseException as e:  # surfaced at wait()
+            errbox.append(e)
+
+    t = threading.Thread(target=write, daemon=True,
+                         name="ckpt-async-write")
+    t.start()
+    return AsyncSave(t, errbox)
+
+
 def load_pytree(path: str, *, name: str = "state", shardings: Any = None) -> Any:
     """Load a pytree saved by :func:`save_pytree`; optionally re-place leaves
     onto ``shardings`` (a matching pytree of ``NamedSharding``)."""

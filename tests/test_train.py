@@ -194,3 +194,28 @@ def test_session_checkpoint_seq_resumes_past_existing(tmp_path):
     # empty dir starts at zero
     s2 = _Session(lambda: None, TrainContext(trial_dir=str(tmp_path / "new")))
     assert s2._checkpoint_seq == 0
+
+
+def test_async_checkpoint_snapshot_semantics(tmp_path):
+    """save_pytree_async snapshots device values at CALL time — mutating
+    (donating) the arrays afterwards must not corrupt the write — and
+    errors surface at wait()."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.train import load_pytree, save_pytree_async
+
+    tree = {"w": jnp.arange(1000, dtype=jnp.float32)}
+    h = save_pytree_async(tree, str(tmp_path / "ck"))
+    # overwrite the source immediately (donation pattern)
+    tree["w"] = tree["w"] * 0 - 1.0
+    h.wait(timeout=60)
+    assert h.done()
+    back = load_pytree(str(tmp_path / "ck"))
+    np.testing.assert_array_equal(np.asarray(back["w"]),
+                                  np.arange(1000, dtype=np.float32))
+
+    bad = save_pytree_async({"x": jnp.zeros(3)},
+                            "/proc/definitely/not/writable")
+    with pytest.raises(BaseException):
+        bad.wait(timeout=60)
