@@ -170,6 +170,29 @@ class TrainLoopHelper:
             self.state, metrics = self.step_fn(self.state, batch)
         return metrics
 
+    def profile_steps(self, batch: Dict[str, jax.Array], n: int,
+                      logdir: str):
+        """Capture an XLA device trace of ``n`` scanned steps to
+        ``logdir`` (view with TensorBoard's profile plugin / xprof).
+
+        The scaling-book loop is "annotate shardings, let XLA insert
+        collectives, PROFILE, iterate" — this is the profile step, one
+        call. Returns the last step's metrics; trace capture failures
+        (some backends don't support profiling) surface as a warning,
+        never break the step."""
+        import warnings
+
+        try:
+            with jax.profiler.trace(logdir):
+                metrics = self.run_steps(batch, n)
+                jax.tree.map(
+                    lambda x: x.block_until_ready()
+                    if hasattr(x, "block_until_ready") else x, metrics)
+            return metrics
+        except Exception as e:
+            warnings.warn(f"profiler trace failed ({e}); ran unprofiled")
+            return self.run_steps(batch, n)
+
     def run_steps(self, batch: Dict[str, jax.Array], n: int):
         """Run ``n`` optimizer steps on the same batch as ONE compiled
         program (``lax.scan`` over the step body) and return the last

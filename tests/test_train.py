@@ -219,3 +219,29 @@ def test_async_checkpoint_snapshot_semantics(tmp_path):
                             "/proc/definitely/not/writable")
     with pytest.raises(BaseException):
         bad.wait(timeout=60)
+
+
+def test_profile_steps_captures_trace(tmp_path):
+    """TrainLoopHelper.profile_steps writes an XLA trace and still returns
+    step metrics."""
+    import jax
+    import optax
+
+    from ray_tpu import models
+    from ray_tpu.parallel import MeshConfig
+    from ray_tpu.train import TrainLoopHelper
+
+    c = models.llama_debug()
+    helper = TrainLoopHelper.create(
+        lambda: models.init_params(jax.random.PRNGKey(0), c),
+        models.param_axes(c),
+        lambda p, b: models.loss_and_metrics(p, b, c),
+        optax.sgd(1e-2),
+        mesh_config=MeshConfig(dp=1, fsdp=-1, tp=1, sp=1),
+    )
+    toks = np.zeros((8, 17), np.int32)
+    logdir = tmp_path / "trace"
+    m = helper.profile_steps({"tokens": toks}, 2, str(logdir))
+    assert np.isfinite(float(jax.device_get(m["loss"])))
+    produced = list(logdir.rglob("*"))
+    assert produced, "no trace files written"
