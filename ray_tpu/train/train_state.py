@@ -163,7 +163,20 @@ class TrainLoopHelper:
                            if a in self.mesh.axis_names)
         return NamedSharding(self.mesh, P(batch_axes or None))
 
+    def _check_batch(self, batch: Dict[str, jax.Array]) -> None:
+        shape = dict(self.mesh.shape)
+        ways = 1
+        for a in ("dcn", "dp", "fsdp"):
+            ways *= shape.get(a, 1)
+        for k, v in batch.items():
+            if hasattr(v, "shape") and v.shape and v.shape[0] % ways:
+                raise ValueError(
+                    f"batch[{k!r}] leading dim {v.shape[0]} does not divide "
+                    f"by the data-parallel ways dcn*dp*fsdp={ways} of mesh "
+                    f"{shape}; pad the batch or change the mesh")
+
     def run_step(self, batch: Dict[str, jax.Array]):
+        self._check_batch(batch)
         bs = self.batch_sharding()
         batch = jax.tree.map(lambda x: jax.device_put(x, bs), batch)
         with jax.set_mesh(self.mesh):
@@ -216,6 +229,7 @@ class TrainLoopHelper:
                 return state, jax.tree.map(lambda a: a[-1], ms)
 
             self._multi_step_cache[n] = jax.jit(multi, donate_argnums=(0,))
+        self._check_batch(batch)
         bs = self.batch_sharding()
         batch = jax.tree.map(lambda x: jax.device_put(x, bs), batch)
         with jax.set_mesh(self.mesh):

@@ -245,3 +245,23 @@ def test_profile_steps_captures_trace(tmp_path):
     assert np.isfinite(float(jax.device_get(m["loss"])))
     produced = list(logdir.rglob("*"))
     assert produced, "no trace files written"
+
+
+def test_run_step_rejects_indivisible_batch_loudly():
+    import jax
+    import optax
+
+    from ray_tpu import models
+    from ray_tpu.parallel import MeshConfig
+    from ray_tpu.train import TrainLoopHelper
+
+    c = models.llama_debug()
+    helper = TrainLoopHelper.create(
+        lambda: models.init_params(jax.random.PRNGKey(0), c),
+        models.param_axes(c),
+        lambda p, b: models.loss_and_metrics(p, b, c),
+        optax.sgd(1e-2),
+        mesh_config=MeshConfig(dp=1, fsdp=-1, tp=1, sp=1),
+    )
+    with pytest.raises(ValueError, match="does not divide"):
+        helper.run_step({"tokens": np.zeros((3, 17), np.int32)})
