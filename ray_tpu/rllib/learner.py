@@ -284,6 +284,10 @@ class LearnerGroup:
         # "int8" ships grads through the object store blockwise-quantized
         # (4x fewer bytes each way; error <= blockwise max_abs/127)
         self._compress = (config or {}).get("grad_compression")
+        if self._compress not in (None, "int8"):
+            raise ValueError(
+                f"unknown grad_compression {self._compress!r}; "
+                "expected None or 'int8'")
         self._remote = num_learners > 0
         if self._remote:
             import ray_tpu

@@ -128,8 +128,12 @@ def save_pytree_async(tree: Any, path: str, *, name: str = "state") -> AsyncSave
     import jax
 
     leaves, treedef = jax.tree.flatten(tree)
-    host = [np.asarray(jax.device_get(leaf)
-                       if hasattr(leaf, "addressable_data") else leaf)
+    # device leaves: device_get materializes a fresh host copy. HOST numpy
+    # leaves must be COPIED — np.asarray aliases, and the caller is told
+    # it may mutate immediately, which would tear the background write.
+    host = [np.asarray(jax.device_get(leaf))
+            if hasattr(leaf, "addressable_data")
+            else np.array(leaf, copy=True)
             for leaf in leaves]
     snapshot = jax.tree.unflatten(treedef, host)
     errbox: list = []

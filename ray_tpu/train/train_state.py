@@ -195,16 +195,21 @@ class TrainLoopHelper:
         never break the step."""
         import warnings
 
+        metrics = None
         try:
             with jax.profiler.trace(logdir):
                 metrics = self.run_steps(batch, n)
-                jax.tree.map(
-                    lambda x: x.block_until_ready()
-                    if hasattr(x, "block_until_ready") else x, metrics)
-            return metrics
+                # completion barrier INSIDE the trace: a dependent
+                # device_get, not block_until_ready (which acks early on
+                # the tunneled axon backend — see CLAUDE.md)
+                jax.device_get(jax.tree.leaves(metrics)[0])
         except Exception as e:
-            warnings.warn(f"profiler trace failed ({e}); ran unprofiled")
-            return self.run_steps(batch, n)
+            warnings.warn(f"profiler trace failed ({e})"
+                          + ("; ran unprofiled" if metrics is None else
+                             "; steps DID run, capture incomplete"))
+            if metrics is None:  # never double-apply optimizer steps
+                metrics = self.run_steps(batch, n)
+        return metrics
 
     def run_steps(self, batch: Dict[str, jax.Array], n: int):
         """Run ``n`` optimizer steps on the same batch as ONE compiled
