@@ -18,6 +18,8 @@ from ray_tpu.models.config import (
     llama_1b,
     llama_250m,
     llama_debug,
+    gemma2_9b,
+    gemma_debug,
     mistral_7b,
     mistral_debug,
     gpt2_small,
@@ -43,6 +45,8 @@ __all__ = [
     "llama_1b",
     "llama_250m",
     "llama_debug",
+    "gemma2_9b",
+    "gemma_debug",
     "mistral_7b",
     "mistral_debug",
     "gpt2_small",

@@ -182,6 +182,25 @@ def gpt2_debug() -> TransformerConfig:
     )
 
 
+def gemma2_9b() -> TransformerConfig:
+    """Gemma-2-9B-family shape: GQA, tied embeddings, tanh logit softcap,
+    alternating-window attention approximated as a uniform 4096 window."""
+    return TransformerConfig(
+        vocab_size=256128, d_model=3584, n_layers=42, n_heads=16,
+        n_kv_heads=8, head_dim=256, d_ff=14336, max_seq_len=8192,
+        tie_embeddings=True, logits_softcap=30.0, sliding_window=4096,
+    )
+
+
+def gemma_debug() -> TransformerConfig:
+    """Tiny softcap+tied-embeddings config for tests."""
+    return TransformerConfig(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=128, tie_embeddings=True, logits_softcap=30.0,
+        remat=False,
+    )
+
+
 def mistral_7b() -> TransformerConfig:
     """Mistral-7B-family shape: GQA + 4096-token sliding-window attention."""
     return TransformerConfig(
@@ -213,6 +232,8 @@ PRESETS = {
     "llama-debug": llama_debug,
     "gpt2-small": gpt2_small,
     "gpt2-debug": gpt2_debug,
+    "gemma2-9b": gemma2_9b,
+    "gemma-debug": gemma_debug,
     "mistral-7b": mistral_7b,
     "mistral-debug": mistral_debug,
     "moe-debug": moe_debug,

@@ -16,6 +16,7 @@ from ray_tpu.parallel import MeshConfig, make_mesh, shard_params
 CONFIGS = {
     "llama": models.llama_debug(),
     "gpt2": models.gpt2_debug(),
+    "gemma": models.gemma_debug(),
     "moe": models.moe_debug(),
 }
 
