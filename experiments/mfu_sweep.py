@@ -23,13 +23,15 @@ import time
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 CONFIGS = [
-    # (name, remat, remat_policy, batch, attn_impl, loss_chunk, env)
+    # (name, remat, remat_policy, batch, attn_impl, loss_chunk, env[, seq])
     # round-4 sweep 1 results (no loss_chunk): remat_full_b16_pallas
     # 0.2027 MFU / remat_attn_b16 0.1968 / remat_attn_b8 0.1947 /
-    # remat_full_b16_xla 0.1078; b32 and no-remat b8 OOMed.
+    # remat_full_b16_xla 0.1078; b32 and no-remat b8 died in the remote
+    # compile helper (HTTP 500 — retried once in-child now).
     ("remat_full_b32_chunk512", True, "full", 32, "pallas", 512, {}),
     ("remat_full_b16_chunk512", True, "full", 16, "pallas", 512, {}),
     ("remat_attn_b32_chunk512", True, "save_attn", 32, "pallas", 512, {}),
+    ("remat_attn_b16_chunk512", True, "save_attn", 16, "pallas", 512, {}),
     ("remat_full_b64_chunk512", True, "full", 64, "pallas", 512, {}),
     ("remat_full_b16_pallas", True, "full", 16, "pallas", 0, {}),
     # flash tile sweep (at the best batch/chunk point)
@@ -39,6 +41,16 @@ CONFIGS = [
      {"RTPU_ATTN_BLOCK_Q": "1024", "RTPU_ATTN_BLOCK_K": "1024"}),
     ("b32_chunk_blkq1024k512", True, "full", 32, "pallas", 512,
      {"RTPU_ATTN_BLOCK_Q": "1024", "RTPU_ATTN_BLOCK_K": "512"}),
+    # scoped-vmem variants: the r4 b32 compile-helper failures are the
+    # kind --xla_tpu_scoped_vmem_limit_kib moves (VERDICT r4 #1)
+    ("b32_chunk_vmem64m", True, "full", 32, "pallas", 512,
+     {"XLA_FLAGS": "--xla_tpu_scoped_vmem_limit_kib=65536"}),
+    ("b32_chunk_vmem16m", True, "full", 32, "pallas", 512,
+     {"XLA_FLAGS": "--xla_tpu_scoped_vmem_limit_kib=16384"}),
+    # longer sequence at constant tokens/step: more attention FLOPs per
+    # token, fewer lm-head+embed passes per token
+    ("seq4096_b16_chunk512", True, "full", 16, "pallas", 512, {}, 4096),
+    ("seq4096_b8_chunk512", True, "full", 8, "pallas", 512, {}, 4096),
 ]
 
 
@@ -57,13 +69,14 @@ def child(cfg: dict) -> None:
     from ray_tpu.train import TrainLoopHelper
     from ray_tpu.util.tpu_info import peak_flops_per_chip
 
-    out = {"name": cfg["name"], "ok": False}
-    try:
+    out = {"name": cfg["name"], "ok": False, "cfg": cfg}
+
+    def attempt():
         set_default_attention_impl(cfg["attn"])
-        config = models.llama_250m().replace(
+        config = models.get_config(cfg.get("model", "llama-250m")).replace(
             remat=cfg["remat"], remat_policy=cfg["policy"],
             loss_chunk=cfg.get("loss_chunk", 0))
-        seq, batch_size = 2048, cfg["batch"]
+        seq, batch_size = cfg.get("seq", 2048), cfg["batch"]
         helper = TrainLoopHelper.create(
             lambda: models.init_params(jax.random.PRNGKey(0), config),
             models.param_axes(config),
@@ -92,9 +105,84 @@ def child(cfg: dict) -> None:
                    tokens_per_sec=round(tokens_per_sec, 1),
                    mfu=round(mfu, 4),
                    backend=jax.default_backend())
-    except Exception as e:
-        out["error"] = f"{type(e).__name__}: {str(e)[:300]}"
+
+    # The r4 sweep lost two configs to one-off remote-compile HTTP 500s
+    # (the axon compile-helper subprocess died); that path is stateless,
+    # so one in-child retry is cheap. The loop (vs a nested except) lets
+    # the first attempt's traceback — which pins the on-device params +
+    # opt state — be dropped before the retry allocates its own.
+    for attempt_no in range(2):
+        err = None
+        try:
+            attempt()
+            break
+        except Exception as e:
+            err = f"{type(e).__name__}: {str(e)[:300]}"
+            retryable = "remote_compile" in str(e) or "INTERNAL" in str(e)
+        if attempt_no == 0 and retryable:
+            out["retried_after"] = err
+            time.sleep(5)
+            continue
+        out["error"] = err
+        break
     print(json.dumps(out))
+
+
+MAX_ATTEMPTS = 2        # deterministic failures (OOM, Mosaic reject)
+MAX_ANY_ATTEMPTS = 4    # all failures incl. timeouts/tunnel flakes
+
+_DETERMINISTIC = ("RESOURCE_EXHAUSTED", "Allocation", "Mosaic",
+                  "NotImplementedError", "ValueError")
+
+
+def _scan_records(path: str) -> list:
+    recs = []
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    recs.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+    except OSError:
+        pass
+    return recs
+
+
+def _done_names(path: str) -> set:
+    """Configs to skip: measured ok, failed MAX_ATTEMPTS times with a
+    deterministic error (an OOM must not busy-loop the watcher), or failed
+    MAX_ANY_ATTEMPTS times with anything (a repeatedly hanging compile is
+    not worth a fifth window). Tunnel-death failures are mostly filtered
+    at the source — the runner aborts instead of logging a failure when a
+    post-failure probe finds the tunnel down."""
+    ok, det_fails, any_fails = set(), {}, {}
+    for rec in _scan_records(path):
+        name = rec.get("name")
+        if rec.get("ok"):
+            ok.add(name)
+        else:
+            any_fails[name] = any_fails.get(name, 0) + 1
+            err = str(rec.get("error", ""))
+            if any(s in err for s in _DETERMINISTIC):
+                det_fails[name] = det_fails.get(name, 0) + 1
+    return (ok
+            | {n for n, c in det_fails.items() if c >= MAX_ATTEMPTS}
+            | {n for n, c in any_fails.items() if c >= MAX_ANY_ATTEMPTS})
+
+
+def _tunnel_alive(timeout: float = 25.0) -> bool:
+    """Cheap child-process device query (same contract as tpu_watch.probe)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; jax.config.update('jax_platforms', 'axon'); "
+             "print('NDEV', len(jax.devices()))"],
+            capture_output=True, text=True, timeout=timeout,
+            env=dict(os.environ))
+        return proc.returncode == 0 and "NDEV" in proc.stdout
+    except Exception:
+        return False
 
 
 def main() -> int:
@@ -102,34 +190,66 @@ def main() -> int:
     ap.add_argument("--child", default=None)
     ap.add_argument("--only", default=None,
                     help="comma-separated config-name filter")
+    ap.add_argument("--out", default=None,
+                    help="append each result record to this jsonl file")
+    ap.add_argument("--skip-ok", action="store_true",
+                    help="skip configs already ok (or failed MAX_ATTEMPTS "
+                         "times) in --out — resumable across tunnel windows")
+    ap.add_argument("--timeout", type=float, default=900.0)
     args = ap.parse_args()
     if args.child:
         child(json.loads(args.child))
         return 0
+    done = _done_names(args.out) if (args.skip_ok and args.out) else set()
     results = []
-    for (name, remat, policy, batch, attn, loss_chunk, extra_env) in CONFIGS:
+    for row in CONFIGS:
+        (name, remat, policy, batch, attn, loss_chunk, extra_env) = row[:7]
+        seq = row[7] if len(row) > 7 else 2048
         if args.only and name not in args.only.split(","):
             continue
+        if name in done:
+            continue
         cfg = {"name": name, "remat": remat, "policy": policy,
-               "batch": batch, "attn": attn, "loss_chunk": loss_chunk}
+               "batch": batch, "attn": attn, "loss_chunk": loss_chunk,
+               "seq": seq, "env": extra_env}
         env = dict(os.environ)
-        env.update(extra_env)
+        for k, v in extra_env.items():
+            # merge (not clobber) composite flag vars the caller may have set
+            env[k] = (env[k] + " " + v) if (k == "XLA_FLAGS" and k in env) else v
         env["JAX_PLATFORMS"] = "axon"
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__),
                  "--child", json.dumps(cfg)],
-                capture_output=True, text=True, timeout=900, env=env,
-                cwd=_REPO)
+                capture_output=True, text=True, timeout=args.timeout,
+                env=env, cwd=_REPO)
             line = next((ln for ln in reversed(proc.stdout.splitlines())
                          if ln.startswith("{")), None)
             rec = (json.loads(line) if line else
-                   {"name": name, "ok": False,
+                   {"name": name, "ok": False, "cfg": cfg,
                     "error": f"rc={proc.returncode}: {proc.stderr[-400:]}"})
         except subprocess.TimeoutExpired:
-            rec = {"name": name, "ok": False, "error": "timeout 900s"}
+            rec = {"name": name, "ok": False, "cfg": cfg,
+                   "error": f"timeout {args.timeout:.0f}s"}
+        if not rec.get("ok") and not _tunnel_alive():
+            # the failure is (probably) the tunnel dying, not the config —
+            # stop the sweep. Still charge ONE non-deterministic failure:
+            # it won't count toward MAX_ATTEMPTS retirement, but the
+            # MAX_ANY_ATTEMPTS backstop must see configs whose failure
+            # wedges the chip itself, or the first such config would be
+            # retried first in every window forever, starving the rest.
+            rec = {"name": name, "ok": False, "cfg": cfg,
+                   "error": f"aborted, tunnel down after: {rec.get('error', '?')[:200]}"}
+            print(json.dumps(rec), flush=True)
+            if args.out:
+                with open(args.out, "a") as f:
+                    f.write(json.dumps(rec) + "\n")
+            break
         results.append(rec)
         print(json.dumps(rec), flush=True)
+        if args.out:
+            with open(args.out, "a") as f:
+                f.write(json.dumps(rec) + "\n")
     best = max((r for r in results if r.get("ok")),
                key=lambda r: r.get("mfu", 0), default=None)
     print(json.dumps({"best": best}))
