@@ -420,9 +420,12 @@ def _rl_learner_bench(jax) -> float:
             "advantages": rng.standard_normal(n).astype(np.float32),
             "value_targets": rng.standard_normal(n).astype(np.float32),
         }
-        learner.update(batch, minibatch_size=512, num_epochs=1)  # compile
-        t0 = time.perf_counter()
+        # warm with the SAME (epochs, minibatch) signature as the timed
+        # call: update() scans the whole epoch×minibatch plan as one
+        # program, so a different num_epochs is a different program
         epochs = 4
+        learner.update(batch, minibatch_size=512, num_epochs=epochs)
+        t0 = time.perf_counter()
         learner.update(batch, minibatch_size=512, num_epochs=epochs)
         dt = time.perf_counter() - t0
         steps = epochs * (n // 512)
