@@ -226,6 +226,13 @@ class GcsService:
             if ent is None or not ent.alive:
                 return
             ent.alive = False
+            # _task_ev_seq is deliberately NOT popped here: a node marked
+            # dead by a connection blip keeps its node_id, reconnects, and
+            # reships history from seq 0 — the high-water mark is what
+            # dedups that reshipment (advisor r3). Entries thus live as
+            # long as the node record itself (self.nodes also keeps dead
+            # entries), so growth is bounded by distinct nodes per cluster
+            # lifetime, not leaked beyond it.
             # objects whose only copies lived there are lost
             lost = [oid for oid, o in self.objects.items()
                     if o.status == READY and o.inline is None

@@ -559,8 +559,28 @@ def flash_attention(
     q_block = min(q_block, lq)
     kv_block = min(kv_block, lk)
     if lq % q_block or lk % kv_block:
-        # ragged lengths: decode paths use naive anyway
-        return naive_attention(q, k, v, causal=causal, window=window)
+        # Non-divisible tile knob (e.g. RTPU_ATTN_BLOCK_Q=768 with seq
+        # 2048): shrink to the largest divisor >=128 rather than silently
+        # dispatching a differentiated TRAINING path to naive — naive
+        # materializes O(L^2) scores and reintroduces the exact OOM the
+        # custom VJP exists to prevent (ADVICE r4 #5). Genuinely ragged
+        # short decode shapes (no >=128 divisor) still use naive.
+        import warnings
+
+        # blocks must stay sublane-aligned (x % 8) or Mosaic rejects the
+        # Pallas BlockSpec on real silicon
+        qb = next((x for x in range(q_block, 127, -1)
+                   if lq % x == 0 and x % 8 == 0), 0)
+        kb = next((x for x in range(kv_block, 127, -1)
+                   if lk % x == 0 and x % 8 == 0), 0)
+        if qb and kb:
+            warnings.warn(
+                f"attention tile sizes (q={q_block}, kv={kv_block}) do not "
+                f"divide seq (lq={lq}, lk={lk}); using largest divisors "
+                f"(q={qb}, kv={kb}) instead")
+            q_block, kv_block = qb, kb
+        else:
+            return naive_attention(q, k, v, causal=causal, window=window)
     scale = d ** -0.5
     return _mha(q, k, v, causal, scale, q_block, kv_block,
                 impl == "pallas", window)

@@ -188,6 +188,19 @@ class Catalog:
 
         shape = tuple(obs_space.shape or ())
         if len(shape) == 3:
+            # The CNN encoder assumes NHWC; a channel-first (C,H,W) space
+            # (common Atari wrappers) would be convolved with channels as
+            # height (reference catalog's dim checks role). Only shapes
+            # that are UNAMBIGUOUSLY channel-first are rejected — odd but
+            # valid channel counts (frame-stacked RGB (84,84,12), optical
+            # flow (84,84,2)) must keep working.
+            if shape[0] <= 4 < shape[-1]:
+                raise ValueError(
+                    f"3-D Box observation {shape} looks channel-first "
+                    "(C,H,W); the CNN encoder expects NHWC. Transpose "
+                    "observations (e.g. gymnasium.wrappers."
+                    "TransformObservation) before handing the space to "
+                    "Catalog.from_spaces.")
             enc = CNNEncoderConfig(obs_shape=shape)
         else:
             enc = MLPEncoderConfig(input_dim=int(np.prod(shape) or 1),

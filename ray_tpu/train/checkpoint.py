@@ -121,18 +121,26 @@ def save_pytree_async(tree: Any, path: str, *, name: str = "state") -> AsyncSave
     """Non-blocking :func:`save_pytree` (orbax async-checkpoint role): the
     device->host pull happens NOW (a consistent snapshot — the train loop
     may donate/overwrite the buffers immediately after this returns), and
-    the disk write runs on a background thread. Call ``.wait()`` before
-    relying on the files (BackendExecutor does at the next report)."""
+    the disk write runs on a background thread. The caller owns the
+    handle: call ``.wait()`` before relying on the files (e.g.
+    ``TrainLoopHelper.save_checkpoint_async`` returns it so a train loop
+    waits before reporting the checkpoint)."""
     import threading
 
     import jax
 
     leaves, treedef = jax.tree.flatten(tree)
-    # device leaves: device_get materializes a fresh host copy. HOST numpy
-    # leaves must be COPIED — np.asarray aliases, and the caller is told
-    # it may mutate immediately, which would tear the background write.
-    host = [np.asarray(jax.device_get(leaf))
-            if hasattr(leaf, "addressable_data")
+    # The snapshot must not ALIAS caller buffers (the caller is licensed
+    # to donate/overwrite immediately). On a real device backend,
+    # device_get already materializes a fresh host buffer — forcing a
+    # second copy there would double host RAM for a multi-GB state. On
+    # the CPU backend device_get/np.asarray can be zero-copy views of the
+    # (donatable) buffer, so there the copy is forced.
+    from ray_tpu.util.tpu_info import is_tpu_backend
+
+    _pull = ((lambda x: np.asarray(jax.device_get(x))) if is_tpu_backend()
+             else (lambda x: np.array(jax.device_get(x), copy=True)))
+    host = [_pull(leaf) if hasattr(leaf, "addressable_data")
             else np.array(leaf, copy=True)
             for leaf in leaves]
     snapshot = jax.tree.unflatten(treedef, host)

@@ -183,6 +183,17 @@ class TrainLoopHelper:
             self.state, metrics = self.step_fn(self.state, batch)
         return metrics
 
+    def save_checkpoint_async(self, path: str, *, name: str = "state"):
+        """Snapshot the CURRENT train state and write it in the background
+        (orbax async-checkpoint role). The device→host pull — with forced
+        copies — completes before this returns, so the next ``run_steps``
+        may donate/overwrite the state buffers immediately; only the disk
+        write overlaps training. Call ``.wait()`` on the returned handle
+        before relying on the files."""
+        from ray_tpu.train.checkpoint import save_pytree_async
+
+        return save_pytree_async(self.state, path, name=name)
+
     def profile_steps(self, batch: Dict[str, jax.Array], n: int,
                       logdir: str):
         """Capture an XLA device trace of ``n`` scanned steps to
