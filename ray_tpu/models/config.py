@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 from dataclasses import dataclass
-from typing import Optional
+from typing import Optional, Tuple
 
 
 @dataclass(frozen=True)
@@ -48,6 +48,17 @@ class TransformerConfig:
     # sliding-window (local) attention: each token attends to its last N
     # keys only (0 = full causal). Mistral-style; applies to every layer.
     sliding_window: int = 0
+    # per-layer window PATTERN (Gemma-2 alternation): a repeating tuple of
+    # windows, one per layer, 0 = global. E.g. (4096, 0) = sliding on even
+    # layers, global on odd. Overrides ``sliding_window`` when set;
+    # n_layers must divide by the pattern length. The training stack scans
+    # layer GROUPS of the pattern length so each sub-layer's window stays
+    # static (the banded kernels need static block liveness).
+    attn_windows: Optional[Tuple[int, ...]] = None
+    # attention-logit tanh soft-capping (Gemma-2: 50.0; 0 = off), applied
+    # inside every attention impl before masking — incl. the Pallas
+    # kernels' fwd and bwd, so training matches real checkpoints exactly
+    attn_softcap: float = 0.0
 
     # pipeline parallelism: microbatch count for the GPipe schedule when
     # the ambient mesh has pp > 1 (0 => 2 * pp, the usual bubble/memory
@@ -93,6 +104,37 @@ class TransformerConfig:
             raise ValueError(
                 f"unknown remat_policy {self.remat_policy!r}; "
                 "expected 'full' or 'save_attn'")
+        if self.attn_windows is not None:
+            if not self.attn_windows or any(
+                    not isinstance(w, int) or w < 0
+                    for w in self.attn_windows):
+                raise ValueError(
+                    f"attn_windows must be a non-empty tuple of ints >= 0 "
+                    f"(0 = global), got {self.attn_windows!r}")
+            if self.n_layers % len(self.attn_windows):
+                raise ValueError(
+                    f"n_layers {self.n_layers} not divisible by the "
+                    f"attn_windows pattern length {len(self.attn_windows)}")
+
+    @property
+    def window_pattern(self) -> Tuple[int, ...]:
+        """The repeating per-layer window pattern (0 = global)."""
+        if self.attn_windows is not None:
+            return tuple(self.attn_windows)
+        return (self.sliding_window,)
+
+    @property
+    def layer_windows(self) -> Tuple[int, ...]:
+        """Window per layer, expanded to all n_layers."""
+        pat = self.window_pattern
+        return pat * (self.n_layers // len(pat))
+
+    @property
+    def uniform_window(self) -> int:
+        """The single window shared by ALL layers, or 0 when layers mix
+        (or no window). Ring KV caches require a uniform window."""
+        pat = set(self.window_pattern)
+        return self.window_pattern[0] if len(pat) == 1 else 0
 
     def replace(self, **kw) -> "TransformerConfig":
         return dataclasses.replace(self, **kw)
@@ -183,20 +225,28 @@ def gpt2_debug() -> TransformerConfig:
 
 
 def gemma2_9b() -> TransformerConfig:
-    """Gemma-2-9B-family shape: GQA, tied embeddings, tanh logit softcap,
-    alternating-window attention approximated as a uniform 4096 window."""
+    """Gemma-2-9B-family shape: GQA, tied embeddings, tanh softcaps on
+    both attention logits (50.0) and output logits (30.0), and the EXACT
+    per-layer alternating windows — sliding 4096 on even layers, global on
+    odd (HF gemma-2 ``layer_types`` order: layer 0 is sliding). Remaining
+    known delta vs the real checkpoint: Gemma-2's pre+post sandwich norms
+    are modeled as pre-norms only."""
     return TransformerConfig(
         vocab_size=256128, d_model=3584, n_layers=42, n_heads=16,
         n_kv_heads=8, head_dim=256, d_ff=14336, max_seq_len=8192,
-        tie_embeddings=True, logits_softcap=30.0, sliding_window=4096,
+        tie_embeddings=True, logits_softcap=30.0, attn_softcap=50.0,
+        attn_windows=(4096, 0),
     )
 
 
 def gemma_debug() -> TransformerConfig:
-    """Tiny softcap+tied-embeddings config for tests."""
+    """Tiny gemma-2-style config for tests: alternating windows (local
+    layer 0, global layer 1), attention + logits softcaps, GQA, tied
+    embeddings."""
     return TransformerConfig(
         vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
         d_ff=128, max_seq_len=128, tie_embeddings=True, logits_softcap=30.0,
+        attn_softcap=50.0, attn_windows=(24, 0),
         remat=False,
     )
 

@@ -176,8 +176,16 @@ def _pp_axis_size() -> int:
     return mesh.shape["pp"]
 
 
-def _attention(q, k, v, config: TransformerConfig):
-    """Training attention: ring over sp when sequence-parallel, else flash."""
+def _attention(q, k, v, config: TransformerConfig, window: Optional[int] = None):
+    """Training attention: ring over sp when sequence-parallel, else flash.
+
+    ``window``: this LAYER's sliding window (per-layer alternation passes
+    it explicitly; 0 = global). ``None`` falls back to the config-uniform
+    window. Always STATIC — the banded kernels' block liveness is
+    compile-time structure.
+    """
+    if window is None:
+        window = config.uniform_window
     sp = _sp_axis_size()
     if sp > 1 and q.shape[1] % sp == 0 and k.shape[1] % sp == 0:
         from jax import shard_map
@@ -191,20 +199,22 @@ def _attention(q, k, v, config: TransformerConfig):
         batch = tuple(a for a in ("dcn", "dp", "fsdp")
                       if a in mesh.axis_names)
         qspec = P(batch or None, "sp", "tp" if "tp" in mesh.axis_names else None, None)
-        if config.sliding_window:
+        if window:
             # windowed + sequence-parallel: halo exchange (one ppermute of
             # the neighbor shard) instead of the full ring — O(1) comm
-            if config.sliding_window > q.shape[1] // sp:
+            if window > q.shape[1] // sp:
                 raise NotImplementedError(
-                    f"sliding_window {config.sliding_window} exceeds the "
+                    f"sliding window {window} exceeds the "
                     f"per-shard sequence {q.shape[1] // sp} (sp={sp}); "
                     "lower sp or raise seq/sp")
             inner = functools.partial(sliding_window_attention_sp,
                                       axis="sp",
-                                      window=config.sliding_window)
+                                      window=window,
+                                      softcap=config.attn_softcap)
         else:
             inner = functools.partial(ring_attention, axis="sp",
-                                      causal=True)
+                                      causal=True,
+                                      softcap=config.attn_softcap)
         fn = shard_map(
             inner,
             mesh=mesh, in_specs=(qspec, qspec, qspec), out_specs=qspec,
@@ -223,7 +233,8 @@ def _attention(q, k, v, config: TransformerConfig):
                            impl=resolve_attention_impl(),
                            q_block=int(_knobs.get("attn_block_q")),
                            kv_block=int(_knobs.get("attn_block_k")),
-                           window=config.sliding_window or None)
+                           window=window or None,
+                           softcap=config.attn_softcap)
 
 
 def _layers_pipelined(layer_params, x, layer_fn, c, pp, cos, sin):
@@ -335,7 +346,7 @@ def forward_features(
     else:
         cos = sin = None
 
-    def layer(x, lp, cos=cos, sin=sin):
+    def layer(x, lp, cos=cos, sin=sin, window=None):
         h = _norm(x, lp["attn_norm"], lp.get("attn_norm_b"), c.norm)
         q = jnp.einsum("bld,dhk->blhk", h, lp["wq"].astype(dt))
         k = jnp.einsum("bld,dhk->blhk", h, lp["wk"].astype(dt))
@@ -345,7 +356,7 @@ def forward_features(
             k = apply_rotary(k, cos, sin)
         q = constrain(q, ("batch", "seq", "heads", None))
         k = constrain(k, ("batch", "seq", "kv_heads", None))
-        o = _attention(q, k, v, c)
+        o = _attention(q, k, v, c, window=window)
         from jax.ad_checkpoint import checkpoint_name
 
         o = checkpoint_name(o, "attn_out")  # no-op unless a policy saves it
@@ -374,13 +385,21 @@ def forward_features(
         x = constrain(x + m, ("batch", "seq", None))
         return x, aux
 
-    body = _remat_wrap(layer, c)
+    pattern = c.window_pattern
+    uniform = len(set(pattern)) == 1
 
     pp = _pp_axis_size()
     if pp > 1:
+        if not uniform:
+            raise NotImplementedError(
+                "per-layer alternating windows (attn_windows) are not "
+                "supported with pipeline parallelism yet; use a uniform "
+                "window or pp=1")
         x, moe_aux = _layers_pipelined(params["layers"], x, layer, c, pp,
                                        cos, sin)
-    else:
+    elif uniform:
+        body = _remat_wrap(layer, c)
+
         def scan_step(carry, lp):
             x, aux_sum = carry
             x, aux = body(x, lp)
@@ -389,6 +408,32 @@ def forward_features(
         (x, moe_aux), _ = lax.scan(scan_step,
                                    (x, jnp.zeros((), jnp.float32)),
                                    params["layers"])
+    else:
+        # Per-layer alternating windows (Gemma-2): scan layer GROUPS of
+        # the pattern length, each sub-layer compiled with its own STATIC
+        # window — the banded kernels' block liveness is compile-time
+        # structure, so a traced per-layer window is not an option. Same
+        # one-compilation scan economy: the group body traces P layers
+        # once, not n_layers times.
+        P_ = len(pattern)
+        n_groups = c.n_layers // P_
+        grouped = jax.tree.map(
+            lambda a: a.reshape((n_groups, P_) + a.shape[1:]),
+            params["layers"])
+        bodies = [_remat_wrap(functools.partial(layer, window=w), c)
+                  for w in pattern]
+
+        def scan_group(carry, glp):
+            x, aux_sum = carry
+            for i in range(P_):
+                lp_i = jax.tree.map(lambda a: a[i], glp)
+                x, aux = bodies[i](x, lp_i)
+                aux_sum = aux_sum + aux
+            return (x, aux_sum), None
+
+        (x, moe_aux), _ = lax.scan(scan_group,
+                                   (x, jnp.zeros((), jnp.float32)),
+                                   grouped)
 
     x = _norm(x, params["final_norm"], params.get("final_norm_b"), c.norm)
     return x, moe_aux
@@ -522,9 +567,13 @@ def init_cache(config: TransformerConfig, batch: int, max_len: int,
     layout (needed when a single prefill chunk exceeds the window)."""
     c = config
     dt = jnp.dtype(dtype or c.dtype)
-    use_ring = (bool(c.sliding_window) and c.sliding_window < max_len
+    # ring layout requires ONE window shared by all layers (the cache is a
+    # single [n_layers, ...] stack); per-layer alternating windows with a
+    # global layer anywhere force the full-length layout
+    uniform = c.uniform_window
+    use_ring = (bool(uniform) and uniform < max_len
                 if rolling is None else rolling)
-    length = c.sliding_window if use_ring else max_len
+    length = uniform if use_ring else max_len
     shape = (c.n_layers, batch, length, c.kv_heads, c.hdim)
     return {
         "k": jnp.zeros(shape, dt),
@@ -551,7 +600,12 @@ def decode_step(
     # ring layout iff the cache was allocated at exactly the window size
     # (init_cache's rolling mode); slots are kept oldest->newest by
     # rolling, so slot j holds absolute position pos_new - cache_len + j
-    is_ring = bool(c.sliding_window) and cache_len == c.sliding_window
+    uniform = c.uniform_window
+    is_ring = bool(uniform) and cache_len == uniform
+    # per-layer effective windows for the masked full-cache path (traced
+    # through the layer scan; 2^30 = "global" — far beyond any position)
+    win_arr = jnp.array([w if w > 0 else (1 << 30)
+                         for w in c.layer_windows], jnp.int32)
     if is_ring and t > cache_len:
         raise ValueError(
             f"prefill chunk {t} exceeds the ring cache ({cache_len}); "
@@ -568,7 +622,7 @@ def decode_step(
 
     def layer(carry, inp):
         x = carry
-        lp, kc, vc = inp
+        lp, kc, vc, wl = inp
         h = _norm(x, lp["attn_norm"], lp.get("attn_norm_b"), c.norm)
         q = jnp.einsum("bld,dhk->blhk", h, lp["wq"].astype(dt))
         k = jnp.einsum("bld,dhk->blhk", h, lp["wk"].astype(dt))
@@ -595,8 +649,9 @@ def decode_step(
                 slot_pos = pos0 - (
                     (slot - jnp.arange(cache_len)) % cache_len)
                 o = naive_attention(q, kc, vc, causal=True, q_offset=pos0,
-                                    window=c.sliding_window,
-                                    k_positions=slot_pos)
+                                    window=uniform,
+                                    k_positions=slot_pos,
+                                    softcap=c.attn_softcap)
             else:
                 # chunked prefill: attend over old ring ++ new keys
                 # BEFORE evicting — a key evicted by the END of this
@@ -610,8 +665,9 @@ def decode_step(
                 pos_all = jnp.concatenate([slot_pos_old, positions])
                 o = naive_attention(q, k_all, v_all, causal=True,
                                     q_offset=pos0,
-                                    window=c.sliding_window,
-                                    k_positions=pos_all)
+                                    window=uniform,
+                                    k_positions=pos_all,
+                                    softcap=c.attn_softcap)
                 idx = positions % cache_len
                 kc = kc.at[:, idx].set(k.astype(kc.dtype))
                 vc = vc.at[:, idx].set(v.astype(vc.dtype))
@@ -620,8 +676,10 @@ def decode_step(
                                           (0, pos0, 0, 0))
             vc = lax.dynamic_update_slice(vc, v.astype(vc.dtype),
                                           (0, pos0, 0, 0))
+            # wl is this layer's window riding the scan (2^30 = global),
+            # so alternating-window models decode exactly
             o = naive_attention(q, kc, vc, causal=True, q_offset=pos0,
-                                window=c.sliding_window or None)
+                                window=wl, softcap=c.attn_softcap)
         o = jnp.einsum("blhk,hkd->bld", o, lp["wo"].astype(dt))
         x = x + o
         h = _norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"), c.norm)
@@ -643,7 +701,7 @@ def decode_step(
         return x + m, (kc, vc)
 
     x, (new_k, new_v) = lax.scan(
-        layer, x, (params["layers"], cache["k"], cache["v"])
+        layer, x, (params["layers"], cache["k"], cache["v"], win_arr)
     )
     x = _norm(x, params["final_norm"], params.get("final_norm_b"), c.norm)
     head = (params["embed"].T if c.tie_embeddings else params["lm_head"]).astype(dt)
@@ -666,7 +724,7 @@ def generate(
     b, p = prompt.shape
     total = max_len or min(config.max_seq_len, p + max_new_tokens)
     cache = init_cache(config, b, total)
-    w = config.sliding_window
+    w = config.uniform_window
     if w and cache["k"].shape[2] == w and p > w:
         # ring cache + long prompt: prefill in window-sized chunks so HBM
         # stays O(window) even for prompts far beyond it (the long-context

@@ -59,14 +59,31 @@ def _band_mask(qpos, kpos, causal, window):
     """[qb, kb] visibility mask for the causal/sliding-window band, or None.
 
     The ONE definition shared by naive/blockwise/backward paths — forward
-    and backward must never disagree on masking.
+    and backward must never disagree on masking. ``window`` may be a
+    TRACED int scalar (per-layer alternating windows ride a scanned layer
+    stack in decode); ``None`` (not 0) means no window, so truthiness is
+    never taken on a tracer.
     """
-    if not (causal or window):
+    if not (causal or window is not None):
         return None
     mask = qpos >= kpos
-    if window:
+    if window is not None:
         mask &= (qpos - kpos) < window
     return mask
+
+
+def _softcap_scores(s, softcap):
+    """Attention-logit soft-capping (Gemma-2): ``cap * tanh(s / cap)``.
+    Apply BEFORE masking — tanh(NEG_INF) would erase the mask value."""
+    if not softcap:
+        return s
+    return softcap * jnp.tanh(s / softcap)
+
+
+def _softcap_dfactor(s_hat, softcap):
+    """d(capped)/d(raw) = 1 - tanh^2 = 1 - (s_hat/cap)^2, from the CAPPED
+    (unmasked) score — shared by every backward recompute."""
+    return 1.0 - jnp.square(s_hat / softcap)
 
 
 def _repeat_kv(k: jax.Array, num_q_heads: int) -> jax.Array:
@@ -90,21 +107,26 @@ def naive_attention(
     window: Optional[int] = None,
     k_offset=0,
     k_positions: Optional[jax.Array] = None,
+    softcap: float = 0.0,
 ) -> jax.Array:
     """Materialized-scores attention; numerical reference for tests.
 
     ``q_offset`` shifts q's global positions (used for decode where q is a
     suffix of the kv sequence). ``window`` limits each query to the last
-    ``window`` keys (sliding-window / Mistral-style local attention).
+    ``window`` keys (sliding-window / Mistral-style local attention); it
+    may be a traced int scalar (per-layer windows riding a decode scan).
     Ring KV caches position their keys explicitly: ``k_offset`` maps slot
     j to global position k_offset + j, or ``k_positions`` gives each slot
     an arbitrary global position; either way negative positions mean
     "slot not filled yet" and are masked. All three features require
     ``causal`` (they are defined in terms of the causal band).
+    ``softcap`` applies Gemma-2-style tanh capping to the logits.
     """
+    if isinstance(window, int) and window <= 0:
+        window = None  # legacy "0 = off" callers; traced windows stay
     has_koff = (k_positions is not None
                 or not (isinstance(k_offset, int) and k_offset == 0))
-    if (window or has_koff) and not causal:
+    if (window is not None or has_koff) and not causal:
         raise ValueError(
             "window / ring key positions require causal attention")
     scale = scale if scale is not None else q.shape[-1] ** -0.5
@@ -113,7 +135,8 @@ def naive_attention(
     # [B, H, Lq, Lk]
     scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
     scores = scores * scale
-    if causal or window or has_koff:
+    scores = _softcap_scores(scores, softcap)
+    if causal or window is not None or has_koff:
         lq, lk = q.shape[1], k.shape[1]
         if k_positions is not None:
             k_pos = k_positions
@@ -129,13 +152,14 @@ def naive_attention(
     return out.astype(q.dtype)
 
 
-def _attend_block(q, k, v, m, l, o, mask, scale):
+def _attend_block(q, k, v, m, l, o, mask, scale, softcap=0.0):
     """One online-softmax update: q block vs one kv block.
 
     q: [B, qb, H, D]; k/v: [B, kb, H, D]; m,l: [B, H, qb]; o: [B, qb, H, D];
     mask: [qb, kb] bool or None.
     """
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale  # fp32
+    s = _softcap_scores(s, softcap)
     if mask is not None:
         s = jnp.where(mask[None, None], s, NEG_INF)
     m_new = jnp.maximum(m, s.max(axis=-1))
@@ -159,6 +183,7 @@ def blockwise_attention(
     kv_block: int = 512,
     q_offset: int = 0,
     window: Optional[int] = None,
+    softcap: float = 0.0,
 ) -> jax.Array:
     """Flash-style attention with online softmax, pure XLA.
 
@@ -166,7 +191,9 @@ def blockwise_attention(
     are static so XLA tiles cleanly onto the MXU. ``window`` masks each
     query to its last ``window`` keys (sliding-window attention).
     """
-    if window and not causal:
+    if isinstance(window, int) and window <= 0:
+        window = None
+    if window is not None and not causal:
         raise ValueError("window requires causal attention")
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     b, lq, h, d = q.shape
@@ -178,7 +205,8 @@ def blockwise_attention(
     if lq % q_block or lk % kv_block:
         # Fall back for ragged lengths; decode paths use naive anyway.
         return naive_attention(q, k, v, causal=causal, scale=scale,
-                               q_offset=q_offset, window=window)
+                               q_offset=q_offset, window=window,
+                               softcap=softcap)
     nq, nk = lq // q_block, lk // kv_block
 
     qf = q.astype(jnp.float32).reshape(b, nq, q_block, h, d)
@@ -199,7 +227,7 @@ def blockwise_attention(
             ki, kb, vb = inp
             mask = _band_mask(qi * q_block + q_ids[:, None] + q_offset,
                               ki * kv_block + k_ids[None, :], causal, window)
-            m, l, o = _attend_block(qb, kb, vb, m, l, o, mask, scale)
+            m, l, o = _attend_block(qb, kb, vb, m, l, o, mask, scale, softcap)
             return (m, l, o), None
 
         (m, l, o), _ = lax.scan(
@@ -262,7 +290,8 @@ def _live_kv_start(qi, nk: int, n_live: int, q_block: int, kv_block: int,
 
 
 def _mha_fwd_blockwise(q, k, v, causal, scale, q_block, kv_block,
-                       window=None, qpos=None, kpos=None, pos_delta=None):
+                       window=None, qpos=None, kpos=None, pos_delta=None,
+                       softcap=0.0):
     """Blockwise forward returning (out, lse). Heads already expanded.
 
     Causal rows always see at least the diagonal key, so lse is finite.
@@ -306,7 +335,7 @@ def _mha_fwd_blockwise(q, k, v, causal, scale, q_block, kv_block,
             mask = _band_mask(qp[:, None], kp[None, :], causal, window)
             if kpos is not None and mask is not None:
                 mask &= (kp >= 0)[None, :]
-            m, l, o = _attend_block(qb, kb, vb, m, l, o, mask, scale)
+            m, l, o = _attend_block(qb, kb, vb, m, l, o, mask, scale, softcap)
             return (m, l, o), None
 
         if kpos is not None and pos_delta is None:
@@ -331,7 +360,7 @@ def _mha_fwd_blockwise(q, k, v, causal, scale, q_block, kv_block,
 
 def _mha_bwd_blockwise(causal, scale, q_block, kv_block,
                        q, k, v, out, lse, dout, window=None,
-                       qpos=None, kpos=None, pos_delta=None):
+                       qpos=None, kpos=None, pos_delta=None, softcap=0.0):
     """Blocked backward; recomputes p per (q-block, kv-block) pair."""
     b, lq, h, d = q.shape
     lk = k.shape[1]
@@ -356,6 +385,7 @@ def _mha_bwd_blockwise(causal, scale, q_block, kv_block,
         def kv_step(_, kin):
             ki, kb, vb = kin
             s = jnp.einsum("bqhd,bkhd->bhqk", qb, kb) * scale
+            s_hat = _softcap_scores(s, softcap)  # pre-mask: dfactor source
             qp = (qi * q_block + q_ids if qpos is None
                   else lax.dynamic_slice_in_dim(qpos, qi * q_block, q_block))
             kp = (ki * kv_block + k_ids if kpos is None
@@ -364,6 +394,7 @@ def _mha_bwd_blockwise(causal, scale, q_block, kv_block,
             mask = _band_mask(qp[:, None], kp[None, :], causal, window)
             if kpos is not None and mask is not None:
                 mask &= (kp >= 0)[None, :]
+            s = s_hat
             if mask is not None:
                 s = jnp.where(mask[None, None], s, NEG_INF)
             # out-of-band keys: s = NEG_INF, lse finite -> p underflows to
@@ -371,6 +402,10 @@ def _mha_bwd_blockwise(causal, scale, q_block, kv_block,
             p = jnp.exp(s - lseb[..., None])       # [B, H, qb, kb]
             dp = jnp.einsum("bqhd,bkhd->bhqk", dob, vb)
             ds = p * (dp - dvec[..., None])
+            if softcap:
+                # chain through the cap: d(raw)/d(capped); masked entries
+                # already have p = 0, so the (finite) factor is harmless
+                ds = ds * _softcap_dfactor(s_hat, softcap)
             dq_c = scale * jnp.einsum("bhqk,bkhd->bqhd", ds, kb)
             dk_c = scale * jnp.einsum("bhqk,bqhd->bkhd", ds, qb)
             dv_c = jnp.einsum("bhqk,bqhd->bkhd", p, dob)
@@ -409,15 +444,16 @@ def _mha_bwd_blockwise(causal, scale, q_block, kv_block,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _mha(q, k, v, causal, scale, q_block, kv_block, use_pallas, window=None):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _mha(q, k, v, causal, scale, q_block, kv_block, use_pallas, window=None,
+         softcap=0.0):
     out, _ = _mha_fwd(q, k, v, causal, scale, q_block, kv_block, use_pallas,
-                      window)
+                      window, softcap)
     return out
 
 
 def _mha_fwd(q, k, v, causal, scale, q_block, kv_block, use_pallas,
-             window=None):
+             window=None, softcap=0.0):
     """k/v stay at their native (possibly fewer, GQA) head count in the
     residuals — expanding before the VJP would multiply residual HBM by the
     group factor, eroding the O(L) memory win this VJP exists for."""
@@ -427,24 +463,25 @@ def _mha_fwd(q, k, v, causal, scale, q_block, kv_block, use_pallas,
         # the Pallas kernel handles GQA natively (kv block reuse per group)
         out, lse = flash_attention_pallas_fwd(
             q, k, v, causal=causal, scale=scale,
-            block_q=q_block, block_k=kv_block, window=window)
+            block_q=q_block, block_k=kv_block, window=window,
+            softcap=softcap)
     else:
         h = q.shape[2]
         out, lse = _mha_fwd_blockwise(q, _repeat_kv(k, h), _repeat_kv(v, h),
                                       causal, scale, q_block, kv_block,
-                                      window)
+                                      window, softcap=softcap)
     return out, (q, k, v, out, lse)
 
 
 def _mha_fwd_rule(q, k, v, causal, scale, q_block, kv_block, use_pallas,
-                  window=None):
+                  window=None, softcap=0.0):
     out, res = _mha_fwd(q, k, v, causal, scale, q_block, kv_block, use_pallas,
-                        window)
+                        window, softcap)
     return out, res
 
 
 def _mha_bwd_rule(causal, scale, q_block, kv_block, use_pallas, window,
-                  res, dout):
+                  softcap, res, dout):
     q, k, v, out, lse = res
     b, lk, hk, d = k.shape
     lq, h = q.shape[1], q.shape[2]
@@ -459,11 +496,13 @@ def _mha_bwd_rule(causal, scale, q_block, kv_block, use_pallas, window,
 
         dq, dk, dv = flash_attention_pallas_bwd(
             q, k, v, out, lse, dout, causal=causal, scale=scale,
-            block_q=q_block, block_k=kv_block, window=window)
+            block_q=q_block, block_k=kv_block, window=window,
+            softcap=softcap)
     else:
         kx, vx = _repeat_kv(k, h), _repeat_kv(v, h)
         dq, dk, dv = _mha_bwd_blockwise(causal, scale, q_block, kv_block,
-                                        q, kx, vx, out, lse, dout, window)
+                                        q, kx, vx, out, lse, dout, window,
+                                        softcap=softcap)
         if hk != h:
             group = h // hk
             dk = dk.reshape(b, lk, hk, group, d).sum(axis=3)
@@ -480,24 +519,26 @@ _mha.defvjp(_mha_fwd_rule, _mha_bwd_rule)
 # custom-vjp cotangents are well-typed zeros; negative key positions mean
 # "halo wrap garbage" and are masked). Same O(L) residuals as _mha.
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
 def _mha_pos(q, k, v, qpos, kpos, scale, q_block, kv_block, window,
-             pos_delta=None):
+             pos_delta=None, softcap=0.0):
     out, _ = _mha_pos_fwd(q, k, v, qpos, kpos, scale, q_block, kv_block,
-                          window, pos_delta)
+                          window, pos_delta, softcap)
     return out
 
 
 def _mha_pos_fwd(q, k, v, qpos, kpos, scale, q_block, kv_block, window,
-                 pos_delta=None):
+                 pos_delta=None, softcap=0.0):
     h = q.shape[2]
     out, lse = _mha_fwd_blockwise(q, _repeat_kv(k, h), _repeat_kv(v, h),
                                   True, scale, q_block, kv_block, window,
-                                  qpos=qpos, kpos=kpos, pos_delta=pos_delta)
+                                  qpos=qpos, kpos=kpos, pos_delta=pos_delta,
+                                  softcap=softcap)
     return out, (q, k, v, out, lse, qpos, kpos)
 
 
-def _mha_pos_bwd(scale, q_block, kv_block, window, pos_delta, res, dout):
+def _mha_pos_bwd(scale, q_block, kv_block, window, pos_delta, softcap,
+                 res, dout):
     q, k, v, out, lse, qpos, kpos = res
     b, lk, hk, d = k.shape
     h = q.shape[2]
@@ -505,7 +546,7 @@ def _mha_pos_bwd(scale, q_block, kv_block, window, pos_delta, res, dout):
     dq, dk, dv = _mha_bwd_blockwise(True, scale, q_block, kv_block,
                                     q, kx, vx, out, lse, dout, window,
                                     qpos=qpos, kpos=kpos,
-                                    pos_delta=pos_delta)
+                                    pos_delta=pos_delta, softcap=softcap)
     if hk != h:
         group = h // hk
         dk = dk.reshape(b, lk, hk, group, d).sum(axis=3)
@@ -526,6 +567,7 @@ def flash_attention(
     q_block: int = 512,
     kv_block: int = 512,
     window: Optional[int] = None,
+    softcap: float = 0.0,
 ) -> jax.Array:
     """Dispatching entry point: Pallas kernel on TPU, blockwise XLA elsewhere.
 
@@ -553,7 +595,8 @@ def flash_attention(
     if impl == "auto":
         impl = resolve_attention_impl()
     if impl == "naive":
-        return naive_attention(q, k, v, causal=causal, window=window)
+        return naive_attention(q, k, v, causal=causal, window=window,
+                               softcap=softcap)
     b, lq, h, d = q.shape
     lk, hk = k.shape[1], k.shape[2]
     q_block = min(q_block, lq)
@@ -580,7 +623,8 @@ def flash_attention(
                 f"(q={qb}, kv={kb}) instead")
             q_block, kv_block = qb, kb
         else:
-            return naive_attention(q, k, v, causal=causal, window=window)
+            return naive_attention(q, k, v, causal=causal, window=window,
+                                   softcap=softcap)
     scale = d ** -0.5
     return _mha(q, k, v, causal, scale, q_block, kv_block,
-                impl == "pallas", window)
+                impl == "pallas", window, softcap)

@@ -21,6 +21,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ray_tpu.ops.attention import (_softcap_dfactor as _softcap_dfac,
+                                   _softcap_scores as _softcap_fwd)
+
 NEG_INF = -1e30
 LANES = 128  # running max / denom stored broadcast over one lane tile
 
@@ -59,7 +62,7 @@ def _require_causal_window(causal: bool, window) -> None:
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
                   *, causal: bool, scale: float, block_q: int, block_k: int,
-                  window=None):
+                  window=None, softcap: float = 0.0):
     qi = pl.program_id(2)
     ki = pl.program_id(3)
     nk = pl.num_programs(3)
@@ -81,6 +84,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale                                    # [bq, bk]
+        s = _softcap_fwd(s, softcap)
         if band is not None:
             s = jnp.where(band, s, NEG_INF)
         m_prev = m_ref[:, 0:1]                       # [bq, 1]
@@ -113,7 +117,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
 @functools.partial(
     jax.jit,
     static_argnames=("causal", "scale", "block_q", "block_k", "window",
-                     "interpret"),
+                     "softcap", "interpret"),
 )
 def flash_attention_pallas_fwd(
     q: jax.Array,
@@ -125,6 +129,7 @@ def flash_attention_pallas_fwd(
     block_q: int = 512,
     block_k: int = 512,
     window: Optional[int] = None,
+    softcap: float = 0.0,
     interpret: bool = False,
 ):
     """Flash attention forward returning ``(out, lse)``.
@@ -146,7 +151,8 @@ def flash_attention_pallas_fwd(
         from ray_tpu.ops.attention import _mha_fwd_blockwise, _repeat_kv
 
         return _mha_fwd_blockwise(q, _repeat_kv(k, h), _repeat_kv(v, h),
-                                  causal, scale, lq, lk, window)
+                                  causal, scale, lq, lk, window,
+                                  softcap=softcap)
     nq, nk = lq // block_q, lk // block_k
 
     qt = q.transpose(0, 2, 1, 3)  # [B, H, Lq, D]
@@ -155,7 +161,7 @@ def flash_attention_pallas_fwd(
 
     kernel = functools.partial(
         _flash_kernel, causal=causal, scale=scale,
-        block_q=block_q, block_k=block_k, window=window,
+        block_q=block_q, block_k=block_k, window=window, softcap=softcap,
     )
     out, lse = pl.pallas_call(
         kernel,
@@ -225,7 +231,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           dk_ref, dv_ref, dk_acc, dv_acc,
                           *, causal: bool, scale: float,
                           block_q: int, block_k: int, nq: int,
-                          window=None):
+                          window=None, softcap: float = 0.0):
     """dK/dV sweep at NATIVE kv-head count: the sequential grid dim walks
     (group, q_block) pairs — ``t = g * nq + qi`` — so each kv head's
     gradients accumulate over every q head of its group without ever
@@ -250,8 +256,11 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0, 0].astype(jnp.float32)         # [bq, d]
         lse = lse_ref[0, 0][:, 0:1]                   # [bq, 1]
         delta = delta_ref[0, 0][:, 0:1]               # [bq, 1]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
+        s_hat = _softcap_fwd(
+            jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale,
+            softcap)
+        s = s_hat
         if band is not None:
             s = jnp.where(band, s, NEG_INF)
         p = jnp.exp(s - lse)                          # [bq, bk]
@@ -261,6 +270,9 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta)                         # [bq, bk]
+        if softcap:
+            # masked entries have p = 0 already, so the factor is harmless
+            ds = ds * _softcap_dfac(s_hat, softcap)
         dk_acc[:] += scale * jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)       # ds^T q: [bk, d]
@@ -274,7 +286,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          dq_ref, dq_acc,
                          *, causal: bool, scale: float,
-                         block_q: int, block_k: int, window=None):
+                         block_q: int, block_k: int, window=None,
+                         softcap: float = 0.0):
     qi = pl.program_id(2)
     ki = pl.program_id(3)
     nk = pl.num_programs(3)
@@ -293,14 +306,19 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0, 0].astype(jnp.float32)
         lse = lse_ref[0, 0][:, 0:1]
         delta = delta_ref[0, 0][:, 0:1]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
+        s_hat = _softcap_fwd(
+            jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale,
+            softcap)
+        s = s_hat
         if band is not None:
             s = jnp.where(band, s, NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
+        if softcap:
+            ds = ds * _softcap_dfac(s_hat, softcap)
         dq_acc[:] += scale * jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)       # ds k: [bq, d]
@@ -313,7 +331,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 @functools.partial(
     jax.jit,
     static_argnames=("causal", "scale", "block_q", "block_k", "window",
-                     "interpret"),
+                     "softcap", "interpret"),
 )
 def flash_attention_pallas_bwd(
     q: jax.Array,
@@ -328,6 +346,7 @@ def flash_attention_pallas_bwd(
     block_q: int = 512,
     block_k: int = 512,
     window: Optional[int] = None,
+    softcap: float = 0.0,
     interpret: bool = False,
 ):
     """Backward pass. ``q``/``out``/``dout``: [B, Lq, H, D]; ``k``/``v``
@@ -364,7 +383,8 @@ def flash_attention_pallas_bwd(
 
     dkv_kernel = functools.partial(
         _flash_bwd_dkv_kernel, causal=causal, scale=scale,
-        block_q=block_q, block_k=block_k, nq=nq, window=window)
+        block_q=block_q, block_k=block_k, nq=nq, window=window,
+        softcap=softcap)
     dk_t, dv_t = pl.pallas_call(
         dkv_kernel,
         grid=(b, hk, nk, nq * group),
@@ -397,7 +417,7 @@ def flash_attention_pallas_bwd(
 
     dq_kernel = functools.partial(
         _flash_bwd_dq_kernel, causal=causal, scale=scale,
-        block_q=block_q, block_k=block_k, window=window)
+        block_q=block_q, block_k=block_k, window=window, softcap=softcap)
     dq_t = pl.pallas_call(
         dq_kernel,
         grid=(b, h, nq, nk),

@@ -31,6 +31,7 @@ def ring_attention(
     axis: str = "sp",
     causal: bool = True,
     scale: Optional[float] = None,
+    softcap: float = 0.0,
 ) -> Array:
     """Sequence-parallel attention; call inside shard_map over ``axis``.
 
@@ -57,7 +58,8 @@ def ring_attention(
             k_pos = src * lloc + jnp.arange(lloc)
             mask = q_pos[:, None] >= k_pos[None, :]
         return _attend_block(
-            qf, kb.astype(jnp.float32), vb.astype(jnp.float32), m, l, o, mask, scale
+            qf, kb.astype(jnp.float32), vb.astype(jnp.float32), m, l, o,
+            mask, scale, softcap
         )
 
     def step(carry, i):
@@ -96,6 +98,7 @@ def sliding_window_attention_sp(
     scale: Optional[float] = None,
     q_block: int = 512,
     kv_block: int = 512,
+    softcap: float = 0.0,
 ) -> Array:
     """Sequence-parallel SLIDING-WINDOW attention via halo exchange.
 
@@ -138,4 +141,4 @@ def sliding_window_attention_sp(
     # pos_delta = qpos[0] - kpos[0] = Lloc (STATIC): keeps the windowed
     # live-kv-block slicing so the band costs O(Lloc*window), not dense
     return _mha_pos(q, k_all, v_all, qpos, kpos, scale, bq, bk, window,
-                    lloc)
+                    lloc, softcap)

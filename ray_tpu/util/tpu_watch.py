@@ -383,6 +383,36 @@ def numerics_child() -> None:
             out["window_ok"] = False
             out["window_error"] = str(e)[-300:]
 
+    # Attention-logit softcap (Gemma-2): tanh in the kernel fwd + the
+    # (1 - (s/cap)^2) chain factor in both bwd kernels — validate the
+    # Pallas path against naive on real Mosaic (r5 addition).
+    if not small and impl_ok.get("pallas"):
+        try:
+            def closs(q, k, v, impl):
+                o = flash_attention(q, k, v, causal=True, impl=impl,
+                                    window=S // 4, softcap=20.0)
+                return (o.astype(jnp.float32) * w.astype(jnp.float32)).sum()
+
+            errs = {}
+            ref = None
+            for impl in ("naive", "pallas"):
+                val, grads = jax.jit(
+                    jax.value_and_grad(closs, argnums=(0, 1, 2)),
+                    static_argnames=("impl",))(q, k, v, impl=impl)
+                jax.device_get(val)
+                if ref is None:
+                    ref = (val, grads)
+                else:
+                    errs["softcap_fwd_rel_err"] = max_err(val, ref[0])
+                    for name, a, b in zip(("dq", "dk", "dv"), grads,
+                                          ref[1]):
+                        errs[f"softcap_{name}_rel_err"] = max_err(a, b)
+            out.update(errs)
+            out["softcap_ok"] = all(e < tol for e in errs.values())
+        except Exception as e:
+            out["softcap_ok"] = False
+            out["softcap_error"] = str(e)[-300:]
+
     # Long-seq bwd: at S=16384, B=4, H=8 the naive per-layer probability
     # residual alone is B*H*S^2*4B = 32 GiB — over the 16 GiB HBM. The
     # memory-efficient VJP must sustain it.
