@@ -650,6 +650,62 @@ def _core_microbench() -> dict:
 
         out["pg_create_remove_per_s"] = best_of(3, pg_trial)
 
+        # -- multi-client + n:n benches (reference ray_perf.py:189,232,146:
+        # "multi client" = WORKER-side clients submitting core-API calls
+        # from inside actors/tasks, not extra driver processes) -----------
+
+        @ray_tpu.remote
+        class BatchClient:
+            def small_value_batch(self, n):
+                ray_tpu.get([noop.remote() for _ in range(n)])
+                return n
+
+        clients = [BatchClient.remote() for _ in range(2)]
+        ray_tpu.get([c.small_value_batch.remote(10) for c in clients])  # warm
+
+        def multi_task_trial(n=250):
+            t0 = time.perf_counter()
+            ray_tpu.get([c.small_value_batch.remote(n) for c in clients])
+            return len(clients) * n / (time.perf_counter() - t0)
+
+        out["multi_client_tasks_async_per_s"] = best_of(3, multi_task_trial)
+
+        @ray_tpu.remote
+        def nn_work(actors, n):
+            ray_tpu.get([actors[i % len(actors)].f.remote()
+                         for i in range(n)])
+            return n
+
+        nn_actors = [A.options(num_cpus=0).remote() for _ in range(2)]
+        ray_tpu.get([x.f.remote() for x in nn_actors])
+        ray_tpu.get(nn_work.remote(nn_actors, 10))  # warm
+
+        def nn_trial(m=2, n=150):
+            t0 = time.perf_counter()
+            ray_tpu.get([nn_work.remote(nn_actors, n) for _ in range(m)])
+            return m * n / (time.perf_counter() - t0)
+
+        out["n_n_actor_calls_async_per_s"] = best_of(3, nn_trial)
+
+        @ray_tpu.remote
+        def do_put(nbytes, times):
+            data = np.zeros(nbytes // 8)
+            for _ in range(times):
+                ray_tpu.put(data)
+            return times * nbytes
+
+        ray_tpu.get(do_put.remote(1 << 16, 1))  # warm
+
+        def multi_put_trial(nbytes=8 << 20, times=4, m=2):
+            t0 = time.perf_counter()
+            ray_tpu.get([do_put.remote(nbytes, times) for _ in range(m)])
+            return m * times * nbytes / (time.perf_counter() - t0) / 1e9
+
+        out["multi_client_put_gb_per_s"] = best_of(3, multi_put_trial,
+                                                   ndigits=2)
+        for x in nn_actors + clients:
+            ray_tpu.kill(x)
+
         # numpy payload rides the zero-copy out-of-band buffer path (the
         # realistic ML case; raw bytes pickle in-band)
         arr = np.random.default_rng(0).standard_normal(1 << 20)  # 8 MiB

@@ -571,6 +571,11 @@ def init_cache(config: TransformerConfig, batch: int, max_len: int,
     # single [n_layers, ...] stack); per-layer alternating windows with a
     # global layer anywhere force the full-length layout
     uniform = c.uniform_window
+    if rolling and not uniform:
+        raise ValueError(
+            "ring KV layout requires ONE window shared by all layers; "
+            f"this config's pattern is {c.window_pattern} (0 = global / "
+            "mixed) — use rolling=False (full-length cache)")
     use_ring = (bool(uniform) and uniform < max_len
                 if rolling is None else rolling)
     length = uniform if use_ring else max_len
