@@ -30,12 +30,15 @@ from ray_tpu.rllib.connectors import (
     NormalizeObservations,
     ScaleActions,
 )
+from ray_tpu.rllib.cql import CQLLearner, train_cql
 from ray_tpu.rllib.offline import (
     BCLearner,
+    MARWILLearner,
     OfflineReader,
     OfflineWriter,
     record_episodes,
     train_bc,
+    train_marwil,
 )
 from ray_tpu.rllib.multi_agent import (
     DebugCooperativeMatch,
@@ -100,8 +103,12 @@ __all__ = [
     "ClipActions",
     "ScaleActions",
     "BCLearner",
+    "CQLLearner",
+    "MARWILLearner",
     "OfflineReader",
     "OfflineWriter",
     "record_episodes",
     "train_bc",
+    "train_cql",
+    "train_marwil",
 ]

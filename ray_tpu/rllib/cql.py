@@ -1,0 +1,217 @@
+"""CQL: conservative Q-learning for offline continuous control.
+
+Reference: ``rllib/algorithms/cql/cql.py`` (config:
+bc_iters/temperature/num_actions/min_q_weight) and
+``cql_torch_policy.py:83`` (loss). CQL is SAC plus a conservative
+penalty on both critics that pushes Q down on out-of-distribution
+actions and up on dataset actions:
+
+    penalty_i = w * t * mean(logsumexp(cat_q_i / t)) - w * mean(q_i_data)
+
+where ``cat_q_i`` stacks, per state, Q on uniform-random actions
+(importance-corrected by the uniform density), on fresh policy actions
+at s, and on fresh policy actions at s' (each corrected by its detached
+log-prob) — the "entropy version" the reference calls best. The first
+``bc_iters`` updates use a behavior-cloning actor loss
+(``alpha * logp_pi - logp(data actions)``), after which the standard
+SAC actor objective takes over. The Bellman target follows the
+reference in OMITTING the entropy bonus (plain ``r + gamma * min_tq``).
+
+TPU-native: everything (critic + penalty + actor + alpha + polyak) is
+one jitted update; the bc_iters switch rides in as a traced step count
+through ``lax.cond`` so no recompilation happens at the handoff.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib.sac import SACLearner
+
+
+def _tanh_gaussian_logp(mean, log_std, actions):
+    """log-prob of ALREADY-SQUASHED actions under the tanh-gaussian
+    (inverse of SACModule.sample_action's change of variables)."""
+    import jax
+    import jax.numpy as jnp
+
+    a = jnp.clip(actions, -1.0 + 1e-6, 1.0 - 1e-6)
+    pre = jnp.arctanh(a)
+    std = jnp.exp(log_std)
+    logp = (-0.5 * (((pre - mean) / std) ** 2 + 2 * log_std
+                    + np.log(2 * np.pi))).sum(-1)
+    logp -= (2 * (np.log(2.0) - pre - jax.nn.softplus(-2 * pre))).sum(-1)
+    return logp
+
+
+class CQLLearner(SACLearner):
+    """SAC learner + conservative-Q penalty + BC actor warmup.
+
+    Extra config keys over SAC: ``min_q_weight`` (5.0), ``temperature``
+    (1.0), ``num_actions`` (4 sampled actions per source), ``bc_iters``
+    (0). ``update()`` counts its own iterations for the bc_iters switch.
+    """
+
+    def __init__(self, module_spec_dict: Dict[str, Any],
+                 config: Dict[str, Any] = None, seed: int = 0):
+        super().__init__(module_spec_dict, config, seed)
+        self._iter = 0
+
+    def _sample_n(self, params, obs, rng, n):
+        """n tanh-gaussian actions per state: obs [B, D] -> ([B*n, A],
+        [B*n] detachable logp), with obs tiled to match."""
+        import jax.numpy as jnp
+
+        b = obs.shape[0]
+        obs_rep = jnp.repeat(obs, n, axis=0)
+        act, logp = self.module.sample_action(params, obs_rep, rng)
+        return obs_rep, act, logp
+
+    def _update_step(self, params, target_params, log_alpha, opt_state,
+                     alpha_state, batch, rng, it=0):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.config
+        gamma = cfg.get("gamma", 0.99)
+        tau = cfg.get("tau", 0.005)
+        w = cfg.get("min_q_weight", 5.0)
+        temp = cfg.get("temperature", 1.0)
+        n_act = int(cfg.get("num_actions", 4))
+        bc_iters = int(cfg.get("bc_iters", 0))
+        target_entropy = cfg.get("target_entropy",
+                                 -float(self.spec.action_dim))
+        alpha = jnp.exp(log_alpha)
+        a_dim = self.spec.action_dim
+        k1, k2, k3, k4, k5 = jax.random.split(rng, 5)
+
+        # -- Bellman target (reference cql_torch_policy.py:185 — NO
+        # entropy bonus in the target, unlike SAC) --
+        next_act, _ = self.module.sample_action(params, batch["next_obs"], k1)
+        tq1, tq2 = self.module.q_values(target_params, batch["next_obs"],
+                                        next_act)
+        target_q = batch["rewards"] + gamma * (1.0 - batch["dones"]) * (
+            jnp.minimum(tq1, tq2))
+        target_q = jax.lax.stop_gradient(target_q)
+
+        def loss_fn(p):
+            q1, q2 = self.module.q_values(p, batch["obs"], batch["actions"])
+            critic = ((q1 - target_q) ** 2 + (q2 - target_q) ** 2).mean()
+
+            # -- conservative penalty --
+            b = batch["obs"].shape[0]
+            rand = jax.random.uniform(k3, (b * n_act, a_dim),
+                                      minval=-1.0, maxval=1.0)
+            obs_rep, curr_a, curr_lp = self._sample_n(p, batch["obs"], k4,
+                                                      n_act)
+            _, next_a, next_lp = self._sample_n(p, batch["next_obs"], k5,
+                                                n_act)
+            q1_rand, q2_rand = self.module.q_values(p, obs_rep, rand)
+            q1_curr, q2_curr = self.module.q_values(p, obs_rep, curr_a)
+            # reference evaluates next-state actions at the CURRENT obs
+            q1_next, q2_next = self.module.q_values(p, obs_rep, next_a)
+            rd = float(np.log(0.5 ** a_dim))  # uniform(-1,1) log-density
+            curr_lp = jax.lax.stop_gradient(curr_lp)
+            next_lp = jax.lax.stop_gradient(next_lp)
+
+            def cat_q(q_rand, q_curr, q_next):
+                # [B, 3*n_act] per-state candidate set
+                return jnp.concatenate([
+                    (q_rand - rd).reshape(b, n_act),
+                    (q_next - next_lp).reshape(b, n_act),
+                    (q_curr - curr_lp).reshape(b, n_act),
+                ], axis=1)
+
+            lse1 = jax.scipy.special.logsumexp(
+                cat_q(q1_rand, q1_curr, q1_next) / temp, axis=1)
+            lse2 = jax.scipy.special.logsumexp(
+                cat_q(q2_rand, q2_curr, q2_next) / temp, axis=1)
+            pen1 = w * temp * lse1.mean() - w * q1.mean()
+            pen2 = w * temp * lse2.mean() - w * q2.mean()
+
+            # -- actor: BC warmup for the first bc_iters, then SAC --
+            act, logp = self.module.sample_action(p, batch["obs"], k2)
+            aq1, aq2 = self.module.q_values(jax.lax.stop_gradient(p),
+                                            batch["obs"], act)
+            sac_actor = (alpha * logp - jnp.minimum(aq1, aq2)).mean()
+            mean, log_std = self.module.actor(p, batch["obs"])
+            bc_logp = _tanh_gaussian_logp(mean, log_std, batch["actions"])
+            bc_actor = (alpha * logp - bc_logp).mean()
+            actor = jax.lax.cond(it < bc_iters, lambda: bc_actor,
+                                 lambda: sac_actor)
+
+            total = critic + pen1 + pen2 + actor
+            # the observable conservatism: how far OOD Q sits BELOW data Q
+            gap = (q1_rand.reshape(b, n_act).mean() - q1.mean())
+            return total, (critic, pen1 + pen2, actor, logp, gap)
+
+        (loss, (c_loss, cql_pen, a_loss, logp, gap)), grads = (
+            jax.value_and_grad(loss_fn, has_aux=True)(params))
+        updates, opt_state = self.optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+
+        def alpha_loss_fn(la):
+            return -(jnp.exp(la) * jax.lax.stop_gradient(
+                logp + target_entropy)).mean()
+
+        a_grad = jax.grad(alpha_loss_fn)(log_alpha)
+        a_updates, alpha_state = self.alpha_opt.update(a_grad, alpha_state)
+        log_alpha = optax.apply_updates(log_alpha, a_updates)
+
+        target_params = jax.tree.map(
+            lambda t, o: (1 - tau) * t + tau * o, target_params, params)
+        metrics = {"critic_loss": c_loss, "cql_penalty": cql_pen,
+                   "actor_loss": a_loss, "alpha": jnp.exp(log_alpha),
+                   "cql_gap": gap, "entropy": -logp.mean()}
+        return (params, target_params, log_alpha, opt_state, alpha_state,
+                metrics)
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        import jax
+        import jax.numpy as jnp
+
+        self._rng, key = jax.random.split(self._rng)
+        (self.params, self.target_params, self.log_alpha, self.opt_state,
+         self.alpha_state, metrics) = self._update_fn(
+            self.params, self.target_params, self.log_alpha,
+            self.opt_state, self.alpha_state, batch, key,
+            jnp.int32(self._iter))
+        self._iter += 1
+        return {k: float(jax.device_get(v)) for k, v in metrics.items()}
+
+
+def train_cql(dataset_path: str, module_spec: Dict[str, Any],
+              *, num_iters: int = 200, batch_size: int = 256,
+              config: Dict[str, Any] = None, seed: int = 0) -> CQLLearner:
+    """Offline CQL training loop over recorded shards (obs, actions,
+    rewards, next_obs, dones — :func:`record_episodes` writes them all)."""
+    from ray_tpu.rllib.offline import OfflineReader
+
+    reader = OfflineReader(dataset_path)
+    data = reader.read_all()
+    for key in ("next_obs", "dones"):
+        if key not in data:
+            raise ValueError(
+                f"dataset at {dataset_path!r} has no {key!r} column; "
+                "re-record with record_episodes (>= round 5)")
+    learner = CQLLearner(module_spec, config, seed=seed)
+    rng = np.random.default_rng(seed)
+    n = len(data["obs"])
+    # Bootstrap mask: TERMINATEDS only — a time-limit truncation is an
+    # ordinary state whose successor still has value (reference masks the
+    # Bellman target on terminateds, not truncations). Older datasets
+    # without the column fall back to the combined dones.
+    term = data.get("terminateds", data["dones"]).astype(np.float32)
+    for _ in range(num_iters):
+        rows = rng.integers(0, n, size=min(batch_size, n))
+        learner.update({
+            "obs": data["obs"][rows].astype(np.float32),
+            "actions": data["actions"][rows].astype(np.float32),
+            "rewards": data["rewards"][rows].astype(np.float32),
+            "next_obs": data["next_obs"][rows].astype(np.float32),
+            "dones": term[rows],
+        })
+    return learner

@@ -92,29 +92,70 @@ class OfflineReader:
         return Dataset([ray_tpu.put(b) for b in (blocks or [{}])])
 
 
+def reward_to_go(rewards: np.ndarray, dones: np.ndarray,
+                 gamma: float) -> np.ndarray:
+    """Discounted reward-to-go over [T, N] env columns, reset at dones.
+    Episodes cut off by the end of recording keep the observed suffix sum
+    (standard for offline data)."""
+    returns = np.zeros_like(rewards, dtype=np.float32)
+    acc = np.zeros(rewards.shape[1], np.float32)
+    for t in range(rewards.shape[0] - 1, -1, -1):
+        acc = rewards[t] + gamma * acc * (1.0 - dones[t])
+        returns[t] = acc
+    return returns
+
+
 def record_episodes(env_name: str, path: str, num_steps: int = 1000,
                     policy=None, seed: int = 0,
-                    num_envs: int = 4) -> OfflineWriter:
+                    num_envs: int = 4, gamma: float = 0.99) -> OfflineWriter:
     """Roll out a policy (default: current random-init module) and persist
-    the experience — the 'generate offline data' workflow."""
+    the experience — the 'generate offline data' workflow.
+
+    Shards carry everything the offline algorithms need: BC uses
+    (obs, actions); MARWIL adds ``returns`` (discounted reward-to-go,
+    computed over full recorded episodes BEFORE env columns are
+    flattened, since flattening interleaves envs); CQL adds
+    (next_obs, dones). Chunks are accumulated before the return pass so
+    episodes spanning chunk boundaries get exact reward-to-go."""
     from ray_tpu.rllib.env_runner import SingleAgentEnvRunner
 
     runner = SingleAgentEnvRunner(env_name, num_envs=num_envs, seed=seed)
     if policy is not None:
         runner.set_weights(policy)
     writer = OfflineWriter(path)
+    chunks = []
     steps = 0
     while steps < num_steps:
         b = runner.sample(num_steps=min(200, num_steps - steps))
-        t_len, n = b["rewards"].shape
-        mask = b["valid"].reshape(-1)
-        writer.write({
-            "obs": b["obs"].reshape(t_len * n, -1)[mask],
-            "actions": b["actions"].reshape(
-                t_len * n, *b["actions"].shape[2:])[mask],
-            "rewards": b["rewards"].reshape(-1)[mask],
-        })
-        steps += t_len
+        chunks.append(b)
+        steps += b["rewards"].shape[0]
+    cat = {k: np.concatenate([c[k] for c in chunks], axis=0)
+           for k in ("obs", "actions", "rewards", "terminateds",
+                     "truncateds", "valid")}
+    t_len, n = cat["rewards"].shape
+    dones = np.logical_or(cat["terminateds"],
+                          cat["truncateds"]).astype(np.float32)
+    returns = reward_to_go(cat["rewards"], dones, gamma)
+    # successor observation per step; the final row bootstraps from the
+    # runner's post-rollout obs. At done steps next_obs is the next
+    # episode's reset obs — consumers mask it with (1 - dones).
+    next_obs = np.concatenate(
+        [cat["obs"][1:], chunks[-1]["next_obs"][None]], axis=0)
+    mask = cat["valid"].reshape(-1)
+    writer.write({
+        "obs": cat["obs"].reshape(t_len * n, -1)[mask],
+        "actions": cat["actions"].reshape(
+            t_len * n, *cat["actions"].shape[2:])[mask],
+        "rewards": cat["rewards"].reshape(-1)[mask].astype(np.float32),
+        # dones = terminated OR truncated (resets the reward-to-go);
+        # terminateds alone gates value BOOTSTRAPPING — a time-limit
+        # truncation is an ordinary state whose successor still has value
+        "dones": dones.reshape(-1)[mask],
+        "terminateds": cat["terminateds"].astype(
+            np.float32).reshape(-1)[mask],
+        "returns": returns.reshape(-1)[mask],
+        "next_obs": next_obs.reshape(t_len * n, -1)[mask],
+    })
     writer.flush()
     runner.stop()
     return writer
@@ -146,6 +187,73 @@ def train_bc(dataset_path: str, module_spec: Dict[str, Any],
     data = reader.read_all()
     batch = {"obs": data["obs"].astype(np.float32),
              "actions": data["actions"]}
+    learner.update(batch, minibatch_size=minibatch_size,
+                   num_epochs=num_epochs)
+    return learner
+
+
+class MARWILLearner(JaxLearner):
+    """Monotonic Advantage Re-Weighted Imitation Learning.
+
+    Reference: ``rllib/algorithms/marwil/marwil.py`` +
+    ``marwil_torch_policy.py:47`` (loss). Policy loss is exponentially
+    advantage-weighted log-likelihood ``-mean(exp(beta * adv / norm) *
+    logp)`` with ``adv = returns - V(s)`` detached, plus the value head's
+    ``0.5 * mean(adv^2)``; ``beta = 0`` degenerates to BC (+vf). One
+    jax-pure deviation from the reference: the squared-advantage
+    normalizer is the CURRENT minibatch's mean square (stop-grad) rather
+    than a moving average carried across updates — the scanned
+    multi-minibatch update has no host-side mutable stat, and the
+    instant estimate plays the same scale-stabilizer role.
+    """
+
+    def compute_loss(self, params, batch):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.rllib.learner import masked_mean
+
+        beta = self.config.get("beta", 1.0)
+        vf_coeff = self.config.get("vf_coeff", 1.0)
+        mask = batch.get("loss_mask")
+        out = self.module.forward_train(params, batch["obs"])
+        logp, entropy = self.module.logp_entropy(out, batch["actions"])
+        v = out["vf_preds"]
+        adv = batch["returns"] - v
+        vf_loss = 0.5 * masked_mean(adv ** 2, mask)
+        if beta:
+            adv_sg = jax.lax.stop_gradient(adv)
+            norm = jnp.sqrt(masked_mean(adv_sg ** 2, mask)) + 1e-8
+            weights = jnp.exp(beta * adv_sg / norm)
+            p_loss = -masked_mean(weights * logp, mask)
+        else:
+            p_loss = -masked_mean(logp, mask)
+            vf_loss = jnp.zeros_like(vf_loss)  # reference: beta=0 -> pure BC
+        loss = p_loss + vf_coeff * vf_loss
+        return loss, {"policy_loss": p_loss, "vf_loss": vf_loss,
+                      "mean_logp": masked_mean(logp, mask),
+                      "entropy": masked_mean(entropy, mask)}
+
+
+def train_marwil(dataset_path: str, module_spec: Dict[str, Any],
+                 *, beta: float = 1.0, vf_coeff: float = 1.0,
+                 lr: float = 1e-3, num_epochs: int = 5,
+                 minibatch_size: int = 256, seed: int = 0) -> MARWILLearner:
+    """Offline MARWIL training loop over recorded shards (which must carry
+    ``returns`` — :func:`record_episodes` writes them)."""
+    reader = OfflineReader(dataset_path)
+    learner = MARWILLearner(
+        module_spec, {"lr": lr, "beta": beta, "vf_coeff": vf_coeff,
+                      "num_devices": 1}, seed=seed)
+    data = reader.read_all()
+    if "returns" not in data:
+        raise ValueError(
+            f"dataset at {dataset_path!r} has no 'returns' column; "
+            "re-record with record_episodes (>= round 5) or add "
+            "discounted reward-to-go")
+    batch = {"obs": data["obs"].astype(np.float32),
+             "actions": data["actions"],
+             "returns": data["returns"].astype(np.float32)}
     learner.update(batch, minibatch_size=minibatch_size,
                    num_epochs=num_epochs)
     return learner

@@ -266,3 +266,150 @@ def test_appo_learns_cartpole(rt_rl2):
         returns.append(algo.train().get("episode_return_mean", 0.0))
     algo.cleanup()
     assert max(returns[-4:]) > 50, f"APPO failed to learn: {returns}"
+
+
+# ---------------------------------------------------------------------------
+# MARWIL + CQL (round-5 offline algorithms)
+# ---------------------------------------------------------------------------
+
+def _rollout_cartpole(policy, seed, n_eps, max_steps=200):
+    import gymnasium as gym
+
+    env = gym.make("CartPole-v1")
+    rows = {"obs": [], "actions": [], "rewards": [], "dones": []}
+    for ep in range(n_eps):
+        obs, _ = env.reset(seed=seed + ep)
+        done = False
+        steps = 0
+        while not done and steps < max_steps:
+            a = policy(obs)
+            rows["obs"].append(obs.astype(np.float32))
+            nobs, r, term, trunc, _ = env.step(a)
+            rows["actions"].append(a)
+            rows["rewards"].append(r)
+            done = term or trunc
+            rows["dones"].append(float(done))
+            obs = nobs
+            steps += 1
+    return rows
+
+
+def _eval_greedy(learner, seed, n_eps=15):
+    import gymnasium as gym
+    import jax
+
+    env = gym.make("CartPole-v1")
+    total = 0.0
+    for ep in range(n_eps):
+        obs, _ = env.reset(seed=seed + ep)
+        done = False
+        while not done:
+            out = learner.module.forward_inference(
+                learner.params,
+                jax.numpy.asarray(obs[None].astype(np.float32)))
+            a = int(jax.device_get(out["actions"])[0])
+            obs, r, term, trunc, _ = env.step(a)
+            total += r
+            done = term or trunc
+    return total / n_eps
+
+
+def test_marwil_beats_bc_on_mixed_quality_data(tmp_path):
+    """VERDICT r4 #7 done-criterion: on a dataset where a few
+    high-return episodes are buried under many random ones, MARWIL's
+    exponential advantage weighting recovers the good policy while plain
+    BC imitates the (mostly random) mixture."""
+    from ray_tpu.rllib.offline import (OfflineWriter, reward_to_go,
+                                       train_bc, train_marwil)
+
+    heur = lambda o: int(o[2] + 0.5 * o[3] > 0)  # near-perfect CartPole
+    rng = np.random.default_rng(7)
+    rand = lambda o: int(rng.integers(0, 2))
+    d1 = _rollout_cartpole(heur, 0, 3)
+    d2 = _rollout_cartpole(rand, 100, 60)
+    merged = {k: np.asarray(d1[k] + d2[k]) for k in d1}
+    rets = reward_to_go(merged["rewards"].astype(np.float32)[:, None],
+                        merged["dones"].astype(np.float32)[:, None],
+                        0.99)[:, 0]
+    path = str(tmp_path / "mixed")
+    w = OfflineWriter(path)
+    w.write({"obs": np.stack(merged["obs"]),
+             "actions": merged["actions"].astype(np.int64),
+             "rewards": merged["rewards"].astype(np.float32),
+             "returns": rets})
+    w.flush()
+
+    spec = {"observation_dim": 4, "action_dim": 2, "discrete": True,
+            "hidden": (64, 64)}
+    bc = train_bc(path, spec, num_epochs=15, minibatch_size=128, seed=0)
+    mw = train_marwil(path, spec, beta=2.0, num_epochs=15,
+                      minibatch_size=128, seed=0)
+    r_bc = _eval_greedy(bc, 999)
+    r_mw = _eval_greedy(mw, 999)
+    # measured across seeds on this box: bc 59-133, marwil 244-384
+    assert r_mw > 1.5 * r_bc, (r_mw, r_bc)
+    assert r_mw > 180, (r_mw, r_bc)
+
+
+def test_cql_conservative_q_penalty(tmp_path):
+    """VERDICT r4 #7 done-criterion: the conservative penalty pushes Q on
+    out-of-distribution (random) actions BELOW Q on dataset actions;
+    plain SAC trained on the same data shows no such gap."""
+    import jax
+
+    from ray_tpu.rllib.cql import CQLLearner
+    from ray_tpu.rllib.sac import SACLearner
+
+    rng = np.random.default_rng(0)
+    n = 2048
+    obs = rng.standard_normal((n, 3)).astype(np.float32)
+    good = np.tanh(obs[:, :1])
+    actions = np.clip(good + 0.05 * rng.standard_normal((n, 1)),
+                      -0.99, 0.99).astype(np.float32)
+    rewards = (1.0 - (actions[:, 0] - good[:, 0]) ** 2).astype(np.float32)
+    batch = {"obs": obs, "actions": actions, "rewards": rewards,
+             "next_obs": obs, "dones": np.ones(n, np.float32)}
+    spec = {"observation_dim": 3, "action_dim": 1, "hidden": (64, 64)}
+
+    def ood_gap(learner):
+        r = np.random.default_rng(1)
+        rand_a = r.uniform(-1, 1, (n, 1)).astype(np.float32)
+        q_data, _ = learner.module.q_values(learner.params, obs, actions)
+        q_rand, _ = learner.module.q_values(learner.params, obs, rand_a)
+        return float(jax.device_get(q_rand.mean() - q_data.mean()))
+
+    cql = CQLLearner(spec, {"min_q_weight": 5.0, "num_actions": 4,
+                            "bc_iters": 20}, seed=0)
+    sac = SACLearner(spec, {}, seed=0)
+    idx = np.random.default_rng(2)
+    for _ in range(120):
+        rows = idx.integers(0, n, 256)
+        sub = {k: v[rows] for k, v in batch.items()}
+        metrics = cql.update(sub)
+        sac.update(sub)
+    assert "cql_penalty" in metrics and "cql_gap" in metrics
+    g_cql, g_sac = ood_gap(cql), ood_gap(sac)
+    # measured on this box: cql ~ -1.3, sac ~ +0.01
+    assert g_cql < -0.5, (g_cql, g_sac)
+    assert g_cql < g_sac - 0.5, (g_cql, g_sac)
+
+
+def test_record_episodes_returns_and_next_obs(rt_rl2, tmp_path):
+    """record_episodes now ships returns/dones/next_obs; returns must be
+    the discounted reward-to-go consistent with dones."""
+    from ray_tpu.rllib import OfflineReader, record_episodes
+
+    path = str(tmp_path / "exp5")
+    record_episodes("CartPole-v1", path, num_steps=200, seed=0, num_envs=2,
+                    gamma=0.9)
+    data = OfflineReader(path).read_all()
+    assert set(data) >= {"obs", "actions", "rewards", "dones", "returns",
+                         "next_obs"}
+    assert data["next_obs"].shape == data["obs"].shape
+    # at every non-terminal step t within one env column the recursion
+    # returns[t] = r[t] + gamma * returns[t+1] holds; spot-check by
+    # reconstructing from a done-terminated suffix: the step BEFORE a done
+    # has return r[t] + 0.9 * r[t+1]-chain — verify terminal steps exactly
+    term_rows = data["dones"] > 0
+    np.testing.assert_allclose(data["returns"][term_rows],
+                               data["rewards"][term_rows], rtol=1e-5)
