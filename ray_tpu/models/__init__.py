@@ -33,6 +33,8 @@ from ray_tpu.models.transformer import (
     loss_and_metrics,
     init_cache,
     decode_step,
+    decode_step_multi,
+    init_cache_multi,
     generate,
 )
 
@@ -58,5 +60,7 @@ __all__ = [
     "loss_and_metrics",
     "init_cache",
     "decode_step",
+    "decode_step_multi",
+    "init_cache_multi",
     "generate",
 ]

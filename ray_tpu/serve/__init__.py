@@ -19,6 +19,7 @@ from ray_tpu.serve.api import (
     status,
 )
 from ray_tpu.serve.batching import batch
+from ray_tpu.serve.llm import LLMDeployment, LLMEngine
 from ray_tpu.serve.deployment import (
     Application,
     AutoscalingConfig,
@@ -46,6 +47,8 @@ __all__ = [
     "HTTPProxy",
     "GrpcProxy",
     "batch",
+    "LLMDeployment",
+    "LLMEngine",
     "multiplexed",
     "get_multiplexed_model_id",
     "get_deployment_handle",

@@ -503,3 +503,68 @@ def test_http_proxy_sustained_load(rt_serve):
           f"p99={p99*1e3:.1f}ms")
     # generous bounds for a 2-vCPU CI box; the point is no collapse
     assert p50 < 0.5 and p99 < 5.0 and rps > 20
+
+
+def test_llm_continuous_batching_deployment(rt_serve):
+    """VERDICT r4 #8 done-criterion: 8 concurrent prompts of different
+    lengths share one slot engine, token streams interleave (every
+    stream's first token lands before the earliest stream finishes), the
+    deployment reports aggregate stats, and each greedy stream is token-
+    exact vs the sequential models.generate reference."""
+    import threading
+
+    import numpy as np
+
+    import jax
+
+    from ray_tpu import models
+    from ray_tpu.models import transformer as T
+    from ray_tpu.serve import LLMDeployment
+
+    app = serve.deployment(
+        LLMDeployment,
+        ray_actor_options={"max_concurrency": 16, "num_cpus": 0},
+    ).bind("llama-debug", max_slots=8, max_len=64, seed=0)
+    handle = serve.run(app, name="llm_cb")
+
+    rng = np.random.default_rng(0)
+    lens = (3, 5, 7, 9, 4, 6, 8, 10)
+    prompts = [rng.integers(0, 256, p).tolist() for p in lens]
+    list(handle.options(stream=True).remote(prompts[0], 2))  # warm/compile
+
+    results = [None] * 8
+    first_ts = [None] * 8
+    last_ts = [None] * 8
+
+    def worker(i):
+        toks = []
+        for tok in handle.options(stream=True).remote(prompts[i], 8):
+            if first_ts[i] is None:
+                first_ts[i] = time.monotonic()
+            toks.append(tok)
+        last_ts[i] = time.monotonic()
+        results[i] = toks
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert all(r is not None and len(r) == 8 for r in results), results
+    # interleaving: engine-level evidence (deterministic on a loaded
+    # 2-vCPU box, unlike wall-clock overlap of sub-100ms streams) — the
+    # slot engine actually held many requests in flight at once
+    stats = handle.options(method_name="stats",
+                           stream=False).remote().result()
+    assert stats["max_concurrent"] >= 6, stats
+    assert stats["tokens_generated"] >= 8 * 8
+
+    # greedy parity: each stream equals the sequential generate reference
+    cfg = models.get_config("llama-debug")
+    params = models.init_params(jax.random.PRNGKey(0), cfg)
+    for i, pr in enumerate(prompts):
+        g = T.generate(params, jax.numpy.asarray(
+            np.asarray(pr, np.int32)[None]), cfg, max_new_tokens=8)
+        want = [int(x) for x in np.asarray(g[0, len(pr):])]
+        assert results[i] == want, (i, results[i], want)
+    serve.delete("llm_cb")
