@@ -140,29 +140,38 @@ class ObjectRefGenerator:
         return self
 
     def __next__(self) -> "ObjectRef":
-        from ray_tpu.core import task_spec as ts
-        from ray_tpu.core.ids import ObjectID
+        while True:
+            out = self._advance(timeout=None)
+            if out is not None:
+                return out
+
+    def _advance(self, timeout):
+        """One consumption attempt, the SHARED core of the blocking
+        (``__next__``, timeout=None) and polling (``try_next``, timeout=0)
+        paths: returns the next item's ref, ``None`` when not ready within
+        ``timeout``, raises ``StopIteration`` at stream end."""
         from ray_tpu.core.runtime import _get_runtime
 
         rt = _get_runtime()
-        item = ObjectRef(ObjectID(ts.streaming_return_id(self._task_id,
-                                                         self._index)))
-        while True:
-            if self._count is not None:
-                if self._index >= self._count:
-                    raise StopIteration
-                # count known -> the item was definitely produced
-                self._index += 1
-                self._ack(rt)
-                return item
+        item = self.next_item_ref()
+        if self._count is None:
             ready, _ = rt.wait([item, self._sentinel], num_returns=1,
-                               timeout=None)
+                               timeout=timeout)
             if item in ready:
                 self._index += 1
                 self._ack(rt)
                 return item
-            # sentinel resolved first: completion (count) or task error
-            self._count = rt.get([self._sentinel], timeout=0)[0]
+            if self._sentinel in ready:
+                # completion (count) or task error
+                self._count = rt.get([self._sentinel], timeout=0)[0]
+            else:
+                return None
+        if self._index >= self._count:
+            raise StopIteration
+        # count known -> the item was definitely produced
+        self._index += 1
+        self._ack(rt)
+        return item
 
     def _ack(self, rt) -> None:
         """Report consumption so a backpressured producer may continue.
@@ -176,6 +185,27 @@ class ObjectRefGenerator:
                                owner=self._owner)
         except Exception:
             pass
+
+    def next_item_ref(self) -> "ObjectRef":
+        """The ref the NEXT ``__next__``/``try_next`` would return, without
+        consuming it. Waitable: ``ray_tpu.wait([g.next_item_ref(), ...])``
+        wakes a scheduler the moment any stream has a ready item (the
+        per-operator data executor's idle wait). Past the end it is the
+        never-resolving ref after the last item — pair with
+        :meth:`completed` when waiting."""
+        from ray_tpu.core import task_spec as ts
+        from ray_tpu.core.ids import ObjectID
+
+        return ObjectRef(ObjectID(ts.streaming_return_id(self._task_id,
+                                                         self._index)))
+
+    def try_next(self):
+        """Non-blocking :meth:`__next__`: the next item's ref if the
+        producer has yielded it, ``None`` if not yet, ``StopIteration``
+        raised when the stream is exhausted. Lets a scheduler poll many
+        streams without parking on any one (reference
+        ``streaming_executor_state`` polls op outqueues the same way)."""
+        return self._advance(timeout=0)
 
     def close(self) -> None:
         """Abandon the stream: release any backpressured producer (it runs

@@ -196,7 +196,17 @@ def execute_streaming(
     ops: List[LogicalOp],
     options: Optional[ExecutionOptions] = None,
 ) -> Iterator[Any]:
-    """Run the plan, yielding ObjectRefs of output blocks as they're ready."""
+    """Run the plan, yielding ObjectRefs of output blocks as they're ready.
+
+    Consecutive (post-fusion) map operators — task OR actor-pool — run as
+    ONE per-operator topology (:class:`TopologyExecutor`): each op keeps
+    its own input/in-flight/output queues and a select-operator-to-run
+    chooser advances whichever op has headroom, so a slow TPU-ingest
+    stage and a fast CPU-decode stage genuinely overlap instead of the
+    fast stage running ahead unboundedly or the chain serializing
+    (reference ``streaming_executor_state.py:503``). All-to-all ops
+    remain barriers between topology segments.
+    """
     options = options or ExecutionOptions()
     if options.optimizer is None:
         from ray_tpu.data.optimizer import Optimizer
@@ -205,21 +215,29 @@ def execute_streaming(
     else:
         ops = options.optimizer.optimize(ops)
     stream: Iterator[Any] = (_ensure_ref(x) for x in source)
+    segment: List[MapOp] = []
+
+    def flush_segment(stream, segment):
+        if segment:
+            stream = TopologyExecutor(stream, list(segment), options).run()
+            segment.clear()
+        return stream
+
     for op in ops:
         if isinstance(op, MapOp):
-            if op.compute is not None:
-                stream = _run_actor_map_stage(stream, op, options)
-            else:
-                stream = _run_map_stage(stream, op, options)
+            segment.append(op)
         elif isinstance(op, ShuffleOp):
+            stream = flush_segment(stream, segment)
             stream = _run_shuffle(stream, op)
         elif isinstance(op, AllToAllOp):
+            stream = flush_segment(stream, segment)
             stream = _run_all_to_all(stream, op)
         elif isinstance(op, LimitOp):
+            stream = flush_segment(stream, segment)
             stream = _run_limit(stream, op.limit)
         else:
             raise TypeError(f"unknown op {op!r}")
-    return stream
+    return flush_segment(stream, segment)
 
 
 def _ensure_ref(x):
@@ -230,28 +248,291 @@ def _ensure_ref(x):
     return ray_tpu.put(x)
 
 
-def _run_map_stage(stream: Iterator[Any], op: MapOp,
-                   options: ExecutionOptions) -> Iterator[Any]:
-    """Bounded-in-flight task pool over input refs (streaming backpressure:
-    reference ``select_operator_to_run``'s resource gating, reduced to a
-    window of ``max_in_flight`` concurrent tasks).
+# ---------------------------------------------------------------------------
+# Per-operator streaming topology
+# ---------------------------------------------------------------------------
 
-    Each map task is a STREAMING task: output blocks surface as refs the
-    moment the worker yields them (overlapping producer/consumer, the
-    reference's streaming-exchange behavior) and block bytes never round-
-    trip through the driver."""
-    remote_fn = ray_tpu.remote(num_returns="streaming")(
-        lambda block, _fn=op.fn: iter(_fn(block)))
-    in_flight: List[Any] = []
+class _TaskDispatcher:
+    """Dispatch one streaming map task per block."""
 
-    for ref in stream:
-        in_flight.append(remote_fn.remote(ref))
-        # the window is re-evaluated per dispatch: memory-aware policies
-        # tighten it dynamically (reference backpressure_policy loop)
-        while len(in_flight) >= options.effective_in_flight(op):
-            yield from in_flight.pop(0)
-    for gen in in_flight:
-        yield from gen
+    def __init__(self, op: MapOp):
+        self._remote = ray_tpu.remote(num_returns="streaming")(
+            lambda block, _fn=op.fn: iter(_fn(block)))
+
+    def dispatch(self, ref):
+        return self._remote.remote(ref)
+
+    def task_finished(self, gen) -> None:
+        pass
+
+    def capacity(self, window: int) -> int:
+        return window
+
+    def close(self) -> None:
+        pass
+
+
+class _ActorPoolDispatcher:
+    """Reference ``ActorPoolMapOperator`` role: blocks run on warm actors
+    (per-actor state loads once); the pool autoscales between min_size
+    and max_size when every actor is saturated."""
+
+    def __init__(self, op: MapOp):
+        import cloudpickle as _cp
+
+        self._strat = op.compute
+        self._fn_blob = _cp.dumps(op.fn)
+        self._actor_cls = ray_tpu.remote(_PoolActor)
+        self._actors = [self._actor_cls.remote(self._fn_blob)
+                        for _ in range(self._strat.min_size)]
+        self._load: Dict[int, int] = {i: 0 for i in range(len(self._actors))}
+        self._gen_actor: Dict[int, int] = {}  # id(gen) -> actor idx
+
+    def dispatch(self, ref):
+        idx = min(self._load, key=self._load.get)
+        if (self._load[idx] >= self._strat.max_tasks_in_flight_per_actor
+                and len(self._actors) < self._strat.max_size):
+            self._actors.append(self._actor_cls.remote(self._fn_blob))
+            idx = len(self._actors) - 1
+            self._load[idx] = 0
+        self._load[idx] += 1
+        gen = self._actors[idx].apply.options(
+            num_returns="streaming").remote(ref)
+        self._gen_actor[id(gen)] = idx
+        return gen
+
+    def task_finished(self, gen) -> None:
+        idx = self._gen_actor.pop(id(gen), None)
+        if idx is not None:
+            self._load[idx] -= 1
+
+    def capacity(self, window: int) -> int:
+        cap = max(1, self._strat.max_size
+                  * self._strat.max_tasks_in_flight_per_actor)
+        return min(cap, window)
+
+    def close(self) -> None:
+        for a in self._actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+
+
+class _InFlight:
+    """One dispatched streaming task: its generator plus an ordered buffer
+    of already-yielded (polled) output refs."""
+
+    __slots__ = ("gen", "buf", "done")
+
+    def __init__(self, gen):
+        self.gen = gen
+        self.buf: List[Any] = []
+        self.done = False
+
+
+class _OpState:
+    """Per-operator queues (reference ``OpState`` in
+    ``streaming_executor_state.py``): input refs waiting to dispatch,
+    in-flight streaming tasks, and ready output refs."""
+
+    def __init__(self, op: MapOp, options: ExecutionOptions):
+        from collections import deque
+
+        self.op = op
+        self.options = options
+        self._dispatcher = None  # LAZY: actor pools must not spawn until
+        # the first block actually reaches this op (and never at all if
+        # the plan iterator is dropped unconsumed)
+        self.inq: "deque" = deque()
+        self.inflight: List[_InFlight] = []
+        self.outq: "deque" = deque()
+        self.input_done = False
+        self.max_inq_seen = 0
+
+    @property
+    def dispatcher(self):
+        if self._dispatcher is None:
+            self._dispatcher = (_ActorPoolDispatcher(self.op)
+                                if self.op.compute is not None
+                                else _TaskDispatcher(self.op))
+        return self._dispatcher
+
+    def close(self) -> None:
+        if self._dispatcher is not None:
+            self._dispatcher.close()
+
+    # -- scheduling predicates -------------------------------------------
+
+    def window(self) -> int:
+        # static capacity math — must not instantiate the dispatcher
+        win = self.options.effective_in_flight(self.op)
+        strat = self.op.compute
+        if strat is not None:
+            win = min(win, max(1, strat.max_size
+                               * strat.max_tasks_in_flight_per_actor))
+        return win
+
+    def can_dispatch(self) -> bool:
+        return bool(self.inq) and len(self.inflight) < self.window()
+
+    def dispatch_one(self) -> None:
+        ref = self.inq.popleft()
+        self.inflight.append(_InFlight(self.dispatcher.dispatch(ref)))
+
+    def poll(self) -> int:
+        """Drain ready items from every in-flight task into per-task
+        buffers (non-blocking), then move buffered refs to ``outq`` —
+        strictly FIFO across tasks when ``preserve_order`` (items within a
+        task are ordered by the stream itself). Returns refs moved."""
+        for f in self.inflight:
+            while not f.done:
+                try:
+                    ref = f.gen.try_next()
+                except StopIteration:
+                    f.done = True
+                    self.dispatcher.task_finished(f.gen)
+                    break
+                if ref is None:
+                    break
+                f.buf.append(ref)
+        moved = 0
+        if self.options.preserve_order:
+            while self.inflight:
+                head = self.inflight[0]
+                self.outq.extend(head.buf)
+                moved += len(head.buf)
+                head.buf.clear()
+                if head.done:
+                    self.inflight.pop(0)
+                else:
+                    break
+        else:
+            for f in list(self.inflight):
+                self.outq.extend(f.buf)
+                moved += len(f.buf)
+                f.buf.clear()
+                if f.done:
+                    self.inflight.remove(f)
+        return moved
+
+    def exhausted(self) -> bool:
+        return (self.input_done and not self.inq and not self.inflight
+                and not self.outq)
+
+    def watch_refs(self) -> List[Any]:
+        """Refs to park on when the whole topology is idle: each live
+        stream's next item + its completion sentinel."""
+        out = []
+        for f in self.inflight:
+            if not f.done:
+                out.append(f.gen.next_item_ref())
+                out.append(f.gen.completed())
+        return out
+
+
+class TopologyExecutor:
+    """select-operator-to-run loop over a chain of map operators
+    (reference ``streaming_executor_state.py:503``).
+
+    Every iteration: poll all streams (non-blocking), move outputs
+    downstream under a bounded per-op input queue, then dispatch ONE task
+    for the runnable op with the least buffered output — draining toward
+    the consumer first keeps total buffered blocks bounded while letting
+    fast and slow stages run concurrently. When nothing is runnable and
+    nothing moved, park on the union of next-item/sentinel refs (no
+    busy-wait, no per-stream blocking)."""
+
+    def __init__(self, source: Iterator[Any], ops: List[MapOp],
+                 options: ExecutionOptions):
+        self.source = source
+        self.options = options
+        self.states = [_OpState(op, options) for op in ops]
+        # bounded inter-op queue: a fast producer may run at most this far
+        # ahead of its consumer (reference outqueue memory gating role)
+        self.max_queued = max(2, 2 * options.max_in_flight)
+        self.stats: Dict[str, Any] = {"max_inq": {}, "dispatches": {}}
+
+    # -- plumbing ---------------------------------------------------------
+
+    def _pull_source(self) -> None:
+        first = self.states[0]
+        while not first.input_done and len(first.inq) < self.max_queued:
+            try:
+                first.inq.append(next(self.source))
+            except StopIteration:
+                first.input_done = True
+        first.max_inq_seen = max(first.max_inq_seen, len(first.inq))
+
+    def _transfer(self) -> int:
+        """outq[i] -> inq[i+1] under the bound; marks input_done edges."""
+        moved = 0
+        for i, st in enumerate(self.states[:-1]):
+            nxt = self.states[i + 1]
+            while st.outq and len(nxt.inq) < self.max_queued:
+                nxt.inq.append(st.outq.popleft())
+                moved += 1
+            nxt.max_inq_seen = max(nxt.max_inq_seen, len(nxt.inq))
+            if st.exhausted():
+                nxt.input_done = True
+        return moved
+
+    def _select_op_to_run(self) -> Optional[_OpState]:
+        """Runnable op with the least buffered output (its outq plus the
+        downstream inq it feeds) — the reference's resource-aware choice,
+        reduced to block counts."""
+        best, best_score = None, None
+        for i, st in enumerate(self.states):
+            if not st.can_dispatch():
+                continue
+            downstream = (len(self.states[i + 1].inq)
+                          if i + 1 < len(self.states) else 0)
+            if i + 1 < len(self.states) and \
+                    len(self.states[i + 1].inq) >= self.max_queued:
+                continue  # downstream full: dispatching only buffers more
+            score = len(st.outq) + downstream
+            if best_score is None or score < best_score:
+                best, best_score = st, score
+        return best
+
+    # -- main loop --------------------------------------------------------
+
+    def run(self) -> Iterator[Any]:
+        states = self.states
+        last = states[-1]
+        try:
+            while True:
+                self._pull_source()
+                progressed = sum(st.poll() for st in states)
+                progressed += self._transfer()
+                st = self._select_op_to_run()
+                if st is not None:
+                    st.dispatch_one()
+                    name = st.op.name
+                    self.stats["dispatches"][name] = \
+                        self.stats["dispatches"].get(name, 0) + 1
+                    progressed += 1
+                while last.outq:
+                    yield last.outq.popleft()
+                if all(s.exhausted() for s in states):
+                    break
+                if not progressed:
+                    # idle: park until ANY stream produces or completes
+                    watch = [r for s in states for r in s.watch_refs()]
+                    if watch:
+                        ray_tpu.wait(watch, num_returns=1, timeout=10)
+        finally:
+            for s in states:
+                s.close()
+            self.stats["max_inq"] = {s.op.name: s.max_inq_seen
+                                     for s in states}
+            _LAST_TOPOLOGY_STATS.clear()
+            _LAST_TOPOLOGY_STATS.update(self.stats)
+
+
+#: instrumentation for tests/debugging: queue-depth + dispatch counts of
+#: the most recently finished topology segment
+_LAST_TOPOLOGY_STATS: Dict[str, Any] = {}
 
 
 def _run_all_to_all(stream: Iterator[Any], op: AllToAllOp) -> Iterator[Any]:
@@ -401,57 +682,6 @@ class _PoolActor:
     def apply(self, block):
         for out in self._fn(block):
             yield out
-
-
-def _run_actor_map_stage(stream: Iterator[Any], op: MapOp,
-                         options: ExecutionOptions) -> Iterator[Any]:
-    """Reference ``ActorPoolMapOperator`` role: blocks run on warm actors
-    (per-actor state loads once), the pool autoscales between min_size and
-    max_size on queue depth, and outputs stream as refs."""
-    import cloudpickle as _cp
-
-    strat = op.compute
-    fn_blob = _cp.dumps(op.fn)
-    actor_cls = ray_tpu.remote(_PoolActor)
-    actors = [actor_cls.remote(fn_blob) for _ in range(strat.min_size)]
-    load: Dict[int, int] = {i: 0 for i in range(len(actors))}
-    in_flight: List[Tuple[int, Any]] = []  # (actor idx, generator)
-
-    def dispatch(ref):
-        # least-loaded actor; grow the pool when everyone is saturated
-        idx = min(load, key=load.get)
-        if (load[idx] >= strat.max_tasks_in_flight_per_actor
-                and len(actors) < strat.max_size):
-            actors.append(actor_cls.remote(fn_blob))
-            idx = len(actors) - 1
-            load[idx] = 0
-        load[idx] += 1
-        gen = actors[idx].apply.options(
-            num_returns="streaming").remote(ref)
-        in_flight.append((idx, gen))
-
-    pool_cap = max(1, strat.max_size * strat.max_tasks_in_flight_per_actor)
-    try:
-        for ref in stream:
-            dispatch(ref)
-            # backpressure policies bound actor stages too (same MIN
-            # contract as task stages); re-evaluated per dispatch
-            cap = min(pool_cap, options.effective_in_flight(op))
-            while len(in_flight) >= cap:
-                idx, gen = in_flight.pop(0)
-                yield from gen
-                load[idx] -= 1
-        for idx, gen in in_flight:
-            yield from gen
-            load[idx] -= 1
-    finally:
-        # an early-stopping consumer (take()/limit()) closes this
-        # generator mid-stream: the pool must not outlive the stage
-        for a in actors:
-            try:
-                ray_tpu.kill(a)
-            except Exception:
-                pass
 
 
 def _run_limit(stream: Iterator[Any], limit: int) -> Iterator[Any]:

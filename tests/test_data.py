@@ -382,3 +382,71 @@ def test_read_images_tensor_column(rt_data, tmp_path):
     ds2 = data.read_images(str(tmp_path))
     first = ds2.take_all()[0]["image"]
     assert first.shape[-1] == 3  # native size preserved
+
+
+def test_topology_overlaps_fast_and_slow_stages(rt_data):
+    """VERDICT r4 #9 golden test: a fast CPU-decode stage and a slow
+    (actor-pool) TPU-ingest stage run CONCURRENTLY under the per-operator
+    topology, and the fast stage cannot run unboundedly ahead — its
+    output buffering is capped by the bounded inter-op queue."""
+    import time as _t
+
+    from ray_tpu.data import execution as ex
+
+    n_blocks = 8
+    blocks = [{"i": np.array([i])} for i in range(n_blocks)]
+
+    def fast(block):
+        t0 = _t.monotonic()
+        _t.sleep(0.05)
+        return [{**block, "fast_iv": np.array([t0, _t.monotonic()])}]
+
+    def slow(block):
+        t0 = _t.monotonic()
+        _t.sleep(0.2)
+        return [{**block, "slow_iv": np.array([t0, _t.monotonic()])}]
+
+    def make_ops():
+        return [
+            ex.MapOp("fast_decode", fast),
+            ex.MapOp("slow_ingest", slow,
+                     compute=ex.ActorPoolStrategy(
+                         min_size=2, max_size=2,
+                         max_tasks_in_flight_per_actor=2)),
+        ]
+
+    opts = ex.ExecutionOptions(max_in_flight=2, optimizer=_NoopOptimizer())
+    # warm: workers pay a one-time first-by-ref-arg cost (~0.3s each) and
+    # the actor pool spawns — never time cold (CLAUDE.md)
+    list(ex.execute_streaming(iter(blocks[:2]), make_ops(), opts))
+    t0 = _t.monotonic()
+    out = [ray_tpu.get(r) for r in
+           ex.execute_streaming(iter(blocks), make_ops(), opts)]
+    wall = _t.monotonic() - t0
+    assert len(out) == n_blocks
+    assert sorted(int(b["i"][0]) for b in out) == list(range(n_blocks))
+
+    # concurrency: some fast-stage interval overlaps some slow-stage
+    # interval (the pipeline genuinely runs both stages at once)
+    fast_ivs = [b["fast_iv"] for b in out]
+    slow_ivs = [b["slow_iv"] for b in out]
+    overlap = any(f[0] < s[1] and s[0] < f[1]
+                  for f in fast_ivs for s in slow_ivs)
+    assert overlap, (fast_ivs, slow_ivs)
+    # and the whole run beats fully-serialized execution: warm pipelined
+    # runs measure ~0.9s; serial is 2.0s. Margin sized for the 2-vCPU
+    # box's 2-4x swings under suite load (CLAUDE.md) — anything below
+    # serial still proves overlap (which the interval check pins anyway)
+    assert wall < 1.9, wall
+
+    # bounded buffering: the slow stage's input queue never exceeded the
+    # inter-op bound (fast stage was backpressured, not unbounded)
+    stats = ex._LAST_TOPOLOGY_STATS
+    bound = max(2, 2 * opts.max_in_flight)
+    assert stats["max_inq"]["slow_ingest"] <= bound, stats
+    assert stats["dispatches"] == {"fast_decode": 8, "slow_ingest": 8}, stats
+
+
+class _NoopOptimizer:
+    def optimize(self, ops):
+        return ops

@@ -337,3 +337,48 @@ def test_streaming_no_backpressure_runs_ahead(rt):
         time.sleep(0.2)
     t = [ts for _, ts in stamps]
     assert t[5] - t[0] < 0.5, "unbackpressured producer should not wait"
+
+
+def test_generator_try_next_nonblocking(rt):
+    """try_next polls without parking: None while the producer works,
+    refs as items land, StopIteration at the end; next_item_ref is
+    waitable for scheduler-style idle parking (the data topology
+    executor's contract)."""
+    import time as _t
+
+    @ray_tpu.remote(num_returns="streaming")
+    def produce():
+        yield 1
+        time.sleep(0.4)
+        yield 2
+
+    gen = produce.remote()
+    # item 1 lands quickly; poll until it surfaces (bounded)
+    deadline = _t.monotonic() + 10
+    first = None
+    while first is None and _t.monotonic() < deadline:
+        first = gen.try_next()
+        if first is None:
+            _t.sleep(0.01)
+    assert first is not None and ray_tpu.get(first) == 1
+    # item 2 not ready yet: non-blocking None, and the next_item_ref is
+    # waitable until it lands
+    assert gen.try_next() is None
+    ready, _ = ray_tpu.wait([gen.next_item_ref(), gen.completed()],
+                            num_returns=1, timeout=10)
+    assert ready
+    second = None
+    while second is None and _t.monotonic() < deadline:
+        second = gen.try_next()
+        if second is None:
+            _t.sleep(0.01)
+    assert ray_tpu.get(second) == 2
+    # exhausted -> StopIteration (possibly after the sentinel resolves)
+    while True:
+        try:
+            r = gen.try_next()
+        except StopIteration:
+            break
+        assert r is None
+        assert _t.monotonic() < deadline, "sentinel never resolved"
+        _t.sleep(0.01)
