@@ -107,25 +107,26 @@ class GcsService:
         self.server: Optional[RpcServer] = None
         self._stop = threading.Event()
         # Fault tolerance (reference: GCS tables over a Redis StoreClient,
-        # gcs/store_client/redis_store_client.h): durable tables persist to
-        # a snapshot file; a restarted GCS reloads them, nodes re-register
-        # via heartbeat NACK, and the directory repopulates as owners
-        # publish. objects/nodes are runtime state and deliberately NOT
-        # snapshotted.
+        # gcs/store_client/redis_store_client.h): durable tables persist
+        # through a pluggable StoreClient (gcs_store.py) — a file snapshot
+        # by default, or an EXTERNAL sqlite database ("sqlite://<path>")
+        # that survives head-node disk loss. A restarted GCS reloads them,
+        # nodes re-register via heartbeat NACK, and the directory
+        # repopulates as owners publish. objects/nodes are runtime state
+        # and deliberately NOT persisted.
+        from ray_tpu.cluster.gcs_store import make_store_client
+
         self.snapshot_path = snapshot_path
+        self._store = make_store_client(snapshot_path)
         self._dirty = False
-        if snapshot_path:
+        if self._store is not None:
             self._load_snapshot()
             threading.Thread(target=self._snapshot_loop, daemon=True,
                              name="gcs-snapshot").start()
 
     def _load_snapshot(self):
-        import pickle
-
-        try:
-            with open(self.snapshot_path, "rb") as f:
-                snap = pickle.load(f)
-        except (FileNotFoundError, EOFError, pickle.PickleError):
+        snap = self._store.load()
+        if not snap:
             return
         self.kv = snap.get("kv", {})
         self.functions = snap.get("functions", {})
@@ -134,9 +135,6 @@ class GcsService:
         self.pgs = snap.get("pgs", {})
 
     def _snapshot_loop(self):
-        import os
-        import pickle
-
         while not self._stop.wait(1.0):
             with self.lock:
                 if not self._dirty:
@@ -148,13 +146,12 @@ class GcsService:
                         "named_actors": dict(self.named_actors),
                         "pgs": {p: dict(r) for p, r in self.pgs.items()}}
                 self._dirty = False
-            tmp = f"{self.snapshot_path}.tmp-{os.getpid()}"
-            try:
-                with open(tmp, "wb") as f:
-                    pickle.dump(snap, f)
-                os.rename(tmp, self.snapshot_path)
-            except OSError:
-                pass
+            if not self._store.save(snap):
+                # transient store failure (lock/IO): the snapshot was NOT
+                # persisted — re-arm so the next tick retries even if no
+                # new mutation arrives
+                with self.lock:
+                    self._dirty = True
 
     # ------------------------------------------------------------------
     # RPC dispatch

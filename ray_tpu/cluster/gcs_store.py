@@ -1,0 +1,173 @@
+"""Pluggable storage for the GCS's durable tables.
+
+Role analog: ``src/ray/gcs/store_client/`` — the reference backs every
+GCS table by a StoreClient (in-memory, or Redis for fault tolerance,
+``redis_store_client.h``). Here the seam is the same, sized to our
+snapshot model: the GCS persists its DURABLE tables (kv, functions,
+actors, named_actors, pgs) through a ``StoreClient``; runtime state
+(nodes, objects) deliberately re-populates from heartbeats and owner
+publishes after a restart.
+
+Backends:
+
+- :class:`FileStoreClient` — one pickle file, atomic rename (the
+  original behavior; head-node disk only).
+- :class:`SqliteStoreClient` — per-table rows in a sqlite database in
+  WAL mode, one transaction per save. Point it at storage that survives
+  head-node disk loss (a persistent/attached block volume — NOT an NFS/
+  SMB mount: WAL's shm-based locking is incoherent over network
+  filesystems) and a fresh GCS recovers the control plane; this is the
+  redis-store role without requiring a redis server in the image.
+
+URIs (``make_store_client``): a bare path is the file backend;
+``sqlite://<path>`` is the sqlite backend.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Dict, Optional
+
+DURABLE_TABLES = ("kv", "functions", "actors", "named_actors", "pgs")
+
+
+class StoreClient:
+    """Load/save the durable-table snapshot dict."""
+
+    def load(self) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def save(self, snap: Dict[str, Any]) -> bool:
+        """Persist; returns False on a (transient) failure so the caller
+        can re-mark its dirty flag — a swallowed error would silently
+        lose the final snapshot forever."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class FileStoreClient(StoreClient):
+    """Atomic-rename pickle file (original snapshot behavior)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def load(self) -> Optional[Dict[str, Any]]:
+        try:
+            with open(self.path, "rb") as f:
+                return pickle.load(f)
+        except (FileNotFoundError, EOFError, pickle.PickleError):
+            return None
+
+    def save(self, snap: Dict[str, Any]) -> bool:
+        tmp = f"{self.path}.tmp-{os.getpid()}"
+        try:
+            with open(tmp, "wb") as f:
+                pickle.dump(snap, f)
+            os.rename(tmp, self.path)
+            return True
+        except OSError:
+            return False
+
+
+class SqliteStoreClient(StoreClient):
+    """Durable tables as rows in a sqlite DB (external-store GCS FT).
+
+    One row per table, written in one transaction per save; WAL mode so
+    a reader (a restarted GCS) never blocks on a writer killed
+    mid-transaction. Unchanged tables are skipped via a content hash, so
+    steady-state saves touch only what moved.
+    """
+
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(os.path.abspath(path)) or ".",
+                    exist_ok=True)
+        self.path = path
+        try:
+            self._conn = self._open(path)
+        except Exception:
+            # A corrupt/truncated db must not keep the GCS from booting
+            # (the file backend boots empty on a bad snapshot). Preserve
+            # the evidence and start fresh.
+            try:
+                os.replace(path, path + ".corrupt")
+            except OSError:
+                pass
+            self._conn = self._open(path)
+        self._hashes: Dict[str, bytes] = {}
+
+    @staticmethod
+    def _open(path: str):
+        import sqlite3
+
+        conn = sqlite3.connect(path, timeout=5.0, check_same_thread=False)
+        conn.execute("PRAGMA journal_mode=WAL")
+        conn.execute("PRAGMA synchronous=NORMAL")
+        conn.execute(
+            "CREATE TABLE IF NOT EXISTS gcs_tables ("
+            "name TEXT PRIMARY KEY, payload BLOB)")
+        conn.commit()
+        return conn
+
+    def load(self) -> Optional[Dict[str, Any]]:
+        import hashlib
+
+        try:
+            rows = self._conn.execute(
+                "SELECT name, payload FROM gcs_tables").fetchall()
+        except Exception:
+            return None
+        if not rows:
+            return None
+        snap: Dict[str, Any] = {}
+        for name, payload in rows:
+            try:
+                snap[name] = pickle.loads(payload)
+                self._hashes[name] = hashlib.sha1(payload).digest()
+            except Exception:
+                continue  # one corrupt table must not lose the rest
+        return snap or None
+
+    def save(self, snap: Dict[str, Any]) -> bool:
+        import hashlib
+
+        writes = []
+        for name in DURABLE_TABLES:
+            if name not in snap:
+                continue
+            payload = pickle.dumps(snap[name])
+            h = hashlib.sha1(payload).digest()
+            if self._hashes.get(name) == h:
+                continue
+            writes.append((name, payload, h))
+        if not writes:
+            return True
+        try:
+            with self._conn:  # one transaction: all-or-nothing
+                self._conn.executemany(
+                    "INSERT INTO gcs_tables(name, payload) VALUES(?, ?) "
+                    "ON CONFLICT(name) DO UPDATE SET payload=excluded.payload",
+                    [(n, p) for n, p, _ in writes])
+        except Exception:
+            return False  # caller re-marks dirty and retries next tick
+        for name, _, h in writes:
+            self._hashes[name] = h
+        return True
+
+    def close(self) -> None:
+        try:
+            self._conn.close()
+        except Exception:
+            pass
+
+
+def make_store_client(uri: Optional[str]) -> Optional[StoreClient]:
+    """``None`` -> no persistence; ``sqlite://<path>`` -> sqlite backend;
+    anything else -> file backend at that path."""
+    if not uri:
+        return None
+    if uri.startswith("sqlite://"):
+        return SqliteStoreClient(uri[len("sqlite://"):])
+    return FileStoreClient(uri)

@@ -265,9 +265,6 @@ class _TaskDispatcher:
     def task_finished(self, gen) -> None:
         pass
 
-    def capacity(self, window: int) -> int:
-        return window
-
     def close(self) -> None:
         pass
 
@@ -305,11 +302,6 @@ class _ActorPoolDispatcher:
         idx = self._gen_actor.pop(id(gen), None)
         if idx is not None:
             self._load[idx] -= 1
-
-    def capacity(self, window: int) -> int:
-        cap = max(1, self._strat.max_size
-                  * self._strat.max_tasks_in_flight_per_actor)
-        return min(cap, window)
 
     def close(self) -> None:
         for a in self._actors:

@@ -1044,3 +1044,132 @@ def test_task_events_dedup_on_cursor_rewind(cluster):
         assert names == [f"t{i}" for i in range(7)], names
     finally:
         cli.close()
+
+
+def test_gcs_sqlite_external_store_fault_tolerance(tmp_path):
+    """VERDICT r4 #6 done-criterion: the GCS backed by an EXTERNAL sqlite
+    store (redis_store_client.h role) survives kill -9 with named
+    actors, KV, and placement groups intact — the store file can live on
+    storage that outlives the head node's disk."""
+    import os
+
+    db = str(tmp_path / "external" / "gcs.db")
+    c = Cluster(gcs_snapshot=f"sqlite://{db}")
+    try:
+        c.add_node(num_cpus=4, resources={"worker": 4})
+        rt = _init(c)
+
+        @ray_tpu.remote(resources={"worker": 1})
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def bump(self):
+                self.n += 1
+                return self.n
+
+        a = Counter.options(name="survivor", lifetime="detached").remote()
+        assert ray_tpu.get(a.bump.remote(), timeout=60) == 1
+        rt.kv_op("put", "durable-key", b"sqlite-survives")
+        from ray_tpu.util.placement_group import placement_group
+
+        pg = placement_group([{"worker": 1}], strategy="PACK")
+        assert pg.wait(timeout_seconds=30)
+        time.sleep(1.5)  # let the snapshot loop persist
+        assert os.path.exists(db)
+
+        c.restart_gcs()  # kill -9 + fresh process reading the sqlite db
+
+        deadline = time.monotonic() + 30
+        val = None
+        while time.monotonic() < deadline:
+            try:
+                val = rt.kv_op("get", "durable-key")
+                if val == b"sqlite-survives":
+                    break
+            except Exception:
+                pass
+            time.sleep(0.5)
+        assert val == b"sqlite-survives"
+        # named actor record survived: resolvable by name again
+        deadline = time.monotonic() + 60
+        got = None
+        while time.monotonic() < deadline:
+            try:
+                h = ray_tpu.get_actor("survivor")
+                got = ray_tpu.get(h.bump.remote(), timeout=20)
+                break
+            except Exception:
+                time.sleep(0.5)
+        assert got == 2, got
+        # pg record survived the restart (read back from the GCS)
+        deadline = time.monotonic() + 30
+        pgs = None
+        while time.monotonic() < deadline:
+            try:
+                pgs = rt.cluster.gcs.call("pg_list", timeout=10)
+                if pgs:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.5)
+        assert pgs, "placement group records lost after GCS restart"
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
+
+
+def test_sqlite_store_client_unit(tmp_path):
+    """Round trip, unchanged-table skip, and corrupt-row tolerance of the
+    sqlite StoreClient (no cluster boot needed)."""
+    import os
+    import sqlite3
+
+    from ray_tpu.cluster.gcs_store import (SqliteStoreClient,
+                                           make_store_client)
+
+    db = str(tmp_path / "t.db")
+    s = make_store_client(f"sqlite://{db}")
+    assert isinstance(s, SqliteStoreClient)
+    snap = {"kv": {"ns": {"k": b"v"}}, "functions": {"h": b"blob"},
+            "actors": {b"a": {"state": "ALIVE"}},
+            "named_actors": {"n": b"a"}, "pgs": {}}
+    s.save(snap)
+    s.save(snap)  # unchanged: second save is a no-op (hash skip)
+    s.close()
+
+    s2 = SqliteStoreClient(db)
+    got = s2.load()
+    assert got["kv"] == snap["kv"] and got["named_actors"] == {"n": b"a"}
+    s2.close()
+
+    # corrupt ONE table row: the rest must still load
+    conn = sqlite3.connect(db)
+    conn.execute("UPDATE gcs_tables SET payload=? WHERE name='functions'",
+                 (b"\x80garbage",))
+    conn.commit()
+    conn.close()
+    s3 = SqliteStoreClient(db)
+    got = s3.load()
+    assert "functions" not in got and got["kv"] == snap["kv"]
+    s3.close()
+
+    # a corrupt/truncated db file must not block boot: it is set aside
+    # and a fresh store opens (the file backend boots empty the same way)
+    bad = str(tmp_path / "bad.db")
+    with open(bad, "wb") as fh:
+        fh.write(b"this is not a sqlite file at all")
+    s4 = SqliteStoreClient(bad)
+    assert s4.load() is None
+    assert s4.save(snap) is True
+    s4.close()
+    assert os.path.exists(bad + ".corrupt")
+
+    # file backend still the default for bare paths
+    from ray_tpu.cluster.gcs_store import FileStoreClient
+
+    f = make_store_client(str(tmp_path / "plain.snap"))
+    assert isinstance(f, FileStoreClient)
+    f.save(snap)
+    assert f.load()["kv"] == snap["kv"]
+    assert make_store_client(None) is None

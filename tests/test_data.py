@@ -433,11 +433,11 @@ def test_topology_overlaps_fast_and_slow_stages(rt_data):
     overlap = any(f[0] < s[1] and s[0] < f[1]
                   for f in fast_ivs for s in slow_ivs)
     assert overlap, (fast_ivs, slow_ivs)
-    # and the whole run beats fully-serialized execution: warm pipelined
-    # runs measure ~0.9s; serial is 2.0s. Margin sized for the 2-vCPU
-    # box's 2-4x swings under suite load (CLAUDE.md) — anything below
-    # serial still proves overlap (which the interval check pins anyway)
-    assert wall < 1.9, wall
+    # No wall-clock bound: the interval-overlap check above already
+    # proves the stages ran concurrently, and any duration assertion
+    # would violate CLAUDE.md's determinism rule under the box's 2-4x
+    # load swings. (Warm pipelined runs measure ~0.9s vs 2.0s serial.)
+    del wall
 
     # bounded buffering: the slow stage's input queue never exceeded the
     # inter-op bound (fast stage was backpressured, not unbounded)
