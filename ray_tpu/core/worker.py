@@ -440,66 +440,94 @@ class WorkerRuntime:
 
     def _apply_runtime_env(self, spec: dict):
         """Apply a per-task/actor runtime_env (reference
-        ``python/ray/runtime_env``: env_vars + working_dir subset — no
-        conda/pip: the image is fixed). Returns an undo closure; actor
-        creation applies permanently (the process is dedicated)."""
+        ``python/ray/runtime_env``: env_vars, working_dir, py_modules,
+        pip site dirs — conda/containers stay unsupported, the image is
+        fixed). Returns an undo closure; actor creation applies
+        permanently (the process is dedicated). A failure mid-apply
+        (bad working_dir, failed pip install) rolls back everything
+        applied so far — a partial env must never leak into later
+        tasks."""
         renv = spec.get("runtime_env")
         if not renv:
             return lambda: None
         saved_env = {}
-        for k, v in (renv.get("env_vars") or {}).items():
-            saved_env[k] = os.environ.get(k)
-            os.environ[k] = str(v)
         saved_cwd = None
         path_entries = []
-        wd = renv.get("working_dir")
-        if wd:
-            saved_cwd = os.getcwd()
-            os.chdir(wd)
-            import sys
+        try:
+            for k, v in (renv.get("env_vars") or {}).items():
+                saved_env[k] = os.environ.get(k)
+                os.environ[k] = str(v)
+            wd = renv.get("working_dir")
+            if wd:
+                saved_cwd = os.getcwd()
+                os.chdir(wd)
+                import sys
 
-            sys.path.insert(0, wd)
-            path_entries.append(wd)
-        uris = renv.get("py_modules_uris")
-        if uris:
-            import sys
+                sys.path.insert(0, wd)
+                path_entries.append(wd)
+            uris = renv.get("py_modules_uris")
+            if uris:
+                import sys
 
-            from ray_tpu.runtime_env import (_PKG_NAMESPACE,
-                                             materialize_py_modules)
+                from ray_tpu.runtime_env import (_PKG_NAMESPACE,
+                                                 materialize_py_modules)
 
-            for entry in materialize_py_modules(
-                    uris, lambda u: self.kv_op("get", u, _PKG_NAMESPACE)):
+                for entry in materialize_py_modules(
+                        uris,
+                        lambda u: self.kv_op("get", u, _PKG_NAMESPACE)):
+                    sys.path.insert(0, entry)
+                    path_entries.append(entry)
+            pip_env = renv.get("pip_env")
+            if pip_env:
+                import sys
+
+                from ray_tpu.runtime_env import ensure_pip_env
+
+                # first use on this node builds the env
+                # (flock-serialized); later uses hit the .ready cache.
+                # The site dir takes import PRECEDENCE for the task's
+                # duration and is fully undone after (module eviction
+                # below included).
+                entry = ensure_pip_env(pip_env)
                 sys.path.insert(0, entry)
                 path_entries.append(entry)
+        except BaseException:
+            self._undo_runtime_env(saved_env, saved_cwd, path_entries)
+            raise
         if spec["type"] == ts.ACTOR_CREATE:
             return lambda: None  # permanent for the actor's lifetime
 
-        def undo():
-            import sys
+        return lambda: self._undo_runtime_env(saved_env, saved_cwd,
+                                              path_entries)
 
-            for k, old in saved_env.items():
-                if old is None:
-                    os.environ.pop(k, None)
-                else:
-                    os.environ[k] = old
-            if saved_cwd is not None:
-                os.chdir(saved_cwd)
-            for entry in path_entries:
-                if entry in sys.path:
-                    sys.path.remove(entry)
-            if path_entries:
-                # evict modules loaded from the removed entries, or they
-                # would leak into later tasks without this runtime_env
-                doomed = [
-                    name for name, mod in list(sys.modules.items())
-                    if getattr(mod, "__file__", None)
-                    and any(mod.__file__.startswith(e + os.sep)
-                            for e in path_entries)
-                ]
-                for name in doomed:
-                    del sys.modules[name]
+    @staticmethod
+    def _undo_runtime_env(saved_env, saved_cwd, path_entries) -> None:
+        """Revert an applied (possibly PARTIAL) runtime_env — the one
+        definition used by both the post-task undo and the mid-apply
+        failure rollback."""
+        import sys
 
-        return undo
+        for k, old in saved_env.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old
+        if saved_cwd is not None:
+            os.chdir(saved_cwd)
+        for entry in path_entries:
+            if entry in sys.path:
+                sys.path.remove(entry)
+        if path_entries:
+            # evict modules loaded from the removed entries, or they
+            # would leak into later tasks without this runtime_env
+            doomed = [
+                name for name, mod in list(sys.modules.items())
+                if getattr(mod, "__file__", None)
+                and any(mod.__file__.startswith(e + os.sep)
+                        for e in path_entries)
+            ]
+            for name in doomed:
+                del sys.modules[name]
 
     def _stream_results(self, spec: dict, value):
         """Drain a streaming task's generator: each yield becomes an object
