@@ -51,6 +51,13 @@ CONFIGS = [
     # token, fewer lm-head+embed passes per token
     ("seq4096_b16_chunk512", True, "full", 16, "pallas", 512, {}, 4096),
     ("seq4096_b8_chunk512", True, "full", 8, "pallas", 512, {}, 4096),
+    # no-remat retry: the r5 bf16-residual custom VJPs (rms/layer norm +
+    # rotary, ops/layers.py) kill the f32 [B,L,D] residuals that OOMed
+    # r4's no-remat runs. No remat = no recompute = the single biggest
+    # MFU lever if it fits (full-remat pays ~1.33x FLOPs).
+    ("noremat_b8_chunk512", False, "full", 8, "pallas", 512, {}),
+    ("noremat_b16_chunk512", False, "full", 16, "pallas", 512, {}),
+    ("noremat_b32_chunk512", False, "full", 32, "pallas", 512, {}),
 ]
 
 

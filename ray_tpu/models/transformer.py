@@ -31,7 +31,8 @@ from jax import lax
 from ray_tpu.models.config import TransformerConfig
 from ray_tpu.ops.attention import (_repeat_kv, _softcap_scores,
                                    naive_attention)
-from ray_tpu.ops.layers import apply_rotary, rms_norm, rotary_embedding
+from ray_tpu.ops.layers import (apply_rotary, layer_norm, rms_norm,
+                                rotary_embedding)
 from ray_tpu.ops.moe import moe_layer_dense
 from ray_tpu.parallel.sharding import constrain
 
@@ -146,15 +147,11 @@ def param_axes(config: TransformerConfig) -> Params:
 # ---------------------------------------------------------------------------
 
 def _norm(x, w, b, kind):
+    # Both kinds carry bf16-residual custom VJPs (ops/layers.py) — plain
+    # autodiff of the f32 upcast keeps f32 [B, L, D] residuals per site.
     if kind == "rms":
         return rms_norm(x, w)
-    xf = x.astype(jnp.float32)
-    mu = xf.mean(axis=-1, keepdims=True)
-    var = ((xf - mu) ** 2).mean(axis=-1, keepdims=True)
-    out = (xf - mu) * lax.rsqrt(var + 1e-5) * w.astype(jnp.float32)
-    if b is not None:
-        out = out + b.astype(jnp.float32)
-    return out.astype(x.dtype)
+    return layer_norm(x, w, b)
 
 
 def _sp_axis_size() -> int:

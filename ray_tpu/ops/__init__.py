@@ -20,6 +20,7 @@ from ray_tpu.ops.attention import (
 )
 from ray_tpu.ops.ring_attention import ring_attention
 from ray_tpu.ops.layers import (
+    layer_norm,
     rms_norm,
     rotary_embedding,
     apply_rotary,
@@ -37,6 +38,7 @@ __all__ = [
     "set_default_attention_impl",
     "resolve_attention_impl",
     "ring_attention",
+    "layer_norm",
     "rms_norm",
     "rotary_embedding",
     "apply_rotary",

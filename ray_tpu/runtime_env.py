@@ -23,7 +23,7 @@ import hashlib
 import io
 import os
 import zipfile
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Optional
 
 _PKG_NAMESPACE = "rtpu_pkg"
 _UNSUPPORTED = ("conda", "container", "uv")
@@ -39,9 +39,10 @@ def package_runtime_env(renv: Optional[Dict[str, Any]],
     for key in _UNSUPPORTED:
         if renv.get(key):
             raise ValueError(
-                f"runtime_env[{key!r}] is not supported: the image is fixed "
-                f"(no package installation at runtime). Bake dependencies "
-                f"into the image or ship pure-python code via py_modules.")
+                f"runtime_env[{key!r}] is not supported. Use "
+                f"runtime_env={{'pip': [...]}} for per-task package "
+                f"isolation (URI-cached per-requirements site dirs), or "
+                f"ship pure-python code via py_modules.")
     out = dict(renv)
     pip = out.pop("pip", None)
     if pip is not None:

@@ -352,9 +352,9 @@ def test_pip_runtime_env_venv_isolation_and_cache(rt_rob, tmp_path,
     def use_pkg():
         import rtpu_testpkg
 
-        return rtpu_testpkg.MAGIC, rtpu_testpkg.__file__
+        return os.getpid(), rtpu_testpkg.MAGIC, rtpu_testpkg.__file__
 
-    magic, path = ray_tpu.get(
+    pkg_pid, magic, path = ray_tpu.get(
         use_pkg.options(runtime_env=renv).remote(), timeout=120)
     assert magic == "wheel-0.1"
     assert env_root in path  # imported from the venv, not the image
@@ -363,16 +363,26 @@ def test_pip_runtime_env_venv_isolation_and_cache(rt_rob, tmp_path,
     with pytest.raises(ImportError):
         importlib.import_module("rtpu_testpkg")
 
-    # a task WITHOUT the env cannot see the package (undo worked)
+    # a task WITHOUT the env cannot see the package (undo worked). The
+    # assertion is only meaningful on the worker that APPLIED the env, so
+    # retry until the scheduler lands the probe on that same pid (any
+    # other worker is trivially isolated).
     @ray_tpu.remote
     def cannot_import():
         try:
             import rtpu_testpkg  # noqa: F401
-            return "leaked"
+            return os.getpid(), "leaked"
         except ImportError:
-            return "isolated"
+            return os.getpid(), "isolated"
 
-    assert ray_tpu.get(cannot_import.remote(), timeout=60) == "isolated"
+    for _ in range(60):
+        pid, status = ray_tpu.get(cannot_import.remote(), timeout=60)
+        if pid == pkg_pid:
+            break
+        _t.sleep(0.05)
+    else:
+        pytest.fail(f"probe never landed on the pip-env worker {pkg_pid}")
+    assert status == "isolated"
 
     # second use hits the cache: .ready mtime unchanged, and fast
     envs = [d for d in os.listdir(env_root) if d.startswith("pipenv-")
@@ -380,7 +390,7 @@ def test_pip_runtime_env_venv_isolation_and_cache(rt_rob, tmp_path,
     assert len(envs) == 1
     ready = os.path.join(env_root, envs[0], ".ready")
     mtime = os.path.getmtime(ready)
-    magic2, _ = ray_tpu.get(
+    _, magic2, _ = ray_tpu.get(
         use_pkg.options(runtime_env=renv).remote(), timeout=60)
     assert magic2 == "wheel-0.1"
     assert os.path.getmtime(ready) == mtime  # no reinstall
