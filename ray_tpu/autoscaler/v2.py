@@ -145,3 +145,193 @@ class InstanceManager:
 
     def summary(self) -> Dict[str, Any]:
         return {status: len(v) for status, v in self.by_status().items()}
+
+
+# ---------------------------------------------------------------------------
+# Resource-demand scheduler (reference autoscaler/v2/scheduler.py role)
+# ---------------------------------------------------------------------------
+
+_ACTIVE = (QUEUED, REQUESTED, ALLOCATED, RAY_RUNNING)
+
+Bundle = Dict[str, float]
+
+
+@dataclass
+class NodeTypeSpec:
+    """Declared node type for v2 (reference ``NodeTypeConfig`` +
+    ``node_config`` resources). Unlike v1, resources are DECLARED here —
+    the scheduler never peeks at the provider."""
+
+    name: str
+    resources: Dict[str, float]
+    min_workers: int = 0
+    max_workers: int = 10
+
+
+@dataclass
+class SchedulingDecision:
+    """Output of one scheduling pass — pure data, applied by
+    :class:`AutoscalerV2` (reference ``SchedulingReply`` role)."""
+
+    launches: Dict[str, int] = field(default_factory=dict)
+    terminations: List[str] = field(default_factory=list)   # instance ids
+    infeasible: List[Bundle] = field(default_factory=list)
+    packing: Dict[str, int] = field(default_factory=dict)   # iid -> bundles
+
+    def summary(self) -> Dict[str, Any]:
+        return {"launches": dict(self.launches),
+                "terminations": list(self.terminations),
+                "infeasible": len(self.infeasible)}
+
+
+class ResourceDemandScheduler:
+    """Bin-pack pending demand over the instance table (reference
+    ``autoscaler/v2/scheduler.py`` ResourceDemandScheduler).
+
+    A pure function of (demand, instances, idle set): no provider calls,
+    no clock — the same inputs always produce the same decision, which is
+    what makes v2 scheduling testable and auditable (the reference logs
+    every decision for exactly this reason).
+
+    Passes, in order (reference ``_sched_*`` pipeline):
+
+    1. **min_workers floors** — launch up to each type's minimum counting
+       every non-terminal instance (QUEUED/REQUESTED included: launches
+       are idempotent against the instance table, never the cloud).
+    2. **first-fit-decreasing bin-pack** of demand bundles onto free
+       capacity of active instances, then onto virtual instances of
+       already-planned launches, then onto new launches (respecting
+       max_workers). Unpackable bundles are reported ``infeasible``.
+    3. **idle release** — idle RAY_RUNNING instances that received no
+       bundle in pass 2 and aren't needed for min_workers are terminated.
+    """
+
+    def __init__(self, node_types: List[NodeTypeSpec]):
+        self.node_types = list(node_types)
+        self._by_name = {t.name: t for t in node_types}
+
+    def schedule(self, demand: List[Bundle],
+                 instances: Dict[str, Instance],
+                 idle_instance_ids: Optional[set] = None,
+                 ) -> SchedulingDecision:
+        idle = set(idle_instance_ids or ())
+        dec = SchedulingDecision()
+
+        active = [i for i in instances.values() if i.status in _ACTIVE
+                  and i.node_type in self._by_name]
+        counts: Dict[str, int] = {}
+        for inst in active:
+            counts[inst.node_type] = counts.get(inst.node_type, 0) + 1
+
+        # pass 1: min_workers floors
+        for t in self.node_types:
+            short = t.min_workers - (counts.get(t.name, 0)
+                                     + dec.launches.get(t.name, 0))
+            if short > 0:
+                dec.launches[t.name] = dec.launches.get(t.name, 0) + short
+
+        # pass 2: FFD bin-pack. Track per-slot free capacity; slots are
+        # (instance_id | planned-launch marker, resources).
+        slots: List[tuple] = [(i.instance_id,
+                               dict(self._by_name[i.node_type].resources))
+                              for i in active]
+        for name, k in dec.launches.items():
+            slots.extend(("<new>", dict(self._by_name[name].resources))
+                         for _ in range(k))
+        for bundle in sorted(demand, key=lambda b: -sum(b.values())):
+            if self._fit(bundle, slots, dec):
+                continue
+            t = self._pick_type(bundle, counts, dec.launches)
+            if t is None:
+                dec.infeasible.append(dict(bundle))
+                continue
+            dec.launches[t.name] = dec.launches.get(t.name, 0) + 1
+            slots.append(("<new>", dict(t.resources)))
+            self._fit(bundle, slots, dec)
+
+        # pass 3: idle release (never below min_workers, never a packed
+        # instance)
+        for t in self.node_types:
+            running = [i for i in active if i.node_type == t.name
+                       and i.status == RAY_RUNNING]
+            releasable = [i for i in running
+                          if i.instance_id in idle
+                          and i.instance_id not in dec.packing]
+            keep = max(t.min_workers, len(running) - len(releasable))
+            n_release = len(running) - keep
+            dec.terminations.extend(
+                i.instance_id for i in releasable[:max(0, n_release)])
+        return dec
+
+    @staticmethod
+    def _fit(bundle: Bundle, slots: List[tuple],
+             dec: SchedulingDecision) -> bool:
+        for iid, free in slots:
+            if all(free.get(k, 0.0) >= v for k, v in bundle.items()):
+                for k, v in bundle.items():
+                    free[k] = free.get(k, 0.0) - v
+                if iid != "<new>":
+                    dec.packing[iid] = dec.packing.get(iid, 0) + 1
+                return True
+        return False
+
+    def _pick_type(self, bundle: Bundle, counts: Dict[str, int],
+                   launches: Dict[str, int]) -> Optional[NodeTypeSpec]:
+        for t in self.node_types:
+            if counts.get(t.name, 0) + launches.get(t.name, 0) \
+                    >= t.max_workers:
+                continue
+            if all(t.resources.get(k, 0.0) >= v for k, v in bundle.items()):
+                return t
+        return None
+
+
+class AutoscalerV2:
+    """The v2 loop: demand -> scheduler -> instance manager (reference
+    ``autoscaler/v2/autoscaler.py`` role). One ``update()`` is one
+    converge step; all state lives in the instance table, so a crashed
+    autoscaler resumes by re-reading it."""
+
+    def __init__(self, provider: NodeProvider,
+                 node_types: List[NodeTypeSpec],
+                 load_source: Optional[Any] = None,
+                 idle_timeout_s: float = 60.0,
+                 clock: Any = time.monotonic):
+        self.im = InstanceManager(provider)
+        self.scheduler = ResourceDemandScheduler(node_types)
+        self.load_source = load_source
+        self.idle_timeout_s = idle_timeout_s
+        self._clock = clock  # injectable for deterministic tests
+        self._last_busy: Dict[str, float] = {}
+
+    def update(self, demand: Optional[List[Bundle]] = None,
+               alive_node_ids: Optional[set] = None,
+               busy_instance_ids: Optional[set] = None,
+               ) -> SchedulingDecision:
+        """One pass. ``busy_instance_ids``: instances with resources in
+        use (idle-timeout input); ``alive_node_ids``: cloud ids seen in
+        the GCS node table."""
+        demand = list(demand or [])
+        if self.load_source is not None:
+            demand += list(self.load_source() or [])
+
+        now = self._clock()
+        busy = set(busy_instance_ids or ())
+        idle = set()
+        for iid, inst in self.im.instances.items():
+            if inst.status != RAY_RUNNING:
+                if inst.status in (TERMINATED, ALLOCATION_FAILED):
+                    self._last_busy.pop(iid, None)
+                continue
+            if iid in busy or iid not in self._last_busy:
+                self._last_busy[iid] = now
+            if now - self._last_busy[iid] >= self.idle_timeout_s:
+                idle.add(iid)
+
+        dec = self.scheduler.schedule(demand, self.im.instances, idle)
+        for name, k in dec.launches.items():
+            self.im.launch(name, k)
+        for iid in dec.terminations:
+            self.im.terminate(iid)
+        self.im.reconcile(alive_node_ids)
+        return dec

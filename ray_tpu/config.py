@@ -136,6 +136,12 @@ _knob("attn_block_q", int, 512,
 _knob("attn_block_k", int, 512,
       "flash-attention key/value tile (cols per MXU block)",
       "ray_tpu/models/transformer.py")
+_knob("xla_compiler_options", str, "",
+      "space-separated k=v XLA compile options for the train step "
+      "(e.g. xla_tpu_scoped_vmem_limit_kib=65536). Passed per-jit, NOT "
+      "via XLA_FLAGS: TPU flags in XLA_FLAGS abort the host-side XLA "
+      "parser on the tunneled axon backend",
+      "ray_tpu/train/train_state.py")
 _knob("bench_child_timeout", float, 420.0,
       "per-attempt timeout for the bench train-step child", "bench.py")
 _knob("bench_retries", int, 3, "bench train-step attempts", "bench.py")

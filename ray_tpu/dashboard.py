@@ -36,6 +36,8 @@ class _Handler(BaseHTTPRequestHandler):
             "/api/summary/objects": st.summarize_objects,
             # serve REST (reference dashboard/modules/serve role)
             "/api/serve/applications": serve_rest.serve_rest_get,
+            # Chrome-trace task spans (reference timeline view role)
+            "/api/timeline": _timeline_events,
         }
         try:
             if self.path == "/metrics":
@@ -113,6 +115,13 @@ class _Handler(BaseHTTPRequestHandler):
                              {"result": serve_rest.serve_rest_delete()})
         except Exception as e:  # noqa: BLE001
             self._json_reply(500, {"error": str(e)})
+
+
+def _timeline_events():
+    """Driver timeline (Chrome-trace X events) for the UI's swimlanes."""
+    import ray_tpu
+
+    return ray_tpu.timeline()
 
 
 class Dashboard:

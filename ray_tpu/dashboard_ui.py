@@ -1,10 +1,25 @@
 """Single-page dashboard UI served at ``/`` (reference ``dashboard/client``
 role, deliberately dependency-free: one static HTML page that polls the
-JSON endpoints and renders cluster state tables — nodes, actors, tasks,
-objects, placement groups, serve applications — plus the raw /metrics
-link. The reference ships a 21.9k-LoC React SPA; the equivalent operator
-value here is live tabular state, which this page delivers without a
-build toolchain)."""
+JSON endpoints).
+
+Views (reference SPA feature -> here):
+
+- live state tables (nodes/actors/tasks/objects/workers/PGs/serve) with
+  **row drill-down**: click a row for the full record as pretty JSON in a
+  side panel (reference actor/task detail pages);
+- **timeline**: per-worker swimlanes of task-execution spans rendered
+  from ``/api/timeline`` (the Chrome-trace events ``ray_tpu timeline``
+  exports), hover for name/duration (reference timeline view);
+- **metrics**: sparkline history + current value for key gauges polled
+  from ``/metrics`` (Prometheus text parsed client-side), plus the full
+  sample table (reference Grafana-panel role, minus Grafana).
+
+The reference ships a 21.9k-LoC React SPA; the operator value is live
+state + drill-down + a timeline + metric trends, which this page delivers
+without a build toolchain. Colors follow the repo-wide dataviz palette
+(series hues for identity, status pills for state, light+dark via
+``prefers-color-scheme``).
+"""
 
 INDEX_HTML = """<!DOCTYPE html>
 <html>
@@ -12,49 +27,124 @@ INDEX_HTML = """<!DOCTYPE html>
 <meta charset="utf-8">
 <title>ray_tpu dashboard</title>
 <style>
+  :root {
+    color-scheme: light;
+    --surface-1: #fcfcfb; --surface-2: #ffffff; --border: #e3e6ea;
+    --text-primary: #0b0b0b; --text-secondary: #52514e;
+    --text-muted: #8a8985;
+    --series-1: #2a78d6; --series-2: #eb6834; --series-3: #1baf7a;
+    --series-other: #9aa3ad;
+    --ok-bg: #e7f6ec; --ok-fg: #16803c;
+    --bad-bg: #fdeaea; --bad-fg: #b42318;
+    --warn-bg: #fff4e5; --warn-fg: #b25e09;
+    --header-bg: #1a1d21; --header-fg: #ffffff;
+  }
+  @media (prefers-color-scheme: dark) {
+    :root {
+      color-scheme: dark;
+      --surface-1: #1a1a19; --surface-2: #242423; --border: #3a3a38;
+      --text-primary: #ffffff; --text-secondary: #c3c2b7;
+      --text-muted: #8a8985;
+      --series-1: #3987e5; --series-2: #d95926; --series-3: #199e70;
+      --series-other: #6a6a68;
+      --ok-bg: #10331d; --ok-fg: #69d391;
+      --bad-bg: #3d1513; --bad-fg: #f1968f;
+      --warn-bg: #3a2a10; --warn-fg: #eec07a;
+      --header-bg: #0b0b0b; --header-fg: #ffffff;
+    }
+  }
   body { font-family: -apple-system, 'Segoe UI', Roboto, sans-serif;
-         margin: 0; background: #f6f7f9; color: #1a1d21; }
-  header { background: #1a1d21; color: #fff; padding: 10px 20px;
-           display: flex; align-items: baseline; gap: 14px; }
+         margin: 0; background: var(--surface-1);
+         color: var(--text-primary); }
+  header { background: var(--header-bg); color: var(--header-fg);
+           padding: 10px 20px; display: flex; align-items: baseline;
+           gap: 14px; }
   header h1 { font-size: 16px; margin: 0; }
-  header span { color: #9aa3ad; font-size: 12px; }
-  nav { padding: 8px 20px; background: #fff; border-bottom: 1px solid #e3e6ea; }
-  nav a { margin-right: 12px; cursor: pointer; color: #2563eb;
+  header span { color: var(--text-muted); font-size: 12px; }
+  nav { padding: 8px 20px; background: var(--surface-2);
+        border-bottom: 1px solid var(--border); }
+  nav a { margin-right: 12px; cursor: pointer; color: var(--series-1);
           text-decoration: none; font-size: 13px; }
-  nav a.active { font-weight: 600; border-bottom: 2px solid #2563eb; }
-  main { padding: 16px 20px; }
-  table { border-collapse: collapse; width: 100%; background: #fff;
-          font-size: 12.5px; }
+  nav a.active { font-weight: 600;
+                 border-bottom: 2px solid var(--series-1); }
+  main { padding: 16px 20px; display: flex; gap: 16px;
+         align-items: flex-start; }
+  #content { flex: 1 1 auto; min-width: 0; }
+  table { border-collapse: collapse; width: 100%;
+          background: var(--surface-2); font-size: 12.5px; }
   th, td { text-align: left; padding: 6px 10px;
-           border-bottom: 1px solid #eceff3; }
-  th { background: #f0f2f5; font-weight: 600; position: sticky; top: 0; }
+           border-bottom: 1px solid var(--border); }
+  th { background: var(--surface-1); font-weight: 600;
+       position: sticky; top: 0; color: var(--text-secondary); }
+  tbody tr { cursor: pointer; }
+  tbody tr:hover { background: color-mix(in srgb, var(--series-1) 8%,
+                                         var(--surface-2)); }
   .pill { padding: 1px 8px; border-radius: 9px; font-size: 11px; }
-  .ALIVE, .READY, .FINISHED, .RUNNING { background:#e7f6ec; color:#16803c; }
-  .DEAD, .ERROR, .FAILED { background: #fdeaea; color: #b42318; }
-  .PENDING, .RESTARTING { background: #fff4e5; color: #b25e09; }
-  #err { color: #b42318; font-size: 12px; padding: 4px 20px; }
+  .ALIVE, .READY, .FINISHED, .RUNNING, .HEALTHY
+    { background: var(--ok-bg); color: var(--ok-fg); }
+  .DEAD, .ERROR, .FAILED, .UNHEALTHY
+    { background: var(--bad-bg); color: var(--bad-fg); }
+  .PENDING, .RESTARTING, .DEPLOYING
+    { background: var(--warn-bg); color: var(--warn-fg); }
+  #err { color: var(--bad-fg); font-size: 12px; padding: 4px 20px; }
+  #detail { flex: 0 0 380px; max-width: 380px; background:
+            var(--surface-2); border: 1px solid var(--border);
+            border-radius: 6px; padding: 10px 12px; display: none;
+            position: sticky; top: 10px; }
+  #detail h2 { font-size: 13px; margin: 0 0 6px;
+               color: var(--text-secondary); display: flex; }
+  #detail h2 a { margin-left: auto; cursor: pointer; font-weight: 400;
+                 color: var(--text-muted); text-decoration: none; }
+  #detail pre { font-size: 11.5px; white-space: pre-wrap;
+                word-break: break-all; margin: 0; max-height: 70vh;
+                overflow: auto; color: var(--text-primary); }
+  svg text { fill: var(--text-secondary); font-size: 10.5px; }
+  .lane-label { fill: var(--text-muted); }
+  .axis line { stroke: var(--border); }
+  #tooltip { position: fixed; pointer-events: none; display: none;
+             background: var(--surface-2); color: var(--text-primary);
+             border: 1px solid var(--border); border-radius: 4px;
+             padding: 4px 8px; font-size: 11.5px; z-index: 10;
+             box-shadow: 0 2px 8px rgba(0,0,0,.15); }
+  .mcards { display: grid; gap: 12px;
+            grid-template-columns: repeat(auto-fill, minmax(240px, 1fr));
+            margin-bottom: 16px; }
+  .mcard { background: var(--surface-2); border: 1px solid var(--border);
+           border-radius: 6px; padding: 10px 12px; }
+  .mcard .name { font-size: 11.5px; color: var(--text-secondary); }
+  .mcard .val { font-size: 20px; font-weight: 600; margin: 2px 0 6px; }
+  .note { font-size: 11.5px; color: var(--text-muted); margin: 8px 0; }
 </style>
 </head>
 <body>
 <header><h1>ray_tpu</h1><span id="ts"></span>
   <span style="margin-left:auto"><a href="/metrics"
-    style="color:#9aa3ad">/metrics</a></span></header>
+    style="color:var(--text-muted)">/metrics</a></span></header>
 <nav id="nav"></nav>
 <div id="err"></div>
-<main><table id="tbl"><thead></thead><tbody></tbody></table></main>
+<main>
+  <div id="content">
+    <table id="tbl"><thead></thead><tbody></tbody></table>
+    <div id="special"></div>
+  </div>
+  <div id="detail"><h2><span id="dtitle"></span>
+    <a id="dclose">close</a></h2><pre id="djson"></pre></div>
+</main>
+<div id="tooltip"></div>
 <script>
 const TABS = {
   nodes: "/api/nodes", actors: "/api/actors", tasks: "/api/tasks",
   objects: "/api/objects", workers: "/api/workers",
   placement_groups: "/api/placement_groups",
   serve: "/api/serve/applications",
+  timeline: null, metrics: null,
 };
 let current = "nodes";
 const nav = document.getElementById("nav");
 for (const name of Object.keys(TABS)) {
   const a = document.createElement("a");
   a.textContent = name; a.id = "tab-" + name;
-  a.onclick = () => { current = name; refresh(); };
+  a.onclick = () => { current = name; hideDetail(); refresh(); };
   nav.appendChild(a);
 }
 function esc(s) {
@@ -74,31 +164,201 @@ function statePill(v) {
   const cls = /^[A-Za-z_]+$/.test(String(v)) ? String(v) : "";
   return `<span class="pill ${cls}">${s}</span>`;
 }
+// -- drill-down ------------------------------------------------------------
+let lastRows = [];
+function showDetail(i) {
+  const r = lastRows[i];
+  if (!r) return;
+  document.getElementById("detail").style.display = "block";
+  document.getElementById("dtitle").textContent =
+    current + " · " + (r.name || r.actor_id || r.task_id || r.node_id ||
+                       r.object_id || r.key || "row " + i);
+  document.getElementById("djson").textContent =
+    JSON.stringify(r, null, 2);
+}
+function hideDetail() {
+  document.getElementById("detail").style.display = "none";
+}
+document.getElementById("dclose").onclick = hideDetail;
+// -- tooltip ---------------------------------------------------------------
+const tip = document.getElementById("tooltip");
+function tipShow(ev, html) {
+  tip.style.display = "block"; tip.innerHTML = html;
+  tip.style.left = (ev.clientX + 12) + "px";
+  tip.style.top = (ev.clientY + 12) + "px";
+}
+function tipHide() { tip.style.display = "none"; }
+// -- timeline --------------------------------------------------------------
+const SERIES = ["var(--series-1)", "var(--series-2)", "var(--series-3)"];
+const nameColor = new Map();  // fixed first-seen assignment, never cycled
+function colorFor(name) {
+  if (!nameColor.has(name))
+    nameColor.set(name, nameColor.size < SERIES.length
+                  ? SERIES[nameColor.size] : "var(--series-other)");
+  return nameColor.get(name);
+}
+function renderTimeline(events) {
+  const sp = document.getElementById("special");
+  const xs = events.filter(e => e.ph === "X" && e.dur > 0);
+  if (!xs.length) {
+    sp.innerHTML = "<div class='note'>no task events yet — " +
+      "run some tasks, then revisit</div>";
+    return;
+  }
+  // axis/lanes derive from the DRAWN window, not all history — else the
+  // axis spans undrawn events and recent spans compress into a sliver
+  const shown = xs.slice(-2000);
+  const t0 = Math.min(...shown.map(e => e.ts));
+  const t1 = Math.max(...shown.map(e => e.ts + e.dur));
+  const span = Math.max(t1 - t0, 1);
+  const lanes = [...new Set(shown.map(e => e.tid))];
+  const W = Math.max(600, sp.clientWidth - 10), laneH = 22,
+        left = 90, H = lanes.length * laneH + 30;
+  let bars = "";
+  shown.forEach((e, i) => {
+    const y = lanes.indexOf(e.tid) * laneH + 4;
+    const x = left + (e.ts - t0) / span * (W - left - 10);
+    const w = Math.max(2, e.dur / span * (W - left - 10));
+    bars += `<rect data-i="${i}" x="${x.toFixed(1)}" y="${y}"
+      width="${w.toFixed(1)}" height="${laneH - 8}" rx="3"
+      fill="${colorFor(e.name)}"
+      stroke="var(--surface-1)" stroke-width="1"></rect>`;
+  });
+  const labels = lanes.map((t, j) =>
+    `<text class="lane-label" x="4" y="${j * laneH + 15}">` +
+    `worker ${esc(t)}</text>`).join("");
+  // time axis: start / mid / end ticks in seconds-since-start
+  const ticks = [0, 0.5, 1].map(f => {
+    const x = left + f * (W - left - 10);
+    return `<line x1="${x}" y1="0" x2="${x}" y2="${H - 24}"
+              stroke="var(--border)"></line>
+            <text x="${x + 3}" y="${H - 10}">` +
+           `${(span * f / 1e6).toFixed(2)}s</text>`;
+  }).join("");
+  sp.innerHTML =
+    `<div class="note">task execution spans per worker ` +
+    `(last ${Math.min(xs.length, 2000)} of ${xs.length}; color = task ` +
+    `name, first three names get hues, the rest gray)</div>` +
+    `<svg id="tl" width="${W}" height="${H}"
+       style="background:var(--surface-2);border:1px solid var(--border);
+              border-radius:6px">${ticks}${labels}${bars}</svg>` +
+    `<div class="note" id="tl-legend"></div>`;
+  const legend = [...nameColor.entries()].slice(0, 6).map(([n, c]) =>
+    `<span style="display:inline-flex;align-items:center;gap:4px;` +
+    `margin-right:12px"><span style="width:10px;height:10px;` +
+    `border-radius:2px;background:${c};display:inline-block"></span>` +
+    `${esc(n)}</span>`).join("");
+  document.getElementById("tl-legend").innerHTML = legend;
+  document.getElementById("tl").addEventListener("mousemove", ev => {
+    const r = ev.target.closest("rect");
+    if (!r) { tipHide(); return; }
+    const e = shown[+r.dataset.i];
+    tipShow(ev, `<b>${esc(e.name)}</b><br>worker ${esc(e.tid)}<br>` +
+                `${(e.dur / 1e3).toFixed(2)} ms`);
+  });
+  document.getElementById("tl").addEventListener("mouseleave", tipHide);
+}
+// -- metrics ---------------------------------------------------------------
+const HISTORY = new Map();  // metric -> [{t, v}], ring of 120
+function parseProm(text) {
+  const out = [];
+  for (const line of text.split("\\n")) {
+    if (!line || line.startsWith("#")) continue;
+    const m = line.match(/^([a-zA-Z_:][\\w:]*)(\\{[^}]*\\})?\\s+(\\S+)/);
+    if (m) out.push({name: m[1] + (m[2] || ""), value: parseFloat(m[3])});
+  }
+  return out;
+}
+function spark(hist, color) {
+  const W = 216, H = 40;
+  if (hist.length < 2)
+    return `<svg width="${W}" height="${H}"></svg>`;
+  const vs = hist.map(p => p.v);
+  const lo = Math.min(...vs), hi = Math.max(...vs), r = (hi - lo) || 1;
+  const pts = hist.map((p, i) =>
+    `${(i / (hist.length - 1) * (W - 4) + 2).toFixed(1)},` +
+    `${(H - 4 - (p.v - lo) / r * (H - 8) + 2).toFixed(1)}`).join(" ");
+  return `<svg width="${W}" height="${H}"><polyline points="${pts}"
+    fill="none" stroke="${color}" stroke-width="2"
+    stroke-linejoin="round"></polyline></svg>`;
+}
+async function renderMetrics() {
+  const sp = document.getElementById("special");
+  const text = await (await fetch("/metrics")).text();
+  const samples = parseProm(text);
+  const now = Date.now();
+  for (const s of samples) {
+    if (!HISTORY.has(s.name)) HISTORY.set(s.name, []);
+    const h = HISTORY.get(s.name);
+    h.push({t: now, v: s.value});
+    if (h.length > 120) h.shift();
+  }
+  // cards for the first 12 metrics in stable (alphabetical) order, so a
+  // card never jumps between polls; the full table below has the rest
+  const ranked = [...HISTORY.entries()]
+    .filter(([, h]) => h.length >= 1)
+    .sort((a, b) => a[0].localeCompare(b[0]));
+  const cards = ranked.slice(0, 12).map(([name, h]) => {
+    const v = h[h.length - 1].v;
+    return `<div class="mcard"><div class="name">${esc(name)}</div>` +
+      `<div class="val">${Number.isInteger(v) ? v : v.toFixed(3)}</div>` +
+      spark(h, "var(--series-1)") + `</div>`;
+  }).join("");
+  sp.innerHTML =
+    `<div class="mcards">${cards || "<div class='note'>no samples " +
+     "yet</div>"}</div>` +
+    `<div class="note">history = this page's polls (3s cadence); full ` +
+    `sample table below</div>` +
+    `<table><thead><tr><th>metric</th><th>value</th></tr></thead>` +
+    `<tbody>` + samples.map(s =>
+      `<tr><td>${esc(s.name)}</td><td>${s.value}</td></tr>`).join("") +
+    `</tbody></table>`;
+}
+// -- main loop -------------------------------------------------------------
 async function refresh() {
   for (const n of Object.keys(TABS))
     document.getElementById("tab-" + n)
       .classList.toggle("active", n === current);
+  const tbl = document.getElementById("tbl"),
+        sp = document.getElementById("special");
   try {
-    const resp = await fetch(TABS[current]);
-    const data = (await resp.json()).result;
-    let rows = Array.isArray(data) ? data
-      : (data && data.applications
-         ? Object.entries(data.applications).map(
-             ([k, v]) => ({name: k, ...v}))
-         : Object.entries(data || {}).map(([k, v]) => ({key: k, ...v})));
-    const thead = document.querySelector("#tbl thead");
-    const tbody = document.querySelector("#tbl tbody");
-    if (!rows.length) { thead.innerHTML = "<tr><th>(empty)</th></tr>";
-                        tbody.innerHTML = ""; }
-    else {
-      const cols = Object.keys(rows[0]);
-      thead.innerHTML = "<tr>" + cols.map(c => `<th>${esc(c)}</th>`).join("")
-                        + "</tr>";
-      tbody.innerHTML = rows.map(r => "<tr>" + cols.map(c => {
-        const v = r[c];
-        const isState = ["state", "status", "Alive", "alive"].includes(c);
-        return `<td>${isState ? statePill(v) : cell(v)}</td>`;
-      }).join("") + "</tr>").join("");
+    if (current === "timeline") {
+      tbl.style.display = "none";
+      const resp = await fetch("/api/timeline");
+      renderTimeline((await resp.json()).result || []);
+    } else if (current === "metrics") {
+      tbl.style.display = "none";
+      await renderMetrics();
+    } else {
+      sp.innerHTML = ""; tbl.style.display = "table";
+      const resp = await fetch(TABS[current]);
+      const data = (await resp.json()).result;
+      let rows = Array.isArray(data) ? data
+        : (data && data.applications
+           ? Object.entries(data.applications).map(
+               ([k, v]) => ({name: k, ...v}))
+           : Object.entries(data || {}).map(([k, v]) => ({key: k, ...v})));
+      lastRows = rows;
+      const thead = document.querySelector("#tbl thead");
+      const tbody = document.querySelector("#tbl tbody");
+      if (!rows.length) { thead.innerHTML = "<tr><th>(empty)</th></tr>";
+                          tbody.innerHTML = ""; }
+      else {
+        const cols = Object.keys(rows[0]);
+        thead.innerHTML = "<tr>" + cols.map(c =>
+          `<th>${esc(c)}</th>`).join("") + "</tr>";
+        tbody.innerHTML = rows.map((r, i) =>
+          `<tr data-i="${i}">` + cols.map(c => {
+            const v = r[c];
+            const isState = ["state", "status", "Alive",
+                             "alive"].includes(c);
+            return `<td>${isState ? statePill(v) : cell(v)}</td>`;
+          }).join("") + "</tr>").join("");
+        tbody.onclick = ev => {
+          const tr = ev.target.closest("tr");
+          if (tr) showDetail(+tr.dataset.i);
+        };
+      }
     }
     document.getElementById("ts").textContent =
       "updated " + new Date().toLocaleTimeString();

@@ -198,13 +198,10 @@ def _attention(q, k, v, config: TransformerConfig, window: Optional[int] = None)
                       if a in mesh.axis_names)
         qspec = P(batch or None, "sp", "tp" if "tp" in mesh.axis_names else None, None)
         if window:
-            # windowed + sequence-parallel: halo exchange (one ppermute of
-            # the neighbor shard) instead of the full ring — O(1) comm
-            if window > q.shape[1] // sp:
-                raise NotImplementedError(
-                    f"sliding window {window} exceeds the "
-                    f"per-shard sequence {q.shape[1] // sp} (sp={sp}); "
-                    "lower sp or raise seq/sp")
+            # windowed + sequence-parallel: halo exchange — ceil(window/
+            # Lloc) chained ppermutes, O(window/Lloc) comm independent
+            # of sp. Multi-hop handles window > Lloc; any window is
+            # exact (hops clamp at sp-1 = all-gather shape).
             inner = functools.partial(sliding_window_attention_sp,
                                       axis="sp",
                                       window=window,

@@ -110,13 +110,19 @@ def sliding_window_attention_sp(
     positional memory-efficient custom VJP (O(L) residuals), so it is
     safe to differentiate in a scanned-layer model.
 
-    Shard 0's halo arrives from the LAST shard (ppermute wraps); its keys
-    get negative global positions and are masked, never attended.
+    A window wider than one shard (``window > Lloc``) needs keys from
+    ``H = ceil(window / Lloc)`` previous shards: the halo is gathered by
+    H chained ppermutes (hop j carries shard ``i-j``'s keys), still
+    O(window / Lloc) communication — independent of sp, vs ring
+    attention's O(sp) rotation of the full sequence.
+
+    Shard 0's halo arrives from the LAST shards (ppermute wraps); their
+    keys get negative global positions and are masked, never attended.
+    H clamps to sp-1 (every other shard exactly once): the band mask
+    enforces the window from positions, so ANY window is handled —
+    at H = sp-1 the traffic degenerates to all-gather shape and ring
+    attention becomes the better schedule, but results stay exact.
     """
-    if window > q.shape[1]:
-        raise NotImplementedError(
-            f"window {window} > local shard length {q.shape[1]}: the halo "
-            "exchange needs multi-hop permutes; lower sp or raise seq/sp")
     from ray_tpu.ops.attention import _mha_pos
 
     scale = scale if scale is not None else q.shape[-1] ** -0.5
@@ -124,21 +130,32 @@ def sliding_window_attention_sp(
     my = lax.axis_index(axis)
     b, lloc, h, d = q.shape
 
+    # ceil: previous shards the band reaches, capped at all-of-them
+    hops = min(-(-window // lloc), sp - 1)
+
     perm = [(i, (i + 1) % sp) for i in range(sp)]
-    halo_k = lax.ppermute(k, axis, perm)   # previous shard's keys
-    halo_v = lax.ppermute(v, axis, perm)
-    k_all = jnp.concatenate([halo_k, k], axis=1)    # [B, 2*Lloc, Hk, D]
-    v_all = jnp.concatenate([halo_v, v], axis=1)
+    halos_k, halos_v = [], []      # hop j (1-based) = shard i-j's k/v
+    hk, hv = k, v
+    for _ in range(hops):
+        hk = lax.ppermute(hk, axis, perm)
+        hv = lax.ppermute(hv, axis, perm)
+        halos_k.append(hk)
+        halos_v.append(hv)
+    # farthest hop first so concatenated positions ascend
+    k_all = jnp.concatenate(halos_k[::-1] + [k], axis=1)
+    v_all = jnp.concatenate(halos_v[::-1] + [v], axis=1)
 
     start = my * lloc
     qpos = (start + jnp.arange(lloc)).astype(jnp.float32)
-    kpos = ((start - lloc) + jnp.arange(2 * lloc)).astype(jnp.float32)
+    kpos = ((start - hops * lloc)
+            + jnp.arange((hops + 1) * lloc)).astype(jnp.float32)
 
     bq = min(q_block, lloc)
-    bk = min(kv_block, lloc)  # divides both Lloc and 2*Lloc
+    bk = min(kv_block, lloc)  # divides both Lloc and (hops+1)*Lloc
     if lloc % bq or lloc % bk:
         bq = bk = lloc
-    # pos_delta = qpos[0] - kpos[0] = Lloc (STATIC): keeps the windowed
-    # live-kv-block slicing so the band costs O(Lloc*window), not dense
+    # pos_delta = qpos[0] - kpos[0] = hops*Lloc (STATIC): keeps the
+    # windowed live-kv-block slicing so the band costs O(Lloc*window),
+    # not dense
     return _mha_pos(q, k_all, v_all, qpos, kpos, scale, bq, bk, window,
-                    lloc, softcap)
+                    hops * lloc, softcap)

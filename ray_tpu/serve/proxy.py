@@ -36,6 +36,7 @@ class HTTPProxy:
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
         self._started = threading.Event()
+        self._stop_event: Optional[asyncio.Event] = None
 
     def register(self, route: str, handle: DeploymentHandle) -> None:
         self._handles[route.strip("/")] = handle
@@ -226,20 +227,33 @@ class HTTPProxy:
         asyncio.set_event_loop(self._loop)
 
         async def main():
+            self._stop_event = asyncio.Event()
             server = await asyncio.start_server(
                 self._handle_conn, self.host, self.port)
             if self.port == 0:
                 self.port = server.sockets[0].getsockname()[1]
             self._started.set()
+            # Wait for stop() rather than serve_forever(): stopping the
+            # loop mid-run_until_complete abandons this coroutine (the
+            # "coroutine ignored GeneratorExit" teardown warning) and
+            # leaks in-flight connection tasks.
             async with server:
-                await server.serve_forever()
+                await self._stop_event.wait()
 
         try:
             self._loop.run_until_complete(main())
+            # drain connection handlers still in flight at shutdown
+            pending = [t for t in asyncio.all_tasks(self._loop)
+                       if not t.done()]
+            for t in pending:
+                t.cancel()
+            if pending:
+                self._loop.run_until_complete(
+                    asyncio.gather(*pending, return_exceptions=True))
         except (asyncio.CancelledError, RuntimeError):
-            # RuntimeError("Event loop stopped before Future completed."):
-            # the expected shape of stop() interrupting serve_forever
             pass
+        finally:
+            self._loop.close()
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._run, daemon=True,
@@ -248,5 +262,11 @@ class HTTPProxy:
         self._started.wait(timeout=10)
 
     def stop(self) -> None:
-        if self._loop is not None:
-            self._loop.call_soon_threadsafe(self._loop.stop)
+        loop, ev = self._loop, self._stop_event
+        if loop is not None and ev is not None:
+            try:
+                loop.call_soon_threadsafe(ev.set)
+            except RuntimeError:
+                pass  # loop already closed
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -105,7 +105,40 @@ def make_train_step(
         }
         return new_state, metrics
 
-    return jax.jit(step, donate_argnums=(0,) if donate else ())
+    return jax.jit(step, donate_argnums=(0,) if donate else (),
+                   compiler_options=_compiler_options())
+
+
+def _compiler_options() -> Optional[Dict[str, str]]:
+    """Per-jit XLA compile options from the ``xla_compiler_options`` knob
+    (``RTPU_XLA_COMPILER_OPTIONS="k=v k2=v2"``). Per-jit because TPU
+    flags in ``XLA_FLAGS`` abort the HOST XLA flag parser on the
+    tunneled axon backend — compile options ride to the remote compiler
+    instead."""
+    from ray_tpu import config as _knobs
+
+    raw = str(_knobs.get("xla_compiler_options") or "").strip()
+    if not raw:
+        return None
+    out: Dict[str, Any] = {}
+    for tok in raw.replace(",", " ").split():
+        key, _, val = tok.partition("=")
+        if not key or not val:
+            raise ValueError(
+                f"xla_compiler_options entry {tok!r} is not k=v")
+        # XLA's option setter wants typed values (a literal "true" is
+        # rejected as "not a valid bool value"; same for int/float
+        # fields fed strings)
+        if val.lower() in ("true", "false"):
+            out[key] = val.lower() == "true"
+        elif val.lstrip("-").isdigit():
+            out[key] = int(val)
+        else:
+            try:
+                out[key] = float(val)
+            except ValueError:
+                out[key] = val
+    return out
 
 
 @dataclass
@@ -244,7 +277,9 @@ class TrainLoopHelper:
                 state, ms = jax.lax.scan(body, state, None, length=n)
                 return state, jax.tree.map(lambda a: a[-1], ms)
 
-            self._multi_step_cache[n] = jax.jit(multi, donate_argnums=(0,))
+            self._multi_step_cache[n] = jax.jit(
+                multi, donate_argnums=(0,),
+                compiler_options=_compiler_options())
         self._check_batch(batch)
         bs = self.batch_sharding()
         batch = jax.tree.map(lambda x: jax.device_put(x, bs), batch)

@@ -394,3 +394,87 @@ def test_usage_stats_report(monkeypatch, tmp_path):
     monkeypatch.setenv("RTPU_USAGE_STATS_ENABLED", "0")
     assert not usage_stats_enabled()
     assert write_usage_report(FakeRt()) == ""
+
+
+def test_v2_scheduler_bin_packing_and_infeasible():
+    """v2 ResourceDemandScheduler (reference autoscaler/v2/scheduler.py):
+    FFD bin-pack over the instance table, min/max floors, infeasible
+    reporting — a pure function, no provider."""
+    from ray_tpu.autoscaler.v2 import (Instance, NodeTypeSpec, RAY_RUNNING,
+                                       ResourceDemandScheduler)
+
+    types = [NodeTypeSpec("cpu", {"CPU": 4.0}, min_workers=1, max_workers=3),
+             NodeTypeSpec("tpu", {"CPU": 8.0, "TPU": 8.0}, max_workers=2)]
+    sched = ResourceDemandScheduler(types)
+
+    # empty table: min_workers floor launches one cpu node
+    dec = sched.schedule([], {}, set())
+    assert dec.launches == {"cpu": 1}
+
+    # FFD: biggest bundle first launches the tpu node, whose spare CPUs
+    # then absorb the small bundles — no second cpu node needed
+    insts = {"i1": Instance("i1", "cpu", status=RAY_RUNNING)}
+    demand = [{"CPU": 2.0}, {"CPU": 2.0},
+              {"CPU": 4.0},
+              {"TPU": 8.0},
+              {"GPU": 1.0}]                           # nobody has GPUs
+    dec = sched.schedule(demand, insts, set())
+    assert dec.launches == {"tpu": 1}
+    assert dec.packing.get("i1") == 1                 # the CPU:4 bundle
+    assert dec.infeasible == [{"GPU": 1.0}]
+    # same inputs -> same decision (pure function)
+    dec2 = sched.schedule(demand, insts, set())
+    assert dec2.launches == dec.launches and dec2.infeasible == dec.infeasible
+
+    # max_workers cap: demand for 5 tpu bundles only launches 2 nodes
+    dec = sched.schedule([{"TPU": 8.0}] * 5, {}, set())
+    assert dec.launches.get("tpu") == 2
+    assert len(dec.infeasible) == 3
+
+    # idle release: idle unpacked nodes terminate, but never below
+    # min_workers and never a node that demand packed onto
+    insts = {f"i{k}": Instance(f"i{k}", "cpu", status=RAY_RUNNING)
+             for k in range(3)}
+    dec = sched.schedule([{"CPU": 4.0}], insts, idle_instance_ids={"i0",
+                                                                   "i1",
+                                                                   "i2"})
+    assert dec.packing  # one instance took the bundle
+    packed = set(dec.packing)
+    assert packed.isdisjoint(dec.terminations)
+    # the packed node satisfies min_workers=1, so both idle ones go
+    assert len(dec.terminations) == 2
+
+
+def test_v2_autoscaler_end_to_end_converges():
+    """AutoscalerV2: demand -> scheduler -> InstanceManager -> provider,
+    idle scale-down after timeout, crash-resume from the instance table."""
+    from ray_tpu.autoscaler.fake_provider import FakeTpuNodeProvider
+    from ray_tpu.autoscaler.v2 import (AutoscalerV2, NodeTypeSpec,
+                                       RAY_RUNNING, TERMINATED)
+
+    provider = FakeTpuNodeProvider({"cpu": {"CPU": 4.0}})
+    types = [NodeTypeSpec("cpu", {"CPU": 4.0}, min_workers=0,
+                          max_workers=4)]
+    # injected clock: idle-timeout behavior without wall-clock races
+    fake_now = [0.0]
+    a = AutoscalerV2(provider, types, idle_timeout_s=60.0,
+                     clock=lambda: fake_now[0])
+
+    # demand appears -> two nodes launched and allocated in one pass
+    a.update(demand=[{"CPU": 4.0}, {"CPU": 4.0}])
+    cloud = {n.node_id for n in provider.non_terminated_nodes()}
+    assert len(cloud) == 2
+
+    # GCS sees them -> RAY_RUNNING
+    a.update(demand=[{"CPU": 4.0}, {"CPU": 4.0}], alive_node_ids=cloud)
+    running = [i for i in a.im.instances.values()
+               if i.status == RAY_RUNNING]
+    assert len(running) == 2
+
+    # demand drains; nodes stay until idle_timeout then scale to zero
+    a.update(demand=[], alive_node_ids=cloud)
+    assert len(provider.non_terminated_nodes()) == 2  # not yet idle long
+    fake_now[0] = 61.0
+    a.update(demand=[], alive_node_ids=cloud)
+    assert len(provider.non_terminated_nodes()) == 0
+    assert all(i.status == TERMINATED for i in a.im.instances.values())

@@ -509,10 +509,14 @@ def test_sliding_window_pallas_interpret_fwd_bwd():
                                    atol=5e-5, rtol=5e-4)
 
 
-def test_sliding_window_sp_halo_matches_single_device():
-    """Halo-exchange SP sliding-window attention (one ppermute, not a full
-    ring) must match the single-device windowed reference, fwd AND grads,
-    differentiated through shard_map."""
+@pytest.mark.parametrize("window", [24, 40, 64, 120])
+def test_sliding_window_sp_halo_matches_single_device(window):
+    """Halo-exchange SP sliding-window attention must match the
+    single-device windowed reference, fwd AND grads, differentiated
+    through shard_map. Lloc = 32, so the windows cover: one hop
+    (24 <= Lloc), two hops (40, 64 > Lloc: multi-hop chained ppermutes),
+    and the sp-1 clamp (120 spans >= all shards — all-gather shape,
+    band mask still exact)."""
     import numpy as np
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
@@ -523,14 +527,15 @@ def test_sliding_window_sp_halo_matches_single_device():
 
     mesh = make_mesh(MeshConfig(dp=1, fsdp=2, tp=1, sp=4))
     rng = np.random.default_rng(4)
-    # global seq 128 over sp=4 -> Lloc 32; window 24 <= Lloc
+    # global seq 128 over sp=4 -> Lloc 32
     q = jnp.asarray(rng.standard_normal((2, 128, 4, 16)), jnp.float32)
     k = jnp.asarray(rng.standard_normal((2, 128, 2, 16)), jnp.float32)
     v = jnp.asarray(rng.standard_normal((2, 128, 2, 16)), jnp.float32)
     w = jnp.asarray(rng.standard_normal((2, 128, 4, 16)), jnp.float32)
 
     def ref_loss(q, k, v):
-        o = flash_attention(q, k, v, causal=True, impl="naive", window=24)
+        o = flash_attention(q, k, v, causal=True, impl="naive",
+                            window=window)
         return (o * w).sum()
 
     ln, gn = jax.value_and_grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
@@ -539,7 +544,8 @@ def test_sliding_window_sp_halo_matches_single_device():
     with jax.set_mesh(mesh):
         fn = shard_map(
             lambda q, k, v: sliding_window_attention_sp(
-                q, k, v, axis="sp", window=24, q_block=16, kv_block=16),
+                q, k, v, axis="sp", window=window, q_block=16,
+                kv_block=16),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False)
 

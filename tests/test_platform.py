@@ -100,6 +100,24 @@ def test_dashboard_endpoints(rt_plat):
         assert resp.status == 200
         body = resp.read().decode()
         assert "<html" in body and "/api/nodes" in body
+        # UI views: drill-down panel, timeline swimlanes, metric sparklines
+        assert "detail" in body and "timeline" in body and "spark" in body
+
+        # /api/timeline returns the driver's Chrome-trace events (the
+        # fixture ran tasks, so X spans exist)
+        @ray_tpu.remote
+        def one():
+            return 1
+
+        ray_tpu.get(one.remote())
+        conn = http.client.HTTPConnection("127.0.0.1", dash.port, timeout=10)
+        conn.request("GET", "/api/timeline")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        events = json.loads(resp.read())["result"]
+        assert isinstance(events, list)
+        assert any(e.get("ph") == "X" and e.get("dur", 0) > 0
+                   for e in events)
     finally:
         dash.stop()
 

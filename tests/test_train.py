@@ -265,3 +265,38 @@ def test_run_step_rejects_indivisible_batch_loudly():
     )
     with pytest.raises(ValueError, match="does not divide"):
         helper.run_step({"tokens": np.zeros((3, 17), np.int32)})
+
+
+def test_xla_compiler_options_knob(monkeypatch):
+    """RTPU_XLA_COMPILER_OPTIONS parses to per-jit compiler options (the
+    axon-safe alternative to TPU flags in XLA_FLAGS) and a jitted step
+    still runs with a benign option set."""
+    import jax
+    import numpy as np
+    import optax
+
+    from ray_tpu.train.train_state import _compiler_options, make_train_step
+
+    monkeypatch.setenv("RTPU_XLA_COMPILER_OPTIONS", "")
+    assert _compiler_options() is None
+
+    monkeypatch.setenv("RTPU_XLA_COMPILER_OPTIONS",
+                       "xla_llvm_disable_expensive_passes=true a=1,b=2")
+    assert _compiler_options() == {
+        "xla_llvm_disable_expensive_passes": True, "a": 1, "b": 2}
+
+    monkeypatch.setenv("RTPU_XLA_COMPILER_OPTIONS", "not-kv")
+    with pytest.raises(ValueError):
+        _compiler_options()
+
+    # end-to-end: a CPU-valid option compiles and runs
+    monkeypatch.setenv("RTPU_XLA_COMPILER_OPTIONS",
+                       "xla_llvm_disable_expensive_passes=true")
+    step = make_train_step(
+        lambda p, b: ((p["w"] * b["x"]).sum() ** 2, {}),
+        optax.sgd(0.1))
+    state = {"step": jnp.zeros((), jnp.int32),
+             "params": {"w": jnp.ones((4,))},
+             "opt_state": optax.sgd(0.1).init({"w": jnp.ones((4,))})}
+    out, _ = step(state, {"x": jnp.asarray(np.ones(4, np.float32))})
+    assert int(out["step"]) == 1
