@@ -31,6 +31,7 @@ from ray_tpu.rllib.connectors import (
     ScaleActions,
 )
 from ray_tpu.rllib.cql import CQLLearner, train_cql
+from ray_tpu.rllib.dreamerv3 import DreamerV3Learner
 from ray_tpu.rllib.offline import (
     BCLearner,
     MARWILLearner,
@@ -104,6 +105,7 @@ __all__ = [
     "ScaleActions",
     "BCLearner",
     "CQLLearner",
+    "DreamerV3Learner",
     "MARWILLearner",
     "OfflineReader",
     "OfflineWriter",

@@ -1,0 +1,484 @@
+"""DreamerV3: model-based RL — RSSM world model + imagination actor-critic.
+
+Reference role: ``rllib/algorithms/dreamerv3/dreamerv3.py`` (the reference
+implementation is TensorFlow-only; this is a from-scratch JAX design, which
+is exactly the TPU-first point: the three training phases — world-model
+fit, imagination rollout, actor/critic update — are each a ``lax.scan``
+inside ONE jitted update, so a full DreamerV3 step is a single device
+program with no host round-trips).
+
+Compact-but-faithful choices (Hafner et al. 2023, arXiv:2301.04104):
+
+- RSSM with deterministic GRU state ``h`` and categorical stochastic
+  state ``z`` (``groups x classes`` one-hots, straight-through gradients,
+  1% uniform mix on the logits);
+- symlog squared-error reconstruction and reward heads, Bernoulli
+  continue head;
+- KL balance: ``L_dyn = KL(sg(post) || prior)``, ``L_rep = KL(post ||
+  sg(prior))`` with free bits (clip at 1 nat) and weights 0.5 / 0.1;
+- imagination from every posterior state for ``horizon`` steps with the
+  frozen world model; lambda-returns (lambda 0.95) against a slow EMA
+  critic; actor trained with REINFORCE on return-range-normalized
+  advantages (the 5th-95th percentile scale EMA) + entropy bonus.
+
+- twohot discrete regression for the critic (41 bins over symlog value
+  space, value = softmax expectation over symexp'd bin centers): the
+  paper's stabilizer — a symlog-MSE critic bootstrapping its own
+  symexp'd output diverges (measured: imagined return 3.7 -> 320 over
+  400 updates on the dev toy env before this was added).
+
+Omission vs the paper (disclosed): image encoder/decoder — vector obs
+only; the catalog's CNN trunk could slot into ``_enc``/``_dec``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+def symlog(x):
+    import jax.numpy as jnp
+
+    return jnp.sign(x) * jnp.log1p(jnp.abs(x))
+
+
+def symexp(x):
+    import jax.numpy as jnp
+
+    return jnp.sign(x) * (jnp.exp(jnp.abs(x)) - 1.0)
+
+
+class DreamerV3Learner:
+    """World model + actor + critic, three Adam optimizers, one jitted
+    ``update(batch)`` over sequence batches.
+
+    ``batch``: dict of [B, T, ...] arrays — ``obs`` [B,T,D] float,
+    ``actions`` [B,T] int32, ``rewards`` [B,T], ``continues`` [B,T]
+    (1.0 until terminal). Returns metrics (world-model losses, imagined
+    return, actor entropy).
+    """
+
+    def __init__(self, module_spec_dict: Dict[str, Any],
+                 config: Optional[Dict[str, Any]] = None, seed: int = 0):
+        import jax
+        import optax
+
+        cfg = dict(config or {})
+        self.config = cfg
+        self.obs_dim = int(module_spec_dict["observation_dim"])
+        self.n_actions = int(module_spec_dict["action_dim"])
+        if not module_spec_dict.get("discrete", True):
+            raise ValueError("DreamerV3Learner: discrete actions only "
+                             "(continuous actor is a straightforward "
+                             "extension; not needed by the test envs)")
+        self.deter = int(cfg.get("deter", 128))
+        self.groups = int(cfg.get("groups", 8))
+        self.classes = int(cfg.get("classes", 8))
+        self.hidden = int(cfg.get("hidden", 128))
+        self.horizon = int(cfg.get("horizon", 10))
+        self.gamma = float(cfg.get("gamma", 0.985))
+        self.lam = float(cfg.get("lambda", 0.95))
+        self.entropy_coef = float(cfg.get("entropy_coef", 3e-4))
+        self.unimix = float(cfg.get("unimix", 0.01))
+        self.free_bits = float(cfg.get("free_bits", 1.0))
+        self.critic_ema = float(cfg.get("critic_ema", 0.98))
+
+        self.zdim = self.groups * self.classes
+        # twohot critic bins: uniform in symlog space, so the softmax
+        # expectation spans large magnitudes with fine resolution near 0
+        self.n_bins = int(cfg.get("critic_bins", 41))
+        self._bin_lim = float(cfg.get("critic_bin_limit", 10.0))
+        key = jax.random.PRNGKey(seed)
+        self.params = self._init_params(key)
+        self.opt = {
+            "wm": optax.chain(optax.clip_by_global_norm(1000.0),
+                              optax.adam(cfg.get("wm_lr", 1e-3))),
+            "actor": optax.chain(optax.clip_by_global_norm(100.0),
+                                 optax.adam(cfg.get("actor_lr", 3e-4))),
+            "critic": optax.chain(optax.clip_by_global_norm(100.0),
+                                  optax.adam(cfg.get("critic_lr", 3e-4))),
+        }
+        self.opt_state = {k: self.opt[k].init(self.params[k])
+                          for k in self.opt}
+        # slow critic (return targets) + return-scale EMA state
+        self.slow_critic = jax.tree.map(lambda a: a, self.params["critic"])
+        self.retnorm = np.array([0.0, 1.0], np.float32)  # [lo, hi] EMA
+        self._update_fn = jax.jit(self._update)
+        self._rng = jax.random.PRNGKey(seed + 1)
+
+    # -- params -----------------------------------------------------------
+
+    def _mlp(self, key, sizes, zero_last: bool = False):
+        # shared helper (rl_module.py); zero_last = the paper's head
+        # init — the twohot critic opens at exactly value 0 instead of
+        # +-thousands of symexp bin noise for the actor to chase
+        from ray_tpu.rllib.rl_module import _mlp_init
+
+        return _mlp_init(key, sizes, zero_last=zero_last)
+
+    def _init_params(self, key):
+        import jax
+        import jax.numpy as jnp
+
+        ks = jax.random.split(key, 10)
+        d, z, h, a = self.deter, self.zdim, self.hidden, self.n_actions
+        wm = {
+            "enc": self._mlp(ks[0], (self.obs_dim, h, h)),
+            # GRU: input [z + a_onehot], 3 gates
+            "gru_x": self._mlp(ks[1], (z + a, 3 * d)),
+            "gru_h": {"w": jax.random.normal(ks[2], (d, 3 * d), jnp.float32)
+                      * np.sqrt(1.0 / d)},
+            "prior": self._mlp(ks[3], (d, h, z)),
+            "post": self._mlp(ks[4], (d + h, h, z)),
+            "dec": self._mlp(ks[5], (d + z, h, self.obs_dim)),
+            "reward": self._mlp(ks[6], (d + z, h, 1), zero_last=True),
+            "cont": self._mlp(ks[7], (d + z, h, 1), zero_last=True),
+        }
+        actor = self._mlp(ks[8], (d + z, h, a), zero_last=True)
+        critic = self._mlp(ks[9], (d + z, h, self.n_bins),
+                           zero_last=True)
+        return {"wm": wm, "actor": actor, "critic": critic}
+
+    @staticmethod
+    def _apply(p, x):
+        from ray_tpu.rllib.rl_module import _mlp_apply
+
+        return _mlp_apply(p, x, "tanh")
+
+    # -- RSSM pieces ------------------------------------------------------
+
+    def _gru(self, wm, hstate, x):
+        import jax
+        import jax.numpy as jnp
+
+        xr, xu, xc = jnp.split(self._apply(wm["gru_x"], x), 3, axis=-1)
+        hr, hu, hc = jnp.split(hstate @ wm["gru_h"]["w"], 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        u = jax.nn.sigmoid(xu + hu)
+        cand = jnp.tanh(xc + r * hc)
+        return u * hstate + (1 - u) * cand
+
+    def _logits(self, head_params, x):
+        """Head logits with the 1% uniform mix (keeps KL finite and
+        exploration alive), shaped [..., groups, classes]."""
+        import jax
+        import jax.numpy as jnp
+
+        logits = self._apply(head_params, x)
+        logits = logits.reshape(*logits.shape[:-1], self.groups,
+                                self.classes)
+        probs = jax.nn.softmax(logits, -1)
+        probs = (1 - self.unimix) * probs + self.unimix / self.classes
+        return jnp.log(probs)
+
+    def _sample_st(self, rng, logits):
+        """Straight-through categorical sample -> flat one-hot [..., z]."""
+        import jax
+        import jax.numpy as jnp
+
+        idx = jax.random.categorical(rng, logits, axis=-1)
+        onehot = jax.nn.one_hot(idx, self.classes, dtype=logits.dtype)
+        probs = jax.nn.softmax(logits, -1)
+        st = onehot + probs - jax.lax.stop_gradient(probs)
+        return st.reshape(*st.shape[:-2], self.zdim)
+
+    # -- twohot value head -------------------------------------------------
+
+    def _value(self, critic_params, feats):
+        """Critic value: softmax expectation over symexp'd bin centers."""
+        import jax
+        import jax.numpy as jnp
+
+        logits = self._apply(critic_params, feats)
+        centers = symexp(jnp.linspace(-self._bin_lim, self._bin_lim,
+                                      self.n_bins))
+        return jax.nn.softmax(logits, -1) @ centers
+
+    def _twohot(self, x):
+        """Twohot encoding of symlog(x) over the uniform symlog bins:
+        probability mass split between the two nearest bin centers so the
+        encoding's expectation reproduces x exactly (within the bin
+        range)."""
+        import jax
+        import jax.numpy as jnp
+
+        s = jnp.clip(symlog(x), -self._bin_lim, self._bin_lim)
+        pos = (s + self._bin_lim) / (2 * self._bin_lim) * (self.n_bins - 1)
+        lo = jnp.clip(jnp.floor(pos).astype(jnp.int32), 0,
+                      self.n_bins - 2)
+        w = pos - lo
+        return (jax.nn.one_hot(lo, self.n_bins) * (1 - w)[..., None]
+                + jax.nn.one_hot(lo + 1, self.n_bins) * w[..., None])
+
+    @staticmethod
+    def _kl(lhs_logits, rhs_logits):
+        """KL(lhs || rhs) summed over groups (both log-prob tensors)."""
+        import jax
+        import jax.numpy as jnp
+
+        p = jax.nn.softmax(lhs_logits, -1)
+        return (p * (lhs_logits - rhs_logits)).sum(-1).sum(-1)
+
+    # -- world-model loss over a sequence batch ---------------------------
+
+    def _wm_observe(self, wm, obs, actions, rng):
+        """Scan the RSSM over time: returns (h, z) features per step and
+        prior/post logits. obs [B,T,D], actions [B,T] (action TAKEN at
+        each step, conditioning the NEXT state)."""
+        import jax
+        import jax.numpy as jnp
+
+        B, T = obs.shape[:2]
+        embed = self._apply(wm["enc"], symlog(obs))  # [B,T,h]
+        a_onehot = jax.nn.one_hot(actions, self.n_actions)
+        rngs = jax.random.split(rng, T)
+
+        def step(carry, xs):
+            hstate, z = carry
+            emb_t, a_prev, r = xs
+            hstate = self._gru(wm, hstate, jnp.concatenate(
+                [z, a_prev], -1))
+            prior_logits = self._logits(wm["prior"], hstate)
+            post_logits = self._logits(wm["post"], jnp.concatenate(
+                [hstate, emb_t], -1))
+            z = self._sample_st(r, post_logits)
+            return (hstate, z), (hstate, z, prior_logits, post_logits)
+
+        h0 = jnp.zeros((B, self.deter))
+        z0 = jnp.zeros((B, self.zdim))
+        # a_prev at t is the action taken at t-1 (zero-pad the first)
+        a_prev = jnp.concatenate(
+            [jnp.zeros_like(a_onehot[:, :1]), a_onehot[:, :-1]], 1)
+        (_, _), (hs, zs, priors, posts) = jax.lax.scan(
+            step, (h0, z0),
+            (embed.swapaxes(0, 1), a_prev.swapaxes(0, 1), rngs))
+        # time-major -> batch-major
+        sw = lambda x: x.swapaxes(0, 1)
+        return sw(hs), sw(zs), sw(priors), sw(posts)
+
+    def _wm_loss(self, wm, batch, rng):
+        import jax
+        import jax.numpy as jnp
+
+        obs, actions = batch["obs"], batch["actions"]
+        hs, zs, priors, posts = self._wm_observe(wm, obs, actions, rng)
+        feat = jnp.concatenate([hs, zs], -1)
+        obs_hat = self._apply(wm["dec"], feat)
+        rew_hat = self._apply(wm["reward"], feat)[..., 0]
+        cont_logit = self._apply(wm["cont"], feat)[..., 0]
+
+        import optax
+
+        recon = ((obs_hat - symlog(obs)) ** 2).sum(-1)
+        rew = (rew_hat - symlog(batch["rewards"])) ** 2
+        cont = optax.sigmoid_binary_cross_entropy(cont_logit,
+                                                  batch["continues"])
+        dyn = jnp.maximum(self.free_bits, self._kl(
+            jax.lax.stop_gradient(posts), priors))
+        rep = jnp.maximum(self.free_bits, self._kl(
+            posts, jax.lax.stop_gradient(priors)))
+        loss = (recon + rew + cont + 0.5 * dyn + 0.1 * rep).mean()
+        metrics = {"wm_recon": recon.mean(), "wm_reward": rew.mean(),
+                   "wm_cont": cont.mean(), "wm_dyn": dyn.mean(),
+                   "wm_loss": loss}
+        return loss, (metrics, hs, zs)
+
+    # -- imagination + actor-critic ---------------------------------------
+
+    def _imagine(self, wm, actor, h0, z0, rng):
+        """Roll the frozen world model forward ``horizon`` steps sampling
+        actions from the actor. h0/z0: [N, ...] start states (posterior
+        states, flattened over B*T). Returns feats [H+1, N, ...],
+        actions, logps, entropies, rewards, continues."""
+        import jax
+        import jax.numpy as jnp
+
+        def step(carry, r):
+            hstate, z = carry
+            feat = jnp.concatenate([hstate, z], -1)
+            logits = jax.nn.log_softmax(self._apply(actor, feat))
+            ra, rz = jax.random.split(r)
+            a = jax.random.categorical(ra, logits)
+            logp = jnp.take_along_axis(logits, a[:, None], 1)[:, 0]
+            ent = -(jnp.exp(logits) * logits).sum(-1)
+            hstate = self._gru(wm, hstate, jnp.concatenate(
+                [z, jax.nn.one_hot(a, self.n_actions)], -1))
+            z = self._sample_st(rz, self._logits(wm["prior"], hstate))
+            return (hstate, z), (feat, a, logp, ent)
+
+        rngs = jax.random.split(rng, self.horizon)
+        (hH, zH), (feats, acts, logps, ents) = jax.lax.scan(
+            step, (h0, z0), rngs)
+        featH = jnp.concatenate([hH, zH], -1)
+        feats = jnp.concatenate([feats, featH[None]], 0)  # [H+1, N, F]
+        rew = symexp(self._apply(wm["reward"], feats)[..., 0])
+        cont = jax.nn.sigmoid(self._apply(wm["cont"], feats)[..., 0])
+        return feats, acts, logps, ents, rew, cont
+
+    def _lambda_returns(self, rewards, conts, values):
+        """TD(lambda) over the imagined horizon. All [H+1, N]; returns
+        [H, N] targets for steps 0..H-1."""
+        import jax
+        import jax.numpy as jnp
+
+        disc = self.gamma * conts
+        H = self.horizon
+
+        def step(nxt, t):
+            r = rewards[t + 1] + disc[t + 1] * (
+                (1 - self.lam) * values[t + 1] + self.lam * nxt)
+            return r, r
+
+        _, rets = jax.lax.scan(step, values[H], jnp.arange(H - 1, -1, -1))
+        return rets[::-1]
+
+    # -- the one-program update -------------------------------------------
+
+    def _update(self, params, opt_state, slow_critic, retnorm, batch, rng):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        r_wm, r_im, r_im2 = jax.random.split(rng, 3)
+
+        # 1. world model
+        (wm_loss, (metrics, hs, zs)), wm_grads = jax.value_and_grad(
+            self._wm_loss, has_aux=True)(params["wm"], batch, r_wm)
+        upd, wm_opt = self.opt["wm"].update(
+            wm_grads, opt_state["wm"], params["wm"])
+        wm_new = optax.apply_updates(params["wm"], upd)
+
+        # 2. imagination from every (updated-)posterior state
+        wm_f = jax.lax.stop_gradient(wm_new)
+        h0 = jax.lax.stop_gradient(hs).reshape(-1, self.deter)
+        z0 = jax.lax.stop_gradient(zs).reshape(-1, self.zdim)
+
+        def actor_loss(actor_params):
+            feats, acts, logps, ents, rew, cont = self._imagine(
+                wm_f, actor_params, h0, z0, r_im)
+            values = self._value(
+                jax.lax.stop_gradient(params["critic"]), feats)
+            rets = self._lambda_returns(rew, cont, values)
+            # return-range normalization (5th-95th percentile EMA)
+            lo = jnp.percentile(rets, 5.0)
+            hi = jnp.percentile(rets, 95.0)
+            new_lo = self.critic_ema * retnorm[0] + (
+                1 - self.critic_ema) * lo
+            new_hi = self.critic_ema * retnorm[1] + (
+                1 - self.critic_ema) * hi
+            scale = jnp.maximum(1.0, new_hi - new_lo)
+            adv = (rets - values[:-1]) / scale
+            # discount-weight imagined step t by prod of continue probs
+            # AFTER the start state: weight_0 = 1, weight_t = c_1..c_t
+            weight = jnp.cumprod(
+                jnp.concatenate([jnp.ones_like(cont[:1]), cont[1:-1]], 0),
+                0)
+            pg = -(jax.lax.stop_gradient(adv * weight) * logps).mean()
+            ent = ents.mean()
+            loss = pg - self.entropy_coef * ent
+            aux = {"rets": rets, "feats": feats, "weight": weight,
+                   "imag_return": rets[0].mean(), "actor_entropy": ent,
+                   "retnorm": jnp.stack([new_lo, new_hi])}
+            return loss, aux
+
+        (a_loss, aux), a_grads = jax.value_and_grad(
+            actor_loss, has_aux=True)(params["actor"])
+        upd, a_opt = self.opt["actor"].update(
+            a_grads, opt_state["actor"], params["actor"])
+        actor_new = optax.apply_updates(params["actor"], upd)
+
+        # 3. critic on the imagined returns (+ slow-critic regularizer)
+        feats = jax.lax.stop_gradient(aux["feats"][:-1])
+        rets = jax.lax.stop_gradient(aux["rets"])
+        weight = jax.lax.stop_gradient(aux["weight"])
+
+        def critic_loss(cp):
+            logits = jax.nn.log_softmax(self._apply(cp, feats), -1)
+            tgt = self._twohot(rets)
+            slow_tgt = jax.nn.softmax(
+                self._apply(slow_critic, feats), -1)
+            ce = -(tgt * logits).sum(-1)
+            reg = -(jax.lax.stop_gradient(slow_tgt) * logits).sum(-1)
+            return (weight * (ce + 0.1 * reg)).mean()
+
+        c_loss, c_grads = jax.value_and_grad(critic_loss)(params["critic"])
+        upd, c_opt = self.opt["critic"].update(
+            c_grads, opt_state["critic"], params["critic"])
+        critic_new = optax.apply_updates(params["critic"], upd)
+        slow_new = jax.tree.map(
+            lambda s, c: self.critic_ema * s + (1 - self.critic_ema) * c,
+            slow_critic, critic_new)
+
+        params = {"wm": wm_new, "actor": actor_new, "critic": critic_new}
+        opt_state = {"wm": wm_opt, "actor": a_opt, "critic": c_opt}
+        metrics = dict(metrics)
+        metrics.update(actor_loss=a_loss, critic_loss=c_loss,
+                       imag_return=aux["imag_return"],
+                       actor_entropy=aux["actor_entropy"])
+        return params, opt_state, slow_new, aux["retnorm"], metrics
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        import jax
+
+        self._rng, r = jax.random.split(self._rng)
+        batch = {k: jax.numpy.asarray(v) for k, v in batch.items()}
+        (self.params, self.opt_state, self.slow_critic, retnorm,
+         metrics) = self._update_fn(self.params, self.opt_state,
+                                    self.slow_critic,
+                                    jax.numpy.asarray(self.retnorm),
+                                    batch, r)
+        self.retnorm = np.asarray(retnorm)
+        return {k: float(v) for k, v in jax.device_get(metrics).items()}
+
+    # -- acting -----------------------------------------------------------
+
+    def policy_state(self, batch_size: int = 1):
+        """Fresh recurrent state for acting: (h, z, key)."""
+        import jax
+
+        self._rng, k = jax.random.split(self._rng)
+        return (np.zeros((batch_size, self.deter), np.float32),
+                np.zeros((batch_size, self.zdim), np.float32), k)
+
+    def _act_jit(self, params, hstate, z, obs, prev_action, key, greedy):
+        import jax
+        import jax.numpy as jnp
+
+        wm = params["wm"]
+        a_prev = jax.nn.one_hot(prev_action, self.n_actions)
+        kz, ka, knext = jax.random.split(key, 3)
+        hstate = self._gru(wm, hstate, jnp.concatenate([z, a_prev], -1))
+        embed = self._apply(wm["enc"], symlog(obs))
+        post = self._logits(wm["post"], jnp.concatenate(
+            [hstate, embed], -1))
+        z = self._sample_st(kz, post)
+        logits = self._apply(params["actor"],
+                             jnp.concatenate([hstate, z], -1))
+        a = jnp.argmax(logits, -1) if greedy \
+            else jax.random.categorical(ka, logits)
+        return hstate, z, a, knext
+
+    def act(self, state, obs, prev_action, rng_seed: Optional[int] = None,
+            greedy: bool = False):
+        """One acting step: posterior update with the real obs, then the
+        actor head — a single jitted program per call (the per-env-step
+        hot path; eager dispatch would pay ~20 op round-trips on the
+        tunneled backend). The PRNG key rides in the policy state and is
+        split fresh each step; ``rng_seed`` optionally pins it (tests).
+        Returns (new_state, action [B])."""
+        import jax
+
+        if not hasattr(self, "_act_fn"):
+            self._act_fn = jax.jit(self._act_jit,
+                                   static_argnames=("greedy",))
+        hstate, z, key = state
+        if rng_seed is not None:
+            key = jax.random.PRNGKey(rng_seed)
+        hstate, z, a, knext = self._act_fn(
+            self.params, jax.numpy.asarray(hstate),
+            jax.numpy.asarray(z),
+            jax.numpy.asarray(obs, jax.numpy.float32),
+            jax.numpy.asarray(prev_action), key, greedy=greedy)
+        return ((np.asarray(hstate), np.asarray(z), knext), np.asarray(a))

@@ -45,12 +45,18 @@ def _act(name: str):
             "gelu": jax.nn.gelu, "silu": jax.nn.silu}[name]
 
 
-def _mlp_init(key, sizes: Sequence[int]) -> Dict[str, Any]:
+def _mlp_init(key, sizes: Sequence[int], *,
+              zero_last: bool = False) -> Dict[str, Any]:
+    """He-init MLP params. ``zero_last`` starts the output layer at zero
+    (DreamerV3 head init: reward/critic/actor heads open neutral instead
+    of emitting large random values for the losses to chase)."""
     layers = []
     keys = jax.random.split(key, len(sizes) - 1)
-    for k, (fan_in, fan_out) in zip(keys, zip(sizes[:-1], sizes[1:])):
-        w = jax.random.normal(k, (fan_in, fan_out), jnp.float32)
-        w = w * np.sqrt(2.0 / fan_in)
+    for j, (k, (fan_in, fan_out)) in enumerate(
+            zip(keys, zip(sizes[:-1], sizes[1:]))):
+        scale = 0.0 if (zero_last and j == len(sizes) - 2) \
+            else np.sqrt(2.0 / fan_in)
+        w = jax.random.normal(k, (fan_in, fan_out), jnp.float32) * scale
         layers.append({"w": w, "b": jnp.zeros((fan_out,), jnp.float32)})
     return {"layers": layers}
 

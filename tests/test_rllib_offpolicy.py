@@ -413,3 +413,104 @@ def test_record_episodes_returns_and_next_obs(rt_rl2, tmp_path):
     term_rows = data["dones"] > 0
     np.testing.assert_allclose(data["returns"][term_rows],
                                data["rewards"][term_rows], rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# DreamerV3 (reference rllib/algorithms/dreamerv3 role; JAX from scratch)
+# ---------------------------------------------------------------------------
+
+
+def _dreamer_sequences(rng, batch, T, n_actions=4, noise=2, policy=None):
+    """Goal-reading toy env: obs encodes a per-episode goal action (+
+    noise dims); acting the goal yields reward 1 delivered with the NEXT
+    obs (replay convention: rewards[t] results from actions[t-1])."""
+    obs_dim = n_actions + noise
+    goals = rng.integers(0, n_actions, size=batch)
+    obs = np.zeros((batch, T, obs_dim), np.float32)
+    obs[np.arange(batch), :, :] = 0.0
+    for b in range(batch):
+        obs[b, :, goals[b]] = 1.0
+    obs[:, :, n_actions:] = rng.standard_normal(
+        (batch, T, noise)).astype(np.float32) * 0.3
+    if policy is None:
+        actions = rng.integers(0, n_actions, size=(batch, T))
+    else:
+        actions = policy(obs)
+    rewards = np.zeros((batch, T), np.float32)
+    rewards[:, 1:] = (actions[:, :-1] == goals[:, None]).astype(
+        np.float32)
+    continues = np.ones((batch, T), np.float32)
+    return {"obs": obs, "actions": actions.astype(np.int32),
+            "rewards": rewards, "continues": continues}, goals
+
+
+def test_dreamerv3_world_model_learns():
+    """The RSSM must learn to reconstruct observations and predict the
+    action-conditioned reward from (h, z) — losses drop by a large
+    factor over random-policy sequences."""
+    from ray_tpu.rllib.dreamerv3 import DreamerV3Learner
+
+    rng = np.random.default_rng(0)
+    lr = DreamerV3Learner(
+        {"observation_dim": 6, "action_dim": 4},
+        {"deter": 64, "hidden": 64, "groups": 4, "classes": 4,
+         "horizon": 5, "wm_lr": 3e-3}, seed=0)
+    batch, _ = _dreamer_sequences(rng, batch=16, T=8)
+    first = lr.update(batch)
+    for _ in range(150):
+        batch, _ = _dreamer_sequences(rng, batch=16, T=8)
+        m = lr.update(batch)
+    assert m["wm_recon"] < 0.3 * first["wm_recon"], (first, m)
+    # the zero-init reward head starts at symlog-0 predictions, so the
+    # ratio vs the first update is uninformative; assert an absolute
+    # level instead: the best CONSTANT predictor scores ~0.09 on the
+    # 25%-Bernoulli symlog rewards, so <0.06 proves the head actually
+    # reads the action-conditioned state (probe: 0.026-0.055 @ 150)
+    assert m["wm_reward"] < 0.06, (first, m)
+    assert np.isfinite(m["wm_loss"])
+
+
+def test_dreamerv3_actor_learns_from_imagination():
+    """End-to-end: training purely from imagined rollouts must beat the
+    random policy on the goal-reading env (random = 0.25 hit rate)."""
+    from ray_tpu.rllib.dreamerv3 import DreamerV3Learner
+
+    rng = np.random.default_rng(1)
+    lr = DreamerV3Learner(
+        {"observation_dim": 6, "action_dim": 4},
+        {"deter": 64, "hidden": 64, "groups": 4, "classes": 4,
+         "horizon": 5, "wm_lr": 3e-3, "actor_lr": 3e-3,
+         "entropy_coef": 1e-2}, seed=0)
+    for i in range(250):
+        batch, _ = _dreamer_sequences(rng, batch=16, T=8)
+        m = lr.update(batch)
+
+    # evaluate the actor through the acting path (posterior filtering)
+    batch, goals = _dreamer_sequences(rng, batch=64, T=8)
+    state = lr.policy_state(64)
+    prev_a = np.zeros(64, np.int64)
+    hits, total = 0, 0
+    for t in range(8):
+        state, a = lr.act(state, batch["obs"][:, t], prev_a,
+                          rng_seed=1000 + t, greedy=True)
+        hits += int((np.asarray(a) == goals).sum())
+        total += 64
+        prev_a = np.asarray(a)
+    rate = hits / total
+
+    # stochastic acting: the carried key must advance (different draws
+    # step to step), and sampled actions still beat random
+    state = lr.policy_state(64)
+    prev_a = np.zeros(64, np.int64)
+    samp_hits = 0
+    keys = []
+    for t in range(8):
+        state, a = lr.act(state, batch["obs"][:, t], prev_a)
+        keys.append(tuple(np.asarray(state[2]).tolist()))
+        samp_hits += int((np.asarray(a) == goals).sum())
+        prev_a = np.asarray(a)
+    assert len(set(keys)) == 8, "acting key did not advance"
+    assert samp_hits / total > 0.5
+    # probe: 0.97-0.98 across seeds 0/1/2 at 250 updates (twohot critic
+    # + zero-init heads + entropy 1e-2); 0.8 leaves seed margin
+    assert rate > 0.8, f"greedy hit rate {rate:.2f} (random 0.25): {m}"
