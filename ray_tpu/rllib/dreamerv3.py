@@ -341,7 +341,7 @@ class DreamerV3Learner:
         import jax.numpy as jnp
         import optax
 
-        r_wm, r_im, r_im2 = jax.random.split(rng, 3)
+        r_wm, r_im = jax.random.split(rng)
 
         # 1. world model
         (wm_loss, (metrics, hs, zs)), wm_grads = jax.value_and_grad(

@@ -420,22 +420,19 @@ def test_record_episodes_returns_and_next_obs(rt_rl2, tmp_path):
 # ---------------------------------------------------------------------------
 
 
-def _dreamer_sequences(rng, batch, T, n_actions=4, noise=2, policy=None):
+def _dreamer_sequences(rng, batch, T, n_actions=4, noise=2):
     """Goal-reading toy env: obs encodes a per-episode goal action (+
     noise dims); acting the goal yields reward 1 delivered with the NEXT
-    obs (replay convention: rewards[t] results from actions[t-1])."""
+    obs (replay convention: rewards[t] results from actions[t-1]).
+    Actions are random-policy (all the learner's training data)."""
     obs_dim = n_actions + noise
     goals = rng.integers(0, n_actions, size=batch)
     obs = np.zeros((batch, T, obs_dim), np.float32)
-    obs[np.arange(batch), :, :] = 0.0
     for b in range(batch):
         obs[b, :, goals[b]] = 1.0
     obs[:, :, n_actions:] = rng.standard_normal(
         (batch, T, noise)).astype(np.float32) * 0.3
-    if policy is None:
-        actions = rng.integers(0, n_actions, size=(batch, T))
-    else:
-        actions = policy(obs)
+    actions = rng.integers(0, n_actions, size=(batch, T))
     rewards = np.zeros((batch, T), np.float32)
     rewards[:, 1:] = (actions[:, :-1] == goals[:, None]).astype(
         np.float32)
