@@ -38,7 +38,16 @@ from ray_tpu.models.transformer import (
     generate,
 )
 
+from ray_tpu.models.import_hf import (
+    config_from_hf,
+    import_hf_llama,
+    load_hf_llama,
+)
+
 __all__ = [
+    "config_from_hf",
+    "import_hf_llama",
+    "load_hf_llama",
     "TransformerConfig",
     "PRESETS",
     "get_config",

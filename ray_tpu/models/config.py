@@ -38,6 +38,10 @@ class TransformerConfig:
     positions: str = "rope"            # "rope" (llama) | "learned" (gpt2)
     rope_theta: float = 500000.0
     tie_embeddings: bool = False
+    # norm epsilon; None = family default (rms 1e-6, layer 1e-5). Real
+    # checkpoints vary (llama-2/3 and mistral use 1e-5) — HF import sets
+    # this from rms_norm_eps so parity is exact.
+    norm_eps: Optional[float] = None
 
     # mixture of experts (0 => dense)
     num_experts: int = 0

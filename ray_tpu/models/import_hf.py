@@ -1,0 +1,172 @@
+"""HuggingFace checkpoint import: real weights into the ray_tpu model zoo.
+
+Role analog: the reference ecosystem's checkpoint interop (RLlib/Train
+users load pretrained torch checkpoints; a TPU framework must ingest the
+same artifacts). Maps a ``transformers`` Llama-family state dict
+(LlamaForCausalLM / MistralForCausalLM — the architectures our
+``TransformerConfig`` reproduces exactly: RMSNorm, RoPE, GQA, SwiGLU, no
+attention biases) onto the scanned-layer param pytree of
+``models/transformer.py``.
+
+Conventions handled:
+
+- torch ``nn.Linear`` stores ``W [out, in]`` computing ``x @ W.T`` — our
+  einsum weights are ``[in, out]``-shaped, so every projection is
+  transposed (then reshaped to split heads);
+- per-layer tensors are STACKED on a leading layer axis (our layers run
+  under ``lax.scan``);
+- rotate-half RoPE matches HF's (first/second half split, same theta);
+- tied embeddings reuse ``embed``; untied checkpoints fill ``lm_head``.
+
+Verified by an exact logits-parity test against ``transformers`` on a
+randomly initialized tiny Llama (tests/test_models.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping
+
+import numpy as np
+
+from ray_tpu.models.config import TransformerConfig
+
+Params = Dict[str, Any]
+
+
+def config_from_hf(hf_config: Any) -> TransformerConfig:
+    """TransformerConfig from a ``transformers`` LlamaConfig/MistralConfig
+    (duck-typed: any object with the HF attribute names)."""
+    scaling = getattr(hf_config, "rope_scaling", None)
+    if scaling:
+        raise ValueError(
+            f"rope_scaling={scaling!r} is not supported: ray_tpu's "
+            "rotary tables are unscaled, so importing (e.g.) a "
+            "Llama-3.1+ checkpoint would produce silently wrong "
+            "frequencies")
+    window = getattr(hf_config, "sliding_window", None) or 0
+    return TransformerConfig(
+        vocab_size=hf_config.vocab_size,
+        d_model=hf_config.hidden_size,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=getattr(hf_config, "num_key_value_heads",
+                           hf_config.num_attention_heads),
+        head_dim=getattr(hf_config, "head_dim", None),
+        d_ff=hf_config.intermediate_size,
+        max_seq_len=hf_config.max_position_embeddings,
+        rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
+        sliding_window=int(window),
+        tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings",
+                                    False)),
+        norm_eps=float(getattr(hf_config, "rms_norm_eps", 1e-6)),
+        mlp="swiglu", norm="rms", positions="rope",
+        dtype="float32", param_dtype="float32",
+    )
+
+
+def _np(w, dtype) -> np.ndarray:
+    """torch tensor (or array) -> numpy in the TARGET param dtype (no
+    transient f32 blow-up: an 8B bf16 checkpoint stays bf16-sized)."""
+    if hasattr(w, "detach"):
+        import torch
+
+        w = w.detach().cpu()
+        if w.dtype == torch.bfloat16:  # numpy has no native bf16 bridge
+            w = w.float()
+        w = w.numpy()
+    import jax.numpy as jnp
+
+    return np.asarray(w).astype(jnp.dtype(dtype))
+
+
+def import_hf_llama(state_dict: Mapping[str, Any],
+                    config: TransformerConfig) -> Params:
+    """Build the ray_tpu param pytree from a Llama-family HF state dict.
+
+    ``state_dict``: ``model.state_dict()`` of a ``LlamaForCausalLM`` /
+    ``MistralForCausalLM`` (torch tensors or numpy arrays).
+    """
+    c = config
+    if c.mlp != "swiglu" or c.norm != "rms" or c.positions != "rope":
+        raise ValueError(
+            "import_hf_llama maps Llama-family architectures only "
+            f"(swiglu/rms/rope); config has {c.mlp}/{c.norm}/{c.positions}")
+    sd = dict(state_dict)
+    pre = "model." if "model.embed_tokens.weight" in sd else ""
+    d, hd, h, kv, L = c.d_model, c.hdim, c.n_heads, c.kv_heads, c.n_layers
+    pdt = c.param_dtype
+    consumed = set()
+
+    def take(key):
+        consumed.add(key)
+        return sd[key]
+
+    def raw(i: int, name: str):
+        return _np(take(f"{pre}layers.{i}.{name}.weight"), pdt)
+
+    def lin(i: int, name: str):
+        return raw(i, name).T  # Linear [out, in] -> einsum [in, out]
+
+    stack = lambda mats: np.stack(mats, axis=0)
+    layers: Params = {
+        "attn_norm": stack([raw(i, "input_layernorm")
+                            for i in range(L)]),
+        "wq": stack([lin(i, "self_attn.q_proj").reshape(d, h, hd)
+                     for i in range(L)]),
+        "wk": stack([lin(i, "self_attn.k_proj").reshape(d, kv, hd)
+                     for i in range(L)]),
+        "wv": stack([lin(i, "self_attn.v_proj").reshape(d, kv, hd)
+                     for i in range(L)]),
+        "wo": stack([lin(i, "self_attn.o_proj").reshape(h, hd, d)
+                     for i in range(L)]),
+        "mlp_norm": stack([raw(i, "post_attention_layernorm")
+                           for i in range(L)]),
+        "w_gate": stack([lin(i, "mlp.gate_proj") for i in range(L)]),
+        "w_up": stack([lin(i, "mlp.up_proj") for i in range(L)]),
+        "w_down": stack([lin(i, "mlp.down_proj") for i in range(L)]),
+    }
+    params: Params = {
+        "embed": _np(take(f"{pre}embed_tokens.weight"), pdt),
+        "layers": layers,
+        "final_norm": _np(take(f"{pre}norm.weight"), pdt),
+    }
+    if not c.tie_embeddings:
+        if "lm_head.weight" in sd:
+            params["lm_head"] = _np(take("lm_head.weight"), pdt).T
+        else:  # tied checkpoint imported into an untied config
+            params["lm_head"] = params["embed"].T.copy()
+    else:
+        consumed.add("lm_head.weight")  # alias of embed when present
+
+    # Strict-consumption check (torch load_state_dict strict=True role):
+    # an architecture this mapping does NOT model (Qwen2 attention
+    # biases, Qwen3 q/k norms, ...) must fail loudly, never silently
+    # drop tensors. Non-parameter buffers (rotary inv_freq caches) are
+    # the only tolerated leftovers.
+    leftovers = [k for k in sd
+                 if k not in consumed and not k.endswith("inv_freq")]
+    if leftovers:
+        raise ValueError(
+            "state dict has tensors this Llama-family mapping does not "
+            f"consume (unsupported architecture?): {sorted(leftovers)[:8]}"
+            f"{' ...' if len(leftovers) > 8 else ''}")
+
+    import jax.numpy as jnp
+
+    jdt = jnp.dtype(pdt)
+    return {k: (jnp.asarray(v, jdt) if not isinstance(v, dict)
+                else {kk: jnp.asarray(vv, jdt) for kk, vv in v.items()})
+            for k, v in params.items()}
+
+
+def load_hf_llama(model_name_or_path: str):
+    """Convenience: load with ``transformers`` and import. Returns
+    (config, params). Requires the checkpoint locally (zero-egress
+    environments must pre-download)."""
+    from transformers import AutoConfig, AutoModelForCausalLM
+
+    hf_cfg = AutoConfig.from_pretrained(model_name_or_path)
+    config = config_from_hf(hf_cfg)
+    model = AutoModelForCausalLM.from_pretrained(model_name_or_path)
+    params = import_hf_llama(model.state_dict(), config)
+    return config, params

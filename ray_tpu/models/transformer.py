@@ -146,12 +146,12 @@ def param_axes(config: TransformerConfig) -> Params:
 # Forward
 # ---------------------------------------------------------------------------
 
-def _norm(x, w, b, kind):
+def _norm(x, w, b, c):
     # Both kinds carry bf16-residual custom VJPs (ops/layers.py) — plain
     # autodiff of the f32 upcast keeps f32 [B, L, D] residuals per site.
-    if kind == "rms":
-        return rms_norm(x, w)
-    return layer_norm(x, w, b)
+    if c.norm == "rms":
+        return rms_norm(x, w, eps=c.norm_eps or 1e-6)
+    return layer_norm(x, w, b, eps=c.norm_eps or 1e-5)
 
 
 def _sp_axis_size() -> int:
@@ -342,7 +342,7 @@ def forward_features(
         cos = sin = None
 
     def layer(x, lp, cos=cos, sin=sin, window=None):
-        h = _norm(x, lp["attn_norm"], lp.get("attn_norm_b"), c.norm)
+        h = _norm(x, lp["attn_norm"], lp.get("attn_norm_b"), c)
         q = jnp.einsum("bld,dhk->blhk", h, lp["wq"].astype(dt))
         k = jnp.einsum("bld,dhk->blhk", h, lp["wk"].astype(dt))
         v = jnp.einsum("bld,dhk->blhk", h, lp["wv"].astype(dt))
@@ -358,7 +358,7 @@ def forward_features(
         o = jnp.einsum("blhk,hkd->bld", o, lp["wo"].astype(dt))
         x = constrain(x + o, ("batch", "seq", None))
 
-        h = _norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"), c.norm)
+        h = _norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"), c)
         aux = jnp.zeros((), jnp.float32)
         if c.num_experts:
             m, aux = moe_layer_dense(
@@ -430,7 +430,7 @@ def forward_features(
                                    (x, jnp.zeros((), jnp.float32)),
                                    grouped)
 
-    x = _norm(x, params["final_norm"], params.get("final_norm_b"), c.norm)
+    x = _norm(x, params["final_norm"], params.get("final_norm_b"), c)
     return x, moe_aux
 
 
@@ -623,7 +623,7 @@ def decode_step(
     def layer(carry, inp):
         x = carry
         lp, kc, vc, wl = inp
-        h = _norm(x, lp["attn_norm"], lp.get("attn_norm_b"), c.norm)
+        h = _norm(x, lp["attn_norm"], lp.get("attn_norm_b"), c)
         q = jnp.einsum("bld,dhk->blhk", h, lp["wq"].astype(dt))
         k = jnp.einsum("bld,dhk->blhk", h, lp["wk"].astype(dt))
         v = jnp.einsum("bld,dhk->blhk", h, lp["wv"].astype(dt))
@@ -687,7 +687,7 @@ def decode_step(
     x, (new_k, new_v) = lax.scan(
         layer, x, (params["layers"], cache["k"], cache["v"], win_arr)
     )
-    x = _norm(x, params["final_norm"], params.get("final_norm_b"), c.norm)
+    x = _norm(x, params["final_norm"], params.get("final_norm_b"), c)
     head = (params["embed"].T if c.tie_embeddings else params["lm_head"]).astype(dt)
     logits = jnp.einsum("bld,dv->blv", x, head).astype(jnp.float32)
     new_cache = {"k": new_k, "v": new_v, "pos": pos0 + t}
@@ -698,7 +698,7 @@ def decode_step(
 def _decode_mlp(x, lp, c, dt):
     """Post-attention norm + MLP tail shared by the decode paths (the ONE
     definition — decode_step and decode_step_multi must never diverge)."""
-    h = _norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"), c.norm)
+    h = _norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"), c)
     if c.num_experts:
         m, _ = moe_layer_dense(
             h, lp["router"].astype(dt), lp["w_gate"].astype(dt),
@@ -780,7 +780,7 @@ def decode_step_multi(
     def layer(carry, inp):
         x = carry
         lp, kc, vc, wl = inp
-        h = _norm(x, lp["attn_norm"], lp.get("attn_norm_b"), c.norm)
+        h = _norm(x, lp["attn_norm"], lp.get("attn_norm_b"), c)
         q = jnp.einsum("bld,dhk->blhk", h, lp["wq"].astype(dt))
         k = jnp.einsum("bld,dhk->blhk", h, lp["wk"].astype(dt))
         v = jnp.einsum("bld,dhk->blhk", h, lp["wv"].astype(dt))
@@ -807,7 +807,7 @@ def decode_step_multi(
 
     x, (new_k, new_v) = lax.scan(
         layer, x, (params["layers"], cache["k"], cache["v"], win_arr))
-    x = _norm(x, params["final_norm"], params.get("final_norm_b"), c.norm)
+    x = _norm(x, params["final_norm"], params.get("final_norm_b"), c)
     head = (params["embed"].T if c.tie_embeddings
             else params["lm_head"]).astype(dt)
     logits = jnp.einsum("bld,dv->blv", x, head).astype(jnp.float32)[:, 0]
