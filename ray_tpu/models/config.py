@@ -42,6 +42,8 @@ class TransformerConfig:
     # checkpoints vary (llama-2/3 and mistral use 1e-5) — HF import sets
     # this from rms_norm_eps so parity is exact.
     norm_eps: Optional[float] = None
+    # q/k/v projection biases (Qwen2; o_proj stays bias-free)
+    attn_qkv_bias: bool = False
 
     # mixture of experts (0 => dense)
     num_experts: int = 0

@@ -3,10 +3,10 @@
 Role analog: the reference ecosystem's checkpoint interop (RLlib/Train
 users load pretrained torch checkpoints; a TPU framework must ingest the
 same artifacts). Maps a ``transformers`` Llama-family state dict
-(LlamaForCausalLM / MistralForCausalLM — the architectures our
-``TransformerConfig`` reproduces exactly: RMSNorm, RoPE, GQA, SwiGLU, no
-attention biases) onto the scanned-layer param pytree of
-``models/transformer.py``.
+(LlamaForCausalLM / MistralForCausalLM / Qwen2ForCausalLM — the
+architectures our ``TransformerConfig`` reproduces exactly: RMSNorm,
+RoPE, GQA, SwiGLU, optional Qwen2 q/k/v biases) onto the scanned-layer
+param pytree of ``models/transformer.py``.
 
 Conventions handled:
 
@@ -43,7 +43,25 @@ def config_from_hf(hf_config: Any) -> TransformerConfig:
             "rotary tables are unscaled, so importing (e.g.) a "
             "Llama-3.1+ checkpoint would produce silently wrong "
             "frequencies")
+    if getattr(hf_config, "attention_bias", False):
+        # HF Llama's attention_bias biases o_proj too, which the forward
+        # does not model — refuse rather than import silently wrong
+        raise ValueError(
+            "attention_bias=True (q/k/v AND o_proj biases) is not "
+            "supported; only Qwen2-style q/k/v-only biases are")
+    qwen2 = getattr(hf_config, "model_type", "") == "qwen2"
     window = getattr(hf_config, "sliding_window", None) or 0
+    attn_windows = None
+    if qwen2:
+        if window and getattr(hf_config, "use_sliding_window", False):
+            # HF applies SWA only to layers >= max_window_layers (the
+            # first max_window_layers layers run full attention); our
+            # attn_windows expresses that as an explicit per-layer tuple
+            full = int(getattr(hf_config, "max_window_layers", 0))
+            attn_windows = tuple(
+                0 if i < full else int(window)
+                for i in range(hf_config.num_hidden_layers))
+        window = 0  # HF ignores sliding_window unless use_sliding_window
     return TransformerConfig(
         vocab_size=hf_config.vocab_size,
         d_model=hf_config.hidden_size,
@@ -56,9 +74,11 @@ def config_from_hf(hf_config: Any) -> TransformerConfig:
         max_seq_len=hf_config.max_position_embeddings,
         rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
         sliding_window=int(window),
+        attn_windows=attn_windows,
         tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings",
                                     False)),
         norm_eps=float(getattr(hf_config, "rms_norm_eps", 1e-6)),
+        attn_qkv_bias=qwen2,  # Qwen2 biases q/k/v only (o stays clean)
         mlp="swiglu", norm="rms", positions="rope",
         dtype="float32", param_dtype="float32",
     )
@@ -125,6 +145,14 @@ def import_hf_llama(state_dict: Mapping[str, Any],
         "w_up": stack([lin(i, "mlp.up_proj") for i in range(L)]),
         "w_down": stack([lin(i, "mlp.down_proj") for i in range(L)]),
     }
+    if c.attn_qkv_bias:  # Qwen2-style q/k/v biases, head-split
+        def bias(i, name, heads):
+            return _np(take(f"{pre}layers.{i}.self_attn.{name}.bias"),
+                       pdt).reshape(heads, hd)
+
+        layers["bq"] = stack([bias(i, "q_proj", h) for i in range(L)])
+        layers["bk"] = stack([bias(i, "k_proj", kv) for i in range(L)])
+        layers["bv"] = stack([bias(i, "v_proj", kv) for i in range(L)])
     params: Params = {
         "embed": _np(take(f"{pre}embed_tokens.weight"), pdt),
         "layers": layers,
@@ -139,9 +167,9 @@ def import_hf_llama(state_dict: Mapping[str, Any],
         consumed.add("lm_head.weight")  # alias of embed when present
 
     # Strict-consumption check (torch load_state_dict strict=True role):
-    # an architecture this mapping does NOT model (Qwen2 attention
-    # biases, Qwen3 q/k norms, ...) must fail loudly, never silently
-    # drop tensors. Non-parameter buffers (rotary inv_freq caches) are
+    # an architecture this mapping does NOT model (Qwen3 q/k norms,
+    # MoE routers, ...) must fail loudly, never silently drop
+    # tensors. Non-parameter buffers (rotary inv_freq caches) are
     # the only tolerated leftovers.
     leftovers = [k for k in sd
                  if k not in consumed and not k.endswith("inv_freq")]

@@ -66,6 +66,10 @@ def init_params(rng: jax.Array, config: TransformerConfig) -> Params:
         "wo": normal(next(keys), (L, h, hd, d), out_std),
         "mlp_norm": jnp.ones((L, d), pdt),
     }
+    if c.attn_qkv_bias:
+        layers["bq"] = jnp.zeros((L, h, hd), pdt)
+        layers["bk"] = jnp.zeros((L, kv, hd), pdt)
+        layers["bv"] = jnp.zeros((L, kv, hd), pdt)
     if c.norm == "layer":
         layers["attn_norm_b"] = jnp.zeros((L, d), pdt)
         layers["mlp_norm_b"] = jnp.zeros((L, d), pdt)
@@ -111,6 +115,10 @@ def param_axes(config: TransformerConfig) -> Params:
         "wo": ("layers", "heads", "head_dim", "embed"),
         "mlp_norm": ("layers", "norm"),
     }
+    if c.attn_qkv_bias:
+        lay["bq"] = ("layers", "heads", "head_dim")
+        lay["bk"] = ("layers", "kv_heads", "head_dim")
+        lay["bv"] = ("layers", "kv_heads", "head_dim")
     if c.norm == "layer":
         lay["attn_norm_b"] = ("layers", "norm")
         lay["mlp_norm_b"] = ("layers", "norm")
@@ -145,6 +153,18 @@ def param_axes(config: TransformerConfig) -> Params:
 # ---------------------------------------------------------------------------
 # Forward
 # ---------------------------------------------------------------------------
+
+def _qkv_proj(h, lp, dt):
+    """q/k/v projections (+ optional Qwen2-style qkv biases)."""
+    q = jnp.einsum("bld,dhk->blhk", h, lp["wq"].astype(dt))
+    k = jnp.einsum("bld,dhk->blhk", h, lp["wk"].astype(dt))
+    v = jnp.einsum("bld,dhk->blhk", h, lp["wv"].astype(dt))
+    if "bq" in lp:
+        q = q + lp["bq"].astype(dt)
+        k = k + lp["bk"].astype(dt)
+        v = v + lp["bv"].astype(dt)
+    return q, k, v
+
 
 def _norm(x, w, b, c):
     # Both kinds carry bf16-residual custom VJPs (ops/layers.py) — plain
@@ -343,9 +363,7 @@ def forward_features(
 
     def layer(x, lp, cos=cos, sin=sin, window=None):
         h = _norm(x, lp["attn_norm"], lp.get("attn_norm_b"), c)
-        q = jnp.einsum("bld,dhk->blhk", h, lp["wq"].astype(dt))
-        k = jnp.einsum("bld,dhk->blhk", h, lp["wk"].astype(dt))
-        v = jnp.einsum("bld,dhk->blhk", h, lp["wv"].astype(dt))
+        q, k, v = _qkv_proj(h, lp, dt)
         if cos is not None:
             q = apply_rotary(q, cos, sin)
             k = apply_rotary(k, cos, sin)
@@ -624,9 +642,7 @@ def decode_step(
         x = carry
         lp, kc, vc, wl = inp
         h = _norm(x, lp["attn_norm"], lp.get("attn_norm_b"), c)
-        q = jnp.einsum("bld,dhk->blhk", h, lp["wq"].astype(dt))
-        k = jnp.einsum("bld,dhk->blhk", h, lp["wk"].astype(dt))
-        v = jnp.einsum("bld,dhk->blhk", h, lp["wv"].astype(dt))
+        q, k, v = _qkv_proj(h, lp, dt)
         if cos is not None:
             q = apply_rotary(q, cos, sin)
             k = apply_rotary(k, cos, sin)
@@ -781,9 +797,7 @@ def decode_step_multi(
         x = carry
         lp, kc, vc, wl = inp
         h = _norm(x, lp["attn_norm"], lp.get("attn_norm_b"), c)
-        q = jnp.einsum("bld,dhk->blhk", h, lp["wq"].astype(dt))
-        k = jnp.einsum("bld,dhk->blhk", h, lp["wk"].astype(dt))
-        v = jnp.einsum("bld,dhk->blhk", h, lp["wv"].astype(dt))
+        q, k, v = _qkv_proj(h, lp, dt)
         if cos is not None:
             q = apply_rotary(q, cos, sin)
             k = apply_rotary(k, cos, sin)

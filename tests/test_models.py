@@ -570,9 +570,10 @@ def test_hf_llama_import_generate_parity():
 
 
 def test_hf_import_rejects_unmapped_tensors_and_rope_scaling():
-    """Strictness: unconsumed state-dict tensors (e.g. Qwen2 attention
-    biases) and rope_scaling configs must fail loudly, never import
-    silently wrong."""
+    """Strictness: unconsumed state-dict tensors (a bias the mapping
+    does not model, standing in for Qwen3 q/k norms etc.) and
+    rope_scaling configs must fail loudly, never import silently
+    wrong."""
     import torch
     from transformers import LlamaConfig, LlamaForCausalLM
 
@@ -595,3 +596,60 @@ def test_hf_import_rejects_unmapped_tensors_and_rope_scaling():
     hf_cfg.rope_scaling = {"rope_type": "llama3", "factor": 8.0}
     with pytest.raises(ValueError, match="rope_scaling"):
         config_from_hf(hf_cfg)
+
+
+def test_hf_qwen2_import_logits_parity():
+    """Qwen2 (q/k/v biases) imports with exact logits parity — the
+    attn_qkv_bias path end to end."""
+    import torch
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+
+    from ray_tpu.models import forward
+    from ray_tpu.models.import_hf import config_from_hf, import_hf_llama
+
+    hf_cfg = Qwen2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=64,
+        rope_theta=10000.0, rms_norm_eps=1e-6, tie_word_embeddings=False,
+        use_sliding_window=False)
+    torch.manual_seed(2)
+    hf = Qwen2ForCausalLM(hf_cfg).eval()
+    # random biases (zeros would not exercise the path)
+    with torch.no_grad():
+        for layer in hf.model.layers:
+            for proj in (layer.self_attn.q_proj, layer.self_attn.k_proj,
+                         layer.self_attn.v_proj):
+                proj.bias.normal_(0, 0.5)
+
+    cfg = config_from_hf(hf_cfg)
+    assert cfg.attn_qkv_bias
+    params = import_hf_llama(hf.state_dict(), cfg)
+
+    tokens = np.asarray([[3, 17, 99, 5, 64, 2, 120, 7]], np.int32)
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(tokens).long()).logits.numpy()
+    ours, _ = forward(params, jnp.asarray(tokens), cfg)
+    np.testing.assert_allclose(np.asarray(ours), ref, atol=2e-4,
+                               rtol=2e-3)
+
+
+def test_hf_qwen2_swa_layer_mapping():
+    """Qwen2 use_sliding_window: HF runs FULL attention on the first
+    max_window_layers layers and SWA after — config_from_hf must map
+    that to an explicit per-layer attn_windows tuple, and ignore
+    sliding_window entirely when use_sliding_window is off."""
+    from transformers import Qwen2Config
+
+    from ray_tpu.models.import_hf import config_from_hf
+
+    cfg = config_from_hf(Qwen2Config(
+        num_hidden_layers=4, sliding_window=1024,
+        use_sliding_window=True, max_window_layers=2))
+    assert cfg.attn_windows == (0, 0, 1024, 1024)
+    assert cfg.sliding_window == 0
+
+    cfg = config_from_hf(Qwen2Config(
+        num_hidden_layers=4, sliding_window=1024,
+        use_sliding_window=False))
+    assert cfg.attn_windows is None and cfg.sliding_window == 0
