@@ -54,13 +54,26 @@ def config_from_hf(hf_config: Any) -> TransformerConfig:
     attn_windows = None
     if qwen2:
         if window and getattr(hf_config, "use_sliding_window", False):
-            # HF applies SWA only to layers >= max_window_layers (the
-            # first max_window_layers layers run full attention); our
-            # attn_windows expresses that as an explicit per-layer tuple
-            full = int(getattr(hf_config, "max_window_layers", 0))
-            attn_windows = tuple(
-                0 if i < full else int(window)
-                for i in range(hf_config.num_hidden_layers))
+            # Per-layer windows. transformers reads layer_types per
+            # layer when present; older configs use the prefix rule
+            # (full attention below max_window_layers, SWA above).
+            layer_types = getattr(hf_config, "layer_types", None)
+            if layer_types:
+                per_layer = tuple(
+                    int(window) if t == "sliding_attention" else 0
+                    for t in layer_types)
+            else:
+                full = int(getattr(hf_config, "max_window_layers", 0))
+                per_layer = tuple(
+                    0 if i < full else int(window)
+                    for i in range(hf_config.num_hidden_layers))
+            # minimal repeating period keeps the grouped layer scan
+            # small (a prefix rule has no short period and pays a
+            # one-group trace; the common alternating/uniform cases
+            # reduce to 1-2 entries)
+            attn_windows = _min_period(per_layer)
+            if set(attn_windows) == {0}:
+                attn_windows = None
         window = 0  # HF ignores sliding_window unless use_sliding_window
     return TransformerConfig(
         vocab_size=hf_config.vocab_size,
@@ -82,6 +95,15 @@ def config_from_hf(hf_config: Any) -> TransformerConfig:
         mlp="swiglu", norm="rms", positions="rope",
         dtype="float32", param_dtype="float32",
     )
+
+
+def _min_period(pat: tuple) -> tuple:
+    """Smallest repeating prefix generating ``pat`` (itself if aperiodic)."""
+    n = len(pat)
+    for p in range(1, n):
+        if n % p == 0 and pat[:p] * (n // p) == pat:
+            return pat[:p]
+    return pat
 
 
 def _np(w, dtype) -> np.ndarray:

@@ -298,10 +298,18 @@ def test_runtime_env_py_modules(rt, tmp_path):
     assert ray_tpu.get(no_pkg.remote(), timeout=60) == "clean"
 
 
-def test_runtime_env_pip_rejected(rt):
-    @ray_tpu.remote(runtime_env={"pip": ["requests"]})
+def test_runtime_env_conda_rejected_pip_normalized(rt):
+    """conda/container envs are rejected loudly (pointing at the pip
+    plugin); a pip env normalizes at submit time (the r5 pip plugin —
+    full behavior in test_core_robustness's venv isolation test)."""
+    @ray_tpu.remote(runtime_env={"conda": ["requests"]})
     def f():
         return 1
 
-    with pytest.raises(ValueError, match="image is fixed"):
+    with pytest.raises(ValueError, match="pip"):
         f.remote()
+
+    from ray_tpu.runtime_env import normalize_pip_env
+
+    env = normalize_pip_env(["requests==2.0"])
+    assert env["uri"].startswith("pipenv-")

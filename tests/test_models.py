@@ -653,3 +653,18 @@ def test_hf_qwen2_swa_layer_mapping():
         num_hidden_layers=4, sliding_window=1024,
         use_sliding_window=False))
     assert cfg.attn_windows is None and cfg.sliding_window == 0
+
+    # explicit layer_types wins over the max_window_layers prefix rule,
+    # and periodic patterns reduce to their minimal repeat
+    hf = Qwen2Config(num_hidden_layers=4, sliding_window=1024,
+                     use_sliding_window=True, max_window_layers=0)
+    hf.layer_types = ["sliding_attention", "full_attention"] * 2
+    cfg = config_from_hf(hf)
+    assert cfg.attn_windows == (1024, 0)
+    assert cfg.layer_windows == (1024, 0, 1024, 0)
+
+    # all-sliding uniform pattern reduces to one entry
+    hf.layer_types = ["sliding_attention"] * 4
+    cfg = config_from_hf(hf)
+    assert cfg.attn_windows == (1024,)
+    assert cfg.uniform_window == 1024
