@@ -59,6 +59,16 @@ def config_from_hf(hf_config: Any) -> TransformerConfig:
             # (full attention below max_window_layers, SWA above).
             layer_types = getattr(hf_config, "layer_types", None)
             if layer_types:
+                known = {"sliding_attention", "full_attention"}
+                bad = set(layer_types) - known
+                if bad or len(layer_types) != hf_config.num_hidden_layers:
+                    # refuse-loudly policy: an unknown attention kind
+                    # (chunked/linear/...) or a mis-sized list must not
+                    # import as silently-wrong full attention
+                    raise ValueError(
+                        f"unsupported layer_types (unknown kinds {sorted(bad)}"
+                        f", len {len(layer_types)} vs "
+                        f"{hf_config.num_hidden_layers} layers)")
                 per_layer = tuple(
                     int(window) if t == "sliding_attention" else 0
                     for t in layer_types)

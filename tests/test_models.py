@@ -370,7 +370,7 @@ def test_gemma2_alternating_windows_exact():
                                       theta=c.rope_theta)
         for li in range(c.n_layers):
             lp = jax.tree.map(lambda a: a[li], params["layers"])
-            h = T._norm(x, lp["attn_norm"], lp.get("attn_norm_b"), c.norm)
+            h = T._norm(x, lp["attn_norm"], lp.get("attn_norm_b"), c)
             q = jnp.einsum("bld,dhk->blhk", h, lp["wq"].astype(dt))
             k = jnp.einsum("bld,dhk->blhk", h, lp["wk"].astype(dt))
             v = jnp.einsum("bld,dhk->blhk", h, lp["wv"].astype(dt))
@@ -380,14 +380,14 @@ def test_gemma2_alternating_windows_exact():
                                 window=c.layer_windows[li] or None,
                                 softcap=c.attn_softcap)
             x = x + jnp.einsum("blhk,hkd->bld", o, lp["wo"].astype(dt))
-            h = T._norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"), c.norm)
+            h = T._norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"), c)
             g = jax.nn.silu(jnp.einsum("bld,df->blf", h,
                                        lp["w_gate"].astype(dt)))
             u = jnp.einsum("bld,df->blf", h, lp["w_up"].astype(dt))
             x = x + jnp.einsum("blf,fd->bld", g * u,
                                lp["w_down"].astype(dt))
         x = T._norm(x, params["final_norm"], params.get("final_norm_b"),
-                    c.norm)
+                    c)
         logits = jnp.einsum("bld,dv->blv", x,
                             params["embed"].T.astype(dt)).astype(jnp.float32)
         return jnp.tanh(logits / c.logits_softcap) * c.logits_softcap
@@ -668,3 +668,11 @@ def test_hf_qwen2_swa_layer_mapping():
     cfg = config_from_hf(hf)
     assert cfg.attn_windows == (1024,)
     assert cfg.uniform_window == 1024
+
+    # unknown attention kinds and mis-sized lists refuse loudly
+    hf.layer_types = ["chunked_attention"] * 4
+    with pytest.raises(ValueError, match="layer_types"):
+        config_from_hf(hf)
+    hf.layer_types = ["sliding_attention", "full_attention"]  # 2 != 4
+    with pytest.raises(ValueError, match="layer_types"):
+        config_from_hf(hf)
