@@ -68,6 +68,8 @@ CONFIGS = [
      {"RTPU_ATTN_BLOCK_Q": "1024", "RTPU_ATTN_BLOCK_K": "1024"}),
     ("b64_chunk_blk1024", True, "full", 64, "pallas", 512,
      {"RTPU_ATTN_BLOCK_Q": "1024", "RTPU_ATTN_BLOCK_K": "1024"}),
+    ("noremat_b8_blk1024", False, "full", 8, "pallas", 512,
+     {"RTPU_ATTN_BLOCK_Q": "1024", "RTPU_ATTN_BLOCK_K": "1024"}),
     ("noremat_b16_blk1024", False, "full", 16, "pallas", 512,
      {"RTPU_ATTN_BLOCK_Q": "1024", "RTPU_ATTN_BLOCK_K": "1024"}),
     ("noremat_b32_blk1024", False, "full", 32, "pallas", 512,

@@ -172,14 +172,22 @@ class ClusterAdapter:
         self._pull_io.shutdown(wait=False)
 
     def _heartbeat_loop(self):
+        from ray_tpu.util.host_stats import host_stats
+
+        beat = 0
         while not self._stop.wait(HEARTBEAT_S):
             try:
                 self.rt.reap_stale_pg_stages()
                 with self.rt.lock:
                     avail = dict(self.rt.avail)
                     depth = len(self.rt.ready_tasks)
+                # host sample every ~2s, not every beat: consumers read
+                # at dashboard cadence and sub-second cpu_percent
+                # windows are noise
+                beat += 1
+                stats = host_stats() if beat % 4 == 1 else None
                 known = self.gcs.call("node_heartbeat", self.node_id, avail,
-                                      depth, timeout=5)
+                                      depth, stats, timeout=5)
                 if known is False:
                     # a restarted GCS lost the (non-durable) node table:
                     # re-register + re-subscribe (GCS FT path)
@@ -1504,7 +1512,8 @@ class ClusterAdapter:
         return [
             {"NodeID": n["node_id"].hex(),
              "Alive": n["alive"], "Resources": dict(n["resources"]),
-             "alive": n["alive"]}
+             "alive": n["alive"],
+             "stats": dict(n.get("stats") or {})}
             for n in self._nodes()
         ]
 

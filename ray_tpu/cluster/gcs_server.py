@@ -50,7 +50,7 @@ class _GlobalObject:
 
 class _NodeEntry:
     __slots__ = ("node_id", "addr", "resources", "avail", "last_seen",
-                 "alive", "is_head", "labels")
+                 "alive", "is_head", "labels", "stats")
 
     def __init__(self, node_id: bytes, addr: str, resources: Dict[str, float],
                  is_head: bool, labels: Optional[Dict[str, str]] = None):
@@ -64,6 +64,8 @@ class _NodeEntry:
         # static key=value node labels (reference NodeLabels): TPU
         # generation / slice type / user labels, set at node start
         self.labels = dict(labels or {})
+        # latest host utilization sample from the heartbeat (reporter role)
+        self.stats: Dict = {}
 
 
 class GcsService:
@@ -179,13 +181,21 @@ class GcsService:
         return True
 
     def rpc_node_heartbeat(self, ctx, node_id: bytes,
-                           avail: Dict[str, float], queue_depth: int):
+                           avail: Dict[str, float], queue_depth: int,
+                           stats: Optional[Dict] = None):
         with self.lock:
             ent = self.nodes.get(node_id)
             if ent is None:
                 return False
             changed = ent.avail != avail
             ent.avail = dict(avail)
+            if stats:
+                # host utilization sample (reporter-module role) — rides
+                # the heartbeat, surfaces via node_list/dashboard. The
+                # timestamp lets readers spot a dead reporter (a node
+                # whose sampling fails keeps heartbeating with stats
+                # None, so ts stops advancing).
+                ent.stats = dict(stats, ts=time.time())
             ent.last_seen = time.monotonic()
             if not ent.alive:
                 ent.alive = True
@@ -204,7 +214,8 @@ class GcsService:
             return [
                 {"node_id": e.node_id, "addr": e.addr, "alive": e.alive,
                  "resources": dict(e.resources), "avail": dict(e.avail),
-                 "is_head": e.is_head, "labels": dict(e.labels)}
+                 "is_head": e.is_head, "labels": dict(e.labels),
+                 "stats": dict(e.stats)}
                 for e in self.nodes.values()
             ]
 

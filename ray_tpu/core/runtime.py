@@ -2062,12 +2062,15 @@ class DriverRuntime:
             nodes = self.cluster.node_info()
             if nodes:
                 return nodes
+        from ray_tpu.util.host_stats import host_stats
+
         return [
             {
                 "NodeID": self.node_id.hex(),
                 "Alive": True,
                 "Resources": dict(self.total),
                 "alive": True,
+                "stats": host_stats(),  # reporter-module role
             }
         ]
 

@@ -44,6 +44,20 @@ def test_cluster_boots_and_lists_nodes(cluster):
         time.sleep(0.2)
     assert len(nodes) >= 3  # head + 2 daemons
 
+    # host utilization samples ride heartbeats into the node table
+    # (reporter-module role) — wait one heartbeat period for the first
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        try:
+            with_stats = [n for n in ray_tpu.nodes()
+                          if (n.get("stats") or {}).get("mem_total")]
+        except ConnectionError:
+            with_stats = []
+        if with_stats:
+            break
+        time.sleep(0.5)
+    assert with_stats, "no node ever reported host stats"
+
 
 def test_tasks_spread_by_custom_resources(cluster):
     """Tasks needing a resource only peers have must run on the peers."""

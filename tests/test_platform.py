@@ -290,3 +290,13 @@ def test_tracing_spans_propagate_to_workers(tmp_path):
         tracing._state["enabled"] = None
         tracing._state["fd"] = None
         ray_tpu.shutdown()
+
+
+def test_node_host_stats_reported(rt_plat):
+    """Per-node host utilization (reference dashboard reporter module):
+    nodes() carries a psutil sample; keys stay stable for the UI."""
+    nodes = ray_tpu.nodes()
+    stats = nodes[0].get("stats") or {}
+    assert {"cpu_percent", "mem_used", "mem_total",
+            "num_cpus"} <= set(stats)
+    assert stats["mem_total"] > stats["mem_used"] > 0
