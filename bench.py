@@ -396,6 +396,7 @@ def train_step_child() -> None:
         raise last_exc
     result["detail"]["attention_impl"] = attn_note
     result["detail"]["rl_learner_grad_steps_per_s"] = rl_rate
+    result["detail"]["rl_forward_exploration"] = _rl_forward_bench(jax)
     print(json.dumps(result))
 
 
@@ -432,6 +433,57 @@ def _rl_learner_bench(jax) -> float:
         return round(steps / dt, 1)
     except Exception:
         return 0.0
+
+
+def _rl_forward_bench(jax) -> dict:
+    """RLModule forward_exploration: jit vs eager speedup — the analog
+    of the reference's one checked-in ML-library number (torch.compile
+    forward_exploration speedups, rllib/benchmarks/torch_compile:
+    +33.9% CPU ... +156.7% A100). jax.jit is the jax-native compile."""
+    try:
+        if jax.default_backend() != "cpu":
+            # On the tunneled axon backend the eager arm is dominated by
+            # per-op tunnel round-trips (the speedup would measure RTT,
+            # not compile benefit) and 50 eager dispatches could eat the
+            # train child's budget during a scarce tunnel window. The
+            # reference's primary comparator is its CPU number anyway.
+            return {"skipped": "CPU-only micro-bench (eager arm is "
+                               "dispatch-RTT-dominated off-CPU)"}
+        import numpy as np
+
+        from ray_tpu.rllib.rl_module import RLModuleSpec
+
+        spec = RLModuleSpec(observation_dim=84, action_dim=6,
+                            discrete=True, hidden=(256, 256))
+        module = spec.build()
+        params = module.init(jax.random.PRNGKey(0))
+        obs0 = jax.numpy.asarray(
+            np.random.default_rng(0).standard_normal(
+                (32, 84)).astype(np.float32))
+        rng = jax.random.PRNGKey(1)
+
+        jitted = jax.jit(module.forward_exploration)
+
+        def timed(fn, n=50):
+            jax.block_until_ready(fn(params, obs0, rng))  # warm
+            obs = obs0
+            t0 = time.perf_counter()
+            for _ in range(n):
+                out = fn(params, obs, rng)
+                # chain: next input depends on this output, so the final
+                # device_get provably spans all n calls (CLAUDE.md
+                # timing rule)
+                obs = obs0 + 0.0 * out["vf_preds"][:, None]
+            float(jax.device_get(out["vf_preds"].sum()))
+            return (time.perf_counter() - t0) / n
+
+        eager_s = timed(module.forward_exploration)
+        jit_s = timed(jitted)
+        return {"eager_ms": round(eager_s * 1e3, 3),
+                "jit_ms": round(jit_s * 1e3, 3),
+                "speedup_pct": round((eager_s / jit_s - 1) * 100, 1)}
+    except Exception:
+        return {}
 
 
 def _claim_backend(jax, retries: int = 4) -> str:
