@@ -27,7 +27,7 @@ from ray_tpu.cluster.rpc import RpcClient, free_port
 
 
 class Cluster:
-    def __init__(self, node_timeout_s: float = 3.0,
+    def __init__(self, node_timeout_s: float = 8.0,
                  gcs_snapshot: Optional[str] = None):
         self.authkey = uuid.uuid4().hex[:16]
         self._node_timeout_s = node_timeout_s
@@ -48,7 +48,13 @@ class Cluster:
             self._gcs_proc = self._spawn_gcs()
             try:
                 self._wait_for_gcs()
-                self._client = RpcClient(self.address, self.authkey.encode())
+                # reconnect=True: wait_for_nodes/list_nodes retry polls
+                # through transient drops — without it the first drop
+                # kills the client permanently and every retry spins on
+                # a dead socket
+                self._client = RpcClient(self.address,
+                                         self.authkey.encode(),
+                                         reconnect=True)
                 return
             except Exception as e:
                 last = e
@@ -141,8 +147,15 @@ class Cluster:
     def wait_for_nodes(self, n_daemons: int, timeout: float = 30.0):
         """Wait until ``n_daemons`` non-head nodes are alive in the GCS."""
         deadline = time.monotonic() + timeout
+        alive = []
         while time.monotonic() < deadline:
-            nodes = self._client.call("node_list", timeout=5)
+            try:
+                nodes = self._client.call("node_list", timeout=5)
+            except (ConnectionError, TimeoutError):
+                # transient GCS connection drop under load: the client
+                # reconnects; a poll must retry, not abort the wait
+                time.sleep(0.3)
+                continue
             alive = [x for x in nodes if x["alive"] and not x["is_head"]]
             if len(alive) >= n_daemons:
                 return

@@ -256,6 +256,15 @@ def test_groupby_runs_distributed_driver_stays_thin(rt_data):
     warm = rd.range(1000, parallelism=2).groupby("id").count()
     warm.take_all()
 
+    # the arena's background prefault commits up to 512 MB into THIS
+    # process's RSS; under suite load it can spill past the baseline
+    # sample and masquerade as a driver concat — wait it out first
+    import threading
+
+    for t in threading.enumerate():
+        if t.name == "rtpu-arena-prefault":
+            t.join(timeout=60)
+
     n_rows = 2_000_000  # 16 MB/block x 8 blocks = 128 MB of float64
     base = _hwm()
     ds = rd.range(n_rows, parallelism=8).add_column(
