@@ -22,6 +22,8 @@ from ray_tpu.models.config import (
     gemma_debug,
     mistral_7b,
     mistral_debug,
+    qwen2_7b,
+    qwen2_debug,
     gpt2_small,
     gpt2_debug,
     moe_debug,
@@ -60,6 +62,8 @@ __all__ = [
     "gemma_debug",
     "mistral_7b",
     "mistral_debug",
+    "qwen2_7b",
+    "qwen2_debug",
     "gpt2_small",
     "gpt2_debug",
     "moe_debug",

@@ -149,18 +149,24 @@ class TransformerConfig:
         """Parameter count (embeddings included once if tied)."""
         d, f, hd = self.d_model, self.ff, self.hdim
         attn = d * hd * self.n_heads + 2 * d * hd * self.kv_heads + hd * self.n_heads * d
+        if self.attn_qkv_bias:
+            attn += hd * (self.n_heads + 2 * self.kv_heads)
         if self.mlp == "swiglu":
             mlp = 3 * d * f
         else:
-            mlp = 2 * d * f
+            mlp = 2 * d * f + f + d  # + b_in/b_out biases
         if self.num_experts:
             mlp = mlp * self.num_experts + d * self.num_experts  # + router
         norms = 2 * d
+        final_norm = d
+        if self.norm == "layer":  # per-norm bias vectors
+            norms += 2 * d
+            final_norm += d
         per_layer = attn + mlp + norms
         emb = self.vocab_size * d
         head = 0 if self.tie_embeddings else self.vocab_size * d
         pos = self.max_seq_len * d if self.positions == "learned" else 0
-        return self.n_layers * per_layer + emb + head + pos + d  # + final norm
+        return self.n_layers * per_layer + emb + head + pos + final_norm
 
     def flops_per_token(self) -> int:
         """Approx training FLOPs/token (fwd+bwd ≈ 6N + attention quadratic)."""
@@ -273,6 +279,25 @@ def mistral_debug() -> TransformerConfig:
     )
 
 
+def qwen2_7b() -> TransformerConfig:
+    """Qwen2-7B-family shape: GQA + q/k/v biases, large vocab, theta 1M.
+    Weight-portable via ``models.import_hf`` (exact parity incl. the
+    bias path)."""
+    return TransformerConfig(
+        vocab_size=152064, d_model=3584, n_layers=28, n_heads=28,
+        n_kv_heads=4, d_ff=18944, max_seq_len=32768,
+        rope_theta=1_000_000.0, norm_eps=1e-6, attn_qkv_bias=True,
+    )
+
+
+def qwen2_debug() -> TransformerConfig:
+    """Tiny qwen2-style config for tests: GQA + qkv biases."""
+    return TransformerConfig(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=128, attn_qkv_bias=True, remat=False,
+    )
+
+
 def moe_debug() -> TransformerConfig:
     return TransformerConfig(
         vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=4,
@@ -292,6 +317,8 @@ PRESETS = {
     "gemma-debug": gemma_debug,
     "mistral-7b": mistral_7b,
     "mistral-debug": mistral_debug,
+    "qwen2-7b": qwen2_7b,
+    "qwen2-debug": qwen2_debug,
     "moe-debug": moe_debug,
 }
 

@@ -17,6 +17,7 @@ CONFIGS = {
     "llama": models.llama_debug(),
     "gpt2": models.gpt2_debug(),
     "gemma": models.gemma_debug(),
+    "qwen2": models.qwen2_debug(),
     "moe": models.moe_debug(),
 }
 
@@ -48,8 +49,9 @@ def test_param_axes_match_params(name):
                      e is None or isinstance(e, str) for e in x))
 
 
-def test_num_params_formula_matches():
-    c = CONFIGS["llama"]
+@pytest.mark.parametrize("name", list(CONFIGS))
+def test_num_params_formula_matches(name):
+    c = CONFIGS[name]
     params = init_params(jax.random.PRNGKey(0), c)
     actual = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
     assert actual == c.num_params()
