@@ -31,7 +31,8 @@ from ray_tpu.rllib.connectors import (
     ScaleActions,
 )
 from ray_tpu.rllib.cql import CQLLearner, train_cql
-from ray_tpu.rllib.dreamerv3 import DreamerV3Learner
+from ray_tpu.rllib.dreamerv3 import (DreamerV3Learner,
+                                     train_dreamerv3)
 from ray_tpu.rllib.offline import (
     BCLearner,
     MARWILLearner,
@@ -106,6 +107,7 @@ __all__ = [
     "BCLearner",
     "CQLLearner",
     "DreamerV3Learner",
+    "train_dreamerv3",
     "MARWILLearner",
     "OfflineReader",
     "OfflineWriter",

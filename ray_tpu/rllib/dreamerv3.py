@@ -482,3 +482,29 @@ class DreamerV3Learner:
             jax.numpy.asarray(obs, jax.numpy.float32),
             jax.numpy.asarray(prev_action), key, greedy=greedy)
         return ((np.asarray(hstate), np.asarray(z), knext), np.asarray(a))
+
+
+def train_dreamerv3(dataset_path: str, module_spec: Dict[str, Any],
+                    *, config: Optional[Dict[str, Any]] = None,
+                    seq_len: int = 16, batch_size: int = 16,
+                    num_updates: int = 100,
+                    seed: int = 0) -> DreamerV3Learner:
+    """Offline DreamerV3 on recorded shards (the train_bc/train_cql
+    companion): world model + imagination actor-critic from a
+    single-env recording (``record_episodes(..., num_envs=1)`` — see
+    ``OfflineReader.iter_sequences``)."""
+    from ray_tpu.rllib.offline import OfflineReader
+
+    reader = OfflineReader(dataset_path)
+    learner = DreamerV3Learner(module_spec, config, seed=seed)
+    done = 0
+    metrics: Dict[str, float] = {}
+    while done < num_updates:
+        for batch in reader.iter_sequences(seq_len, batch_size,
+                                           seed=seed + done):
+            metrics = learner.update(batch)
+            done += 1
+            if done >= num_updates:
+                break
+    learner.last_metrics = metrics
+    return learner

@@ -79,6 +79,88 @@ class OfflineReader:
             rows = idx[start:start + batch_size]
             yield {k: v[rows] for k, v in data.items()}
 
+    def _sequence_windows(self, seq_len: int) -> list:
+        """Build (and cache) the [T, ...] sequence windows for
+        :meth:`iter_sequences` — the expensive part, independent of the
+        shuffle seed, so repeated epochs don't re-read the shards."""
+        cache = getattr(self, "_window_cache", None)
+        if cache is not None and cache[0] == seq_len:
+            return cache[1]
+        data = self.read_all()
+        dones = data["dones"].astype(bool)
+        terminateds = data.get("terminateds", data["dones"]).astype(bool)
+        obs = data["obs"].astype(np.float32)
+        next_obs = data["next_obs"].astype(np.float32)
+        actions = data["actions"]
+        rewards = data["rewards"].astype(np.float32)
+
+        windows = []
+        ep_start = 0
+        bounds = list(np.flatnonzero(dones))
+        if not bounds or bounds[-1] != len(dones) - 1:
+            bounds.append(len(dones) - 1)
+        for end in bounds:
+            a, b = ep_start, end
+            ep_start = end + 1
+            # Per-episode arrays in the Dreamer replay convention —
+            # index i describes ARRIVING at eobs[i]:
+            #   eobs  = [obs_a .. obs_b, successor of obs_b]
+            #   erew[i] = reward of the transition INTO eobs[i] (0 for
+            #             the episode's true first state)
+            #   econt[i] = that transition was non-TERMINAL (truncation
+            #             bootstraps, so only terminateds gate it)
+            # Including the successor obs is what puts the terminal
+            # state (continue=0) and the final reward into the stream —
+            # without it the continue head only ever sees 1.
+            eobs = np.concatenate([obs[a:b + 1], next_obs[b:b + 1]])
+            eact = np.concatenate([actions[a:b + 1],
+                                   np.zeros_like(actions[b:b + 1])])
+            erew = np.concatenate([[0.0], rewards[a:b + 1]])
+            econt = np.concatenate(
+                [np.ones(b + 1 - a, np.float32),
+                 1.0 - terminateds[b:b + 1].astype(np.float32)])
+            L = len(eobs)
+            for w0 in range(0, L - seq_len + 1, seq_len):
+                s = slice(w0, w0 + seq_len)
+                windows.append({
+                    "obs": eobs[s], "actions": eact[s],
+                    "rewards": erew[s].astype(np.float32),
+                    "continues": econt[s].astype(np.float32)})
+        if not windows:
+            raise ValueError(
+                f"no episode yields a full {seq_len}-step window")
+        self._window_cache = (seq_len, windows)
+        return windows
+
+    def iter_sequences(self, seq_len: int, batch_size: int, *,
+                       shuffle: bool = True, seed: int = 0
+                       ) -> Iterator[Dict[str, np.ndarray]]:
+        """[B, T] sequence windows for model-based learners (DreamerV3).
+
+        Episodes are recovered by splitting the flat stream at ``dones``
+        — valid only for recordings whose rows are episode-contiguous
+        (``record_episodes(..., num_envs=1)``; multi-env recordings
+        interleave envs time-major and cannot be re-segmented). Each
+        episode is extended with its terminal successor observation
+        (continue=0 there unless truncated), windows are non-overlapping
+        within an episode, and tails shorter than ``seq_len`` are
+        dropped. Raises when the dataset yields fewer than
+        ``batch_size`` windows (a silent empty iterator would hang
+        epoch loops).
+        """
+        windows = self._sequence_windows(seq_len)
+        if len(windows) < batch_size:
+            raise ValueError(
+                f"dataset yields {len(windows)} windows of len "
+                f"{seq_len} < batch_size {batch_size}")
+        idx = np.arange(len(windows))
+        if shuffle:
+            np.random.default_rng(seed).shuffle(idx)
+        for start in range(0, len(idx) - batch_size + 1, batch_size):
+            rows = idx[start:start + batch_size]
+            yield {k: np.stack([windows[i][k] for i in rows])
+                   for k in windows[0]}
+
     def as_dataset(self, parallelism: int = 8):
         """The shards as a ray_tpu.data Dataset of row blocks."""
         import ray_tpu

@@ -511,3 +511,44 @@ def test_dreamerv3_actor_learns_from_imagination():
     # probe: 0.97-0.98 across seeds 0/1/2 at 250 updates (twohot critic
     # + zero-init heads + entropy 1e-2); 0.8 leaves seed margin
     assert rate > 0.8, f"greedy hit rate {rate:.2f} (random 0.25): {m}"
+
+
+def test_dreamerv3_offline_pipeline(tmp_path):
+    """train_dreamerv3 over recorded single-env shards: sequence windows
+    respect episode boundaries + the Dreamer replay shift, and the world
+    model trains to finite, decreasing losses on real cartpole data."""
+    import ray_tpu
+    from ray_tpu.rllib import train_dreamerv3
+    from ray_tpu.rllib.offline import OfflineReader, record_episodes
+
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    try:
+        path = str(tmp_path / "dreamer-data")
+        record_episodes("CartPole-v1", path, num_steps=600, num_envs=1,
+                        seed=0)
+
+        # window semantics (non-vacuous): terminal successor states DO
+        # appear with continue=0; windows mid-episode carry the true
+        # boundary reward (1.0 on cartpole), only each episode's first
+        # state gets reward 0
+        reader = OfflineReader(path)
+        batch = next(reader.iter_sequences(8, 4, shuffle=False))
+        assert batch["obs"].shape[:2] == (4, 8)
+        wins = reader._sequence_windows(8)
+        first_rewards = {float(w["rewards"][0]) for w in wins}
+        assert 0.0 in first_rewards, "episode-start windows missing"
+        assert 1.0 in first_rewards, "mid-episode windows lost the true boundary reward"
+        assert any(w["continues"].min() == 0.0 for w in wins), \
+            "terminal states never reach the learner"
+        assert all(w["continues"][0] == 1.0 for w in wins)
+
+        learner = train_dreamerv3(
+            path, {"observation_dim": 4, "action_dim": 2},
+            config={"deter": 32, "hidden": 32, "groups": 4, "classes": 4,
+                    "horizon": 5, "wm_lr": 3e-3},
+            seq_len=8, batch_size=8, num_updates=30)
+        m = learner.last_metrics
+        assert np.isfinite(m["wm_loss"]) and np.isfinite(m["imag_return"])
+        assert m["wm_recon"] < 2.0, m  # symlog recon converging on 4-dim obs
+    finally:
+        ray_tpu.shutdown()
