@@ -63,7 +63,7 @@ def test_pallas_interpret_gqa():
 @pytest.mark.parametrize("causal", [True, False])
 def test_ring_attention_matches_full(causal):
     from jax.sharding import Mesh, PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     devs = np.array(jax.devices()[:4])
     mesh = Mesh(devs, ("sp",))
