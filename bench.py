@@ -63,6 +63,10 @@ def main() -> None:
     # path), so it survives any TPU trouble — round 1 lost these numbers
     # because the TPU crash happened first.
     detail["core_microbench"] = _core_microbench()
+    # Streaming-shuffle bench (r6): out-of-core sort throughput + peak
+    # RSS, so exchange regressions (a stage starting to materialize)
+    # show up in the BENCH trajectory.
+    detail["data_shuffle"] = _data_shuffle_bench()
 
     # Cheap pre-gate (VERDICT r3 #4): a ~25s device probe decides whether
     # the axon tunnel is alive BEFORE burning a 420s train-child timeout.
@@ -683,6 +687,123 @@ def _measure(jax, on_tpu: bool, batch_size: int = 16) -> dict:
 # release/microbenchmark/run_microbenchmark.py — tasks/s, actor calls/s,
 # put GB/s) on a throwaway local cluster. jax-free.
 # ---------------------------------------------------------------------------
+
+def _data_shuffle_bench() -> dict:
+    """Out-of-core sort through the streaming exchange, scaled for a
+    2-vCPU box: 24 MB of (key, payload) rows sorted under an 8 MB spill
+    threshold. Reports rows/s (best-of-3 per the CLAUDE.md noise rule —
+    capability, not average-under-load) and the peak per-process RSS
+    growth over the run (max across driver + workers): a materializing
+    regression shows up as peak_rss_mb jumping toward the dataset size."""
+    import threading
+
+    import numpy as np
+
+    out = {}
+    n_blocks, rows_per = 12, 125_000  # 12 x 125k x 16 B = 24 MB
+    overrides = {
+        "RTPU_STORE_CAPACITY": str(4 << 20),
+        "RTPU_SPILL_THRESHOLD": str(8 << 20),
+        "RTPU_DATA_EXCHANGE_RUN_BYTES": str(2 << 20),
+        "RTPU_STORE_PREFAULT_BYTES": "0",
+    }
+    saved = {k: os.environ.get(k) for k in overrides}
+    os.environ.update(overrides)
+    started = False
+    try:
+        import ray_tpu
+        from ray_tpu.core.runtime import _get_runtime
+        from ray_tpu.data.dataset import Dataset
+
+        ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+        started = True
+
+        def gen():
+            rng = np.random.default_rng(0)
+            for i in range(n_blocks):
+                yield {"key": rng.integers(0, 1 << 40, size=rows_per),
+                       "pay": np.full(rows_per, float(i))}
+
+        def _vmrss_kb(pid):
+            try:
+                with open(f"/proc/{pid}/status") as f:
+                    for line in f:
+                        if line.startswith("VmRSS:"):
+                            return int(line.split()[1])
+            except OSError:
+                pass
+            return None
+
+        stop = threading.Event()
+        rss = {}  # pid -> [base, peak]
+        spill_peak = [0]
+
+        def sample():
+            while not stop.wait(0.05):
+                pids = [os.getpid()]
+                try:
+                    pids += [ws.proc.pid for ws in
+                             list(_get_runtime().workers.values())]
+                except Exception:
+                    pass
+                for pid in pids:
+                    kb = _vmrss_kb(pid)
+                    if kb is None:
+                        continue
+                    ent = rss.setdefault(pid, [kb, kb])
+                    ent[1] = max(ent[1], kb)
+                try:
+                    spill_peak[0] = max(
+                        spill_peak[0],
+                        ray_tpu.object_store_memory()["spilled_bytes"])
+                except Exception:
+                    pass
+
+        def trial():
+            t0 = time.perf_counter()
+            rows = 0
+            last = None
+            for ref in Dataset(gen).sort(
+                    "key", num_blocks=8).iter_block_refs():
+                block = ray_tpu.get(ref)
+                keys = block.get("key")
+                if keys is None or not len(keys):
+                    continue
+                assert np.all(keys[1:] >= keys[:-1])
+                assert last is None or keys[0] >= last
+                last = keys[-1]
+                rows += len(keys)
+                ray_tpu.free(ref)
+            assert rows == n_blocks * rows_per
+            return rows / (time.perf_counter() - t0)
+
+        trial()  # warm: pool spawn + first-exchange fixed costs
+        t = threading.Thread(target=sample, daemon=True)
+        t.start()
+        try:
+            out["sort_rows_per_s"] = round(max(trial() for _ in range(3)))
+        finally:
+            stop.set()
+            t.join(timeout=5)
+        out["peak_rss_mb"] = round(max(
+            (peak - base) for base, peak in rss.values()) / 1024, 1)
+        out["dataset_mb"] = round(n_blocks * rows_per * 16 / 1e6, 1)
+        out["peak_spilled_mb"] = round(spill_peak[0] / 1e6, 1)
+    except Exception as e:  # the bench must never die on the data side
+        out["error"] = str(e)
+    finally:
+        if started:
+            try:
+                ray_tpu.shutdown()
+            except Exception:
+                pass
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return out
+
 
 def _core_microbench() -> dict:
     import numpy as np

@@ -33,6 +33,7 @@ from ray_tpu.core.runtime import (
     wait,
     kill,
     cancel,
+    free,
     get_actor,
     available_resources,
     object_store_memory,
@@ -56,6 +57,7 @@ __all__ = [
     "wait",
     "kill",
     "cancel",
+    "free",
     "get_actor",
     "get_runtime_context",
     "available_resources",

@@ -213,8 +213,16 @@ class ResourceDemandScheduler:
     def schedule(self, demand: List[Bundle],
                  instances: Dict[str, Instance],
                  idle_instance_ids: Optional[set] = None,
+                 available: Optional[Dict[str, Bundle]] = None,
                  ) -> SchedulingDecision:
+        """``available``: per-instance AVAILABLE resources (instance_id ->
+        free bundle), typically from the GCS node table. Instances listed
+        here bin-pack against their free capacity; unlisted ones (and all
+        pre-RAY_RUNNING states, which have no load yet) fall back to the
+        type's full declared resources. Without this input a saturated
+        cluster looks infinitely packable and never scales up (ADVICE r5)."""
         idle = set(idle_instance_ids or ())
+        available = available or {}
         dec = SchedulingDecision()
 
         active = [i for i in instances.values() if i.status in _ACTIVE
@@ -231,10 +239,15 @@ class ResourceDemandScheduler:
                 dec.launches[t.name] = dec.launches.get(t.name, 0) + short
 
         # pass 2: FFD bin-pack. Track per-slot free capacity; slots are
-        # (instance_id | planned-launch marker, resources).
-        slots: List[tuple] = [(i.instance_id,
-                               dict(self._by_name[i.node_type].resources))
-                              for i in active]
+        # (instance_id | planned-launch marker, resources) — seeded from
+        # each instance's AVAILABLE capacity when known, never the full
+        # declared resources of a node that is already running load.
+        slots: List[tuple] = [
+            (i.instance_id,
+             dict(available.get(i.instance_id)
+                  if i.instance_id in available
+                  else self._by_name[i.node_type].resources))
+            for i in active]
         for name, k in dec.launches.items():
             slots.extend(("<new>", dict(self._by_name[name].resources))
                          for _ in range(k))
@@ -307,10 +320,13 @@ class AutoscalerV2:
     def update(self, demand: Optional[List[Bundle]] = None,
                alive_node_ids: Optional[set] = None,
                busy_instance_ids: Optional[set] = None,
+               available_resources: Optional[Dict[str, Bundle]] = None,
                ) -> SchedulingDecision:
         """One pass. ``busy_instance_ids``: instances with resources in
         use (idle-timeout input); ``alive_node_ids``: cloud ids seen in
-        the GCS node table."""
+        the GCS node table; ``available_resources``: per-instance free
+        capacity from the node table, so pending demand packs against
+        what is actually free instead of each node's declared total."""
         demand = list(demand or [])
         if self.load_source is not None:
             demand += list(self.load_source() or [])
@@ -328,7 +344,8 @@ class AutoscalerV2:
             if now - self._last_busy[iid] >= self.idle_timeout_s:
                 idle.add(iid)
 
-        dec = self.scheduler.schedule(demand, self.im.instances, idle)
+        dec = self.scheduler.schedule(demand, self.im.instances, idle,
+                                      available=available_resources)
         for name, k in dec.launches.items():
             self.im.launch(name, k)
         for iid in dec.terminations:

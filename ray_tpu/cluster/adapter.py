@@ -60,6 +60,25 @@ LOCALITY_MIN_BYTES = int(config.get("locality_min_bytes"))
 # utilization is below this, then spread to the least-loaded
 HYBRID_PACK_THRESHOLD = float(config.get("hybrid_threshold"))
 
+#: node-to-node transfer instrumentation (reference pull/push manager
+#: metrics in ``src/ray/stats/metric_defs.cc``). Lazy: adapters live in
+#: daemons and drivers alike; only processes that scrape /metrics read it.
+_xfer_metrics = None
+
+
+def _transfer_metrics():
+    global _xfer_metrics
+    if _xfer_metrics is None:
+        from ray_tpu.util.metrics import Counter
+
+        _xfer_metrics = {
+            "pulled": Counter("cluster_object_pull_bytes_total",
+                              "object bytes pulled from peer nodes"),
+            "served": Counter("cluster_object_serve_bytes_total",
+                              "object bytes served to peer nodes"),
+        }
+    return _xfer_metrics
+
 
 class ClusterAdapter:
     def __init__(self, gcs_addr: str, authkey: bytes, *,
@@ -277,8 +296,15 @@ class ClusterAdapter:
             return ("e", st.error)
         if st is not None and st.status == "READY" and st.inline is not None:
             return ("i", st.inline)
+        # Spilled holder: restore into shm first when headroom allows
+        # (reference raylet restore-for-remote-pull,
+        # ``local_object_manager.h:110``); get_raw reads the spill file
+        # directly either way, so a failed restore still serves the pull.
+        if self.rt.store.contains_spilled(oid):
+            self.rt.store.restore_spilled(oid)
         raw = self.rt.store.get_raw(oid)
         if raw is not None:
+            _transfer_metrics()["served"].inc(len(raw))
             return ("s", raw)
         # segment gone (evicted/deleted behind the directory's back)
         self.gcs.cast("obj_forget_location", oid_b, self.node_id)
@@ -286,9 +312,19 @@ class ClusterAdapter:
 
     def _serve_pull_chunk(self, oid_b: bytes, offset: int, length: int):
         """One chunk of a segment; only ``length`` bytes leave the store."""
-        blob = self.rt.store.get_raw_chunk(ObjectID(oid_b), offset, length)
+        oid = ObjectID(oid_b)
+        if offset == 0 and self.rt.store.contains_spilled(oid):
+            # restore at stream start so the remaining chunks read shm,
+            # not disk. First chunk ONLY: restore_spilled's headroom gate
+            # scans /dev/shm, which must not run once per chunk of a
+            # multi-GB pull. A refused restore just means every chunk
+            # reads from the spill file — still correct.
+            self.rt.store.restore_spilled(oid)
+        blob = self.rt.store.get_raw_chunk(oid, offset, length)
         if blob is None:
             self.gcs.cast("obj_forget_location", oid_b, self.node_id)
+        else:
+            _transfer_metrics()["served"].inc(len(blob))
         return blob
 
     # ------------------------------------------------------------------
@@ -474,6 +510,7 @@ class ClusterAdapter:
             elif kind == "i":
                 self.rt.gcs.mark_ready(oid, inline=blob, _local_only=True)
             else:
+                _transfer_metrics()["pulled"].inc(len(blob))
                 if not self.rt.store.contains(oid):
                     self.rt.store.put_serialized(oid, blob)
                 # local copy now exists: advertise it so future readers
@@ -505,6 +542,7 @@ class ClusterAdapter:
                     w.abort()
                     return False
                 w.write(off, blob)
+                _transfer_metrics()["pulled"].inc(ln)
                 off += ln
             w.seal()
         except Exception:

@@ -357,7 +357,12 @@ class GcsService:
             # same tombstone as the free sweep: a late pin on an evicted
             # entry must surface ObjectLostError, not resurrect a silent
             # empty PENDING that hangs the pinner's get()
-            self._freed_tombstones[oid] = now2
+            self._record_tombstone_locked(oid, now2)
+
+    def _record_tombstone_locked(self, oid: bytes, now: float) -> None:
+        """Record a swept/evicted/freed oid (bounded map shared by all
+        three removal paths); caller holds the lock."""
+        self._freed_tombstones[oid] = now
         while len(self._freed_tombstones) > 20000:
             self._freed_tombstones.pop(next(iter(self._freed_tombstones)))
 
@@ -430,10 +435,7 @@ class GcsService:
                 del self.objects[oid]
                 # bounded tombstone: lets a LATE pin distinguish "swept"
                 # from "not yet created" (advisor r3)
-                self._freed_tombstones[oid] = now
-            while len(self._freed_tombstones) > 20000:
-                self._freed_tombstones.pop(
-                    next(iter(self._freed_tombstones)))
+                self._record_tombstone_locked(oid, now)
         for oid, locations in freed:
             self._publish("objects", {"oid": oid, "freed": True,
                                       "locations": locations})
@@ -514,8 +516,19 @@ class GcsService:
         return out
 
     def rpc_obj_drop(self, ctx, oid: bytes):
+        """Explicit owner-driven free (``ray_tpu.free``): unlike the
+        refcount sweep there is no grace — the caller asserts the object
+        is fully consumed. Holder nodes must free their segments (and
+        spill files) too, or every free()d exchange intermediate leaks on
+        the node that produced it."""
         with self.lock:
-            self.objects.pop(oid, None)
+            o = self.objects.pop(oid, None)
+            locations = list(o.locations) if o is not None else []
+            self._free_candidates.pop(oid, None)
+            self._record_tombstone_locked(oid, time.monotonic())
+        if o is not None:
+            self._publish("objects", {"oid": oid, "freed": True,
+                                      "locations": locations})
         return True
 
     def rpc_obj_forget_location(self, ctx, oid: bytes, node_id: bytes):

@@ -82,6 +82,10 @@ _knob("store_capacity", int, 1 << 30,
 _knob("spill_threshold", int, 4 << 30,
       "total shm bytes after which big objects spill to disk",
       "core/object_store.py")
+_knob("spill_restore", _bool, True,
+      "promote spilled objects back into shm on access when headroom "
+      "allows (reference LocalObjectManager restore role)",
+      "core/object_store.py")
 _knob("store_prefault_bytes", str, str(512 << 20),
       "arena head bytes prefaulted in the background at boot (first-touch "
       "page faults cap cold tmpfs writes at ~2 GB/s on this class of box "
@@ -114,6 +118,25 @@ _knob("hybrid_threshold", float, 0.5,
       "hybrid scheduling: pack until a node passes this utilization, then "
       "spread (reference hybrid_scheduling_policy.h)",
       "cluster/adapter.py")
+
+# -- data (streaming exchange) ----------------------------------------------
+_knob("data_streaming_exchange", _bool, True,
+      "run Data all-to-all ops (sort/shuffle/repartition/groupby) through "
+      "the streaming exchange engine; off = legacy one-shot task exchange",
+      "data/streaming.py")
+_knob("data_exchange_reducers", int, 4,
+      "max reducer actors per streaming exchange (logical partitions are "
+      "multiplexed over them)", "data/streaming.py")
+_knob("data_exchange_inflight", int, 32,
+      "max exchange blocks in flight (partition outputs not yet consumed "
+      "by a reducer) — the engine's backpressure bound",
+      "data/streaming.py")
+_knob("data_exchange_run_bytes", int, 32 << 20,
+      "reducer buffer bytes before a sorted run is flushed to the object "
+      "store (external-sort run size)", "data/streaming.py")
+_knob("data_exchange_target_rows", int, 250_000,
+      "rows per output block emitted by a streaming reducer",
+      "data/streaming.py")
 
 # -- ops / models -----------------------------------------------------------
 _knob("attn_impl", str, "",

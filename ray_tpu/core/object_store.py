@@ -28,6 +28,39 @@ INLINE_THRESHOLD = 8192
 
 _SHM_DIR = "/dev/shm"
 
+#: process-local spill/restore instrumentation (reference
+#: ``src/ray/stats/metric_defs.cc`` spill metrics role). Created lazily so
+#: importing the store never drags the metrics registry into processes that
+#: don't serve /metrics; registered on first StoreClient so a scrape shows
+#: the series (at 0) before the first spill.
+_metrics = None
+
+
+def _store_metrics():
+    global _metrics
+    if _metrics is None:
+        from ray_tpu.util.metrics import Counter
+
+        _metrics = {
+            "spilled_bytes": Counter(
+                "object_store_spilled_bytes_total",
+                "bytes written to the disk spill directory"),
+            "spilled_objects": Counter(
+                "object_store_spilled_objects_total",
+                "objects written to the disk spill directory"),
+            "restored_bytes": Counter(
+                "object_store_restored_bytes_total",
+                "spilled bytes promoted back into shared memory"),
+            "restored_objects": Counter(
+                "object_store_restored_objects_total",
+                "spilled objects promoted back into shared memory"),
+            "spill_read_bytes": Counter(
+                "object_store_spill_read_bytes_total",
+                "bytes served directly from spill files (reads + remote "
+                "pulls that did not restore first)"),
+        }
+    return _metrics
+
 
 def _seg_path(session: str, obj_id: ObjectID) -> str:
     return os.path.join(_SHM_DIR, f"rtpu-{session}-{obj_id.hex()}")
@@ -94,6 +127,7 @@ class StoreClient:
         # check must be O(1), not a /dev/shm scan per put (store_bytes()
         # stays the accurate cross-process accounting API).
         self._file_bytes = 0
+        _store_metrics()  # register the series for /metrics scrapes
 
     # -- write path -------------------------------------------------------
 
@@ -156,7 +190,11 @@ class StoreClient:
         finally:
             os.close(fd)
         mm.close()
-        if not spill:
+        if spill:
+            m = _store_metrics()
+            m["spilled_bytes"].inc(size)
+            m["spilled_objects"].inc()
+        else:
             self._file_bytes += size
         return None, size
 
@@ -205,17 +243,32 @@ class StoreClient:
                     del base, view
                     self._arena.release(obj_id.binary())
         if pinned is None:
-            path = _seg_path(self.session, obj_id)
-            if not os.path.exists(path):
-                spilled = _spill_path(self.session, obj_id)
-                if os.path.exists(spilled):
-                    path = spilled
-            fd = os.open(path, os.O_RDONLY)
-            try:
-                size = os.fstat(fd).st_size
-                mm = mmap.mmap(fd, size, prot=mmap.PROT_READ)
-            finally:
-                os.close(fd)
+            seg = _seg_path(self.session, obj_id)
+            spilled = _spill_path(self.session, obj_id)
+            if not os.path.exists(seg) and os.path.exists(spilled):
+                if self.restore_spilled(obj_id):
+                    # restored into the arena or a fresh segment; re-enter
+                    # (the spill file is gone, so this recurses only once)
+                    return self.get(obj_id)
+            # seg -> spill -> seg: a concurrent restorer can unlink the
+            # spill file between the exists check and the open, in which
+            # case the segment path exists again
+            mm = None
+            for path in (seg, spilled, seg):
+                try:
+                    fd = os.open(path, os.O_RDONLY)
+                except FileNotFoundError:
+                    continue
+                try:
+                    size = os.fstat(fd).st_size
+                    mm = mmap.mmap(fd, size, prot=mmap.PROT_READ)
+                finally:
+                    os.close(fd)
+                if path == spilled:
+                    _store_metrics()["spill_read_bytes"].inc(size)
+                break
+            if mm is None:
+                raise FileNotFoundError(seg)
             with self._lock:
                 existing = self._pins.get(obj_id)
                 if existing is not None:
@@ -240,13 +293,19 @@ class StoreClient:
                 finally:
                     del view
                     self._arena.release(obj_id.binary())
-        for path in (_seg_path(self.session, obj_id),
-                     _spill_path(self.session, obj_id)):
+        seg = _seg_path(self.session, obj_id)
+        spilled = _spill_path(self.session, obj_id)
+        # seg -> spill -> seg: tolerate a concurrent restore unlinking the
+        # spill file between candidates
+        for path in (seg, spilled, seg):
             try:
                 with open(path, "rb") as f:
-                    return f.read()
+                    data = f.read()
             except FileNotFoundError:
                 continue
+            if path == spilled:
+                _store_metrics()["spill_read_bytes"].inc(len(data))
+            return data
         return None
 
     def get_raw_chunk(self, obj_id: ObjectID, offset: int,
@@ -262,14 +321,18 @@ class StoreClient:
                 finally:
                     del view
                     self._arena.release(obj_id.binary())
-        for path in (_seg_path(self.session, obj_id),
-                     _spill_path(self.session, obj_id)):
+        seg = _seg_path(self.session, obj_id)
+        spilled = _spill_path(self.session, obj_id)
+        for path in (seg, spilled, seg):
             try:
                 with open(path, "rb") as f:
                     f.seek(offset)
-                    return f.read(length)
+                    data = f.read(length)
             except FileNotFoundError:
                 continue
+            if path == spilled:
+                _store_metrics()["spill_read_bytes"].inc(len(data))
+            return data
         return None
 
     def begin_receive(self, obj_id: ObjectID,
@@ -358,6 +421,118 @@ class StoreClient:
     def contains_spilled(self, obj_id: ObjectID) -> bool:
         return os.path.exists(_spill_path(self.session, obj_id))
 
+    def spill_dir_bytes(self) -> int:
+        """Total bytes currently spilled to disk for this session (node-
+        wide: every process of the session writes the same directory)."""
+        total = 0
+        try:
+            with os.scandir(_spill_dir(self.session)) as it:
+                for e in it:
+                    try:
+                        total += e.stat().st_size
+                    except OSError:
+                        pass
+        except OSError:
+            pass
+        return total
+
+    def restore_spilled(self, obj_id: ObjectID) -> bool:
+        """Promote a spilled object back into shared memory (reference
+        ``LocalObjectManager`` restore, ``local_object_manager.h:110``):
+        later local reads and chunked peer pulls hit shm instead of disk.
+        Skipped when restoring would push shm usage back over the spill
+        threshold — that pressure is why the object spilled. Concurrency-
+        safe across processes: the shm copy lands under arena create/seal
+        or an O_EXCL temp file renamed into place, and the spill file is
+        unlinked only after the copy is readable."""
+        if not config.get("spill_restore"):
+            return False
+        if self._arena is not None and self._arena.contains(obj_id.binary()):
+            return True  # a peer already restored it
+        seg = _seg_path(self.session, obj_id)
+        if os.path.exists(seg):
+            return True
+        path = _spill_path(self.session, obj_id)
+        try:
+            size = os.stat(path).st_size
+        except OSError:
+            return False  # not spilled here
+        # headroom gate on the ACCURATE cross-process accounting, not this
+        # client's O(1) running total: the process serving a peer pull has
+        # written nothing itself, and restoring into a /dev/shm already
+        # full of other processes' segments would re-create the very
+        # pressure that caused the spill. Restore is rare, so the scan is
+        # affordable here (unlike the per-put spill check).
+        if self.store_bytes() + size > self._spill_threshold:
+            return False  # no shm headroom; serve reads from disk
+        restored = False
+        if self._arena is not None:
+            view = self._arena.create(obj_id.binary(), size)
+            if view is not None:
+                ok = self._copy_file_into(path, view, size)
+                del view
+                if not ok:
+                    self._arena.delete(obj_id.binary())
+                    return False
+                self._arena.seal(obj_id.binary())
+                # like put_parts: the create-ref IS the directory's
+                # reference, dropped only by delete()
+                restored = True
+        if not restored:
+            # arena create returning None can mean FULL or a LOST RACE to
+            # a concurrent restorer (duplicate id): re-check before paying
+            # for a duplicate file-segment copy of the whole object
+            if self._arena is not None and \
+                    self._arena.contains(obj_id.binary()):
+                return True
+            if os.path.exists(seg):
+                return True
+            part = seg + f".restore-{os.getpid()}"
+            try:
+                fd = os.open(part, os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
+            except OSError:
+                return False
+            try:
+                os.ftruncate(fd, size)
+                if size:
+                    mm = mmap.mmap(fd, size)
+                    ok = self._copy_file_into(path, mm, size)
+                    mm.close()
+                    if not ok:
+                        os.unlink(part)
+                        return False
+            finally:
+                os.close(fd)
+            os.rename(part, seg)
+            self._file_bytes += size
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        m = _store_metrics()
+        m["restored_bytes"].inc(size)
+        m["restored_objects"].inc()
+        return True
+
+    @staticmethod
+    def _copy_file_into(path: str, buf, size: int,
+                        chunk: int = 8 << 20) -> bool:
+        """Copy a spill file into a writable buffer in bounded chunks —
+        restoring a multi-GB object (the serve path runs this inside a
+        chunked peer pull) must never materialize it in this heap."""
+        off = 0
+        try:
+            with open(path, "rb") as f:
+                while off < size:
+                    data = f.read(min(chunk, size - off))
+                    if not data:
+                        return False  # truncated under us
+                    buf[off:off + len(data)] = data
+                    off += len(data)
+        except OSError:
+            return False
+        return off == size
+
     @staticmethod
     def cleanup_session(session: str) -> None:
         try:
@@ -441,7 +616,11 @@ class IncomingObject:
                 self._mm.close()
                 self._mm = None
             os.rename(self._path + ".part", self._path)
-            if not self._spilled:
+            if self._spilled:
+                m = _store_metrics()
+                m["spilled_bytes"].inc(self._size)
+                m["spilled_objects"].inc()
+            else:
                 self._store._file_bytes += self._size
 
     def abort(self) -> None:

@@ -411,9 +411,17 @@ class DriverRuntime:
         from ray_tpu.core.refqueue import DeferredDrops, OrderedCastFlusher
 
         self._cast_flusher = OrderedCastFlusher(self._send_pin_cast)
+        # store pins to drop once outside _ref_lock: when the driver's
+        # local refcount for an object hits zero, its store pin (taken by
+        # get()) must drop too, or a free()d object consumed with the
+        # get-then-free pattern stays kDeleting on the driver's reader ref
+        # forever (the worker-side twin lives in worker.py)
+        from collections import deque as _deque
+
+        self._local_pin_releases: "_deque" = _deque()
         self._deferred_unpins = DeferredDrops(
             self._ref_lock, lambda b: self._apply_pin_locked(b, -1),
-            self._flush_ref_casts)
+            self._after_ref_unpins)
         # outer object id -> ids of refs nested in its stored bytes, pinned
         # by THIS owner until the outer object is freed
         self._result_ref_pins: Dict[bytes, set] = {}
@@ -970,10 +978,10 @@ class DriverRuntime:
         elif op == "refpin":
             self.worker_ref_delta(ws, args[0], args[1])
         elif op == "free":
-            for b in args[0]:
-                oid = ObjectID(b)
-                self.gcs.drop_object(oid)
-                self.store.delete(oid)
+            # full free path (directory + store + CLUSTER publication):
+            # a worker-initiated free must reach holder nodes too, or the
+            # streaming reducers' frees leak remote copies cluster-wide
+            self.free(args[0])
 
     def _handle_req(self, ws: _WorkerState, req_id: int, op: str, args):
         def reply(payload, err: Optional[BaseException] = None):
@@ -1075,6 +1083,23 @@ class DriverRuntime:
             self._apply_pin_locked(oid_b, d)
         self._flush_ref_casts()
         self._drain_deferred_unpins()
+        self._drain_local_pin_releases()
+
+    def _after_ref_unpins(self) -> None:
+        """Post-drain hook of the deferred __del__ unpins."""
+        self._flush_ref_casts()
+        self._drain_local_pin_releases()
+
+    def _drain_local_pin_releases(self) -> None:
+        while True:
+            try:
+                b = self._local_pin_releases.popleft()
+            except IndexError:
+                return
+            try:
+                self.store.release(ObjectID(b))
+            except Exception:
+                pass
 
     def _apply_pin_locked(self, oid_b: bytes, d: int) -> None:
         before = self._pin_total.get(oid_b, 0)
@@ -1083,6 +1108,10 @@ class DriverRuntime:
             self._pin_total[oid_b] = after
         else:
             self._pin_total.pop(oid_b, None)
+            if before > 0:
+                # last local reference gone: queue the store-pin drop
+                # (executed outside _ref_lock; view-liveness guarded)
+                self._local_pin_releases.append(oid_b)
         # record the transition INSIDE the lock (pin/unpin casts must reach
         # the directory in transition order or a 1->0->1 race could leave a
         # live object unpinned remotely); the network cast itself happens
@@ -1149,6 +1178,7 @@ class DriverRuntime:
             time.sleep(2.0)
             try:
                 self._drain_deferred_unpins()
+                self._drain_local_pin_releases()
             except Exception:
                 pass
 
@@ -2265,14 +2295,32 @@ def get_actor(name: str, namespace: Optional[str] = None):
     return ActorHandle(ActorID(aid))
 
 
+def free(refs) -> None:
+    """Eagerly delete objects from the store + directory (reference
+    ``ray.internal.free`` role). For owners that KNOW an object is fully
+    consumed — the streaming exchange drops partition blocks this way so a
+    shuffle's intermediates never accumulate. Unlike dropping ObjectRefs,
+    this reclaims the segment immediately; lineage reconstruction of a
+    freed object is impossible, so never free values a consumer may still
+    fetch."""
+    if isinstance(refs, ObjectRef):
+        refs = [refs]
+    refs = list(refs)  # a generator must not be exhausted by validation
+    if not all(isinstance(r, ObjectRef) for r in refs):
+        raise TypeError("free() takes an ObjectRef or list of ObjectRefs")
+    if refs:
+        _get_runtime().free([r.id.binary() for r in refs])
+
+
 def object_store_memory() -> Dict[str, int]:
     """Local object-store usage (public API so libraries never reach into
-    store internals): {"used_bytes", "capacity_bytes"}."""
+    store internals): {"used_bytes", "capacity_bytes", "spilled_bytes"}."""
     from ray_tpu import config
 
     rt = _get_runtime()
     return {"used_bytes": int(rt.store.store_bytes()),
-            "capacity_bytes": int(config.get("store_capacity"))}
+            "capacity_bytes": int(config.get("store_capacity")),
+            "spilled_bytes": int(rt.store.spill_dir_bytes())}
 
 
 def available_resources() -> Dict[str, float]:

@@ -75,9 +75,14 @@ class WorkerRuntime:
 
         self._ref_casts = OrderedCastFlusher(
             lambda item: self.cast("refpin", item[0], item[1]))
+        # store pins to drop once outside _refs_lock (see
+        # _apply_ref_drop_locked); deque: append/popleft are atomic
+        from collections import deque as _deque
+
+        self._pending_pin_releases: "_deque" = _deque()
         self._deferred_ref_drops = DeferredDrops(
             self._refs_lock, self._apply_ref_drop_locked,
-            self._ref_casts.flush)
+            self._after_ref_drops)
         from ray_tpu.core import object_ref as _object_ref
 
         _object_ref.set_ref_hook(self._ref_added,
@@ -146,6 +151,23 @@ class WorkerRuntime:
             self._ref_counts.pop(b, None)
             if n == 0:
                 self._ref_casts.append((b, -1))
+                # local refcount hit zero: this process's store pin must
+                # drop too (release() keeps it if zero-copy views are
+                # still alive), or a free()d arena object stays kDeleting
+                # forever on our reader ref and its memory never returns
+                self._pending_pin_releases.append(b)
+
+    def _after_ref_drops(self) -> None:
+        self._ref_casts.flush()
+        while True:
+            try:
+                b = self._pending_pin_releases.popleft()
+            except IndexError:
+                return
+            try:
+                self.store.release(ObjectID(b))
+            except Exception:
+                pass
 
     def _drain_ref_drops(self) -> None:
         """Apply ref drops queued by ObjectRef.__del__ (which cannot lock)."""
@@ -330,6 +352,15 @@ class WorkerRuntime:
         return self.request("nodes")
 
     def free(self, ids: List[bytes]):
+        # the caller asserts the objects are fully consumed: drop OUR store
+        # pin first (view-liveness guarded), then let the driver delete —
+        # otherwise the arena entry waits on this process's reader ref,
+        # which leaks outright if this worker is killed before idle-drain
+        for b in ids:
+            try:
+                self.store.release(ObjectID(b))
+            except Exception:
+                pass
         self.cast("free", ids)
 
     # -- cooperative cancellation ----------------------------------------
@@ -682,6 +713,10 @@ class WorkerRuntime:
         tid_b = spec["task_id"]
         with self._running_lock:
             self._running_threads[tid_b] = threading.get_ident()
+        # computed BEFORE decoding (it reads only the encoded spec): a
+        # mid-decode failure must still release the pins the args decoded
+        # so far already took
+        arg_oids = ts.arg_refs(spec["args"], spec["kwargs"])
         try:
             # inside the try: a bad runtime_env (missing working_dir...)
             # must fail THIS task, not crash the worker process
@@ -766,6 +801,20 @@ class WorkerRuntime:
             self._send_error(spec, e)
         finally:
             undo_env()
+            # Drop the store pins _decode_arg's gets took: no ObjectRef
+            # tracks them, so without this a free()d arg object stays
+            # kDeleting on our reader ref and its arena memory never
+            # returns. The frame's own locals are view-holders — clear
+            # them first or the liveness guard below always fires.
+            # release() keeps the pin whenever OTHER live zero-copy views
+            # still reference the segment (baseline guard), so a
+            # task/actor that stashed a view of its arg stays safe.
+            args = kwargs = value = results = None  # noqa: F841
+            for _oid in arg_oids:
+                try:
+                    self.store.release(_oid)
+                except Exception:
+                    pass
             with self._running_lock:
                 self._running_threads.pop(tid_b, None)
                 # Absorb a cancel injected but not yet DELIVERED: a pending

@@ -14,7 +14,8 @@ from ray_tpu.data.execution import (ActorPoolStrategy,
                                     ConcurrencyCapBackpressurePolicy,
                                     ExecutionOptions,
                                     StoreMemoryBackpressurePolicy)
-from ray_tpu.data.optimizer import (DEFAULT_RULES, EliminateRedundantShuffles,
+from ray_tpu.data.optimizer import (CollapseRepartitionIntoShuffle,
+                                    DEFAULT_RULES, EliminateRedundantShuffles,
                                     FuseLimits, OperatorFusionRule, Optimizer,
                                     Rule, plan_summary)
 from ray_tpu.data.grouped import GroupedData
@@ -50,6 +51,7 @@ __all__ = [
     "DEFAULT_RULES",
     "OperatorFusionRule",
     "EliminateRedundantShuffles",
+    "CollapseRepartitionIntoShuffle",
     "FuseLimits",
     "plan_summary",
     "GroupedData",

@@ -37,9 +37,16 @@ from ray_tpu.data.execution import (
 
 
 class Dataset:
-    def __init__(self, source_refs: List[Any], ops: Optional[List[Any]] = None,
+    def __init__(self, source_refs: Any, ops: Optional[List[Any]] = None,
                  options: Optional[ExecutionOptions] = None):
-        self._source = list(source_refs)
+        # source: a list of block refs, OR a zero-arg callable returning an
+        # iterator of raw Blocks (lazy datasource, ``read_api`` ``lazy=``):
+        # lazy blocks are generated + put per execution and the streaming
+        # exchange frees them once consumed, so a dataset bigger than the
+        # object store can flow through a shuffle without ever being
+        # materialized up front
+        self._source = (source_refs if callable(source_refs)
+                        else list(source_refs))
         self._ops = list(ops or [])
         self._options = options or ExecutionOptions()
 
@@ -135,18 +142,26 @@ class Dataset:
 
         return self._with_op(MapOp(name="rename_columns", fn=_map))
 
-    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+    def random_shuffle(self, *, seed: Optional[int] = None,
+                       num_blocks: Optional[int] = None) -> "Dataset":
         return self._with_op(ShuffleOp("random_shuffle", "random_shuffle",
-                                       {"seed": seed}))
+                                       {"seed": seed,
+                                        "num_blocks": num_blocks}))
 
     def repartition(self, num_blocks: int) -> "Dataset":
         return self._with_op(ShuffleOp("repartition", "repartition",
                                        {"num_blocks": num_blocks}))
 
-    def sort(self, key: str, descending: bool = False) -> "Dataset":
+    def sort(self, key: str, descending: bool = False,
+             num_blocks: Optional[int] = None) -> "Dataset":
+        """Global sort. ``num_blocks`` sets the number of reduce
+        partitions (each streaming reducer materializes at most one
+        partition — more partitions = flatter per-worker memory for
+        out-of-core sorts); default one per input block."""
         return self._with_op(ShuffleOp("sort", "sort",
                                        {"key": key,
-                                        "descending": descending}))
+                                        "descending": descending,
+                                        "num_blocks": num_blocks}))
 
     def limit(self, n: int) -> "Dataset":
         return self._with_op(LimitOp("limit", n))
@@ -176,7 +191,9 @@ class Dataset:
     # -- execution --------------------------------------------------------
 
     def iter_block_refs(self) -> Iterator[Any]:
-        return execute_streaming(iter(self._source), self._ops, self._options)
+        source = (self._source() if callable(self._source)
+                  else iter(self._source))
+        return execute_streaming(source, self._ops, self._options)
 
     def iter_blocks(self) -> Iterator[Block]:
         for ref in self.iter_block_refs():
@@ -328,4 +345,6 @@ class Dataset:
 
     def __repr__(self):
         ops = " -> ".join(op.name for op in self._ops) or "source"
-        return f"Dataset({len(self._source)} source blocks, plan: {ops})"
+        src = ("lazy source" if callable(self._source)
+               else f"{len(self._source)} source blocks")
+        return f"Dataset({src}, plan: {ops})"

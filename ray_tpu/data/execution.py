@@ -237,7 +237,41 @@ def execute_streaming(
             stream = _run_limit(stream, op.limit)
         else:
             raise TypeError(f"unknown op {op!r}")
-    return flush_segment(stream, segment)
+    return _unmark_on_yield(flush_segment(stream, segment))
+
+
+def _unmark_on_yield(stream: Iterator[Any]) -> Iterator[Any]:
+    """Refs escaping to the caller lose executor ownership: a later plan
+    consuming them (e.g. sort over a materialized dataset) must never
+    free the user's blocks."""
+    for ref in stream:
+        unmark_ephemeral(ref)
+        yield ref
+
+
+#: ids of refs OWNED by the executor (raw source blocks it put itself):
+#: the streaming exchange may free these eagerly once consumed — user-held
+#: refs are never marked, and refs yielded back to the caller are unmarked
+#: first (see execute_streaming's final wrapper)
+_EPHEMERAL: set = set()
+
+
+def mark_ephemeral(ref) -> None:
+    if len(_EPHEMERAL) > 100_000:
+        # residue from abandoned plans (limit()/take() drop upstream
+        # generators with marked refs in flight). Dropping marks is SAFE —
+        # an unmarked block merely loses eager freeing and waits for
+        # ObjectRef GC — so a rare wholesale clear bounds the set.
+        _EPHEMERAL.clear()
+    _EPHEMERAL.add(ref.id.binary())
+
+
+def unmark_ephemeral(ref) -> None:
+    _EPHEMERAL.discard(ref.id.binary())
+
+
+def is_ephemeral(ref) -> bool:
+    return ref.id.binary() in _EPHEMERAL
 
 
 def _ensure_ref(x):
@@ -245,7 +279,11 @@ def _ensure_ref(x):
 
     if isinstance(x, ObjectRef):
         return x
-    return ray_tpu.put(x)
+    ref = ray_tpu.put(x)
+    # the caller handed a raw Block: the executor owns this ref and may
+    # reclaim it the moment the plan consumed it
+    mark_ephemeral(ref)
+    return ref
 
 
 # ---------------------------------------------------------------------------
@@ -370,6 +408,10 @@ class _OpState:
 
     def dispatch_one(self) -> None:
         ref = self.inq.popleft()
+        # consumed by a map stage: no exchange will ever see this ref, so
+        # retire its ownership mark (keeps _EPHEMERAL from growing with
+        # every intermediate block of map->map chains)
+        unmark_ephemeral(ref)
         self.inflight.append(_InFlight(self.dispatcher.dispatch(ref)))
 
     def poll(self) -> int:
@@ -387,6 +429,11 @@ class _OpState:
                     break
                 if ref is None:
                     break
+                # map outputs are executor-owned until they escape to the
+                # caller (execute_streaming unmarks at the final yield): a
+                # downstream exchange may free them the moment they're
+                # consumed
+                mark_ephemeral(ref)
                 f.buf.append(ref)
         moved = 0
         if self.options.preserve_order:
@@ -528,7 +575,10 @@ _LAST_TOPOLOGY_STATS: Dict[str, Any] = {}
 
 
 def _run_all_to_all(stream: Iterator[Any], op: AllToAllOp) -> Iterator[Any]:
-    blocks = [ray_tpu.get(r) for r in stream]
+    blocks = []
+    for r in stream:
+        unmark_ephemeral(r)  # consumed here, never by an exchange
+        blocks.append(ray_tpu.get(r))
     for out in op.fn(blocks):
         yield ray_tpu.put(out)
 
@@ -591,53 +641,41 @@ def _shuffle_reduce(kind: str, args: dict, red_idx: int,
 
 
 def _run_shuffle(stream: Iterator[Any], op: ShuffleOp) -> Iterator[Any]:
-    """Task-based exchange (reference all-to-all ops,
+    """Distributed exchange. Default: the streaming engine
+    (``data/streaming.py``) — bounded blocks-in-flight, reducer actors,
+    spill-absorbed memory pressure, no global barrier. The legacy one-shot
+    task exchange below remains behind ``RTPU_DATA_STREAMING_EXCHANGE=0``."""
+    from ray_tpu import config as _config
+
+    if _config.get("data_streaming_exchange"):
+        from ray_tpu.data.streaming import run_exchange
+
+        return run_exchange(op.kind, dict(op.args), stream)
+    return _run_shuffle_tasks(stream, op)
+
+
+def _run_shuffle_tasks(stream: Iterator[Any], op: ShuffleOp) -> Iterator[Any]:
+    """Legacy task-based exchange (reference all-to-all ops,
     ``_internal/planner/exchange/``): a barrier on block REFS only — the
     driver orchestrates tasks and never materializes block bytes
     (VERDICT r3 #5; the old path pulled the whole dataset into the
-    driver)."""
+    driver) — but every partition block exists in the store at once, so
+    it cannot exceed store+spill capacity headroom the way the streaming
+    engine can."""
     refs = list(stream)
+    for r in refs:
+        unmark_ephemeral(r)  # consumed here; this path never frees
     if not refs:
         return
     args = dict(op.args)
     n_red = int(args.get("num_blocks") or len(refs))
 
     if op.kind == "sort":
-        key, desc = args["key"], bool(args.get("descending"))
-
-        @ray_tpu.remote
-        def _sample(block, k=key):
-            vals = block[k]
-            if len(vals) == 0:
-                return np.asarray([])
-            take = min(32, len(vals))
-            idx = np.linspace(0, len(vals) - 1, take).astype(np.int64)
-            return np.sort(vals)[idx]
-
-        samples = np.concatenate(
-            [np.asarray(s) for s in
-             ray_tpu.get([_sample.remote(r) for r in refs])] or
-            [np.asarray([])])
-        if len(samples) == 0:
-            bounds = np.asarray([])
-        else:
-            # index-based boundary selection (not np.quantile): works for
-            # any sortable dtype, strings included
-            ss = np.sort(samples)
-            idxs = (np.linspace(0, 1, n_red + 1)[1:-1]
-                    * (len(ss) - 1)).astype(np.int64)
-            bounds = ss[idxs]
-        args["boundaries"] = bounds
-        args["descending"] = desc
+        args.update(sample_sort_boundaries(refs, args["key"],
+                                           bool(args.get("descending")),
+                                           n_red))
     elif op.kind == "repartition":
-        @ray_tpu.remote
-        def _count(block):
-            return block_num_rows(block)
-
-        counts = ray_tpu.get([_count.remote(r) for r in refs])
-        total = int(sum(counts))
-        args["target_size"] = max(1, (total + n_red - 1) // n_red)
-        offsets = np.concatenate([[0], np.cumsum(counts)[:-1]])
+        args["target_size"], offsets = repartition_layout(refs, n_red)
 
     if n_red > 1:
         part_task = ray_tpu.remote(num_returns=n_red)(_shuffle_partition)
@@ -657,6 +695,52 @@ def _run_shuffle(stream: Iterator[Any], op: ShuffleOp) -> Iterator[Any]:
     for j in range(n_red):
         yield reduce_task.remote(op.kind, args, j,
                                  *[parts[i][j] for i in range(len(parts))])
+
+
+def sample_sort_boundaries(refs: List[Any], key: str, descending: bool,
+                           n_red: int) -> Dict[str, Any]:
+    """Sample per-block quantiles and derive reducer key boundaries
+    (index-based selection, not np.quantile: works for any sortable
+    dtype, strings included). Barrier on refs only; shared by the
+    streaming engine and the legacy exchange so the two paths can never
+    diverge on boundary math."""
+    @ray_tpu.remote
+    def _sample(block, k=key):
+        vals = block[k]
+        if len(vals) == 0:
+            return np.asarray([])
+        take = min(32, len(vals))
+        idx = np.linspace(0, len(vals) - 1, take).astype(np.int64)
+        return np.sort(vals)[idx]
+
+    samples = np.concatenate(
+        [np.asarray(s) for s in
+         ray_tpu.get([_sample.remote(r) for r in refs])] or
+        [np.asarray([])])
+    if len(samples) == 0:
+        bounds = np.asarray([])
+    else:
+        ss = np.sort(samples)
+        idxs = (np.linspace(0, 1, n_red + 1)[1:-1]
+                * (len(ss) - 1)).astype(np.int64)
+        bounds = ss[idxs]
+    return {"boundaries": bounds, "descending": descending}
+
+
+def repartition_layout(refs: List[Any], n_red: int):
+    """(target_size, per-block global row offsets) for an equal-range
+    repartition — shared by both exchange paths."""
+    @ray_tpu.remote
+    def _count(block):
+        return block_num_rows(block)
+
+    counts = ray_tpu.get([_count.remote(r) for r in refs])
+    total = int(sum(counts))
+    target_size = max(1, (total + n_red - 1) // n_red)
+    offsets = (list(np.concatenate(
+        [[0], np.cumsum(counts)[:-1]]).astype(np.int64))
+        if counts else [])
+    return target_size, offsets
 
 
 # ---------------------------------------------------------------------------

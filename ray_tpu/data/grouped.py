@@ -87,6 +87,57 @@ class GroupedData:
         self._dataset = dataset
         self._key = key
 
+    @staticmethod
+    def _streaming() -> bool:
+        from ray_tpu import config
+
+        return bool(config.get("data_streaming_exchange"))
+
+    # -- streaming path (data/streaming.py engine) ------------------------
+
+    def _agg_rows(self, kind: str, args: dict):
+        """Run a streaming groupby exchange; only the (small) aggregated
+        rows ever return to the driver."""
+        from ray_tpu.data.block import block_from_rows
+        from ray_tpu.data.dataset import Dataset
+        from ray_tpu.data.streaming import run_exchange
+
+        rows: List[Dict[str, Any]] = []
+        for ref in run_exchange(kind, args,
+                                self._dataset.iter_block_refs()):
+            rows.extend(ray_tpu.get(ref))
+        rows.sort(key=lambda r: r[self._key])
+        return Dataset([ray_tpu.put(block_from_rows(rows))])
+
+    def _agg_specs(self, specs: List[tuple]):
+        """Built-in aggregations as COMBINABLE (op, col, out_name) specs:
+        the streaming reducer folds them into per-key accumulators, so the
+        aggregation runs in O(distinct keys) memory at any dataset size."""
+        if self._streaming():
+            return self._agg_rows("groupby_agg",
+                                  {"key": self._key, "specs": specs})
+
+        def cols_fn(k, b, _specs=tuple(specs)):
+            out = {}
+            for op, col, name in _specs:
+                if op == "count":
+                    out[name] = block_num_rows(b)
+                elif op == "sum":
+                    out[name] = float(b[col].sum())
+                elif op == "mean":
+                    out[name] = float(b[col].mean())
+                elif op == "min":
+                    out[name] = float(b[col].min())
+                elif op == "max":
+                    out[name] = float(b[col].max())
+                elif op == "std":
+                    out[name] = float(b[col].std())
+            return out
+
+        return self._agg(cols_fn)
+
+    # -- legacy one-shot exchange (RTPU_DATA_STREAMING_EXCHANGE=0) --------
+
     def _exchange(self, reduce_fn, blob: bytes) -> List[Any]:
         """Hash-partition the dataset's blocks and run one reduce task per
         partition; returns the reduce tasks' result refs."""
@@ -119,30 +170,48 @@ class GroupedData:
         return Dataset([ray_tpu.put(block_from_rows(rows))])
 
     def count(self):
-        return self._agg(lambda k, b: {"count()": block_num_rows(b)})
+        return self._agg_specs([("count", None, "count()")])
 
     def sum(self, col: str):
-        return self._agg(lambda k, b: {f"sum({col})": float(b[col].sum())})
+        return self._agg_specs([("sum", col, f"sum({col})")])
 
     def mean(self, col: str):
-        return self._agg(lambda k, b: {f"mean({col})": float(b[col].mean())})
+        return self._agg_specs([("mean", col, f"mean({col})")])
 
     def min(self, col: str):
-        return self._agg(lambda k, b: {f"min({col})": float(b[col].min())})
+        return self._agg_specs([("min", col, f"min({col})")])
 
     def max(self, col: str):
-        return self._agg(lambda k, b: {f"max({col})": float(b[col].max())})
+        return self._agg_specs([("max", col, f"max({col})")])
 
     def std(self, col: str):
-        return self._agg(lambda k, b: {f"std({col})": float(b[col].std())})
+        return self._agg_specs([("std", col, f"std({col})")])
 
     def aggregate(self, name: str, fn: Callable[[Block], Any]):
+        """Arbitrary per-group aggregation — not combinable, so the
+        streaming reducer materializes each hash partition (only its own)
+        at finish."""
+        if self._streaming():
+            import cloudpickle as _cp
+
+            blob = _cp.dumps(lambda k, b, _fn=fn, _n=name: {_n: _fn(b)})
+            return self._agg_rows("groupby_fn",
+                                  {"key": self._key, "cols_fn_blob": blob})
         return self._agg(lambda k, b: {name: fn(b)})
 
     def map_groups(self, fn: Callable[[Block], Block]):
         import cloudpickle as _cp
 
         from ray_tpu.data.dataset import Dataset
+
+        if self._streaming():
+            from ray_tpu.data.streaming import run_exchange
+
+            refs = list(run_exchange(
+                "groupby_groups",
+                {"key": self._key, "fn_blob": _cp.dumps(fn)},
+                self._dataset.iter_block_refs()))
+            return Dataset(refs)
 
         out = self._exchange(_reduce_map_groups, _cp.dumps(fn))
 

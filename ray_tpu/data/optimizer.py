@@ -15,6 +15,9 @@ Built-in rules:
   random_shuffle, or repartition followed by repartition. Mixed kinds
   never collapse (a repartition is order-preserving and cannot stand in
   for a shuffle; block counts differ the other way);
+- :class:`CollapseRepartitionIntoShuffle` — repartition followed by an
+  UNSEEDED random_shuffle becomes one shuffle carrying the repartition's
+  block count (the shuffle redistributes every row anyway);
 - :class:`FuseLimits` — consecutive limits collapse to the minimum;
 - :class:`OperatorFusionRule` — consecutive task-compute MapOps fuse into
   one stage (``fuse_ops``).
@@ -77,6 +80,33 @@ class EliminateRedundantShuffles(Rule):
         return out
 
 
+class CollapseRepartitionIntoShuffle(Rule):
+    """``repartition(n)`` immediately followed by an UNSEEDED
+    ``random_shuffle`` collapses to ``random_shuffle(num_blocks=n)``: the
+    shuffle redistributes every row anyway, so the order-preserving
+    repartition pass is pure wasted work — one full-data exchange instead
+    of two. The repartition's block count survives as the shuffle's
+    ``num_blocks`` (unless the shuffle already pins its own). SEEDED
+    shuffles never collapse: their deterministic output depends on the
+    exact input block boundaries the repartition would have produced."""
+
+    def apply(self, plan: List[LogicalOp]) -> List[LogicalOp]:
+        out: List[LogicalOp] = []
+        for op in plan:
+            prev = out[-1] if out else None
+            if (isinstance(op, ShuffleOp) and op.kind == "random_shuffle"
+                    and op.args.get("seed") is None
+                    and isinstance(prev, ShuffleOp)
+                    and prev.kind == "repartition"):
+                args = dict(op.args)
+                if not args.get("num_blocks"):
+                    args["num_blocks"] = prev.args.get("num_blocks")
+                out[-1] = ShuffleOp(op.name, "random_shuffle", args)
+            else:
+                out.append(op)
+        return out
+
+
 class FuseLimits(Rule):
     def apply(self, plan: List[LogicalOp]) -> List[LogicalOp]:
         out: List[LogicalOp] = []
@@ -92,6 +122,7 @@ class FuseLimits(Rule):
 
 DEFAULT_RULES: List[Rule] = [
     EliminateRedundantShuffles(),
+    CollapseRepartitionIntoShuffle(),
     FuseLimits(),
     OperatorFusionRule(),
 ]

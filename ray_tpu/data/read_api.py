@@ -50,12 +50,27 @@ def _file_dataset(files: List[str], parse) -> Dataset:
 
 # -- in-memory sources ------------------------------------------------------
 
-def range(n: int, *, parallelism: int = 8) -> Dataset:  # noqa: A001
+def range(n: int, *, parallelism: int = 8,  # noqa: A001
+          lazy: bool = False) -> Dataset:
+    """Integer range dataset. ``lazy=True`` defers block creation to
+    execution time: blocks are generated + put as the plan pulls them and
+    the streaming exchange frees each one once consumed, so a range far
+    larger than the object store can flow through a sort/shuffle without
+    ever being materialized at once (map-only plans still retain their
+    output blocks — only exchanges reclaim eagerly)."""
     parallelism = max(1, min(parallelism, n or 1))
     size = (n + parallelism - 1) // parallelism
-    blocks = [{"id": np.arange(i, min(i + size, n), dtype=np.int64)}
-              for i in builtins.range(0, n, size)] if n else [{}]
-    return Dataset([ray_tpu.put(b) for b in blocks])
+
+    def gen():
+        if not n:
+            yield {}
+            return
+        for i in builtins.range(0, n, size):
+            yield {"id": np.arange(i, min(i + size, n), dtype=np.int64)}
+
+    if lazy:
+        return Dataset(gen)
+    return Dataset([ray_tpu.put(b) for b in gen()])
 
 
 def range_tensor(n: int, *, shape=(1,), parallelism: int = 8) -> Dataset:
@@ -70,13 +85,20 @@ def range_tensor(n: int, *, shape=(1,), parallelism: int = 8) -> Dataset:
     return Dataset([ray_tpu.put(b) for b in (blocks or [{}])])
 
 
-def from_items(items: List[Any], *, parallelism: int = 8) -> Dataset:
+def from_items(items: List[Any], *, parallelism: int = 8,
+               lazy: bool = False) -> Dataset:
     n = len(items)
     parallelism = max(1, min(parallelism, n or 1))
     size = (n + parallelism - 1) // parallelism
-    blocks = [block_from_rows(items[i:i + size])
-              for i in builtins.range(0, n, size)]
-    return Dataset([ray_tpu.put(b) for b in (blocks or [{}])])
+
+    def gen():
+        blocks = [block_from_rows(items[i:i + size])
+                  for i in builtins.range(0, n, size)]
+        yield from (blocks or [{}])
+
+    if lazy:
+        return Dataset(gen)
+    return Dataset([ray_tpu.put(b) for b in gen()])
 
 
 def from_numpy(arr: np.ndarray, *, column: str = "data",

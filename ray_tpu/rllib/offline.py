@@ -79,12 +79,28 @@ class OfflineReader:
             rows = idx[start:start + batch_size]
             yield {k: v[rows] for k, v in data.items()}
 
+    def _refresh_shards(self) -> None:
+        """Re-list the directory: a writer appending shards between epochs
+        (online data collection interleaved with training) must become
+        visible to the next read."""
+        shards = sorted(
+            os.path.join(self.path, f) for f in os.listdir(self.path)
+            if f.endswith(".npz"))
+        if shards:
+            self.shards = shards
+
     def _sequence_windows(self, seq_len: int) -> list:
         """Build (and cache) the [T, ...] sequence windows for
         :meth:`iter_sequences` — the expensive part, independent of the
-        shuffle seed, so repeated epochs don't re-read the shards."""
+        shuffle seed, so repeated epochs don't re-read the shards.
+
+        The cache is keyed on (seq_len, shard list): shards appended after
+        the first epoch invalidate it instead of being silently ignored
+        (ADVICE r5 — the old key was seq_len alone)."""
+        self._refresh_shards()
+        fingerprint = (seq_len, tuple(self.shards))
         cache = getattr(self, "_window_cache", None)
-        if cache is not None and cache[0] == seq_len:
+        if cache is not None and cache[0] == fingerprint:
             return cache[1]
         data = self.read_all()
         dones = data["dones"].astype(bool)
@@ -129,7 +145,7 @@ class OfflineReader:
         if not windows:
             raise ValueError(
                 f"no episode yields a full {seq_len}-step window")
-        self._window_cache = (seq_len, windows)
+        self._window_cache = (fingerprint, windows)
         return windows
 
     def iter_sequences(self, seq_len: int, batch_size: int, *,

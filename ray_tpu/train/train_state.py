@@ -126,6 +126,12 @@ def _compiler_options() -> Optional[Dict[str, str]]:
         if not key or not val:
             raise ValueError(
                 f"xla_compiler_options entry {tok!r} is not k=v")
+        # Quoted values opt OUT of type coercion: string-typed XLA options
+        # whose value LOOKS numeric/bool (k='123') stay strings — the
+        # coercion below would otherwise make them unexpressible
+        if len(val) >= 2 and val[0] == val[-1] and val[0] in "\"'":
+            out[key] = val[1:-1]
+            continue
         # XLA's option setter wants typed values (a literal "true" is
         # rejected as "not a valid bool value"; same for int/float
         # fields fed strings)

@@ -32,6 +32,11 @@ except (AttributeError, ValueError):
     pass
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running capacity/stress tests")
+
+
 @pytest.fixture
 def rt():
     import ray_tpu

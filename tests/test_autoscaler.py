@@ -445,6 +445,35 @@ def test_v2_scheduler_bin_packing_and_infeasible():
     assert len(dec.terminations) == 2
 
 
+def test_v2_scheduler_packs_against_available_capacity():
+    """ADVICE r5: pending demand must bin-pack against each node's
+    AVAILABLE resources, not its full declared resources — a saturated
+    cluster otherwise absorbs every bundle on paper and never scales up."""
+    from ray_tpu.autoscaler.v2 import (Instance, NodeTypeSpec, RAY_RUNNING,
+                                       ResourceDemandScheduler)
+
+    types = [NodeTypeSpec("cpu", {"CPU": 4.0}, max_workers=4)]
+    sched = ResourceDemandScheduler(types)
+    insts = {"i1": Instance("i1", "cpu", status=RAY_RUNNING)}
+
+    # saturated node (0 CPU free): the bundle needs a NEW node
+    dec = sched.schedule([{"CPU": 4.0}], insts, set(),
+                         available={"i1": {"CPU": 0.0}})
+    assert dec.launches == {"cpu": 1}, dec.launches
+    assert not dec.packing
+
+    # partially free node: small bundle packs, big bundle launches
+    dec = sched.schedule([{"CPU": 2.0}, {"CPU": 4.0}], insts, set(),
+                         available={"i1": {"CPU": 2.0}})
+    assert dec.packing.get("i1") == 1
+    assert dec.launches == {"cpu": 1}
+
+    # no availability info (pre-RAY_RUNNING instances): full declared
+    # resources remain the seed — launches stay idempotent
+    dec = sched.schedule([{"CPU": 4.0}], insts, set())
+    assert dec.launches == {} and dec.packing.get("i1") == 1
+
+
 def test_v2_autoscaler_end_to_end_converges():
     """AutoscalerV2: demand -> scheduler -> InstanceManager -> provider,
     idle scale-down after timeout, crash-resume from the instance table."""

@@ -114,6 +114,75 @@ def test_cross_handle_visibility():
         NativeArena.destroy(session)
 
 
+def test_spill_restore_roundtrip(monkeypatch):
+    """Spilled objects are restorable back into shm once headroom exists
+    (ISSUE r6 / VERDICT missing #4): refused while the store is still
+    over threshold, promoted (and the spill file removed) after."""
+    monkeypatch.setenv("RTPU_NATIVE_STORE", "0")
+    monkeypatch.setenv("RTPU_SPILL_THRESHOLD", str(1 << 20))
+    session = uuid.uuid4().hex[:12]
+    client = StoreClient(session)
+    try:
+        resident = ObjectID.from_random()
+        spilly = ObjectID.from_random()
+        v1 = np.arange(100_000, dtype=np.float64)   # ~800 KB -> shm
+        v2 = np.arange(50_000, dtype=np.float64)    # ~400 KB -> spills
+        client.put(resident, v1)
+        assert not client.contains_spilled(resident)
+        client.put(spilly, v2)
+        assert client.contains_spilled(spilly)
+        assert client.spill_dir_bytes() > v2.nbytes
+
+        # reads + chunked reads serve straight from the spill file
+        raw = client.get_raw(spilly)
+        assert raw is not None
+        assert client.get_raw_chunk(spilly, 0, 64) == raw[:64]
+
+        # no shm headroom yet: restore refuses, the file stays
+        assert not client.restore_spilled(spilly)
+        assert client.contains_spilled(spilly)
+
+        client.delete(resident)                     # headroom appears
+        assert client.restore_spilled(spilly)
+        assert not client.contains_spilled(spilly)
+        assert client.spill_dir_bytes() == 0
+        np.testing.assert_array_equal(client.get(spilly), v2)
+        # restore is idempotent once resident
+        assert client.restore_spilled(spilly)
+    finally:
+        StoreClient.cleanup_session(session)
+
+
+def test_spill_restore_through_arena(monkeypatch):
+    """With the native arena as the backend, restore lands the object in
+    the arena (create/seal) and a local get reads it zero-copy."""
+    monkeypatch.setenv("RTPU_SPILL_THRESHOLD", str(1 << 20))
+    session = uuid.uuid4().hex[:12]
+    # tiny arena so the first put overflows it into file segments
+    monkeypatch.setenv("RTPU_STORE_CAPACITY", str(1 << 20))
+    client = StoreClient(session)
+    if client._arena is None:
+        pytest.skip("arena unavailable")
+    try:
+        a = ObjectID.from_random()
+        b = ObjectID.from_random()
+        client.put(a, np.arange(110_000, dtype=np.float64))  # overflows
+        client.put(b, np.arange(60_000, dtype=np.float64))
+        # one of the two crossed the threshold into the spill dir
+        spilled = [o for o in (a, b) if client.contains_spilled(o)]
+        assert spilled
+        target = spilled[0]
+        client.delete(a if target == b else b)
+        assert client.restore_spilled(target)
+        assert not client.contains_spilled(target)
+        got = client.get(target)
+        assert got[1] == 1.0
+        del got
+        client.release(target)
+    finally:
+        StoreClient.cleanup_session(session)
+
+
 def test_store_client_uses_arena_for_big_objects():
     session = uuid.uuid4().hex[:12]
     client = StoreClient(session)

@@ -513,6 +513,42 @@ def test_dreamerv3_actor_learns_from_imagination():
     assert rate > 0.8, f"greedy hit rate {rate:.2f} (random 0.25): {m}"
 
 
+def test_sequence_window_cache_sees_appended_shards(tmp_path):
+    """ADVICE r5: the window cache was keyed on seq_len alone, so shards
+    appended after the first epoch were silently ignored. The key now
+    fingerprints the shard list and the reader re-lists the directory."""
+    from ray_tpu.rllib.offline import OfflineReader, OfflineWriter
+
+    path = str(tmp_path / "shards")
+
+    def episode(n, base):
+        return {
+            "obs": np.full((n, 2), base, np.float32),
+            "next_obs": np.full((n, 2), base + 1, np.float32),
+            "actions": np.zeros(n, np.int64),
+            "rewards": np.ones(n, np.float32),
+            "dones": np.eye(1, n, n - 1, dtype=bool)[0],
+            "terminateds": np.eye(1, n, n - 1, dtype=bool)[0],
+        }
+
+    w = OfflineWriter(path)
+    w.write(episode(8, 0.0))
+    w.flush()
+
+    reader = OfflineReader(path)
+    first = reader._sequence_windows(4)
+    assert len(first) == 2  # 9 replay steps -> two non-overlapping windows
+    assert reader._sequence_windows(4) is first  # cache hit, same shards
+
+    # a second epoch of collection lands a new shard in the same dir
+    w.write(episode(8, 10.0))
+    w.flush()
+    second = reader._sequence_windows(4)
+    assert len(second) == 4, "appended shard silently ignored"
+    # and the refreshed cache is stable again
+    assert reader._sequence_windows(4) is second
+
+
 def test_dreamerv3_offline_pipeline(tmp_path):
     """train_dreamerv3 over recorded single-env shards: sequence windows
     respect episode boundaries + the Dreamer replay shift, and the world

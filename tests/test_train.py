@@ -285,6 +285,12 @@ def test_xla_compiler_options_knob(monkeypatch):
     assert _compiler_options() == {
         "xla_llvm_disable_expensive_passes": True, "a": 1, "b": 2}
 
+    # quoted values opt out of coercion: string-typed options whose value
+    # looks numeric/bool stay strings (ADVICE r5)
+    monkeypatch.setenv("RTPU_XLA_COMPILER_OPTIONS",
+                       "a='123' b=\"true\" c=123")
+    assert _compiler_options() == {"a": "123", "b": "true", "c": 123}
+
     monkeypatch.setenv("RTPU_XLA_COMPILER_OPTIONS", "not-kv")
     with pytest.raises(ValueError):
         _compiler_options()
