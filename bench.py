@@ -650,7 +650,7 @@ def _measure(jax, on_tpu: bool, batch_size: int = 16) -> dict:
 
     t0 = time.perf_counter()
     metrics = helper.run_steps(batch, iters)
-    float(jax.device_get(metrics["loss"]))
+    loss = float(jax.device_get(metrics["loss"]))
     dt = (time.perf_counter() - t0) / iters
 
     tokens_per_step = batch_size * seq
@@ -661,6 +661,19 @@ def _measure(jax, on_tpu: bool, batch_size: int = 16) -> dict:
     model_flops = flops_token * tokens_per_sec
     peak = peak_flops_per_chip() * n_dev if on_tpu else float("nan")
     mfu = model_flops / peak if on_tpu else 0.0
+
+    # self-reporting perf trajectory: the measured step lands in the
+    # train-telemetry metrics (HBM gauges included on-chip) and its
+    # snapshot rides the bench JSON
+    try:
+        from ray_tpu.train import telemetry
+
+        telemetry.record_step(dt, tokens=tokens_per_step,
+                              mfu=(mfu if on_tpu else None),
+                              loss=loss, steps=iters)
+        tele = telemetry.snapshot()
+    except Exception:
+        tele = None
 
     return {
         "metric": "llama_train_mfu" if on_tpu else "llama_train_tokens_per_sec_cpu",
@@ -677,7 +690,8 @@ def _measure(jax, on_tpu: bool, batch_size: int = 16) -> dict:
             "device_kind": getattr(jax.devices()[0], "device_kind", "unknown"),
             "timing_mode": ("scanned n-step program, single dependent "
                             "device_get (tunnel-safe)"),
-            "loss": float(jax.device_get(metrics["loss"])),
+            "loss": loss,
+            "telemetry": tele,
         },
     }
 

@@ -205,8 +205,14 @@ class ClusterAdapter:
                 # windows are noise
                 beat += 1
                 stats = host_stats() if beat % 4 == 1 else None
+                # metrics federation rides the same ~2s beats: this
+                # process's registry plus its workers' ingested samples,
+                # as a full (small) snapshot the GCS replaces per node —
+                # idempotent, so a dropped heartbeat self-heals
+                mpayload = (self._metrics_payload()
+                            if beat % 4 == 1 else None)
                 known = self.gcs.call("node_heartbeat", self.node_id, avail,
-                                      depth, stats, timeout=5)
+                                      depth, stats, mpayload, timeout=5)
                 if known is False:
                     # a restarted GCS lost the (non-durable) node table:
                     # re-register + re-subscribe (GCS FT path)
@@ -226,6 +232,26 @@ class ClusterAdapter:
                         self._task_ev_cursor = cur + len(batch)
             except Exception:
                 pass
+
+    def _metrics_payload(self):
+        """[(origin_labels, records)] for this node: the local registry
+        (driver/daemon process) plus every federated origin it ingested
+        (its workers). None when federation is disabled or empty."""
+        try:
+            if not config.get("metrics_federation"):
+                return None
+            from ray_tpu.util import metrics as _metrics
+
+            labels = {"node_id": self.node_id.hex()[:8],
+                      "component": ("driver" if self.is_scheduler
+                                    else "raylet")}
+            recs = _metrics.registry_records()
+            origins = _metrics.federation.export()
+            if not origins and not any(r["samples"] for r in recs):
+                return None  # nothing recorded anywhere yet: skip the ride
+            return [(labels, recs)] + origins
+        except Exception:
+            return None
 
     def _register(self):
         self.gcs.call("subscribe", "nodes", timeout=10)

@@ -95,6 +95,11 @@ class GcsService:
         # per-node high-water mark of received task-event sequence numbers
         # (dedup for cursor rewinds after node re-registration)
         self._task_ev_seq: Dict[bytes, int] = {}
+        # metrics federation: latest [(origin_labels, records)] payload per
+        # node, replaced wholesale on each carrying heartbeat (idempotent;
+        # reference metrics-agent -> head pipeline role). Head /metrics
+        # pulls via rpc_metrics_get at scrape time.
+        self._node_metrics: Dict[bytes, list] = {}
         self.kv: Dict[str, Dict[str, bytes]] = {}
         self.functions: Dict[str, bytes] = {}
         # named/global actor registry: actor_id -> record dict
@@ -182,11 +187,14 @@ class GcsService:
 
     def rpc_node_heartbeat(self, ctx, node_id: bytes,
                            avail: Dict[str, float], queue_depth: int,
-                           stats: Optional[Dict] = None):
+                           stats: Optional[Dict] = None,
+                           metrics: Optional[list] = None):
         with self.lock:
             ent = self.nodes.get(node_id)
             if ent is None:
                 return False
+            if metrics is not None:
+                self._node_metrics[node_id] = metrics
             changed = ent.avail != avail
             ent.avail = dict(avail)
             if stats:
@@ -234,6 +242,10 @@ class GcsService:
             if ent is None or not ent.alive:
                 return
             ent.alive = False
+            # stop serving the dead node's frozen metric samples (a
+            # reconnecting node reships a full snapshot on its next
+            # carrying heartbeat, so nothing is lost on a blip)
+            self._node_metrics.pop(node_id, None)
             # _task_ev_seq is deliberately NOT popped here: a node marked
             # dead by a connection blip keeps its node_id, reconnects, and
             # reships history from seq 0 — the high-water mark is what
@@ -475,6 +487,19 @@ class GcsService:
         with self.lock:
             evs = list(self.task_events)
         return evs[-limit:]
+
+    def rpc_metrics_get(self, ctx, exclude_node: Optional[bytes] = None):
+        """Flattened [(origin_labels, records)] across nodes for the head
+        /metrics exposition. ``exclude_node``: the caller's own node id —
+        its samples are already rendered locally (its registry and its
+        workers' federation store live in-process)."""
+        out = []
+        with self.lock:
+            for nid, payload in self._node_metrics.items():
+                if nid == exclude_node:
+                    continue
+                out.extend(payload)
+        return out
 
     def rpc_obj_info(self, ctx, oids):
         """Batch (size, locations) for READY segment objects — the

@@ -15,7 +15,9 @@ fidelity.
 from __future__ import annotations
 
 import argparse
+import faulthandler
 import json
+import os
 import signal
 import sys
 import time
@@ -48,8 +50,21 @@ def main(argv=None):
                              is_scheduler=False,
                              listen_host=args.listen_host)
     adapter.attach(rt)
+    # `kill -USR1 <daemon pid>` dumps every thread's stack — into the
+    # session's log dir, NOT the daemon's stdout (spawners routinely point
+    # that at /dev/null, which used to lose daemon dumps and blind
+    # hung-cluster debugging; workers/pytest already log theirs).
+    dump_path = os.path.join(rt.session_dir, "logs",
+                             f"daemon-{rt.node_id.hex()[:8]}.log")
+    try:
+        dump_file = open(dump_path, "a")  # held open for process lifetime
+        faulthandler.register(signal.SIGUSR1, file=dump_file,
+                              all_threads=True)
+    except (AttributeError, ValueError, OSError):
+        dump_path = "(unavailable)"
     print(f"node daemon {rt.node_id.hex()[:8]} serving on "
-          f"{adapter.server.addr} (gcs {args.gcs})", flush=True)
+          f"{adapter.server.addr} (gcs {args.gcs}); "
+          f"USR1 stack dumps -> {dump_path}", flush=True)
 
     stop = []
 

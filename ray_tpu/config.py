@@ -143,6 +143,23 @@ _knob("attn_impl", str, "",
       "force the attention kernel: pallas | xla | naive (empty = auto)",
       "ops/attention.py")
 
+# -- observability ----------------------------------------------------------
+_knob("metrics_federation", _bool, True,
+      "federate per-process metric registries to the head /metrics "
+      "endpoint (workers push deltas over the control pipe; nodes ride "
+      "the GCS heartbeat)", "util/metrics.py")
+_knob("metrics_push_interval_s", float, 2.0,
+      "min seconds between a worker's batched metric-delta pushes over "
+      "the control pipe (<= 0 disables the push)", "core/worker.py")
+_knob("flight_recorder", _bool, True,
+      "record per-task lifecycle phases (worker-side timing, driver "
+      "histograms/ring, nested timeline slices); off = zero per-task "
+      "telemetry cost", "core/runtime.py")
+_knob("task_ring", int, 2048,
+      "recent task lifecycle records kept in the driver's flight-recorder "
+      "ring (feeds state.summarize_tasks per-phase percentiles)",
+      "core/runtime.py")
+
 # -- serve ------------------------------------------------------------------
 _knob("serve_max_body", int, 64 << 20,
       "max HTTP request body bytes accepted by the serve proxy",

@@ -367,6 +367,18 @@ class DriverRuntime:
         self._pg_staged: Dict[bytes, dict] = {}
         self.timeline_events: List[dict] = []
         self._task_start_ts: Dict[bytes, float] = {}
+        # Task-lifecycle flight recorder (reference task_event_buffer.h
+        # role): bounded ring of per-task phase timings feeding
+        # state.summarize_tasks percentiles; built-in phase histograms are
+        # created lazily (first finished task), with pre-sorted tag keys so
+        # the per-task observe cost stays a few microseconds.
+        self.task_ring: deque = deque(maxlen=int(config.get("task_ring")))
+        self._flight_enabled = bool(config.get("flight_recorder"))
+        self._phase_hist = None
+        self._phase_keys: Dict[str, tuple] = {}
+        self._status_keys = {False: (("status", "ok"),),
+                             True: (("status", "error"),)}
+        self._finished_counter = None
         self.pool_cap = max(4, cpus)
         self.pool_hard_cap = max(64, cpus * 8)
         self._spawning = 0  # spawns decided but not yet registered
@@ -448,7 +460,12 @@ class DriverRuntime:
         self._sock_addr = os.path.join(self.session_dir, "driver.sock")
         from multiprocessing.connection import Listener
 
-        self._listener = Listener(self._sock_addr, family="AF_UNIX", authkey=self.session.encode())
+        # backlog: the default of 1 makes a 16-actor burst race the serial
+        # accept loop — unix sockets return EAGAIN (not block) on a full
+        # backlog, crashing the connecting worker (workers also retry)
+        self._listener = Listener(self._sock_addr, family="AF_UNIX",
+                                  backlog=64,
+                                  authkey=self.session.encode())
         threading.Thread(target=self._accept_loop, daemon=True).start()
 
         self._zygote_obj = None
@@ -829,13 +846,15 @@ class DriverRuntime:
             else:
                 self._pump()
         elif kind == "done":
-            self._handle_done(ws, msg[1], msg[2])
+            self._handle_done(ws, msg[1], msg[2],
+                              msg[3] if len(msg) > 3 else None)
         elif kind == "cast":
             self._handle_cast(ws, msg[1], msg[2])
         elif kind == "req":
             self._handle_req(ws, msg[1], msg[2], msg[3])
 
-    def _handle_done(self, ws: _WorkerState, task_id_b: bytes, results):
+    def _handle_done(self, ws: _WorkerState, task_id_b: bytes, results,
+                     phases: Optional[dict] = None):
         with self.lock:
             spec = ws.inflight_specs.pop(task_id_b, None)
         if spec is None:
@@ -877,6 +896,7 @@ class DriverRuntime:
         start = self._task_start_ts.pop(task_id_b, None)
         if start is not None and len(self.timeline_events) < 200_000:
             name = (spec or {}).get("name") or (spec or {}).get("method") or "task"
+            tid_lane = ws.worker_id.hex()[:8]
             self.timeline_events.append(
                 {
                     "name": name,
@@ -884,9 +904,31 @@ class DriverRuntime:
                     "ts": start * 1e6,
                     "dur": (time.time() - start) * 1e6,
                     "pid": 1,
-                    "tid": ws.worker_id.hex()[:8],
+                    "tid": tid_lane,
                 }
             )
+            if phases:
+                # nested lifecycle slices: Chrome-trace nests same-lane X
+                # events by containment, so the worker-side phase durations
+                # laid out sequentially from dispatch render as children of
+                # the task slice. Sub-millisecond phases are skipped — they
+                # are invisible at trace zoom and would swell the event
+                # list ~5x on microbench-style task storms.
+                t = start
+                for ph in ("arg_fetch", "deserialize", "execute",
+                           "store_result"):
+                    d = phases.get(ph)
+                    if not d:
+                        continue
+                    if d >= 1e-3:
+                        self.timeline_events.append(
+                            {"name": f"{name}:{ph}", "ph": "X",
+                             "ts": t * 1e6, "dur": d * 1e6, "pid": 1,
+                             "tid": tid_lane, "cat": "task_phase"})
+                    t += d
+        if spec is not None and start is not None and self._flight_enabled:
+            self._record_flight(spec, ws, start, phases,
+                                failed=bool(results and results[0][1] == "e"))
         failed = bool(results and results[0][1] == "e")
         with self.lock:
             if not ws.inflight_specs:
@@ -929,6 +971,69 @@ class DriverRuntime:
                 ActorID(spec["actor_id"]), "creation task failed", results[0][2]
             )
         self._pump()
+
+    # ------------------------------------------------------------------
+    # task-lifecycle flight recorder
+    # ------------------------------------------------------------------
+
+    def _phase_metrics(self):
+        if self._phase_hist is None:
+            from ray_tpu.util.metrics import Counter, Histogram
+
+            # racing first-finishers both create; registration merges, so
+            # samples land in one shared store either way
+            self._phase_hist = Histogram(
+                "rtpu_task_phase_seconds",
+                "task lifecycle phase latency "
+                "(submit->queue->lease->arg_fetch->deserialize->execute->"
+                "store_result)",
+                boundaries=[0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1,
+                            0.5, 1, 5, 10, 60],
+                tag_keys=("phase",))
+            self._finished_counter = Counter(
+                "rtpu_tasks_finished_total",
+                "tasks finished on this node's scheduler",
+                tag_keys=("status",))
+        return self._phase_hist
+
+    def _record_flight(self, spec: dict, ws: _WorkerState, start_ts: float,
+                       wphases: Optional[dict], failed: bool) -> None:
+        """One finished task -> phase histograms + ring-buffer record.
+        Driver-side phases (queue = dependency wait, lease = wait for a
+        worker) come from the spec's lifecycle stamps; worker-side phases
+        ride the done message. Everything here is dict/list work — no
+        syscalls on the result path."""
+        now = time.time()
+        ph: Dict[str, float] = {}
+        sub = spec.get("lc_submit")
+        rdy = spec.get("lc_ready")
+        if sub is not None and rdy is not None:
+            ph["queue"] = max(0.0, rdy - sub)
+        if rdy is not None:
+            ph["lease"] = max(0.0, start_ts - rdy)
+        if wphases:
+            ph.update(wphases)
+        ph["total"] = max(0.0, now - (sub if sub is not None else start_ts))
+        try:
+            hist = self._phase_metrics()
+            keys = self._phase_keys
+            hist.observe_many(
+                (keys.get(k) or keys.setdefault(k, (("phase", k),)), v)
+                for k, v in ph.items())
+            self._finished_counter._inc_key(self._status_keys[failed])
+        except Exception:
+            pass
+        # raw ids here; state.list_task_events hexes at query time (the
+        # conversion is per-query, not per-task)
+        self.task_ring.append({
+            "task_id": spec["task_id"],
+            "name": spec.get("name") or spec.get("method") or "task",
+            "type": spec["type"],
+            "worker_id": ws.worker_id,
+            "status": "error" if failed else "ok",
+            "phases": ph,
+            "ts": now,
+        })
 
     def _handle_cast(self, ws: _WorkerState, op: str, args):
         if op == "put":
@@ -977,6 +1082,17 @@ class DriverRuntime:
                                  args[2] if len(args) > 2 else None)
         elif op == "refpin":
             self.worker_ref_delta(ws, args[0], args[1])
+        elif op == "metrics":
+            # batched metric-delta push from the worker (federation): pure
+            # dict merges — safe on this receiver thread
+            from ray_tpu.util.metrics import federation
+
+            wid = ws.worker_id.hex()[:8]
+            federation.ingest(
+                "worker:" + wid,
+                {"worker_id": wid, "node_id": self.node_id.hex()[:8],
+                 "component": "worker"},
+                args[0])
         elif op == "free":
             # full free path (directory + store + CLUSTER publication):
             # a worker-initiated free must reach holder nodes too, or the
@@ -1598,6 +1714,9 @@ class DriverRuntime:
 
     def submit_spec(self, spec: dict) -> List[ObjectRef]:
         tid = TaskID(spec["task_id"])
+        # flight-recorder stamp (setdefault: retries/reconstruction and
+        # forwarded specs keep the ORIGINAL submit time)
+        spec.setdefault("lc_submit", time.time())
         self._trace_submit(spec)
         deps = ts.arg_refs(spec["args"], spec["kwargs"])
         self._pin_args(spec)
@@ -1631,6 +1750,7 @@ class DriverRuntime:
         return [ObjectRef(ObjectID(b), task_id=tid) for b in spec["return_ids"]]
 
     def _submit_actor_spec(self, spec: dict) -> List[ObjectRef]:
+        spec.setdefault("lc_submit", time.time())
         self._pin_args(spec)
         if (self.cluster is not None
                 and self.gcs.get_actor(ActorID(spec["actor_id"])) is None
@@ -1661,6 +1781,7 @@ class DriverRuntime:
             for rid in spec["return_ids"]:
                 self.gcs.mark_error(ObjectID(rid), err)
             return
+        spec["lc_ready"] = time.time()
         with self.lock:
             info.pending_queue.append(spec)
         self._pump()
@@ -1690,6 +1811,7 @@ class DriverRuntime:
                     ActorID(spec["actor_id"]), "creation args errored", err_blob
                 )
             return
+        spec["lc_ready"] = time.time()
         with self.lock:
             self.ready_tasks.append(spec)
         self._pump()
@@ -2110,6 +2232,12 @@ class DriverRuntime:
     def shutdown(self):
         from ray_tpu.core import object_ref as _object_ref
 
+        try:
+            from ray_tpu.util.metrics import federation
+
+            federation.clear()  # drop this runtime's worker-origin samples
+        except Exception:
+            pass
         _object_ref.clear_ref_hook()
         self.gcs.on_terminal = None
         self._log_monitor_stop.set()

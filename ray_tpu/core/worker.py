@@ -12,6 +12,7 @@ from __future__ import annotations
 import itertools
 import os
 import threading
+import time
 import traceback
 from typing import Any, Dict, List, Optional
 
@@ -101,6 +102,20 @@ class WorkerRuntime:
         # concurrent actors (max_concurrency > 1) execute methods on pool
         # threads and must not see each other's ids
         self._task_ctx = threading.local()
+        # metrics federation (sender side): this process's registry —
+        # built-ins below plus any user metrics tasks create — is pushed
+        # to the driver as batched DELTAS over the existing pipe, never
+        # per-call; see _maybe_push_metrics
+        self._metrics_exporter = None
+        self._metrics_last_push = 0.0
+        self._metrics_interval: Optional[float] = None
+        self._wmetrics = None
+        try:
+            from ray_tpu import config as _cfg
+
+            self._flight_enabled = bool(_cfg.get("flight_recorder"))
+        except Exception:
+            self._flight_enabled = True
 
     @property
     def labels(self) -> Dict[str, str]:
@@ -407,17 +422,28 @@ class WorkerRuntime:
             self.registered_fns.add(h)
         return fn
 
-    def _decode_arg(self, e):
+    def _decode_arg(self, e, timings: Optional[Dict[str, float]] = None):
+        """Decode one spec argument. ``timings`` (flight recorder)
+        accumulates inline/deserialize time under "deserialize" and
+        store reads of ref args — fetch + load together, the store get
+        returns the object — under "arg_fetch"."""
         kind = e[0]
+        t0 = time.perf_counter() if timings is not None else 0.0
         if kind == "v":
-            return serialization.loads_oob(e[1])
-        if kind == "ri":
-            return serialization.loads_oob(e[2])
-        if kind == "r":
-            return self._store_get_with_recovery(ObjectID(e[1]))
-        if kind == "re":
+            out, tkey = serialization.loads_oob(e[1]), "deserialize"
+        elif kind == "ri":
+            out, tkey = serialization.loads_oob(e[2]), "deserialize"
+        elif kind == "r":
+            out = self._store_get_with_recovery(ObjectID(e[1]))
+            tkey = "arg_fetch"
+        elif kind == "re":
             raise cloudpickle.loads(e[1])
-        raise ValueError(f"bad arg encoding {kind}")
+        else:
+            raise ValueError(f"bad arg encoding {kind}")
+        if timings is not None:
+            timings[tkey] = (timings.get(tkey, 0.0)
+                             + time.perf_counter() - t0)
+        return out
 
     def _store_get_with_recovery(self, oid: ObjectID):
         """Store read with lineage recovery: a missing segment (evicted /
@@ -644,6 +670,7 @@ class WorkerRuntime:
                 self._send_error(spec, e)
             finally:
                 undo_env()
+                self._note_task_metrics({})  # async calls count too
 
         fut.add_done_callback(on_done)
 
@@ -717,19 +744,40 @@ class WorkerRuntime:
         # mid-decode failure must still release the pins the args decoded
         # so far already took
         arg_oids = ts.arg_refs(spec["args"], spec["kwargs"])
+        # flight-recorder phase durations; ride the done message so the
+        # driver's recorder sees worker-side phases without extra traffic
+        # (None when disabled: no timing calls, no extra message payload)
+        phases: Optional[Dict[str, float]] = (
+            {} if self._flight_enabled else None)
+
+        def enc(v, streaming=False):
+            if phases is None:
+                return (self._stream_results(spec, v) if streaming
+                        else self._encode_results(spec, v))
+            t2 = time.perf_counter()
+            if streaming:
+                # the generator drain IS the execution (produce + store
+                # interleave); no separate store_result phase
+                r = self._stream_results(spec, v)
+                phases["execute"] = time.perf_counter() - t_exec
+            else:
+                phases["execute"] = t2 - t_exec
+                r = self._encode_results(spec, v)
+                phases["store_result"] = time.perf_counter() - t2
+            return r
+
         try:
             # inside the try: a bad runtime_env (missing working_dir...)
             # must fail THIS task, not crash the worker process
             undo_env = self._apply_runtime_env(spec)
-            args = [self._decode_arg(a) for a in spec["args"]]
-            kwargs = {k: self._decode_arg(v) for k, v in spec["kwargs"].items()}
+            args = [self._decode_arg(a, phases) for a in spec["args"]]
+            kwargs = {k: self._decode_arg(v, phases)
+                      for k, v in spec["kwargs"].items()}
+            t_exec = time.perf_counter()
             if ttype == ts.TASK:
                 fn = self._resolve_fn(spec["fn_hash"])
                 value = fn(*args, **kwargs)
-                if spec.get("streaming"):
-                    results = self._stream_results(spec, value)
-                else:
-                    results = self._encode_results(spec, value)
+                results = enc(value, streaming=bool(spec.get("streaming")))
             elif ttype == ts.ACTOR_CREATE:
                 cls = self._resolve_fn(spec["fn_hash"])
                 self.current_actor_id = ActorID(spec["actor_id"])
@@ -739,7 +787,7 @@ class WorkerRuntime:
                     spec.get("max_concurrency", 1))
                 if _has_async_methods(cls):
                     self._make_actor_loop(spec["actor_id"])
-                results = self._encode_results(spec, None)
+                results = enc(None)
             elif ttype == ts.ACTOR_METHOD:
                 instance = self.actors.get(spec["actor_id"])
                 if instance is None:
@@ -790,15 +838,17 @@ class WorkerRuntime:
                     import asyncio
 
                     value = asyncio.run(value)
-                if spec.get("streaming"):
-                    results = self._stream_results(spec, value)
-                else:
-                    results = self._encode_results(spec, value)
+                results = enc(value, streaming=bool(spec.get("streaming")))
             else:
                 raise ValueError(f"unknown task type {ttype}")
-            self._send(("done", spec["task_id"], results))
+            if phases is None:
+                self._send(("done", spec["task_id"], results))
+            else:
+                self._send(("done", spec["task_id"], results, phases))
+            self._note_task_metrics(phases or {})
         except BaseException as e:  # noqa: BLE001 — remote errors must not kill the worker
             self._send_error(spec, e)
+            self._note_task_metrics(phases or {})  # errored tasks count too
         finally:
             undo_env()
             # Drop the store pins _decode_arg's gets took: no ObjectRef
@@ -830,6 +880,63 @@ class WorkerRuntime:
                     ctypes.c_void_p(0))
             self.current_task_id = None
 
+    # -- metrics federation (sender side) --------------------------------
+
+    def _note_task_metrics(self, phases: Dict[str, float]) -> None:
+        """Worker-local built-ins: executed-task counter + exec-time
+        histogram. These live in THIS process's registry and reach the
+        head /metrics via the federated delta push, labeled with this
+        worker's id."""
+        try:
+            if self._wmetrics is None:
+                from ray_tpu.util.metrics import Counter, Histogram
+
+                self._wmetrics = {
+                    "tasks": Counter(
+                        "rtpu_worker_tasks_total",
+                        "tasks executed by this worker process"),
+                    "exec": Histogram(
+                        "rtpu_worker_task_exec_seconds",
+                        "user-code execution time in this worker",
+                        boundaries=[0.001, 0.01, 0.1, 1, 10, 60, 600]),
+                }
+            self._wmetrics["tasks"].inc()
+            if "execute" in phases:
+                self._wmetrics["exec"].observe(phases["execute"])
+        except Exception:
+            pass
+
+    def _maybe_push_metrics(self) -> None:
+        """Push metric-registry DELTAS to the driver over the existing
+        pipe, rate-limited (default 2s) — the federation hop for worker
+        processes. Between pushes the hot path pays one monotonic-clock
+        read; nothing is sent when no metric changed."""
+        if self._metrics_interval is None:
+            try:
+                from ray_tpu import config as _cfg
+
+                self._metrics_interval = (
+                    float(_cfg.get("metrics_push_interval_s"))
+                    if _cfg.get("metrics_federation") else 0.0)
+            except Exception:
+                self._metrics_interval = 0.0
+        if self._metrics_interval <= 0:
+            return
+        now = time.monotonic()
+        if now - self._metrics_last_push < self._metrics_interval:
+            return
+        self._metrics_last_push = now
+        try:
+            from ray_tpu.util import metrics as _metrics
+
+            if self._metrics_exporter is None:
+                self._metrics_exporter = _metrics.DeltaExporter()
+            records = self._metrics_exporter.collect()
+            if records:
+                self.cast("metrics", records)
+        except Exception:
+            pass
+
     def main_loop(self):
         self._start_receiver()
         self._send(("ready",))
@@ -841,8 +948,10 @@ class WorkerRuntime:
             except _queue.Empty:
                 # idle: bounded staleness for __del__-deferred ref drops
                 self._drain_ref_drops()
+                self._maybe_push_metrics()
                 continue
             self._drain_ref_drops()
+            self._maybe_push_metrics()
             conc = (self.actor_concurrency.get(spec.get("actor_id", b""), 1)
                     if spec["type"] == ts.ACTOR_METHOD else 1)
             if (spec["type"] == ts.ACTOR_METHOD
@@ -936,7 +1045,20 @@ def _main():
     ap.add_argument("--worker-id", required=True)
     args = ap.parse_args()
 
-    conn = Client(args.addr, family="AF_UNIX", authkey=args.session.encode())
+    # Retry transient connect failures: a spawn burst can momentarily
+    # fill the driver listener's accept backlog, and unix sockets fail
+    # with EAGAIN instead of blocking — crashing here would kill the
+    # actor this worker was spawned for.
+    deadline = time.monotonic() + 10.0
+    while True:
+        try:
+            conn = Client(args.addr, family="AF_UNIX",
+                          authkey=args.session.encode())
+            break
+        except (BlockingIOError, ConnectionRefusedError, OSError):
+            if time.monotonic() >= deadline:
+                raise
+            time.sleep(0.05)
     wid = bytes.fromhex(args.worker_id)
     conn.send(("hello", wid))
     worker_entry(conn, args.session, wid)

@@ -20,7 +20,6 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):  # noqa: N802 — stdlib API
         from ray_tpu.util import state as st
-        from ray_tpu.util.metrics import prometheus_text
 
         from ray_tpu.serve import config_api as serve_rest
 
@@ -34,6 +33,8 @@ class _Handler(BaseHTTPRequestHandler):
             "/api/summary/tasks": st.summarize_tasks,
             "/api/summary/actors": st.summarize_actors,
             "/api/summary/objects": st.summarize_objects,
+            # task-lifecycle flight recorder (recent per-phase records)
+            "/api/task_events": st.list_task_events,
             # serve REST (reference dashboard/modules/serve role)
             "/api/serve/applications": serve_rest.serve_rest_get,
             # Chrome-trace task spans (reference timeline view role)
@@ -41,7 +42,7 @@ class _Handler(BaseHTTPRequestHandler):
         }
         try:
             if self.path == "/metrics":
-                body = prometheus_text().encode()
+                body = _metrics_text().encode()
                 self.send_response(200)
                 self.send_header("Content-Type",
                                  "text/plain; version=0.0.4")
@@ -122,6 +123,28 @@ def _timeline_events():
     import ray_tpu
 
     return ray_tpu.timeline()
+
+
+def _metrics_text() -> str:
+    """Federated Prometheus exposition: this process's registry (unlabeled,
+    pre-federation format), its workers' pushed samples, and — on a
+    cluster head — every peer node's samples pulled from the GCS, all as
+    one scrape target with node_id/worker_id/component labels."""
+    from ray_tpu.util.metrics import federation, prometheus_text
+
+    extra = federation.export()
+    try:
+        from ray_tpu.core.runtime import _runtime
+
+        rt = _runtime
+        if rt is not None and getattr(rt, "cluster", None) is not None:
+            remote = rt.cluster.gcs.call(
+                "metrics_get", rt.node_id.binary(), timeout=5)
+            if remote:
+                extra.extend(remote)
+    except Exception:
+        pass  # scrape must degrade to local samples, never 500
+    return prometheus_text(extra=extra)
 
 
 class Dashboard:

@@ -43,6 +43,7 @@ from ray_tpu.train.train_state import (
     make_train_step,
     state_shardings,
 )
+from ray_tpu.train.telemetry import StepTelemetry, get_step_telemetry
 
 __all__ = [
     "Checkpoint",
@@ -67,4 +68,6 @@ __all__ = [
     "DataParallelTrainer",
     "JaxTrainer",
     "TrainingFailedError",
+    "StepTelemetry",
+    "get_step_telemetry",
 ]

@@ -127,6 +127,15 @@ class _Session:
             if os.path.abspath(checkpoint.path) != os.path.abspath(dest):
                 checkpoint.to_directory(dest)
             ckpt_path = os.path.dirname(dest)
+        # step telemetry: each report is one user-loop step — inter-report
+        # wall time + well-known keys land in the metrics registry (and
+        # federate to the head /metrics); never fails the report
+        try:
+            from ray_tpu.train import telemetry
+
+            telemetry.on_report(metrics)
+        except Exception:
+            pass
         self.result_queue.put(("result", dict(metrics), ckpt_path))
         # Block until the driver consumed the result — keeps workers in
         # lockstep at report granularity and bounds queue memory.

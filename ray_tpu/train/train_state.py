@@ -272,7 +272,8 @@ class TrainLoopHelper:
         carry chains them), so a ``device_get`` of it provably spans all
         n steps — sound timing even on backends where
         ``block_until_ready`` acks early."""
-        if n not in self._multi_step_cache:
+        fresh = n not in self._multi_step_cache
+        if fresh:
             step_fn = self.step_fn
 
             def multi(state, batch):
@@ -289,6 +290,19 @@ class TrainLoopHelper:
         self._check_batch(batch)
         bs = self.batch_sharding()
         batch = jax.tree.map(lambda x: jax.device_put(x, bs), batch)
+        import time as _time
+
+        t0 = _time.perf_counter()
         with jax.set_mesh(self.mesh):
             self.state, metrics = self._multi_step_cache[n](self.state, batch)
+        if fresh:
+            # a fresh scanned program's first call is a compile event
+            # (timing includes its first execution — dispatch is async so
+            # compile dominates); telemetry must never break the step
+            try:
+                from ray_tpu.train import telemetry
+
+                telemetry.record_compile(_time.perf_counter() - t0)
+            except Exception:
+                pass
         return metrics

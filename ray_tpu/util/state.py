@@ -109,14 +109,58 @@ def list_workers() -> List[Dict[str, Any]]:
     return out
 
 
-def summarize_tasks() -> Dict[str, Dict[str, int]]:
-    """Counts by (name, state) — reference ``summarize_tasks``."""
-    summary: Dict[str, Dict[str, int]] = {}
+def list_task_events(limit: int = 1000) -> List[Dict[str, Any]]:
+    """Recent task-lifecycle flight-recorder records (newest last): one
+    dict per finished task with per-phase durations in seconds
+    (queue/lease/arg_fetch/deserialize/execute/store_result/total)."""
+    rt = _gcs()
+    ring = list(getattr(rt, "task_ring", ()) or ())
+    out = []
+    for ev in ring[-int(limit):]:
+        ev = dict(ev)
+        # the hot path stores raw ids; render them here, per query
+        ev["task_id"] = ev["task_id"].hex()[:16]
+        ev["worker_id"] = ev["worker_id"].hex()[:8]
+        out.append(ev)
+    return out
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile over a pre-sorted list."""
+    import math
+
+    n = len(sorted_vals)
+    if n == 0:
+        return 0.0
+    return sorted_vals[min(n - 1, max(0, int(math.ceil(q * n)) - 1))]
+
+
+def summarize_tasks() -> Dict[str, Dict[str, Any]]:
+    """Counts by (name, state) — reference ``summarize_tasks`` — plus a
+    ``"phases"`` entry per task name with per-phase latency percentiles
+    (p50/p99, milliseconds) over the driver's flight-recorder ring."""
+    summary: Dict[str, Dict[str, Any]] = {}
     for t in list_tasks():
         name = t.get("name", "unknown")
         state = t.get("state", "unknown")
         summary.setdefault(name, {}).setdefault(state, 0)
         summary[name][state] += 1
+    by_phase: Dict[str, Dict[str, List[float]]] = {}
+    for ev in list_task_events(limit=100_000):
+        phases = by_phase.setdefault(ev.get("name") or "task", {})
+        for ph, v in (ev.get("phases") or {}).items():
+            phases.setdefault(ph, []).append(v)
+    for name, phases in by_phase.items():
+        ent = summary.setdefault(name, {})
+        ent["phases"] = {}
+        for ph, vals in phases.items():
+            vals.sort()
+            ent["phases"][ph] = {
+                "count": len(vals),
+                "mean_ms": round(sum(vals) / len(vals) * 1e3, 3),
+                "p50_ms": round(_percentile(vals, 0.5) * 1e3, 3),
+                "p99_ms": round(_percentile(vals, 0.99) * 1e3, 3),
+            }
     return summary
 
 

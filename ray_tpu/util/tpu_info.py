@@ -45,6 +45,29 @@ def peak_flops_per_chip(default: float = 197e12) -> float:
     return default
 
 
+def hbm_usage():
+    """HBM usage summed over local devices: ``{"bytes_in_use",
+    "bytes_limit"}``, or None off-TPU / when the backend exposes no
+    ``memory_stats`` (the tunneled axon plugin sometimes doesn't)."""
+    try:
+        import jax
+
+        if jax.default_backend() not in TPU_PLATFORMS:
+            return None
+        used = limit = 0
+        for d in jax.local_devices():
+            ms = getattr(d, "memory_stats", None)
+            ms = ms() if callable(ms) else None
+            if not ms:
+                return None
+            used += int(ms.get("bytes_in_use", 0))
+            limit += int(ms.get("bytes_limit", 0)
+                         or ms.get("bytes_reservable_limit", 0))
+        return {"bytes_in_use": used, "bytes_limit": limit}
+    except Exception:
+        return None
+
+
 def honor_jax_platform_env(*, only_if_imported: bool = False) -> None:
     """Make jax respect the JAX_PLATFORMS env var in this process.
 

@@ -37,6 +37,33 @@ def pytest_configure(config):
         "markers", "slow: long-running capacity/stress tests")
 
 
+def poll_until(predicate, timeout=30.0, interval=0.2, desc="condition"):
+    """Retry ``predicate`` until it returns a truthy value (returned).
+
+    Deflake helper for cluster tests (round-5 flake notes): transient
+    ``ConnectionError``/``TimeoutError``/``OSError`` raised by a poll —
+    a GCS client mid-reconnect, an HTTP scrape racing server start — are
+    retried instead of failing the test; any other exception propagates.
+    Raises AssertionError with the last transient error on timeout.
+    """
+    import time as _time
+
+    deadline = _time.monotonic() + timeout
+    last_exc = None
+    while _time.monotonic() < deadline:
+        try:
+            val = predicate()
+            if val:
+                return val
+            last_exc = None
+        except (ConnectionError, TimeoutError, OSError) as e:
+            last_exc = e
+        _time.sleep(interval)
+    raise AssertionError(
+        f"poll_until({desc}) timed out after {timeout}s"
+        + (f"; last transient error: {last_exc!r}" if last_exc else ""))
+
+
 @pytest.fixture
 def rt():
     import ray_tpu

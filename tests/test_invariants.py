@@ -1,0 +1,77 @@
+"""Static architecture-invariant checks (CI/tooling satellite, ISSUE 3).
+
+These greps encode invariants from CLAUDE.md that a reviewer can't see
+break in a diff hunk:
+
+- ONE receiver thread demuxes each worker pipe — a second ``conn.recv()``
+  call site races the demux and corrupts the reply routing.
+- Differentiating raw attention kernels OOMs real HBM: training attention
+  must go through ``ray_tpu.ops.flash_attention`` (memory-efficient VJP),
+  never ``flash_attention_pallas``/``blockwise_attention`` directly.
+"""
+
+import re
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parents[1]
+
+
+def _code_lines(path: Path):
+    """Source lines with comments stripped (keeps strings; good enough for
+    call-site greps)."""
+    for n, line in enumerate(path.read_text().splitlines(), 1):
+        yield n, line.split("#", 1)[0]
+
+
+def test_single_receiver_per_worker_pipe():
+    """CLAUDE.md invariant: one receiver thread per worker demuxes the
+    pipe (replies vs execs) — never add a second ``conn.recv()`` site."""
+    worker = ROOT / "ray_tpu" / "core" / "worker.py"
+    sites = [(n, line) for n, line in _code_lines(worker)
+             if re.search(r"\bconn\.recv\(\)", line)]
+    assert len(sites) == 1, (
+        f"worker.py has {len(sites)} conn.recv() call sites {sites}; the "
+        "one-receiver-thread invariant (CLAUDE.md 'Architecture "
+        "invariants') allows only _recv_loop to read the pipe — route new "
+        "message kinds through it instead of adding a reader")
+
+    runtime = ROOT / "ray_tpu" / "core" / "runtime.py"
+    sites = [(n, line) for n, line in _code_lines(runtime)
+             if re.search(r"\bconn\.recv\(\)", line)]
+    # allowed: the _accept_loop "hello" handshake (before the reader
+    # exists) and the per-worker _reader_loop itself
+    assert len(sites) <= 2, (
+        f"runtime.py has {len(sites)} conn.recv() call sites {sites}; "
+        "only the _accept_loop handshake and _reader_loop may read a "
+        "worker pipe (CLAUDE.md one-receiver-thread invariant)")
+
+
+def test_no_raw_attention_kernels_outside_ops():
+    """CLAUDE.md invariant: ALL training attention routes through
+    ``ray_tpu.ops.flash_attention`` (it carries the memory-efficient
+    custom VJP); calling the raw kernels from a differentiated path saves
+    every probability block as a residual (~50 GB at llama-250M scale)."""
+    offenders = []
+    for path in sorted((ROOT / "ray_tpu").rglob("*.py")):
+        rel = path.relative_to(ROOT)
+        if rel.parts[:2] == ("ray_tpu", "ops"):
+            continue  # the kernels' home (impl + dispatch) is exempt
+        for n, line in _code_lines(path):
+            if re.search(r"\b(flash_attention_pallas|blockwise_attention)"
+                         r"\s*\(", line):
+                offenders.append(f"{rel}:{n}: {line.strip()}")
+    assert not offenders, (
+        "direct raw-attention kernel call(s) outside ray_tpu/ops:\n  "
+        + "\n  ".join(offenders)
+        + "\nroute attention through ray_tpu.ops.flash_attention — the "
+        "raw kernels have no memory-efficient VJP and OOM real HBM when "
+        "differentiated (CLAUDE.md 'Architecture invariants')")
+
+
+def test_serialization_stays_cloudpickle_first():
+    """CLAUDE.md invariant: ``serialization.serialize`` must try
+    cloudpickle FIRST (plain pickle serializes ``__main__`` functions by
+    reference and breaks workers)."""
+    src = (ROOT / "ray_tpu" / "core" / "serialization.py").read_text()
+    cp = src.find("cloudpickle.dumps")
+    assert cp != -1, "serialization.py no longer uses cloudpickle.dumps?"
