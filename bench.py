@@ -989,6 +989,26 @@ def _core_microbench() -> dict:
             16 / (time.perf_counter() - t0), 2)
         for x in actors:
             ray_tpu.kill(x)
+
+        # spawn->ready latency behind actors_launched (ISSUE 4: the
+        # zygote histogram attributes launch rate to worker-boot
+        # queueing, not scheduler overhead) + the hottest locks of the
+        # whole microbench — near-zero waits mean the driver is
+        # CPU-bound, not lock-bound
+        try:
+            from ray_tpu.util import contention
+            from ray_tpu.util.metrics import registry_records
+
+            for rec in registry_records():
+                if rec["name"] == "rtpu_worker_spawn_seconds":
+                    for key, (counts, s, n) in rec["samples"]:
+                        if n:
+                            out.setdefault("spawn_latency", {})[
+                                dict(key).get("mode", "?")] = {
+                                "n": n, "mean_s": round(s / n, 3)}
+            out["contention_hot"] = contention.top_waits(3)
+        except Exception:
+            pass
     except Exception as e:  # bench must never fail on the micro side
         out["error"] = str(e)
     finally:

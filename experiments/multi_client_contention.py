@@ -1,0 +1,196 @@
+"""Root-cause the multi-client microbench inversion (ISSUE 4).
+
+BENCH r5: multi-client tasks 1,940/s vs 4,776/s single-client; worker
+puts 3.12 GB/s aggregate vs 7.37 GB/s driver-local — where the
+reference SCALES UP ~3x with extra clients. This experiment reruns the
+bench's multi-client sections under the new core instrumentation and
+attributes the gap between three suspects:
+
+  (a) driver dispatch-lock contention  -> rtpu_lock_wait_seconds /
+      summarize_contention deltas per section;
+  (b) per-task control-plane work growth (extra pipe messages: specs,
+      refpins, get waiters ship from client workers) -> pipe
+      message/byte deltas per task;
+  (c) plain CPU saturation (2 vCPUs run driver + 2 clients + 4 pool
+      workers) -> process CPU time vs wall time per section.
+
+Run: JAX_PLATFORMS=cpu python experiments/multi_client_contention.py
+Prints one JSON object; append findings to CHANGES.md.
+"""
+
+import json
+import os
+import resource
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+import ray_tpu  # noqa: E402
+from ray_tpu.util import contention  # noqa: E402
+from ray_tpu.util.metrics import registry_records  # noqa: E402
+
+
+def _counter(name, tags=None):
+    total = 0.0
+    for rec in registry_records():
+        if rec["name"] != name:
+            continue
+        want = tuple((tags or {}).items())
+        for key, val in rec["samples"]:
+            if all(t in key for t in want):
+                total += val if not isinstance(val, tuple) else val[2]
+    return total
+
+
+class Section:
+    """Deltas of contention stats, pipe counters, and CPU time around a
+    measured section."""
+
+    def __init__(self, name):
+        self.name = name
+
+    def __enter__(self):
+        contention.reset()
+        self.t0 = time.perf_counter()
+        r = resource.getrusage(resource.RUSAGE_SELF)
+        self.cpu0 = r.ru_utime + r.ru_stime
+        self.msgs0 = (_counter("rtpu_pipe_messages_total",
+                               {"direction": "sent"})
+                      + _counter("rtpu_pipe_messages_total",
+                                 {"direction": "recv"}))
+        self.bytes0 = (_counter("rtpu_pipe_sent_bytes_total")
+                       + _counter("rtpu_pipe_recv_bytes_total"))
+        return self
+
+    def __exit__(self, *exc):
+        self.wall = time.perf_counter() - self.t0
+        r = resource.getrusage(resource.RUSAGE_SELF)
+        self.cpu = r.ru_utime + r.ru_stime - self.cpu0
+        self.msgs = (_counter("rtpu_pipe_messages_total",
+                              {"direction": "sent"})
+                     + _counter("rtpu_pipe_messages_total",
+                                {"direction": "recv"})) - self.msgs0
+        self.bytes = (_counter("rtpu_pipe_sent_bytes_total")
+                      + _counter("rtpu_pipe_recv_bytes_total")
+                      ) - self.bytes0
+        self.locks = {k: v for k, v in contention.summarize().items()
+                      if v["wait_total_s"] > 0.0005}
+
+    def report(self, n_tasks=None):
+        out = {"wall_s": round(self.wall, 3),
+               "driver_cpu_s": round(self.cpu, 3),
+               "driver_cpu_frac": round(self.cpu / self.wall, 3),
+               "pipe_msgs": int(self.msgs),
+               "pipe_bytes": int(self.bytes),
+               "lock_waits": self.locks}
+        if n_tasks:
+            out["rate_per_s"] = round(n_tasks / self.wall, 1)
+            out["pipe_msgs_per_task"] = round(self.msgs / n_tasks, 2)
+            out["driver_cpu_us_per_task"] = round(
+                self.cpu / n_tasks * 1e6, 1)
+        return out
+
+
+def main():
+    ray_tpu.init(num_cpus=4)
+    out = {"loadavg_start": os.getloadavg()}
+
+    @ray_tpu.remote
+    def noop():
+        return None
+
+    for _ in range(3):  # steady-state pool
+        ray_tpu.get([noop.remote() for _ in range(60)])
+
+    # -- A: single-client task throughput --------------------------------
+    n = 600
+    best = None
+    for _ in range(3):
+        with Section("single") as s:
+            ray_tpu.get([noop.remote() for _ in range(n)])
+        rep = s.report(n)
+        if best is None or rep["rate_per_s"] > best["rate_per_s"]:
+            best = rep
+    out["single_client_tasks"] = best
+
+    # -- B: multi-client (bench shape: 2 actor clients x 250 noops) ------
+    @ray_tpu.remote
+    class BatchClient:
+        def small_value_batch(self, n):
+            ray_tpu.get([noop.remote() for _ in range(n)])
+            return n
+
+    clients = [BatchClient.remote() for _ in range(2)]
+    ray_tpu.get([c.small_value_batch.remote(10) for c in clients])
+    best = None
+    for _ in range(3):
+        with Section("multi") as s:
+            ray_tpu.get([c.small_value_batch.remote(250)
+                         for c in clients])
+        rep = s.report(500)
+        if best is None or rep["rate_per_s"] > best["rate_per_s"]:
+            best = rep
+    out["multi_client_tasks"] = best
+
+    # -- B2: clients at num_cpus=0 (slot-starvation control: with 1-CPU
+    # clients only 2 of 4 CPU slots remain for noops) ---------------------
+    zclients = [BatchClient.options(num_cpus=0).remote()
+                for _ in range(2)]
+    ray_tpu.get([c.small_value_batch.remote(10) for c in zclients])
+    best = None
+    for _ in range(3):
+        with Section("multi0") as s:
+            ray_tpu.get([c.small_value_batch.remote(250)
+                         for c in zclients])
+        rep = s.report(500)
+        if best is None or rep["rate_per_s"] > best["rate_per_s"]:
+            best = rep
+    out["multi_client_tasks_cpus0"] = best
+    for c in clients + zclients:
+        ray_tpu.kill(c)
+
+    # -- C: put bandwidth, driver-local vs worker-side -------------------
+    arr = np.zeros((8 << 20) // 8)
+
+    best = None
+    for _ in range(3):
+        with Section("put_local") as s:
+            for _ in range(8):
+                ray_tpu.put(arr)
+        gbs = 8 * arr.nbytes / s.wall / 1e9
+        if best is None or gbs > best["gb_per_s"]:
+            best = {"gb_per_s": round(gbs, 2), **s.report()}
+    out["put_driver_local"] = best
+
+    @ray_tpu.remote
+    def do_put(nbytes, times):
+        data = np.zeros(nbytes // 8)
+        for _ in range(times):
+            ray_tpu.put(data)
+        return times * nbytes
+
+    ray_tpu.get(do_put.remote(1 << 16, 1))
+    best = None
+    for _ in range(3):
+        with Section("put_multi") as s:
+            ray_tpu.get([do_put.remote(8 << 20, 4) for _ in range(2)])
+        gbs = 2 * 4 * (8 << 20) / s.wall / 1e9
+        if best is None or gbs > best["gb_per_s"]:
+            best = {"gb_per_s": round(gbs, 2), **s.report()}
+    out["put_worker_multi"] = best
+
+    # task-phase percentiles for the whole run (queue vs lease vs exec)
+    from ray_tpu.util.state import summarize_tasks
+
+    phases = summarize_tasks().get("noop", {}).get("phases", {})
+    out["noop_phases_ms"] = {k: {"p50": v["p50_ms"], "p99": v["p99_ms"]}
+                             for k, v in phases.items()}
+    out["loadavg_end"] = os.getloadavg()
+    ray_tpu.shutdown()
+    print(json.dumps(out, indent=1))
+
+
+if __name__ == "__main__":
+    main()

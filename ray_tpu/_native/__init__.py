@@ -155,6 +155,13 @@ class NativeArena:
         base = self._base
 
         def run():
+            try:
+                from ray_tpu.util import metric_defs
+
+                progress = metric_defs.get(
+                    "rtpu_object_store_prefault_bytes")
+            except Exception:
+                progress = None
             page = 4096
             start = (base + page - 1) // page * page
             end = base + limit
@@ -172,6 +179,11 @@ class NativeArena:
                 # redundant madvise walk)
                 self._populated_end = max(self._populated_end,
                                           off - base)
+                if progress is not None:
+                    try:
+                        progress.set(off - base)
+                    except Exception:
+                        progress = None
 
         threading.Thread(target=run, daemon=True,
                          name="rtpu-arena-prefault").start()

@@ -60,24 +60,26 @@ LOCALITY_MIN_BYTES = int(config.get("locality_min_bytes"))
 # utilization is below this, then spread to the least-loaded
 HYBRID_PACK_THRESHOLD = float(config.get("hybrid_threshold"))
 
-#: node-to-node transfer instrumentation (reference pull/push manager
-#: metrics in ``src/ray/stats/metric_defs.cc``). Lazy: adapters live in
-#: daemons and drivers alike; only processes that scrape /metrics read it.
-_xfer_metrics = None
+#: node-to-node transfer + spillback instrumentation, defined centrally
+#: in ``util/metric_defs.py`` (reference pull/push manager metrics in
+#: ``src/ray/stats/metric_defs.cc``). Lazy: adapters live in daemons and
+#: drivers alike; only processes that record/scrape pay for it
+#: (metric_defs.get caches and survives clear_registry).
+
+_FWD_KEYS = {r: (("reason", r),) for r in (
+    "resources", "locality", "strategy", "pg", "actor_route")}
 
 
 def _transfer_metrics():
-    global _xfer_metrics
-    if _xfer_metrics is None:
-        from ray_tpu.util.metrics import Counter
+    from ray_tpu.util import metric_defs as md
 
-        _xfer_metrics = {
-            "pulled": Counter("cluster_object_pull_bytes_total",
-                              "object bytes pulled from peer nodes"),
-            "served": Counter("cluster_object_serve_bytes_total",
-                              "object bytes served to peer nodes"),
-        }
-    return _xfer_metrics
+    return {
+        "pulled": md.get("rtpu_cluster_object_pull_bytes_total"),
+        "served": md.get("rtpu_cluster_object_serve_bytes_total"),
+        "forwarded": md.get("rtpu_cluster_tasks_forwarded_total"),
+        "heartbeats": md.get("rtpu_cluster_heartbeats_total"),
+        "hb_rtt": md.get("rtpu_cluster_heartbeat_rtt_seconds"),
+    }
 
 
 class ClusterAdapter:
@@ -211,8 +213,18 @@ class ClusterAdapter:
                 # idempotent, so a dropped heartbeat self-heals
                 mpayload = (self._metrics_payload()
                             if beat % 4 == 1 else None)
+                t0 = time.perf_counter()
                 known = self.gcs.call("node_heartbeat", self.node_id, avail,
                                       depth, stats, mpayload, timeout=5)
+                try:
+                    # guarded on its own: a metrics failure must never
+                    # abort the beat (the loop's blanket except would
+                    # drop the RPC and get this node declared dead)
+                    m = _transfer_metrics()
+                    m["heartbeats"]._inc_key(())
+                    m["hb_rtt"]._observe_key((), time.perf_counter() - t0)
+                except Exception:
+                    pass
                 if known is False:
                     # a restarted GCS lost the (non-durable) node table:
                     # re-register + re-subscribe (GCS FT path)
@@ -769,7 +781,8 @@ class ClusterAdapter:
                          if n["node_id"] == best and n["alive"]
                          and all(n["resources"].get(k, 0.0) >= v
                                  for k, v in res.items())), None)
-                    if target is not None and self._forward(best, spec):
+                    if target is not None and self._forward(
+                            best, spec, reason="locality"):
                         return True
             return False
         candidates, with_avail = self._feasible_peers(res)
@@ -794,7 +807,8 @@ class ClusterAdapter:
         return candidates, with_avail
 
     def _forward_to_best(self, picks, res: Dict[str, float],
-                         spec: dict, dep_bytes=None) -> bool:
+                         spec: dict, dep_bytes=None,
+                         reason: str = "resources") -> bool:
         """Rank feasible peers: dependency bytes first, then hybrid
         pack-until-threshold-then-spread on CPU utilization (reference
         hybrid_scheduling_policy.h:50 — pack onto busy-but-not-saturated
@@ -814,7 +828,7 @@ class ClusterAdapter:
         # peers instead of piling onto one node until the next heartbeat
         for k, v in res.items():
             target["avail"][k] = target["avail"].get(k, 0.0) - v
-        return self._forward(target["node_id"], spec)
+        return self._forward(target["node_id"], spec, reason=reason)
 
     def _dep_bytes_by_node(self, spec: dict) -> Dict[bytes, int]:
         """READY-segment bytes of the spec's direct ref args, per holder
@@ -927,14 +941,16 @@ class ClusterAdapter:
             local_preferred = local_ok and self._labels_match(my_labels,
                                                               soft)
             if preferred and not local_preferred:
-                if self._forward_to_best(preferred, res, spec):
+                if self._forward_to_best(preferred, res, spec,
+                                         reason="strategy"):
                     return True
             if local_preferred:
                 return False  # run locally (soft + hard match here)
         if local_ok:
             return False  # run locally (hard match here)
         others = [n for n in candidates if n["node_id"] != self.node_id]
-        if others and self._forward_to_best(others, res, spec):
+        if others and self._forward_to_best(others, res, spec,
+                                            reason="strategy"):
             return True
         self._fail_returns(spec, ValueError(
             f"no reachable node matches label predicates {hard}"))
@@ -954,7 +970,7 @@ class ClusterAdapter:
             self._fail_returns(spec, WorkerCrashedError(
                 f"node affinity target {node_id.hex()[:8]} is not alive"))
             return True
-        return self._forward(node_id, spec)
+        return self._forward(node_id, spec, reason="strategy")
 
     def _feasible_slots(self, res: Dict[str, float]) -> List[dict]:
         """Candidate slot list for spread/random placement: this node first
@@ -977,7 +993,7 @@ class ClusterAdapter:
         self._spread_rr += 1
         if pick["node_id"] == self.node_id:
             return False
-        return self._forward(pick["node_id"], spec)
+        return self._forward(pick["node_id"], spec, reason="strategy")
 
     def _place_random(self, spec: dict, res: Dict[str, float]) -> bool:
         """Uniform over feasible nodes including this one (reference
@@ -993,7 +1009,7 @@ class ClusterAdapter:
         pick = _random.choice(slots)
         if pick["node_id"] == self.node_id:
             return False
-        return self._forward(pick["node_id"], spec)
+        return self._forward(pick["node_id"], spec, reason="strategy")
 
     def _record_forward(self, node_id: bytes, spec: dict) -> None:
         """Bookkeeping after handing a spec to a peer: failure-retry map,
@@ -1016,7 +1032,8 @@ class ClusterAdapter:
         self.watch_many([ObjectID(b) for b in spec["return_ids"]],
                         fetch=False)
 
-    def _forward(self, node_id: bytes, spec: dict) -> bool:
+    def _forward(self, node_id: bytes, spec: dict,
+                 reason: str = "resources") -> bool:
         peer = self._peer(node_id)
         if peer is None:
             return False
@@ -1024,6 +1041,13 @@ class ClusterAdapter:
             peer.call("submit_spec", spec, timeout=30)
         except Exception:
             return False
+        try:
+            # spillback decision record (reference scheduler spillback
+            # metrics role): WHY work left this node
+            _transfer_metrics()["forwarded"]._inc_key(
+                _FWD_KEYS.get(reason) or _FWD_KEYS["resources"])
+        except Exception:
+            pass
         self._record_forward(node_id, spec)
         aid = spec.get("actor_id")
         if aid:
@@ -1300,7 +1324,7 @@ class ClusterAdapter:
                 return True
             if target == self.node_id:
                 return False
-            if self._forward(target, spec):
+            if self._forward(target, spec, reason="pg"):
                 return True
             self._park_pg_spec(pg_id, spec)
             return True
@@ -1324,12 +1348,12 @@ class ClusterAdapter:
         pick = cands[self._pg_rr % len(cands)]
         if pick == self.node_id:
             return False
-        if self._forward(pick, spec):
+        if self._forward(pick, spec, reason="pg"):
             return True
         for nid in cands:  # fallback sweep
             if nid == self.node_id:
                 return False
-            if self._forward(nid, spec):
+            if self._forward(nid, spec, reason="pg"):
                 return True
         self._park_pg_spec(pg_id, spec)
         return True
@@ -1440,6 +1464,11 @@ class ClusterAdapter:
             self._fail_returns(spec, ActorDiedError(
                 f"actor's node {node_id.hex()[:8]} unreachable"))
             return True
+        try:
+            _transfer_metrics()["forwarded"]._inc_key(
+                _FWD_KEYS["actor_route"])
+        except Exception:
+            pass
         self._record_forward(node_id, spec)
         return True
 

@@ -73,7 +73,24 @@ class GcsService:
                  snapshot_path: Optional[str] = None):
         import os
 
-        self.lock = threading.RLock()
+        from ray_tpu.util.contention import timed_rlock
+
+        # one coarse state lock — instrumented, because every RPC handler
+        # serializes on it (the "is the GCS the bottleneck?" question is
+        # answered by this lock's wait histogram)
+        self.lock = timed_rlock("gcs.state")
+        # built-in GCS metrics (defs in util/metric_defs.py; exported to
+        # the head /metrics by rpc_metrics_get with component=gcs labels)
+        from ray_tpu.util import metric_defs as _md
+
+        self._m_rpc = _md.get("rtpu_gcs_rpc_total")
+        self._m_rpc_lat = _md.get("rtpu_gcs_rpc_seconds")
+        self._m_pubsub = _md.get("rtpu_gcs_pubsub_messages_total")
+        self._m_tables = _md.get("rtpu_gcs_table_size")
+        self._m_alive = _md.get("rtpu_gcs_nodes_alive")
+        self._m_hb_gap = _md.get("rtpu_gcs_heartbeat_gap_seconds")
+        self._method_keys: Dict[str, tuple] = {}
+        self._channel_keys: Dict[str, tuple] = {}
         self.nodes: Dict[bytes, _NodeEntry] = {}
         self.objects: Dict[bytes, _GlobalObject] = {}
         self.max_objects = int(config.get("gcs_max_objects"))
@@ -168,7 +185,18 @@ class GcsService:
         fn = getattr(self, "rpc_" + method, None)
         if fn is None:
             raise AttributeError(f"gcs: unknown method {method!r}")
-        return fn(ctx, *args)
+        # per-method RPC count + latency (reference metric_defs.cc GCS
+        # rpc metrics role); cached pre-sorted keys keep this at two
+        # metric-lock hops per call
+        keys = self._method_keys
+        key = keys.get(method) or keys.setdefault(
+            method, (("method", method),))
+        t0 = time.perf_counter()
+        try:
+            return fn(ctx, *args)
+        finally:
+            self._m_rpc._inc_key(key)
+            self._m_rpc_lat._observe_key(key, time.perf_counter() - t0)
 
     # -- nodes ----------------------------------------------------------
 
@@ -193,6 +221,10 @@ class GcsService:
             ent = self.nodes.get(node_id)
             if ent is None:
                 return False
+            # inter-heartbeat gap (nominal 0.5s): the cheapest cluster-
+            # wide contention canary — a loaded sender or GCS stretches it
+            self._m_hb_gap._observe_key(
+                (), time.monotonic() - ent.last_seen)
             if metrics is not None:
                 self._node_metrics[node_id] = metrics
             changed = ent.avail != avail
@@ -303,6 +335,28 @@ class GcsService:
             for node_id in stale:
                 self._mark_node_dead(node_id, "heartbeat timeout")
             self._sweep_free_candidates()
+            self._sample_table_sizes()
+
+    def _sample_table_sizes(self):
+        """Refresh the table-size gauges once per health tick (~1s) —
+        operators read growth trends, not per-mutation precision."""
+        try:
+            with self.lock:
+                sizes = {"objects": len(self.objects),
+                         "nodes": len(self.nodes),
+                         "actors": len(self.actors),
+                         "kv": sum(len(d) for d in self.kv.values()),
+                         "functions": len(self.functions),
+                         "pgs": len(self.pgs),
+                         "task_events": len(self.task_events),
+                         "free_candidates": len(self._free_candidates),
+                         "tombstones": len(self._freed_tombstones)}
+                alive = sum(1 for e in self.nodes.values() if e.alive)
+            for t, n in sizes.items():
+                self._m_tables.set(n, tags={"table": t})
+            self._m_alive.set(alive)
+        except Exception:
+            pass
 
     # -- object directory ----------------------------------------------
 
@@ -492,13 +546,24 @@ class GcsService:
         """Flattened [(origin_labels, records)] across nodes for the head
         /metrics exposition. ``exclude_node``: the caller's own node id —
         its samples are already rendered locally (its registry and its
-        workers' federation store live in-process)."""
+        workers' federation store live in-process). The GCS process's OWN
+        registry (rpc counts/latency, pubsub fanout, table sizes, lock
+        waits) rides along under component=gcs — the server has no other
+        path to a scrape."""
         out = []
         with self.lock:
             for nid, payload in self._node_metrics.items():
                 if nid == exclude_node:
                     continue
                 out.extend(payload)
+        try:
+            from ray_tpu.util import metrics as _metrics
+
+            recs = _metrics.registry_records()
+            if any(r["samples"] for r in recs):
+                out.append(({"component": "gcs"}, recs))
+        except Exception:
+            pass
         return out
 
     def rpc_obj_info(self, ctx, oids):
@@ -705,7 +770,12 @@ class GcsService:
 
     def _publish(self, channel: str, payload):
         if self.server is not None:
-            self.server.broadcast(channel, payload)
+            n = self.server.broadcast(channel, payload)
+            if n:
+                keys = self._channel_keys
+                key = keys.get(channel) or keys.setdefault(
+                    channel, (("channel", channel),))
+                self._m_pubsub._inc_key(key, n)
 
     def rpc_ping(self, ctx):
         return "pong"

@@ -50,6 +50,18 @@ def main(argv=None):
                              is_scheduler=False,
                              listen_host=args.listen_host)
     adapter.attach(rt)
+    # daemon uptime, refreshed whenever this process's registry snapshots
+    # (heartbeat federation payloads) — a reset on the head /metrics
+    # reveals a silently restarted daemon
+    try:
+        from ray_tpu.util import metric_defs, metrics
+
+        started = time.monotonic()
+        uptime = metric_defs.get("rtpu_daemon_uptime_seconds")
+        metrics.register_collector(
+            lambda: uptime.set(time.monotonic() - started))
+    except Exception:
+        pass
     # `kill -USR1 <daemon pid>` dumps every thread's stack — into the
     # session's log dir, NOT the daemon's stdout (spawners routinely point
     # that at /dev/null, which used to lose daemon dumps and blind

@@ -28,15 +28,60 @@ interoperate.
 from __future__ import annotations
 
 import itertools
+import pickle
 import socket
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from multiprocessing.connection import Client as _MpClient
 from multiprocessing.connection import Listener as _MpListener
+from multiprocessing.reduction import ForkingPickler
+from time import perf_counter
 from typing import Any, Callable, Dict, Optional, Tuple
 
 WIRE_VERSION: Tuple[int, int] = (1, 0)
+
+#: transport instrumentation (defs in util/metric_defs.py): framed bytes
+#: both directions, server queue-wait (socket read -> handler start, the
+#: GCS accept-loop contention signal), client reconnects/timeouts.
+#: metric_defs.get is a cached fast path that survives clear_registry,
+#: so the accessor just rebuilds; tag keys stay pre-sorted.
+_REQ_KEY = (("kind", "req"),)
+_CAST_KEY = (("kind", "cast"),)
+
+
+def _rpc_metrics():
+    from ray_tpu.util import metric_defs as md
+
+    return {"sent": md.get("rtpu_rpc_sent_bytes_total"),
+            "recv": md.get("rtpu_rpc_recv_bytes_total"),
+            "requests": md.get("rtpu_rpc_server_requests_total"),
+            "queue_wait": md.get("rtpu_rpc_server_queue_wait_seconds"),
+            "reconnects": md.get("rtpu_rpc_client_reconnects_total"),
+            "reconnect_attempts": md.get(
+                "rtpu_rpc_client_reconnect_attempts_total"),
+            "timeouts": md.get("rtpu_rpc_client_timeouts_total")}
+
+
+def _send_framed(conn, send_lock, msg) -> None:
+    """Pickle-then-send_bytes (what ``conn.send`` does internally — same
+    reducer, no extra copy) so the framed size feeds the byte counters."""
+    buf = ForkingPickler.dumps(msg)
+    with send_lock:
+        conn.send_bytes(buf)
+    try:
+        _rpc_metrics()["sent"]._inc_key((), len(buf))
+    except Exception:
+        pass
+
+
+def _recv_framed(conn):
+    buf = conn.recv_bytes()
+    try:
+        _rpc_metrics()["recv"]._inc_key((), len(buf))
+    except Exception:
+        pass
+    return pickle.loads(buf)
 
 
 class WireVersionError(ConnectionError):
@@ -102,13 +147,17 @@ class RpcServer:
             self._conns.pop(conn.conn_id, None)
 
     def broadcast(self, channel: str, payload: Any,
-                  only_subscribed: bool = True):
+                  only_subscribed: bool = True) -> int:
+        """Push to subscribers; returns the delivery count (fanout)."""
         with self._lock:
             conns = list(self._conns.values())
+        n = 0
         for c in conns:
             if only_subscribed and channel not in c.subscriptions:
                 continue
             c.push(channel, payload)
+            n += 1
+        return n
 
     def close(self):
         self._closed = True
@@ -136,7 +185,7 @@ class ServerConn:
     def reader_loop(self):
         # handshake: first message must be a compatible hello
         try:
-            first = self.raw.recv()
+            first = _recv_framed(self.raw)
         except (EOFError, OSError, TypeError, ValueError):
             first = None
         try:
@@ -161,18 +210,23 @@ class ServerConn:
             return
         self.meta["wire_version"] = peer_version
         self._send(("hello_ack", WIRE_VERSION))
+        m = _rpc_metrics()
         while True:
             try:
-                msg = self.raw.recv()
+                msg = _recv_framed(self.raw)
             except (EOFError, OSError, TypeError, ValueError):
                 break
             kind = msg[0]
             if kind == "req":
                 _, req_id, method, args = msg
-                self.server._pool.submit(self._run, req_id, method, args)
+                m["requests"]._inc_key(_REQ_KEY)
+                self.server._pool.submit(self._run, req_id, method, args,
+                                         perf_counter())
             elif kind == "cast":
                 _, method, args = msg
-                self.server._pool.submit(self._run, None, method, args)
+                m["requests"]._inc_key(_CAST_KEY)
+                self.server._pool.submit(self._run, None, method, args,
+                                         perf_counter())
         self.server._drop_conn(self)
         cb = self.on_close
         if cb is not None:
@@ -181,7 +235,18 @@ class ServerConn:
             except Exception:
                 pass
 
-    def _run(self, req_id: Optional[int], method: str, args: tuple):
+    def _run(self, req_id: Optional[int], method: str, args: tuple,
+             enq_ts: Optional[float] = None):
+        if enq_ts is not None:
+            # thread-pool queue wait: socket read -> handler start. Tail
+            # growth here means the server's 16 handler threads (or 2
+            # host vCPUs) are saturated — the "is the GCS the
+            # bottleneck?" signal.
+            try:
+                _rpc_metrics()["queue_wait"]._observe_key(
+                    (), perf_counter() - enq_ts)
+            except Exception:
+                pass
         try:
             payload = self.server._handler(method, args, self)
             ok = True
@@ -195,8 +260,7 @@ class ServerConn:
 
     def _send(self, msg):
         try:
-            with self.send_lock:
-                self.raw.send(msg)
+            _send_framed(self.raw, self.send_lock, msg)
         except (OSError, BrokenPipeError, ValueError):
             pass
 
@@ -292,7 +356,7 @@ class RpcClient:
     def _read_until_drop(self):
         while True:
             try:
-                msg = self._conn.recv()
+                msg = _recv_framed(self._conn)
             except (EOFError, OSError, TypeError, ValueError):
                 # TypeError/ValueError: multiprocessing internals raise
                 # these when the fd is closed from under a blocked recv
@@ -313,8 +377,10 @@ class RpcClient:
     def _try_reconnect(self, max_wait_s: float = 120.0) -> bool:
         deadline = time.monotonic() + max_wait_s
         delay = 0.2
+        m = _rpc_metrics()
         while not self._closed and time.monotonic() < deadline:
             try:
+                m["reconnect_attempts"]._inc_key(())
                 conn = _MpClient(self._hostport, family="AF_INET",
                                  authkey=self._authkey)
                 try:
@@ -342,6 +408,7 @@ class RpcClient:
                     old.close()  # don't leak one fd per outage
                 except Exception:
                     pass
+                m["reconnects"]._inc_key(())
                 return True
             except Exception:
                 time.sleep(delay)
@@ -354,21 +421,34 @@ class RpcClient:
         box: list = []
         with self._pending_lock:
             self._pending[req_id] = (ev, box)
-        with self._send_lock:
-            self._conn.send(("req", req_id, method, args))
+        self._send_counted(("req", req_id, method, args))
         if not ev.wait(timeout):
             with self._pending_lock:
                 self._pending.pop(req_id, None)
+            try:
+                _rpc_metrics()["timeouts"]._inc_key(())
+            except Exception:
+                pass
             raise TimeoutError(f"rpc {method} timed out after {timeout}s")
         ok, payload = box
         if not ok:
             raise payload
         return payload
 
+    def _send_counted(self, msg) -> None:
+        # self._conn must be read INSIDE the send lock: the reconnect
+        # path swaps it under the same lock
+        buf = ForkingPickler.dumps(msg)
+        with self._send_lock:
+            self._conn.send_bytes(buf)
+        try:
+            _rpc_metrics()["sent"]._inc_key((), len(buf))
+        except Exception:
+            pass
+
     def cast(self, method: str, *args) -> None:
         try:
-            with self._send_lock:
-                self._conn.send(("cast", method, args))
+            self._send_counted(("cast", method, args))
         except (OSError, BrokenPipeError, ValueError):
             pass
 

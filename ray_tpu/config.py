@@ -151,6 +151,11 @@ _knob("metrics_federation", _bool, True,
 _knob("metrics_push_interval_s", float, 2.0,
       "min seconds between a worker's batched metric-delta pushes over "
       "the control pipe (<= 0 disables the push)", "core/worker.py")
+_knob("contention_profiler", _bool, True,
+      "instrument the runtime's hot locks (driver dispatch/ref locks, "
+      "GCS state lock) with wait-time accounting: rtpu_lock_wait_seconds "
+      "histograms + state.summarize_contention(); off = raw locks, zero "
+      "overhead", "util/contention.py")
 _knob("flight_recorder", _bool, True,
       "record per-task lifecycle phases (worker-side timing, driver "
       "histograms/ring, nested timeline slices); off = zero per-task "

@@ -28,38 +28,39 @@ INLINE_THRESHOLD = 8192
 
 _SHM_DIR = "/dev/shm"
 
-#: process-local spill/restore instrumentation (reference
-#: ``src/ray/stats/metric_defs.cc`` spill metrics role). Created lazily so
-#: importing the store never drags the metrics registry into processes that
-#: don't serve /metrics; registered on first StoreClient so a scrape shows
-#: the series (at 0) before the first spill.
-_metrics = None
+#: process-local store instrumentation, defined centrally in
+#: ``util/metric_defs.py`` (reference ``src/ray/stats/metric_defs.cc``
+#: role). Fetched lazily so importing the store never drags the metrics
+#: registry into processes that don't serve /metrics; the first
+#: StoreClient touches it so a scrape shows the series (at 0) before the
+#: first use. metric_defs.get caches + survives clear_registry, so the
+#: accessor just rebuilds the dict.
+
+#: pre-sorted tag keys for the hot put path (merging/sorting a one-tag
+#: dict per put is pure overhead there)
+_PATH_KEYS = {p: (("path", p),) for p in ("inline", "arena", "file",
+                                          "spill")}
+_NO_TAGS = ()
 
 
 def _store_metrics():
-    global _metrics
-    if _metrics is None:
-        from ray_tpu.util.metrics import Counter
+    from ray_tpu.util import metric_defs as md
 
-        _metrics = {
-            "spilled_bytes": Counter(
-                "object_store_spilled_bytes_total",
-                "bytes written to the disk spill directory"),
-            "spilled_objects": Counter(
-                "object_store_spilled_objects_total",
-                "objects written to the disk spill directory"),
-            "restored_bytes": Counter(
-                "object_store_restored_bytes_total",
-                "spilled bytes promoted back into shared memory"),
-            "restored_objects": Counter(
-                "object_store_restored_objects_total",
-                "spilled objects promoted back into shared memory"),
-            "spill_read_bytes": Counter(
-                "object_store_spill_read_bytes_total",
-                "bytes served directly from spill files (reads + remote "
-                "pulls that did not restore first)"),
-        }
-    return _metrics
+    return {
+        "put_seconds": md.get("rtpu_object_store_put_seconds"),
+        "get_seconds": md.get("rtpu_object_store_get_seconds"),
+        "puts": md.get("rtpu_object_store_puts_total"),
+        "put_bytes": md.get("rtpu_object_store_put_bytes_total"),
+        "spilled_bytes": md.get("rtpu_object_store_spilled_bytes_total"),
+        "spilled_objects": md.get(
+            "rtpu_object_store_spilled_objects_total"),
+        "restored_bytes": md.get(
+            "rtpu_object_store_restored_bytes_total"),
+        "restored_objects": md.get(
+            "rtpu_object_store_restored_objects_total"),
+        "spill_read_bytes": md.get(
+            "rtpu_object_store_spill_read_bytes_total"),
+    }
 
 
 def _seg_path(session: str, obj_id: ObjectID) -> str:
@@ -128,6 +129,59 @@ class StoreClient:
         # stays the accurate cross-process accounting API).
         self._file_bytes = 0
         _store_metrics()  # register the series for /metrics scrapes
+        self._register_gauges()
+
+    def _register_gauges(self) -> None:
+        """Sampled store gauges, refreshed by the metrics collector hook
+        at every exposition/federation snapshot. Weakly bound: a client
+        dropped by shutdown unregisters itself on the next run, so
+        repeated init/shutdown cycles don't accumulate hooks."""
+        import weakref
+
+        from ray_tpu.util import metric_defs, metrics
+
+        used = metric_defs.get("rtpu_object_store_bytes_used")
+        cap = metric_defs.get("rtpu_object_store_capacity_bytes")
+        pins = metric_defs.get("rtpu_object_store_pins")
+        spill_dir = metric_defs.get("rtpu_object_store_spill_dir_bytes")
+        capacity = int(config.get("store_capacity"))
+        wr = weakref.ref(self)
+        # spill_dir_bytes is a directory scan (one stat per spilled
+        # object) over a SHARED per-node dir; collectors fire on every
+        # snapshot (worker delta push ~2s, heartbeat ~2s, scrapes), so
+        # rate-limit the scan AND sample it only outside workers — N
+        # workers rescanning the same dir would multiply identical
+        # node-wide sweeps (the driver/daemon series carries the value)
+        import os as _os
+
+        sample_spill = _os.environ.get("RTPU_WORKER") != "1"
+        spill_cache = [0.0, 0.0]  # [last_scan_monotonic, last_value]
+
+        def collect():
+            import time as _time
+
+            c = wr()
+            if c is None:
+                metrics.unregister_collector(collect)
+                return
+            total = c._file_bytes
+            if c._arena is not None:
+                try:
+                    total += c._arena.stats()["used"]
+                except Exception:
+                    pass
+            used.set(total)
+            cap.set(capacity)
+            pins.set(len(c._pins))
+            if sample_spill:
+                now = _time.monotonic()
+                if now - spill_cache[0] >= 5.0:
+                    spill_cache[0] = now
+                    spill_cache[1] = c.spill_dir_bytes()
+                spill_dir.set(spill_cache[1])
+
+        self._collector = collect
+        metrics.register_collector(collect)
 
     # -- write path -------------------------------------------------------
 
@@ -151,10 +205,15 @@ class StoreClient:
         return of the producing task, and siblings that survived the loss
         keep their existing segment (deterministic tasks produce the same
         bytes)."""
+        import time as _time
+
+        m = _store_metrics()
         size = serialization.serialized_size(data, buffers)
+        t0 = _time.perf_counter()
         if size < INLINE_THRESHOLD:
             out = bytearray(size)
             serialization.write_into(memoryview(out), data, buffers)
+            self._note_put(m, "inline", size, t0)
             return bytes(out), size
         if self.contains(obj_id):
             return None, size  # already present (lineage re-run survivor)
@@ -168,6 +227,7 @@ class StoreClient:
                 # directory's reference, dropped only by delete(). Sealed
                 # objects with it held are never evicted, so live
                 # ObjectRefs can't lose data to allocation pressure.
+                self._note_put(m, "arena", size, t0)
                 return None, size
             # arena full: fall through to a file segment (never evict
             # referenced objects to make room)
@@ -191,12 +251,24 @@ class StoreClient:
             os.close(fd)
         mm.close()
         if spill:
-            m = _store_metrics()
             m["spilled_bytes"].inc(size)
             m["spilled_objects"].inc()
         else:
             self._file_bytes += size
+        self._note_put(m, "spill" if spill else "file", size, t0)
         return None, size
+
+    @staticmethod
+    def _note_put(m, path: str, size: int, t0: float) -> None:
+        import time as _time
+
+        try:
+            m["puts"]._inc_key(_PATH_KEYS[path])
+            m["put_bytes"]._inc_key(_NO_TAGS, size)
+            m["put_seconds"]._observe_key(
+                _NO_TAGS, _time.perf_counter() - t0)
+        except Exception:
+            pass
 
     def put_serialized(self, obj_id: ObjectID, blob: bytes) -> None:
         """Write an already-serialized blob into a segment (spill-in path)."""
@@ -214,6 +286,9 @@ class StoreClient:
 
     def get(self, obj_id: ObjectID) -> Any:
         """Deserialize from shm; zero-copy views pin the mapping."""
+        import time as _time
+
+        t0 = _time.perf_counter()
         with self._lock:
             pinned = self._pins.get(obj_id)
         if pinned is None and self._arena is not None:
@@ -277,7 +352,13 @@ class StoreClient:
                 else:
                     pinned = _Pinned(mm, -1, size)
                     self._pins[obj_id] = pinned
-        return serialization.read_from(memoryview(pinned.mm))
+        value = serialization.read_from(memoryview(pinned.mm))
+        try:
+            _store_metrics()["get_seconds"]._observe_key(
+                _NO_TAGS, _time.perf_counter() - t0)
+        except Exception:
+            pass
+        return value
 
     def get_raw(self, obj_id: ObjectID) -> Optional[bytes]:
         """The serialized segment bytes (node-to-node transfer source).

@@ -43,6 +43,23 @@ logger = logging.getLogger(__name__)
 _runtime = None
 _runtime_lock = threading.Lock()
 
+# -- built-in pipe/spawn instrumentation (defs in util/metric_defs) ------
+# Pre-sorted tag keys: the pipe counters sit on the per-message hot
+# path, so each message pays two cached _inc_key calls and nothing
+# else. metric_defs.get is itself a cached fast path that re-registers
+# after a test's clear_registry, so the accessor just rebuilds.
+_SENT_KEY = (("direction", "sent"),)
+_RECV_KEY = (("direction", "recv"),)
+_SPAWN_KEYS = {"zygote": (("mode", "zygote"),), "exec": (("mode", "exec"),)}
+
+
+def _pipe_metrics():
+    from ray_tpu.util import metric_defs as md
+
+    return {"sent": md.get("rtpu_pipe_sent_bytes_total"),
+            "recv": md.get("rtpu_pipe_recv_bytes_total"),
+            "msgs": md.get("rtpu_pipe_messages_total")}
+
 
 def _set_runtime(rt):
     global _runtime
@@ -59,10 +76,13 @@ class _WorkerState:
     __slots__ = (
         "worker_id", "proc", "conn", "kind", "status", "current",
         "held", "actor_id", "reader", "released", "send_lock", "log_path",
-        "pending_spec", "inflight_specs", "pinned",
+        "pending_spec", "inflight_specs", "pinned", "spawn_ts",
+        "spawn_mode",
     )
 
     def __init__(self, worker_id: WorkerID, proc, kind: str):
+        from ray_tpu.util.contention import timed_lock
+
         self.worker_id = worker_id
         self.proc = proc  # subprocess.Popen
         self.conn = None  # attached when the worker dials back
@@ -72,7 +92,7 @@ class _WorkerState:
         self.held: Dict[str, float] = {}
         self.actor_id: Optional[bytes] = None
         self.released = False
-        self.send_lock = threading.Lock()
+        self.send_lock = timed_lock("driver.worker_send")
         self.log_path = ""
         self.pending_spec: Optional[dict] = None  # dispatch once connected
         # all dispatched-but-unfinished specs keyed by task id (>1 only for
@@ -80,12 +100,26 @@ class _WorkerState:
         self.inflight_specs: Dict[bytes, dict] = {}
         # objects this worker process borrows (oid -> transition count)
         self.pinned: Dict[bytes, int] = {}
+        # spawn-latency stamp (zygote | exec), observed on "ready"
+        self.spawn_ts = time.monotonic()
+        self.spawn_mode = "exec"
 
     def send(self, msg):
         if self.conn is None:
             raise OSError("worker not connected yet")
+        # pre-pickle so the framed byte count is known (what conn.send
+        # does internally anyway — same reducer, no extra copy)
+        from multiprocessing.reduction import ForkingPickler
+
+        buf = ForkingPickler.dumps(msg)
         with self.send_lock:
-            self.conn.send(msg)
+            self.conn.send_bytes(buf)
+        try:
+            m = _pipe_metrics()
+            m["sent"]._inc_key((), len(buf))
+            m["msgs"]._inc_key(_SENT_KEY)
+        except Exception:
+            pass
 
 
 def _worker_site_dirs() -> list:
@@ -350,7 +384,13 @@ class DriverRuntime:
             self.total[k] = float(v)
         self.avail = dict(self.total)
 
-        self.lock = threading.RLock()
+        # hot-lock contention accounting (util/contention.py): the
+        # dispatch lock and ref lock are the driver's scalability
+        # bottlenecks under multi-client load — instrument them so
+        # state.summarize_contention() can say WHERE time goes
+        from ray_tpu.util.contention import timed_lock, timed_rlock
+
+        self.lock = timed_rlock("driver.lock")
         self.workers: Dict[WorkerID, _WorkerState] = {}
         self.ready_tasks: deque = deque()
         self.waiting_specs: Dict[bytes, dict] = {}
@@ -379,6 +419,21 @@ class DriverRuntime:
         self._status_keys = {False: (("status", "ok"),),
                              True: (("status", "error"),)}
         self._finished_counter = None
+        # built-in scheduler/worker-pool counters (defs in
+        # util/metric_defs.py, reference metric_defs.cc role); tag keys
+        # pre-sorted for the submit/dispatch hot paths
+        from ray_tpu.util import metric_defs as _md
+
+        self._m_submitted = _md.get("rtpu_scheduler_tasks_submitted_total")
+        self._m_dispatched = _md.get(
+            "rtpu_scheduler_tasks_dispatched_total")
+        self._m_spawns = _md.get("rtpu_worker_spawns_total")
+        self._m_spawn_lat = _md.get("rtpu_worker_spawn_seconds")
+        self._m_deaths = _md.get("rtpu_worker_deaths_total")
+        self._m_zygote_restarts = _md.get("rtpu_zygote_restarts_total")
+        self._type_keys = {ts.TASK: (("type", "task"),),
+                           ts.ACTOR_CREATE: (("type", "actor_create"),),
+                           ts.ACTOR_METHOD: (("type", "actor_method"),)}
         self.pool_cap = max(4, cpus)
         self.pool_hard_cap = max(64, cpus * 8)
         self._spawning = 0  # spawns decided but not yet registered
@@ -409,7 +464,7 @@ class DriverRuntime:
         # first return turns terminal. Node-level 0<->1 transitions are
         # reported to the cluster directory, which never evicts pinned
         # entries and tells holders to free segments on the last unpin.
-        self._ref_lock = threading.Lock()
+        self._ref_lock = timed_lock("driver.ref_lock")
         self._pin_total: Dict[bytes, int] = {}
         self._arg_pins: Dict[bytes, List[bytes]] = {}
         # GC-safety (advisor r3): ObjectRef.__del__ can fire at ANY
@@ -499,6 +554,51 @@ class DriverRuntime:
                 usage_threshold=threshold,
                 on_pressure=kill_retriable_policy(self),
             ).start()
+
+        self._metrics_collector = None
+        self._register_core_gauges()
+
+    def _register_core_gauges(self) -> None:
+        """Sampled scheduler gauges (queue depth, in-flight, pool size,
+        refcount/lineage table sizes), refreshed by the metrics collector
+        hook at every exposition/federation snapshot — the mutation hot
+        paths pay nothing. Lock-free reads: dict/deque sizes are
+        approximate by nature here and a torn read only skews one sample."""
+        from ray_tpu.util import metric_defs, metrics
+
+        g_ready = metric_defs.get("rtpu_scheduler_ready_queue_depth")
+        g_inflight = metric_defs.get("rtpu_scheduler_inflight_tasks")
+        g_pending = metric_defs.get("rtpu_scheduler_actor_pending_calls")
+        g_pool = metric_defs.get("rtpu_worker_pool_size")
+        g_ref = metric_defs.get("rtpu_refcount_entries")
+        g_argpin = metric_defs.get("rtpu_refcount_arg_pin_entries")
+        g_lin = metric_defs.get("rtpu_lineage_entries")
+        g_linb = metric_defs.get("rtpu_lineage_bytes")
+
+        def collect():
+            if self._shutdown:
+                metrics.unregister_collector(collect)
+                return
+            g_ready.set(len(self.ready_tasks))
+            inflight = 0
+            pool = {"starting": 0, "idle": 0, "busy": 0}
+            for ws in list(self.workers.values()):
+                inflight += len(ws.inflight_specs)
+                if ws.status in pool:
+                    pool[ws.status] += 1
+            g_inflight.set(inflight)
+            for k, v in pool.items():
+                g_pool.set(v, tags={"state": k})
+            g_pending.set(sum(
+                len(i.pending_queue)
+                for i in list(self.gcs.actors.values())))
+            g_ref.set(len(self._pin_total))
+            g_argpin.set(len(self._arg_pins))
+            g_lin.set(len(self._lineage))
+            g_linb.set(self._lineage_bytes)
+
+        self._metrics_collector = collect
+        metrics.register_collector(collect)
 
     # ------------------------------------------------------------------
     # log streaming
@@ -600,6 +700,8 @@ class DriverRuntime:
                 env["PYTHONPATH"] = (pkg_root + os.pathsep
                                      + env.get("PYTHONPATH", ""))
                 self._zygote_obj = _Zygote(env)
+                if z is not None:  # a previous fork-server died
+                    self._m_zygote_restarts._inc_key(())
                 return self._zygote_obj
             except Exception:
                 logger.exception("zygote start failed; exec spawning only")
@@ -628,7 +730,9 @@ class DriverRuntime:
                 logger.exception("zygote spawn failed; falling back to exec")
             else:
                 ws = _WorkerState(wid, proc, kind)
+                ws.spawn_mode = "zygote"
                 ws.log_path = log_path
+                self._m_spawns._inc_key(_SPAWN_KEYS["zygote"])
                 with self.lock:
                     self.workers[wid] = ws
                 threading.Thread(target=self._reap, args=(ws,),
@@ -701,6 +805,7 @@ class DriverRuntime:
         log_f.close()
         ws = _WorkerState(wid, proc, kind)
         ws.log_path = log_path
+        self._m_spawns._inc_key(_SPAWN_KEYS["exec"])
         with self.lock:
             self.workers[wid] = ws
         threading.Thread(target=self._reap, args=(ws,), daemon=True).start()
@@ -712,12 +817,23 @@ class DriverRuntime:
             self._on_worker_death(ws)
 
     def _reader_loop(self, ws: _WorkerState):
+        import pickle as _pickle
+
         while True:
             try:
-                msg = ws.conn.recv()
+                # recv_bytes + loads == conn.recv() internals, with the
+                # framed size in hand for the pipe byte counters
+                buf = ws.conn.recv_bytes()
+                msg = _pickle.loads(buf)
             except (EOFError, OSError):
                 self._on_worker_death(ws)
                 return
+            try:
+                m = _pipe_metrics()
+                m["recv"]._inc_key((), len(buf))
+                m["msgs"]._inc_key(_RECV_KEY)
+            except Exception:
+                pass
             try:
                 self._handle_msg(ws, msg)
             except Exception:
@@ -731,6 +847,10 @@ class DriverRuntime:
                 return
             was = ws.status
             ws.status = "dead"
+        try:
+            self._m_deaths._inc_key(())
+        except Exception:
+            pass
         self._drop_worker_pins(ws)
         with self.lock:
             if not ws.released:
@@ -837,10 +957,20 @@ class DriverRuntime:
         kind = msg[0]
         if kind == "ready":
             with self.lock:
-                if ws.status == "starting":
+                was_starting = ws.status == "starting"
+                if was_starting:
                     ws.status = "idle"
                 pending = ws.pending_spec
                 ws.pending_spec = None
+            if was_starting:
+                # worker launch latency: spawn decision -> ready message
+                # (the zygote-vs-exec attribution for actors_launched/s)
+                try:
+                    self._m_spawn_lat._observe_key(
+                        _SPAWN_KEYS[ws.spawn_mode],
+                        time.monotonic() - ws.spawn_ts)
+                except Exception:
+                    pass
             if pending is not None:
                 self._dispatch_to(ws, pending)
             else:
@@ -978,22 +1108,13 @@ class DriverRuntime:
 
     def _phase_metrics(self):
         if self._phase_hist is None:
-            from ray_tpu.util.metrics import Counter, Histogram
+            from ray_tpu.util import metric_defs
 
             # racing first-finishers both create; registration merges, so
             # samples land in one shared store either way
-            self._phase_hist = Histogram(
-                "rtpu_task_phase_seconds",
-                "task lifecycle phase latency "
-                "(submit->queue->lease->arg_fetch->deserialize->execute->"
-                "store_result)",
-                boundaries=[0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1,
-                            0.5, 1, 5, 10, 60],
-                tag_keys=("phase",))
-            self._finished_counter = Counter(
-                "rtpu_tasks_finished_total",
-                "tasks finished on this node's scheduler",
-                tag_keys=("status",))
+            self._phase_hist = metric_defs.get("rtpu_task_phase_seconds")
+            self._finished_counter = metric_defs.get(
+                "rtpu_tasks_finished_total")
         return self._phase_hist
 
     def _record_flight(self, spec: dict, ws: _WorkerState, start_ts: float,
@@ -1717,6 +1838,10 @@ class DriverRuntime:
         # flight-recorder stamp (setdefault: retries/reconstruction and
         # forwarded specs keep the ORIGINAL submit time)
         spec.setdefault("lc_submit", time.time())
+        try:
+            self._m_submitted._inc_key(self._type_keys[spec["type"]])
+        except Exception:
+            pass
         self._trace_submit(spec)
         deps = ts.arg_refs(spec["args"], spec["kwargs"])
         self._pin_args(spec)
@@ -1751,6 +1876,10 @@ class DriverRuntime:
 
     def _submit_actor_spec(self, spec: dict) -> List[ObjectRef]:
         spec.setdefault("lc_submit", time.time())
+        try:
+            self._m_submitted._inc_key(self._type_keys[spec["type"]])
+        except Exception:
+            pass
         self._pin_args(spec)
         if (self.cluster is not None
                 and self.gcs.get_actor(ActorID(spec["actor_id"])) is None
@@ -1829,6 +1958,10 @@ class DriverRuntime:
 
     def _dispatch_to(self, ws: _WorkerState, spec: dict):
         self._attach_inline_args(spec)
+        try:
+            self._m_dispatched._inc_key(())
+        except Exception:
+            pass
         with self.lock:
             ws.status = "busy"
             ws.current = spec
@@ -2233,9 +2366,11 @@ class DriverRuntime:
         from ray_tpu.core import object_ref as _object_ref
 
         try:
-            from ray_tpu.util.metrics import federation
+            from ray_tpu.util.metrics import federation, unregister_collector
 
             federation.clear()  # drop this runtime's worker-origin samples
+            if self._metrics_collector is not None:
+                unregister_collector(self._metrics_collector)
         except Exception:
             pass
         _object_ref.clear_ref_hook()

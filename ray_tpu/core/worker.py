@@ -889,16 +889,12 @@ class WorkerRuntime:
         worker's id."""
         try:
             if self._wmetrics is None:
-                from ray_tpu.util.metrics import Counter, Histogram
+                from ray_tpu.util import metric_defs
 
                 self._wmetrics = {
-                    "tasks": Counter(
-                        "rtpu_worker_tasks_total",
-                        "tasks executed by this worker process"),
-                    "exec": Histogram(
-                        "rtpu_worker_task_exec_seconds",
-                        "user-code execution time in this worker",
-                        boundaries=[0.001, 0.01, 0.1, 1, 10, 60, 600]),
+                    "tasks": metric_defs.get("rtpu_worker_tasks_total"),
+                    "exec": metric_defs.get(
+                        "rtpu_worker_task_exec_seconds"),
                 }
             self._wmetrics["tasks"].inc()
             if "execute" in phases:

@@ -1,17 +1,39 @@
-"""Dashboard-lite: in-driver HTTP endpoints for state + metrics.
+"""Dashboard-lite: in-driver HTTP endpoints for state + metrics + jobs.
 
 Role analog: the reference dashboard head (``dashboard/head.py``) reduced
 to its API surface: JSON state endpoints (nodes/actors/tasks/objects/
-workers/placement groups/summaries) and a Prometheus ``/metrics``
-exposition, served from the driver process on a background thread.
+workers/placement groups/summaries), a Prometheus ``/metrics``
+exposition, and the job-submission REST surface (reference
+``dashboard/modules/job/job_head.py``: submit/stop/status/logs over
+HTTP), served from the driver process on a background thread. The server
+is a ``ThreadingHTTPServer`` on purpose: one slow log poll or job submit
+must never block a concurrent ``/metrics`` scrape.
 """
 
 from __future__ import annotations
 
 import json
+import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+
+_JOB_ID_RE = re.compile(r"^/api/jobs/([\w.-]+)(/logs|/stop)?$")
+
+_job_client = None
+_job_client_lock = threading.Lock()
+
+
+def _jobs():
+    """Lazy singleton JobSubmissionClient — created on first REST use so
+    starting a dashboard never spawns job machinery by itself."""
+    global _job_client
+    with _job_client_lock:
+        if _job_client is None:
+            from ray_tpu.job_submission import JobSubmissionClient
+
+            _job_client = JobSubmissionClient()
+        return _job_client
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -35,6 +57,10 @@ class _Handler(BaseHTTPRequestHandler):
             "/api/summary/objects": st.summarize_objects,
             # task-lifecycle flight recorder (recent per-phase records)
             "/api/task_events": st.list_task_events,
+            # lock-contention profiler (this process's hot locks)
+            "/api/contention": st.summarize_contention,
+            # job submission REST (list; per-job routes handled below)
+            "/api/jobs": _jobs_list,
             # serve REST (reference dashboard/modules/serve role)
             "/api/serve/applications": serve_rest.serve_rest_get,
             # Chrome-trace task spans (reference timeline view role)
@@ -64,6 +90,18 @@ class _Handler(BaseHTTPRequestHandler):
                 payload = {"endpoints": sorted(routes) + ["/metrics"]}
             elif self.path in routes:
                 payload = routes[self.path]()
+            elif (m := _JOB_ID_RE.match(self.path)) and \
+                    m.group(2) in (None, "/logs"):
+                job_id = m.group(1)
+                try:
+                    if m.group(2) == "/logs":
+                        payload = {"job_id": job_id,
+                                   "logs": _jobs().get_job_logs(job_id)}
+                    else:
+                        payload = vars(_jobs().get_job_info(job_id))
+                except ValueError as e:
+                    self._json_reply(404, {"error": str(e)})
+                    return
             else:
                 self.send_response(404)
                 self.end_headers()
@@ -89,6 +127,33 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
+
+    def do_POST(self):  # noqa: N802 — job submission REST
+        try:
+            m = _JOB_ID_RE.match(self.path)
+            if self.path == "/api/jobs":
+                n = int(self.headers.get("Content-Length", 0) or 0)
+                body = json.loads(self.rfile.read(n) or b"{}")
+                entrypoint = body.get("entrypoint")
+                if not entrypoint:
+                    self._json_reply(400,
+                                     {"error": "entrypoint is required"})
+                    return
+                job_id = _jobs().submit_job(
+                    entrypoint=entrypoint,
+                    runtime_env=body.get("runtime_env"),
+                    metadata=body.get("metadata"),
+                    submission_id=body.get("submission_id"))
+                self._json_reply(200, {"result": {"job_id": job_id}})
+            elif m and m.group(2) == "/stop":
+                stopped = _jobs().stop_job(m.group(1))
+                code = 200 if stopped else 404
+                self._json_reply(code, {"result": {"stopped": stopped}})
+            else:
+                self.send_response(404)
+                self.end_headers()
+        except Exception as e:  # noqa: BLE001
+            self._json_reply(500, {"error": str(e)})
 
     def do_PUT(self):  # noqa: N802 — declarative serve deploy (REST)
         if self.path != "/api/serve/applications":
@@ -116,6 +181,11 @@ class _Handler(BaseHTTPRequestHandler):
                              {"result": serve_rest.serve_rest_delete()})
         except Exception as e:  # noqa: BLE001
             self._json_reply(500, {"error": str(e)})
+
+
+def _jobs_list():
+    """All known jobs (reference GET /api/jobs/)."""
+    return [vars(info) for info in _jobs().list_jobs()]
 
 
 def _timeline_events():
@@ -180,7 +250,10 @@ def start_dashboard(host: str = "127.0.0.1", port: int = 8265) -> Dashboard:
 
 
 def stop_dashboard() -> None:
-    global _dashboard
+    global _dashboard, _job_client
     if _dashboard is not None:
         _dashboard.stop()
         _dashboard = None
+    with _job_client_lock:
+        # drop the job client: its actor handles die with the runtime
+        _job_client = None

@@ -136,8 +136,9 @@ const TABS = {
   nodes: "/api/nodes", actors: "/api/actors", tasks: "/api/tasks",
   objects: "/api/objects", workers: "/api/workers",
   placement_groups: "/api/placement_groups",
+  jobs: "/api/jobs",
   serve: "/api/serve/applications",
-  timeline: null, metrics: null,
+  timeline: null, metrics: null, contention: null,
 };
 let current = "nodes";
 const nav = document.getElementById("nav");
@@ -293,11 +294,22 @@ async function renderMetrics() {
     h.push({t: now, v: s.value});
     if (h.length > 120) h.shift();
   }
-  // cards for the first 12 metrics in stable (alphabetical) order, so a
-  // card never jumps between polls; the full table below has the rest
+  // cards: core-runtime signal first (queue depth, in-flight, store
+  // usage, GCS heartbeat lag), then everything else alphabetically —
+  // stable order, so a card never jumps between polls
+  const CORE = ["rtpu_scheduler_ready_queue_depth",
+    "rtpu_scheduler_inflight_tasks", "rtpu_object_store_bytes_used",
+    "rtpu_worker_pool_size", "rtpu_pipe_recv_bytes_total",
+    "rtpu_tasks_finished_total", "rtpu_gcs_nodes_alive",
+    "rtpu_refcount_entries"];
+  const coreRank = n => {
+    const i = CORE.findIndex(c => n === c || n.startsWith(c + "{"));
+    return i === -1 ? CORE.length : i;
+  };
   const ranked = [...HISTORY.entries()]
     .filter(([, h]) => h.length >= 1)
-    .sort((a, b) => a[0].localeCompare(b[0]));
+    .sort((a, b) => (coreRank(a[0]) - coreRank(b[0]))
+                    || a[0].localeCompare(b[0]));
   const cards = ranked.slice(0, 12).map(([name, h]) => {
     const v = h[h.length - 1].v;
     return `<div class="mcard"><div class="name">${esc(name)}</div>` +
@@ -314,6 +326,33 @@ async function renderMetrics() {
       `<tr><td>${esc(s.name)}</td><td>${s.value}</td></tr>`).join("") +
     `</tbody></table>`;
 }
+// -- contention ------------------------------------------------------------
+async function renderContention() {
+  const sp = document.getElementById("special");
+  const data = (await (await fetch("/api/contention")).json()).result;
+  if (!data || !data.enabled) {
+    sp.innerHTML = "<div class='note'>contention profiler disabled " +
+      "(RTPU_CONTENTION_PROFILER=0)</div>";
+    return;
+  }
+  const rows = Object.entries(data.locks || {});
+  if (!rows.length) {
+    sp.innerHTML = "<div class='note'>no instrumented locks touched " +
+      "yet</div>";
+    return;
+  }
+  sp.innerHTML =
+    `<div class="note">driver-process hot locks, worst cumulative wait ` +
+    `first (peer processes' rtpu_lock_* series are on /metrics)</div>` +
+    `<table><thead><tr><th>lock</th><th>acquisitions</th>` +
+    `<th>contended</th><th>contended %</th><th>total wait (s)</th>` +
+    `<th>max wait (s)</th></tr></thead><tbody>` +
+    rows.map(([n, s]) =>
+      `<tr><td>${esc(n)}</td><td>${s.acquisitions}</td>` +
+      `<td>${s.contended}</td><td>${s.contended_pct}</td>` +
+      `<td>${s.wait_total_s}</td><td>${s.wait_max_s}</td></tr>`
+    ).join("") + `</tbody></table>`;
+}
 // -- main loop -------------------------------------------------------------
 async function refresh() {
   for (const n of Object.keys(TABS))
@@ -329,6 +368,9 @@ async function refresh() {
     } else if (current === "metrics") {
       tbl.style.display = "none";
       await renderMetrics();
+    } else if (current === "contention") {
+      tbl.style.display = "none";
+      await renderContention();
     } else {
       sp.innerHTML = ""; tbl.style.display = "table";
       const resp = await fetch(TABS[current]);

@@ -59,37 +59,20 @@ from ray_tpu.data.block import (
 #: finished exchange (max blocks in flight seen, parts, bytes, ...)
 _LAST_EXCHANGE_STATS: Dict[str, Any] = {}
 
-_metrics = None
-
 
 def _exchange_metrics():
-    """Engine metrics (reference data-metrics role): registered on first
-    exchange so a /metrics scrape during a run shows the live values."""
-    global _metrics
-    if _metrics is None:
-        from ray_tpu.util.metrics import Counter, Gauge
+    """Engine metrics (reference data-metrics role), defined centrally in
+    util/metric_defs.py; registered on first exchange so a /metrics
+    scrape during a run shows the live values. metric_defs.get caches
+    and survives clear_registry, so the accessor just rebuilds."""
+    from ray_tpu.util import metric_defs as md
 
-        _metrics = {
-            "in_flight": Gauge(
-                "data_exchange_blocks_in_flight",
-                "partition-output blocks not yet consumed by a reducer"),
-            "queue_depth": Gauge(
-                "data_exchange_reducer_queue_depth",
-                "forwarded-but-unacked blocks per reducer actor",
-                tag_keys=("reducer",)),
-            "bytes": Counter(
-                "data_exchange_bytes_total",
-                "block bytes that crossed the exchange",
-                tag_keys=("kind",)),
-            "blocks": Counter(
-                "data_exchange_blocks_total",
-                "blocks that crossed the exchange", tag_keys=("kind",)),
-            "spill_dir": Gauge(
-                "object_store_spill_dir_bytes",
-                "bytes currently spilled to disk on this node (sampled "
-                "while an exchange runs)"),
-        }
-    return _metrics
+    return {
+        "in_flight": md.get("rtpu_data_exchange_blocks_in_flight"),
+        "queue_depth": md.get("rtpu_data_exchange_reducer_queue_depth"),
+        "bytes": md.get("rtpu_data_exchange_bytes_total"),
+        "blocks": md.get("rtpu_data_exchange_blocks_total"),
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -409,7 +392,6 @@ class _ExchangeScheduler:
                       "max_in_flight_seen": 0, "partitions": 0,
                       "reducers": 0}
         self._reducers: List[Any] = []
-        self._spill_sampled = 0.0
 
     # -- prologues --------------------------------------------------------
 
@@ -537,14 +519,9 @@ class _ExchangeScheduler:
             self.stats["max_in_flight_seen"] = max(
                 self.stats["max_in_flight_seen"], fl)
             m["in_flight"].set(fl)
-            now = time.monotonic()
-            if now - self._spill_sampled > 0.5:
-                self._spill_sampled = now
-                try:
-                    mem = ray_tpu.object_store_memory()
-                    m["spill_dir"].set(mem.get("spilled_bytes", 0))
-                except Exception:
-                    pass
+            # spill_dir_bytes is NOT sampled here: the StoreClient
+            # collector owns that gauge and runs right before every
+            # snapshot, so a second writer could never be observed
 
         try:
             while True:

@@ -35,8 +35,8 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 
-@dataclass
-class _Request:
+@dataclass(eq=False)   # identity semantics: generated __eq__ would
+class _Request:        # elementwise-compare the prompt arrays and raise
     prompt: np.ndarray                 # [P] int32
     max_new_tokens: int
     # token sink: int token, None = done, Exception = engine failure

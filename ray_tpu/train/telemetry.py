@@ -46,32 +46,18 @@ class StepTelemetry:
 
     def _metrics(self) -> Dict[str, Any]:
         if self._m is None:
-            from ray_tpu.util.metrics import Counter, Gauge, Histogram
+            from ray_tpu.util import metric_defs as md
 
             self._m = {
-                "step_time": Histogram(
-                    "rtpu_train_step_seconds",
-                    "wall time per optimizer step",
-                    boundaries=[0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5,
-                                10, 60, 600]),
-                "steps": Counter("rtpu_train_steps_total",
-                                 "optimizer steps recorded"),
-                "tokens_per_s": Gauge("rtpu_train_tokens_per_s",
-                                      "training throughput"),
-                "mfu": Gauge("rtpu_train_mfu",
-                             "measured model FLOPs utilization (0..1)"),
-                "loss": Gauge("rtpu_train_loss", "last reported loss"),
-                "compiles": Counter("rtpu_train_compile_total",
-                                    "XLA (re)compilation events"),
-                "compile_time": Histogram(
-                    "rtpu_train_compile_seconds",
-                    "wall time of compile events (first call of a fresh "
-                    "program; includes its first execution)",
-                    boundaries=[0.1, 1, 5, 10, 30, 60, 300, 1200]),
-                "hbm_used": Gauge("rtpu_tpu_hbm_used_bytes",
-                                  "HBM bytes in use (local devices)"),
-                "hbm_limit": Gauge("rtpu_tpu_hbm_limit_bytes",
-                                   "HBM capacity (local devices)"),
+                "step_time": md.get("rtpu_train_step_seconds"),
+                "steps": md.get("rtpu_train_steps_total"),
+                "tokens_per_s": md.get("rtpu_train_tokens_per_s"),
+                "mfu": md.get("rtpu_train_mfu"),
+                "loss": md.get("rtpu_train_loss"),
+                "compiles": md.get("rtpu_train_compile_total"),
+                "compile_time": md.get("rtpu_train_compile_seconds"),
+                "hbm_used": md.get("rtpu_tpu_hbm_used_bytes"),
+                "hbm_limit": md.get("rtpu_tpu_hbm_limit_bytes"),
             }
         return self._m
 

@@ -1,0 +1,437 @@
+"""Built-in core-runtime metric definitions — ONE central registry.
+
+Role analog: ``src/ray/stats/metric_defs.cc`` (the reference's ~90
+built-in gauges/counters/histograms for scheduler, object store, GCS,
+pull/push managers, worker pools). Every metric the runtime itself
+records is DEFINED here and instantiated via :func:`get`; core modules
+never call ``Counter(...)``/``Gauge(...)``/``Histogram(...)`` directly
+(``tests/test_invariants.py`` greps for violations). That single-source
+rule is what keeps the invariants testable: every built-in has help
+text, the ``rtpu_`` prefix, and exactly one definition — and the README
+"Built-in metrics reference" table is GENERATED from this module
+(``python -m ray_tpu.util.metric_defs --markdown``), so it cannot
+drift.
+
+Conventions (Prometheus):
+- counters end in ``_total`` (or ``_bytes_total``);
+- histograms/gauges carry a unit suffix (``_seconds``, ``_bytes``);
+- every name starts with ``rtpu_`` so one scrape config covers the
+  whole runtime.
+
+Which process records what: scheduler/pipe/refcount metrics live in the
+driver (and each node daemon — a daemon IS a DriverRuntime); store
+metrics in whichever process touches the store (driver, workers,
+daemons); GCS metrics in the GCS server process; RPC metrics in every
+process that speaks cluster RPC. Federation (util/metrics.py) merges
+them all onto the head ``/metrics`` with origin labels.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+
+class MetricDef(NamedTuple):
+    name: str
+    kind: str                       # "counter" | "gauge" | "histogram"
+    help: str
+    tag_keys: Tuple[str, ...]
+    boundaries: Optional[Tuple[float, ...]]
+    component: str                  # subsystem, for docs/grouping
+
+
+_DEFS: "OrderedDict[str, MetricDef]" = OrderedDict()
+
+
+def _def(name: str, kind: str, help: str, *,
+         tag_keys: Sequence[str] = (),
+         boundaries: Optional[Sequence[float]] = None,
+         component: str = "") -> None:
+    assert name.startswith("rtpu_"), f"built-in metric {name} lacks rtpu_"
+    assert help.strip(), f"built-in metric {name} has no help text"
+    assert name not in _DEFS, f"duplicate metric definition {name}"
+    assert kind in ("counter", "gauge", "histogram"), kind
+    if kind == "counter":
+        assert name.endswith("_total"), f"counter {name} must end _total"
+    _DEFS[name] = MetricDef(name, kind, help, tuple(tag_keys),
+                            tuple(boundaries) if boundaries else None,
+                            component)
+
+
+# latency boundary presets (seconds)
+_LAT_FAST = (1e-5, 5e-5, 1e-4, 5e-4, 1e-3, 5e-3, 0.01, 0.05, 0.1, 0.5,
+             1.0, 5.0)                      # locks, RPC handlers, store ops
+_LAT_TASK = (1e-4, 5e-4, 1e-3, 5e-3, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 60)
+_LAT_SPAWN = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2, 5, 10, 30)
+
+# ---------------------------------------------------------------------------
+# scheduler / driver runtime (core/runtime.py)
+# ---------------------------------------------------------------------------
+
+_def("rtpu_scheduler_tasks_submitted_total", "counter",
+     "task specs submitted to this node's scheduler",
+     tag_keys=("type",), component="scheduler")
+_def("rtpu_scheduler_tasks_dispatched_total", "counter",
+     "tasks leased to a worker (lease grants)", component="scheduler")
+_def("rtpu_tasks_finished_total", "counter",
+     "tasks finished on this node's scheduler",
+     tag_keys=("status",), component="scheduler")
+_def("rtpu_task_phase_seconds", "histogram",
+     "task lifecycle phase latency (submit->queue->lease->arg_fetch->"
+     "deserialize->execute->store_result)",
+     tag_keys=("phase",), boundaries=_LAT_TASK, component="scheduler")
+_def("rtpu_scheduler_ready_queue_depth", "gauge",
+     "tasks ready to run but not yet leased to a worker (sampled)",
+     component="scheduler")
+_def("rtpu_scheduler_inflight_tasks", "gauge",
+     "tasks currently executing on this node's workers (sampled)",
+     component="scheduler")
+_def("rtpu_scheduler_actor_pending_calls", "gauge",
+     "actor method calls queued behind busy actors (sampled)",
+     component="scheduler")
+_def("rtpu_refcount_entries", "gauge",
+     "objects with a nonzero local pin count in the driver's reference "
+     "table (sampled)", component="scheduler")
+_def("rtpu_refcount_arg_pin_entries", "gauge",
+     "submitted-task argument pin sets held until first return is "
+     "terminal (sampled)", component="scheduler")
+_def("rtpu_lineage_entries", "gauge",
+     "task specs retained for object reconstruction (sampled)",
+     component="scheduler")
+_def("rtpu_lineage_bytes", "gauge",
+     "approximate bytes retained by the lineage table (sampled)",
+     component="scheduler")
+
+# worker control pipe (driver side of every worker connection)
+_def("rtpu_pipe_sent_bytes_total", "counter",
+     "bytes the driver sent over worker control pipes (framed message "
+     "payloads)", component="scheduler")
+_def("rtpu_pipe_recv_bytes_total", "counter",
+     "bytes the driver received over worker control pipes",
+     component="scheduler")
+_def("rtpu_pipe_messages_total", "counter",
+     "control-pipe messages by direction (sent/recv, driver side)",
+     tag_keys=("direction",), component="scheduler")
+
+# worker pool / zygote (spawn path)
+_def("rtpu_worker_pool_size", "gauge",
+     "worker processes attached to this node's pool by state (sampled)",
+     tag_keys=("state",), component="worker_pool")
+_def("rtpu_worker_spawns_total", "counter",
+     "worker processes spawned, by mode (zygote fork vs interpreter "
+     "exec)", tag_keys=("mode",), component="worker_pool")
+_def("rtpu_worker_spawn_seconds", "histogram",
+     "worker launch latency: spawn decision to the worker's ready "
+     "message", tag_keys=("mode",), boundaries=_LAT_SPAWN,
+     component="worker_pool")
+_def("rtpu_worker_deaths_total", "counter",
+     "worker processes that died (crash, kill, or shutdown race)",
+     component="worker_pool")
+_def("rtpu_zygote_restarts_total", "counter",
+     "fork-server (zygote) restarts after death", component="worker_pool")
+
+# worker-process built-ins (recorded inside each worker, federated up)
+_def("rtpu_worker_tasks_total", "counter",
+     "tasks executed by this worker process", component="worker")
+_def("rtpu_worker_task_exec_seconds", "histogram",
+     "user-code execution time in this worker",
+     boundaries=(0.001, 0.01, 0.1, 1, 10, 60, 600), component="worker")
+
+# ---------------------------------------------------------------------------
+# object store (core/object_store.py)
+# ---------------------------------------------------------------------------
+
+_def("rtpu_object_store_put_seconds", "histogram",
+     "store write latency (serialize excluded; segment/arena/inline "
+     "write + seal)", boundaries=_LAT_FAST, component="object_store")
+_def("rtpu_object_store_get_seconds", "histogram",
+     "store read latency (map + deserialize)", boundaries=_LAT_FAST,
+     component="object_store")
+_def("rtpu_object_store_puts_total", "counter",
+     "store writes by landing path (inline/arena/file/spill)",
+     tag_keys=("path",), component="object_store")
+_def("rtpu_object_store_put_bytes_total", "counter",
+     "serialized bytes written to the store (all paths)",
+     component="object_store")
+_def("rtpu_object_store_bytes_used", "gauge",
+     "bytes this process accounts in shm (arena used + its file "
+     "segments; sampled)", component="object_store")
+_def("rtpu_object_store_capacity_bytes", "gauge",
+     "configured arena capacity (sampled)", component="object_store")
+_def("rtpu_object_store_pins", "gauge",
+     "segments pinned by live deserialized views in this process "
+     "(sampled)", component="object_store")
+_def("rtpu_object_store_prefault_bytes", "gauge",
+     "arena bytes pre-faulted by the background populate thread",
+     component="object_store")
+_def("rtpu_object_store_spilled_bytes_total", "counter",
+     "bytes written to the disk spill directory", component="object_store")
+_def("rtpu_object_store_spilled_objects_total", "counter",
+     "objects written to the disk spill directory",
+     component="object_store")
+_def("rtpu_object_store_restored_bytes_total", "counter",
+     "spilled bytes promoted back into shared memory",
+     component="object_store")
+_def("rtpu_object_store_restored_objects_total", "counter",
+     "spilled objects promoted back into shared memory",
+     component="object_store")
+_def("rtpu_object_store_spill_read_bytes_total", "counter",
+     "bytes served directly from spill files (reads + remote pulls that "
+     "did not restore first)", component="object_store")
+_def("rtpu_object_store_spill_dir_bytes", "gauge",
+     "bytes currently spilled to disk on this node (sampled)",
+     component="object_store")
+
+# ---------------------------------------------------------------------------
+# GCS server (cluster/gcs_server.py — recorded in the GCS process,
+# exported to the head /metrics via rpc_metrics_get with component=gcs)
+# ---------------------------------------------------------------------------
+
+_def("rtpu_gcs_rpc_total", "counter",
+     "GCS RPCs handled, by method", tag_keys=("method",), component="gcs")
+_def("rtpu_gcs_rpc_seconds", "histogram",
+     "GCS RPC handler latency, by method", tag_keys=("method",),
+     boundaries=_LAT_FAST, component="gcs")
+_def("rtpu_gcs_pubsub_messages_total", "counter",
+     "pubsub deliveries pushed to subscribers (fanout: one per "
+     "subscriber per publish)", tag_keys=("channel",), component="gcs")
+_def("rtpu_gcs_table_size", "gauge",
+     "GCS table entry counts (objects/nodes/actors/kv/functions/pgs/"
+     "task_events/free_candidates/tombstones; sampled)",
+     tag_keys=("table",), component="gcs")
+_def("rtpu_gcs_nodes_alive", "gauge",
+     "cluster nodes currently alive (sampled)", component="gcs")
+_def("rtpu_gcs_heartbeat_gap_seconds", "histogram",
+     "observed gap between consecutive heartbeats of a node (nominal "
+     "0.5s; tail growth = control-plane or sender contention)",
+     boundaries=(0.25, 0.5, 0.75, 1, 1.5, 2, 3, 5, 8, 15, 30),
+     component="gcs")
+
+# ---------------------------------------------------------------------------
+# cluster RPC transport (cluster/rpc.py)
+# ---------------------------------------------------------------------------
+
+_def("rtpu_rpc_sent_bytes_total", "counter",
+     "framed bytes sent over cluster RPC connections (client calls/casts "
+     "+ server replies/pushes)", component="rpc")
+_def("rtpu_rpc_recv_bytes_total", "counter",
+     "framed bytes received over cluster RPC connections", component="rpc")
+_def("rtpu_rpc_server_requests_total", "counter",
+     "requests accepted by RPC servers in this process, by kind "
+     "(req/cast)", tag_keys=("kind",), component="rpc")
+_def("rtpu_rpc_server_queue_wait_seconds", "histogram",
+     "time a request waited between socket read and handler start (the "
+     "server thread-pool queue — the GCS accept-loop contention signal)",
+     boundaries=_LAT_FAST, component="rpc")
+_def("rtpu_rpc_client_reconnects_total", "counter",
+     "successful RPC client reconnects after a connection drop",
+     component="rpc")
+_def("rtpu_rpc_client_reconnect_attempts_total", "counter",
+     "RPC client reconnect attempts (including failed retries)",
+     component="rpc")
+_def("rtpu_rpc_client_timeouts_total", "counter",
+     "RPC calls that hit their caller-side timeout", component="rpc")
+
+# ---------------------------------------------------------------------------
+# cluster adapter / node daemon (cluster/adapter.py, node_daemon.py)
+# ---------------------------------------------------------------------------
+
+_def("rtpu_cluster_tasks_forwarded_total", "counter",
+     "task/actor specs forwarded to a peer node, by spillback reason "
+     "(resources/locality/strategy/pg/actor_route)",
+     tag_keys=("reason",), component="cluster")
+_def("rtpu_cluster_object_pull_bytes_total", "counter",
+     "object bytes pulled from peer nodes", component="cluster")
+_def("rtpu_cluster_object_serve_bytes_total", "counter",
+     "object bytes served to peer nodes", component="cluster")
+_def("rtpu_cluster_heartbeats_total", "counter",
+     "heartbeats this node sent to the GCS", component="cluster")
+_def("rtpu_cluster_heartbeat_rtt_seconds", "histogram",
+     "round-trip of the node_heartbeat RPC as seen by the sender",
+     boundaries=_LAT_FAST, component="cluster")
+_def("rtpu_daemon_uptime_seconds", "gauge",
+     "node daemon uptime (sampled)", component="cluster")
+
+# ---------------------------------------------------------------------------
+# lock contention profiler (util/contention.py)
+# ---------------------------------------------------------------------------
+
+_def("rtpu_lock_wait_seconds", "histogram",
+     "time spent waiting to acquire an instrumented runtime lock "
+     "(contended acquisitions only; uncontended fast path records "
+     "nothing here)", tag_keys=("lock",), boundaries=_LAT_FAST,
+     component="contention")
+_def("rtpu_lock_acquisitions", "gauge",
+     "total acquisitions of an instrumented lock (monotonic, sampled "
+     "from unlocked accumulators)", tag_keys=("lock",),
+     component="contention")
+_def("rtpu_lock_contended", "gauge",
+     "acquisitions that had to wait (monotonic, sampled)",
+     tag_keys=("lock",), component="contention")
+_def("rtpu_lock_wait_seconds_sum", "gauge",
+     "cumulative seconds spent waiting on an instrumented lock "
+     "(monotonic, sampled)", tag_keys=("lock",), component="contention")
+
+# ---------------------------------------------------------------------------
+# data streaming exchange (data/streaming.py)
+# ---------------------------------------------------------------------------
+
+_def("rtpu_data_exchange_blocks_in_flight", "gauge",
+     "partition-output blocks not yet consumed by a reducer",
+     component="data")
+_def("rtpu_data_exchange_reducer_queue_depth", "gauge",
+     "forwarded-but-unacked blocks per reducer actor",
+     tag_keys=("reducer",), component="data")
+_def("rtpu_data_exchange_bytes_total", "counter",
+     "block bytes that crossed the exchange", tag_keys=("kind",),
+     component="data")
+_def("rtpu_data_exchange_blocks_total", "counter",
+     "blocks that crossed the exchange", tag_keys=("kind",),
+     component="data")
+
+# ---------------------------------------------------------------------------
+# train / TPU telemetry (train/telemetry.py)
+# ---------------------------------------------------------------------------
+
+_def("rtpu_train_step_seconds", "histogram",
+     "wall time per optimizer step",
+     boundaries=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 60, 600),
+     component="train")
+_def("rtpu_train_steps_total", "counter", "optimizer steps recorded",
+     component="train")
+_def("rtpu_train_tokens_per_s", "gauge", "training throughput",
+     component="train")
+_def("rtpu_train_mfu", "gauge",
+     "measured model FLOPs utilization (0..1)", component="train")
+_def("rtpu_train_loss", "gauge", "last reported loss", component="train")
+_def("rtpu_train_compile_total", "counter", "XLA (re)compilation events",
+     component="train")
+_def("rtpu_train_compile_seconds", "histogram",
+     "wall time of compile events (first call of a fresh program; "
+     "includes its first execution)",
+     boundaries=(0.1, 1, 5, 10, 30, 60, 300, 1200), component="train")
+_def("rtpu_tpu_hbm_used_bytes", "gauge",
+     "HBM bytes in use (local devices)", component="train")
+_def("rtpu_tpu_hbm_limit_bytes", "gauge",
+     "HBM capacity (local devices)", component="train")
+
+
+# ---------------------------------------------------------------------------
+# instantiation
+# ---------------------------------------------------------------------------
+
+_instances_lock = threading.Lock()
+_instances: Dict[str, object] = {}
+
+
+def get(name: str):
+    """The live metric instance for a built-in definition.
+
+    Instances are cached per process; if the registry was cleared since
+    (tests), a fresh instance is created and re-registered — the merge
+    semantics in util/metrics make concurrent creators share storage.
+    Hot paths should cache the returned object (and pre-sorted tag keys)
+    themselves; this lookup is for wiring, not per-event use.
+    """
+    from ray_tpu.util import metrics
+
+    d = _DEFS[name]
+    inst = _instances.get(name)
+    if inst is not None and metrics.registered(name) is inst:
+        return inst
+    with _instances_lock:
+        inst = _instances.get(name)
+        if inst is not None and metrics.registered(name) is inst:
+            return inst
+        if d.kind == "counter":
+            inst = metrics.Counter(name, d.help, tag_keys=d.tag_keys)
+        elif d.kind == "gauge":
+            inst = metrics.Gauge(name, d.help, tag_keys=d.tag_keys)
+        else:
+            inst = metrics.Histogram(name, d.help,
+                                     boundaries=list(d.boundaries or ()),
+                                     tag_keys=d.tag_keys)
+        _instances[name] = inst
+        return inst
+
+
+def all_defs() -> List[MetricDef]:
+    return list(_DEFS.values())
+
+
+def lookup(name: str) -> Optional[MetricDef]:
+    return _DEFS.get(name)
+
+
+# ---------------------------------------------------------------------------
+# docs generation (README "Built-in metrics reference")
+# ---------------------------------------------------------------------------
+
+MD_BEGIN = "<!-- metric-defs:begin (generated; do not edit by hand) -->"
+MD_END = "<!-- metric-defs:end -->"
+
+
+def markdown_table() -> str:
+    """The generated metrics reference, fenced by markers so a test can
+    assert the README copy matches this registry exactly."""
+    lines = [MD_BEGIN,
+             f"{len(_DEFS)} built-in metrics "
+             "(generated by `python -m ray_tpu.util.metric_defs "
+             "--markdown`):", "",
+             "| Metric | Type | Labels | Help |",
+             "|---|---|---|---|"]
+    for d in _DEFS.values():
+        labels = ", ".join(d.tag_keys) if d.tag_keys else "—"
+        lines.append(f"| `{d.name}` | {d.kind} | {labels} | "
+                     f"{d.help} |")
+    lines.append(MD_END)
+    return "\n".join(lines)
+
+
+def _main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="built-in metric registry tools")
+    p.add_argument("--markdown", action="store_true",
+                   help="print the generated metrics reference table")
+    p.add_argument("--check", metavar="README",
+                   help="verify README's fenced table matches the "
+                        "registry (exit 1 on drift)")
+    p.add_argument("--update", metavar="README",
+                   help="rewrite README's fenced table in place")
+    args = p.parse_args(argv)
+    table = markdown_table()
+    if args.markdown:
+        print(table)
+        return 0
+    if args.check or args.update:
+        path = args.check or args.update
+        with open(path) as f:
+            text = f.read()
+        start, end = text.find(MD_BEGIN), text.find(MD_END)
+        if start == -1 or end == -1:
+            print(f"{path}: no generated-table markers found")
+            return 1
+        current = text[start:end + len(MD_END)]
+        if args.check:
+            if current != table:
+                print(f"{path}: metrics reference table is stale — run "
+                      f"python -m ray_tpu.util.metric_defs --update "
+                      f"{path}")
+                return 1
+            print(f"{path}: metrics reference table is up to date")
+            return 0
+        with open(path, "w") as f:
+            f.write(text[:start] + table + text[end + len(MD_END):])
+        print(f"{path}: metrics reference table rewritten "
+              f"({len(_DEFS)} metrics)")
+        return 0
+    p.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(_main())

@@ -28,6 +28,40 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 _registry_lock = threading.Lock()
 _registry: Dict[str, "Metric"] = {}
 
+# Collector hooks: callables run right before every registry snapshot
+# (local exposition, worker delta push, node heartbeat payload), so
+# sampled gauges — queue depths, table sizes, arena usage — are refreshed
+# at read time instead of taxing every mutation on the hot path
+# (reference: opencensus gauge-callback role). Hooks must be fast and
+# never raise (exceptions are swallowed; a broken hook loses its samples,
+# not the scrape).
+_collectors_lock = threading.Lock()
+_collectors: List = []
+
+
+def register_collector(fn) -> None:
+    with _collectors_lock:
+        if fn not in _collectors:
+            _collectors.append(fn)
+
+
+def unregister_collector(fn) -> None:
+    with _collectors_lock:
+        try:
+            _collectors.remove(fn)
+        except ValueError:
+            pass
+
+
+def _run_collectors() -> None:
+    with _collectors_lock:
+        hooks = list(_collectors)
+    for fn in hooks:
+        try:
+            fn()
+        except Exception:
+            pass
+
 
 class Metric:
     metric_type = "untyped"
@@ -223,7 +257,9 @@ def metric_record(m: Metric) -> Dict[str, Any]:
 
 
 def registry_records() -> List[Dict[str, Any]]:
-    """Snapshot every registered metric as a plain record."""
+    """Snapshot every registered metric as a plain record (running the
+    sampled-gauge collector hooks first, so reads see fresh values)."""
+    _run_collectors()
     with _registry_lock:
         metrics = list(_registry.values())
     return [metric_record(m) for m in metrics]
@@ -356,6 +392,12 @@ def prometheus_text(extra: Optional[List[Tuple[Dict[str, str],
             else:
                 _render_scalar(lines, name, labels, rec["samples"])
     return "\n".join(lines) + "\n"
+
+
+def registered(name: str) -> Optional[Metric]:
+    """The currently registered instance for ``name`` (None if absent).
+    Lets caches (metric_defs) notice a clear_registry and re-register."""
+    return _registry.get(name)
 
 
 def clear_registry() -> None:

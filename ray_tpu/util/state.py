@@ -164,6 +164,19 @@ def summarize_tasks() -> Dict[str, Dict[str, Any]]:
     return summary
 
 
+def summarize_contention() -> Dict[str, Any]:
+    """Per-lock contention totals for THIS process (see
+    :mod:`ray_tpu.util.contention`): acquisitions, contended count/%,
+    cumulative and max wait. Worst lock first — the first row answers
+    "which lock is the bottleneck?". Remote processes' accumulators are
+    on the head ``/metrics`` as ``rtpu_lock_*`` series with origin
+    labels."""
+    from ray_tpu.util import contention
+
+    return {"locks": contention.summarize(),
+            "enabled": contention.enabled()}
+
+
 def summarize_actors() -> Dict[str, int]:
     summary: Dict[str, int] = {}
     for a in list_actors():

@@ -985,6 +985,81 @@ def test_metrics_federation_across_nodes(cluster, monkeypatch):
         r'rtpu_task_phase_seconds_count\{[^}]*node_id="\w+"', txt)
 
 
+def test_core_runtime_metrics_from_all_layers_on_head(cluster,
+                                                      monkeypatch):
+    """ISSUE 4 acceptance: the head /metrics shows BUILT-IN core-runtime
+    metrics from >= 2 nodes (scheduler + object store from the head,
+    unlabeled, AND from the daemon, node_id-labeled) plus the GCS
+    server's own instrumentation (component="gcs"): per-method RPC
+    counters/latency, heartbeat-gap histogram, table sizes."""
+    import re
+    import urllib.request
+
+    from conftest import poll_until
+
+    monkeypatch.setenv("RTPU_METRICS_PUSH_INTERVAL_S", "0.2")
+    cluster.add_node(num_cpus=2, resources={"peer": 2})
+    _init(cluster)
+    _wait_nodes(2)
+
+    @ray_tpu.remote(resources={"peer": 1})
+    def remote_side(i):
+        return np.zeros(50_000), i  # big enough to hit the store
+
+    @ray_tpu.remote(num_cpus=1)
+    def local_side(i):
+        return np.zeros(50_000), i
+
+    out = ray_tpu.get([remote_side.remote(i) for i in range(3)]
+                      + [local_side.remote(i) for i in range(3)],
+                      timeout=120)
+    assert sorted(x[1] for x in out) == [0, 0, 1, 1, 2, 2]
+
+    from ray_tpu.dashboard import start_dashboard, stop_dashboard
+
+    dash = start_dashboard(port=0)
+    url = f"http://127.0.0.1:{dash.port}/metrics"
+    try:
+        def scrape():
+            txt = urllib.request.urlopen(url, timeout=5).read().decode()
+            ok = (
+                # scheduler: head (unlabeled) + daemon (node-labeled)
+                re.search(r"^rtpu_scheduler_tasks_dispatched_total \d",
+                          txt, re.M)
+                and re.search(r'rtpu_scheduler_tasks_dispatched_total\{'
+                              r'[^}]*node_id="\w+"', txt)
+                # object store: both origins again
+                and re.search(r"^rtpu_object_store_bytes_used \d",
+                              txt, re.M)
+                and re.search(r'rtpu_object_store_bytes_used\{'
+                              r'[^}]*node_id="\w+"', txt)
+                # GCS process instrumentation arrives via metrics_get
+                and re.search(r'rtpu_gcs_rpc_total\{[^}]*'
+                              r'component="gcs"[^}]*'
+                              r'method="node_heartbeat"', txt)
+                and re.search(r'rtpu_gcs_heartbeat_gap_seconds_count\{'
+                              r'[^}]*component="gcs"', txt)
+                and re.search(r'rtpu_gcs_table_size\{[^}]*'
+                              r'table="objects"', txt)
+            )
+            return txt if ok else None
+
+        # worker pushes (0.2s) -> daemon heartbeat (~2s) -> GCS -> head
+        txt = poll_until(scrape, timeout=60, interval=0.5,
+                         desc="scheduler/store/GCS built-ins on head "
+                              "/metrics")
+    finally:
+        stop_dashboard()
+
+    # spillback decisions surfaced with a reason label
+    assert re.search(
+        r'rtpu_cluster_tasks_forwarded_total\{[^}]*reason="\w+"', txt)
+    # the GCS's state-lock contention accounting federates too
+    assert re.search(r'rtpu_lock_acquisitions\{[^}]*component="gcs"'
+                     r'[^}]*lock="gcs.state"', txt) or \
+        re.search(r'rtpu_lock_acquisitions\{[^}]*lock="gcs.state"', txt)
+
+
 def test_refs_nested_in_results_survive_producer_exit(monkeypatch):
     """A ref nested in a task's RETURN value is pinned by the owner against
     the return object's lifetime (advisor r3): after the producing worker

@@ -194,7 +194,7 @@ def test_out_of_core_sort_and_groupby_bounded_rss(monkeypatch):
                 continue
             seen["last"] = txt
             for line in txt.splitlines():
-                if line.startswith("data_exchange_blocks_in_flight "):
+                if line.startswith("rtpu_data_exchange_blocks_in_flight "):
                     seen["inflight"] = max(seen["inflight"],
                                            float(line.split()[1]))
 
@@ -240,7 +240,7 @@ def test_out_of_core_sort_and_groupby_bounded_rss(monkeypatch):
 
     # exchange metrics were visible in a mid-run scrape
     assert seen["inflight"] > 0, "blocks-in-flight never observed mid-run"
-    assert "data_exchange_bytes_total" in txt
+    assert "rtpu_data_exchange_bytes_total" in txt
     assert "data_exchange_reducer_queue_depth" in txt
 
     # the dataset actually spilled (driver put the source blocks, so the
@@ -253,9 +253,9 @@ def test_out_of_core_sort_and_groupby_bounded_rss(monkeypatch):
                 return float(line.rsplit(" ", 1)[1])
         return 0.0
 
-    assert metric("object_store_spilled_bytes_total") > dataset_bytes / 4
-    assert (metric("object_store_restored_bytes_total")
-            + metric("object_store_spill_read_bytes_total")) > 0
+    assert metric("rtpu_object_store_spilled_bytes_total") > dataset_bytes / 4
+    assert (metric("rtpu_object_store_restored_bytes_total")
+            + metric("rtpu_object_store_spill_read_bytes_total")) > 0
 
     # bounded RSS: no process ever grew by even one dataset's worth —
     # nothing materialized the exchange (driver included)

@@ -37,9 +37,10 @@ def test_single_receiver_per_worker_pipe():
 
     runtime = ROOT / "ray_tpu" / "core" / "runtime.py"
     sites = [(n, line) for n, line in _code_lines(runtime)
-             if re.search(r"\bconn\.recv\(\)", line)]
+             if re.search(r"\bconn\.recv(_bytes)?\(\)", line)]
     # allowed: the _accept_loop "hello" handshake (before the reader
-    # exists) and the per-worker _reader_loop itself
+    # exists) and the per-worker _reader_loop itself (recv_bytes + loads,
+    # so the pipe byte counters see the framed size)
     assert len(sites) <= 2, (
         f"runtime.py has {len(sites)} conn.recv() call sites {sites}; "
         "only the _accept_loop handshake and _reader_loop may read a "
@@ -66,6 +67,26 @@ def test_no_raw_attention_kernels_outside_ops():
         + "\nroute attention through ray_tpu.ops.flash_attention — the "
         "raw kernels have no memory-efficient VJP and OOM real HBM when "
         "differentiated (CLAUDE.md 'Architecture invariants')")
+
+
+def test_core_metrics_only_via_metric_defs():
+    """ISSUE 4 satellite: ``util/metric_defs.py`` is the single source of
+    truth for built-in metrics — core/cluster modules must not create
+    ad-hoc ``Counter(``/``Gauge(``/``Histogram(`` instances (they'd skip
+    the help/prefix/uniqueness invariants and the generated README
+    table). User-facing metric creation stays in util/metrics.py."""
+    offenders = []
+    for sub in ("core", "cluster"):
+        for path in sorted((ROOT / "ray_tpu" / sub).rglob("*.py")):
+            rel = path.relative_to(ROOT)
+            for n, line in _code_lines(path):
+                if re.search(r"\b(Counter|Gauge|Histogram)\s*\(", line):
+                    offenders.append(f"{rel}:{n}: {line.strip()}")
+    assert not offenders, (
+        "ad-hoc metric construction in core/cluster modules:\n  "
+        + "\n  ".join(offenders)
+        + "\ndefine the metric in ray_tpu/util/metric_defs.py and fetch "
+        "it with metric_defs.get(name) instead")
 
 
 def test_serialization_stays_cloudpickle_first():

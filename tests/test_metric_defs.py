@@ -1,0 +1,159 @@
+"""Built-in metric registry invariants (ISSUE 4 tentpole + satellites).
+
+The ``metric_defs.cc`` analog must stay the single source of truth:
+every built-in has help text, the ``rtpu_`` prefix, one definition, one
+registration — and the README reference table is generated from it, so
+drift is a test failure, not a doc-review hope.
+"""
+
+import re
+from pathlib import Path
+
+import pytest
+
+from ray_tpu.util import metric_defs
+
+ROOT = Path(__file__).resolve().parents[1]
+
+
+def test_registry_size_meets_acceptance_floor():
+    # ISSUE 4 acceptance: >= 40 built-in core-runtime metrics
+    assert len(metric_defs.all_defs()) >= 40
+
+
+def test_every_def_has_prefix_help_and_unique_name():
+    seen = set()
+    for d in metric_defs.all_defs():
+        assert d.name.startswith("rtpu_"), d.name
+        assert d.help.strip(), f"{d.name} has empty help"
+        assert d.name not in seen, f"duplicate def {d.name}"
+        seen.add(d.name)
+        assert d.kind in ("counter", "gauge", "histogram"), d.name
+        if d.kind == "counter":
+            assert d.name.endswith("_total"), \
+                f"counter {d.name} must end in _total"
+        if d.kind == "histogram":
+            assert d.boundaries, f"histogram {d.name} needs boundaries"
+            assert list(d.boundaries) == sorted(d.boundaries), d.name
+
+
+def test_all_instantiate_and_expose_exactly_once():
+    """Every def instantiates under its declared type and appears under
+    exactly ONE HELP/TYPE header — duplicate registration across modules
+    would repeat the header (forbidden by the text format)."""
+    from ray_tpu.util.metrics import clear_registry, prometheus_text
+
+    clear_registry()
+    try:
+        for d in metric_defs.all_defs():
+            m = metric_defs.get(d.name)
+            assert m.metric_type == d.kind, d.name
+            m2 = metric_defs.get(d.name)  # second get: same instance
+            assert m2 is m, d.name
+        text = prometheus_text()
+        for d in metric_defs.all_defs():
+            assert text.count(f"# TYPE {d.name} ") == 1, d.name
+            assert f"# HELP {d.name} " in text, d.name
+    finally:
+        clear_registry()
+
+
+def test_get_survives_registry_clear():
+    """A cleared registry (tests do this) must not leave metric_defs
+    serving orphaned instances whose samples never reach /metrics."""
+    from ray_tpu.util.metrics import clear_registry, prometheus_text
+
+    clear_registry()
+    try:
+        c = metric_defs.get("rtpu_worker_deaths_total")
+        c.inc(1)
+        clear_registry()
+        c2 = metric_defs.get("rtpu_worker_deaths_total")
+        assert c2 is not c  # fresh registration, not the orphan
+        c2.inc(2)
+        assert "rtpu_worker_deaths_total 2.0" in prometheus_text()
+    finally:
+        clear_registry()
+
+
+def test_markdown_table_lists_every_metric():
+    table = metric_defs.markdown_table()
+    assert table.startswith(metric_defs.MD_BEGIN)
+    assert table.endswith(metric_defs.MD_END)
+    for d in metric_defs.all_defs():
+        assert f"`{d.name}`" in table, d.name
+
+
+def test_readme_reference_table_matches_registry():
+    """The README table is generated — regenerate and compare, so it can
+    never drift from the registry (satellite: doc update)."""
+    readme = (ROOT / "README.md").read_text()
+    start = readme.find(metric_defs.MD_BEGIN)
+    end = readme.find(metric_defs.MD_END)
+    assert start != -1 and end != -1, (
+        "README.md lacks the generated metrics reference markers; run "
+        "python -m ray_tpu.util.metric_defs --update README.md")
+    current = readme[start:end + len(metric_defs.MD_END)]
+    assert current == metric_defs.markdown_table(), (
+        "README metrics reference is stale — regenerate with "
+        "python -m ray_tpu.util.metric_defs --update README.md")
+
+
+def test_contention_profiler_exports():
+    """Instrumented locks surface both the accumulators (summarize) and
+    the wait histogram under names defined in metric_defs."""
+    import threading
+    import time
+
+    from ray_tpu.util import contention
+    from ray_tpu.util.metrics import prometheus_text
+
+    lk = contention.timed_rlock("test.defs_lock")
+    if not contention.enabled():
+        pytest.skip("contention profiler disabled in env")
+
+    def holder():
+        with lk:
+            time.sleep(0.03)
+
+    t = threading.Thread(target=holder)
+    t.start()
+    time.sleep(0.005)
+    with lk:
+        pass
+    t.join()
+    s = contention.summarize()["test.defs_lock"]
+    assert s["acquisitions"] >= 2
+    assert s["contended"] >= 1
+    assert s["wait_total_s"] > 0
+    text = prometheus_text()
+    assert 'rtpu_lock_wait_seconds_bucket{le="0.05",lock="test.defs_lock"}' \
+        in text
+    assert 'rtpu_lock_acquisitions{lock="test.defs_lock"}' in text
+
+
+def test_condition_over_timed_rlock():
+    """threading.Condition must work over the instrumented RLock (the
+    driver's _stream_cv is built exactly this way)."""
+    import threading
+
+    from ray_tpu.util.contention import TimedRLock
+
+    lk = TimedRLock("test.cv_lock")
+    cv = threading.Condition(lk)
+    hits = []
+
+    def waiter():
+        with cv:
+            cv.wait(2.0)
+            hits.append(1)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    import time
+
+    time.sleep(0.05)
+    with cv:
+        cv.notify_all()
+    t.join(5)
+    assert hits == [1]

@@ -1,0 +1,99 @@
+"""Scale-envelope guard (ISSUE 4 satellite, VERDICT Weak #7).
+
+Push the single-node scheduler well past its steady-state shape — 10k
+queued no-op tasks, then a 64-actor herd — at deadlines scaled to this
+2-vCPU box, and assert the built-in metrics return to a sane idle state
+afterwards (queue drained, nothing leaked in flight). The reference runs
+these as release benchmarks (``release/benchmarks/single_node.json``);
+here they are a ``slow``-marked regression fence.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def _drain_poll(rt, deadline_s, desc):
+    """Wait until the scheduler is idle: empty ready queue, no in-flight
+    specs on any live worker."""
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        with rt.lock:
+            ready = len(rt.ready_tasks)
+            inflight = sum(len(ws.inflight_specs)
+                           for ws in rt.workers.values()
+                           if ws.status != "dead")
+        if ready == 0 and inflight == 0:
+            return
+        time.sleep(0.2)
+    raise AssertionError(
+        f"{desc}: scheduler not idle after {deadline_s}s "
+        f"(ready={ready}, inflight={inflight})")
+
+
+@pytest.mark.slow
+def test_scale_envelope_10k_tasks_and_64_actors():
+    from ray_tpu.core.runtime import _get_runtime
+    from ray_tpu.util.metrics import prometheus_text
+
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    try:
+        rt = _get_runtime()
+
+        @ray_tpu.remote
+        def noop(i):
+            return i
+
+        # 10k queued no-ops: the queue must build AND fully drain.
+        # ~5k tasks/s measured on this box -> generous 240s deadline.
+        t0 = time.monotonic()
+        refs = [noop.remote(i) for i in range(10_000)]
+        out = ray_tpu.get(refs, timeout=240)
+        assert out[0] == 0 and out[-1] == 9_999 and len(out) == 10_000
+        took = time.monotonic() - t0
+        _drain_poll(rt, 30, "post-10k-tasks")
+
+        # 64 actors (4x the 16-actor bench herd): all must come up,
+        # answer one call each, and die cleanly. ~17-26 actors/s
+        # measured -> 180s deadline leaves a wide margin under load.
+        @ray_tpu.remote
+        class Echo:
+            def ping(self, i):
+                return i
+
+        actors = [Echo.options(num_cpus=0).remote() for _ in range(64)]
+        got = ray_tpu.get([a.ping.remote(i) for i, a in enumerate(actors)],
+                          timeout=180)
+        assert got == list(range(64))
+        for a in actors:
+            ray_tpu.kill(a)
+        _drain_poll(rt, 60, "post-64-actors")
+
+        # built-in metrics agree the envelope was traversed and closed:
+        # sampled gauges back at 0, counters saw the volume
+        text = prometheus_text()
+
+        def sample(name):
+            for line in text.splitlines():
+                if line.startswith(name + " "):
+                    return float(line.rsplit(" ", 1)[1])
+            raise AssertionError(f"{name} not on /metrics:\n{text[:800]}")
+
+        assert sample("rtpu_scheduler_ready_queue_depth") == 0
+        assert sample("rtpu_scheduler_inflight_tasks") == 0
+        submitted = sample(
+            'rtpu_scheduler_tasks_submitted_total{type="task"}')
+        assert submitted >= 10_000
+        assert sample("rtpu_scheduler_tasks_dispatched_total") >= 10_000
+        assert sample(
+            'rtpu_scheduler_tasks_submitted_total{type="actor_create"}'
+        ) >= 64
+        # no leaked arg-pin entries for finished tasks (a small residue
+        # from in-flight janitor timing is tolerated, not 10k)
+        assert sample("rtpu_refcount_arg_pin_entries") < 100
+        print(f"10k tasks in {took:.1f}s "
+              f"({10_000 / took:.0f}/s), 64 actors ok")
+    finally:
+        ray_tpu.shutdown()

@@ -263,6 +263,85 @@ def test_worker_metrics_federate_to_driver(rt_telemetry):
 
 
 # ---------------------------------------------------------------------------
+# job submission REST (ISSUE 4 satellite, reference job_head.py role)
+# ---------------------------------------------------------------------------
+
+
+def test_job_rest_submit_status_logs_stop(rt_telemetry):
+    import json
+    import urllib.request
+
+    from conftest import poll_until
+    from ray_tpu.dashboard import start_dashboard, stop_dashboard
+
+    dash = start_dashboard(port=0)
+    base = f"http://127.0.0.1:{dash.port}"
+    try:
+        def post(path, body=None):
+            def once():
+                req = urllib.request.Request(
+                    base + path,
+                    data=json.dumps(body or {}).encode(),
+                    headers={"Content-Type": "application/json"},
+                    method="POST")
+                return json.loads(
+                    urllib.request.urlopen(req, timeout=15).read())
+            return poll_until(once, timeout=30, desc=f"POST {path}")
+
+        def get(path):
+            def once():
+                return json.loads(urllib.request.urlopen(
+                    base + path, timeout=15).read())
+            return poll_until(once, timeout=30, desc=f"GET {path}")
+
+        # submit -> terminal SUCCEEDED -> logs round-trip
+        job_id = post("/api/jobs", {
+            "entrypoint": "echo rest-job-output"})["result"]["job_id"]
+
+        def done():
+            info = get(f"/api/jobs/{job_id}")["result"]
+            return info if info["status"] in ("SUCCEEDED", "FAILED",
+                                              "STOPPED") else None
+
+        info = poll_until(done, timeout=90, desc="job terminal")
+        assert info["status"] == "SUCCEEDED"
+        logs = get(f"/api/jobs/{job_id}/logs")["result"]["logs"]
+        assert "rest-job-output" in logs
+        assert any(j["job_id"] == job_id
+                   for j in get("/api/jobs")["result"])
+
+        # a long-running job stops via the REST stop route
+        jid2 = post("/api/jobs",
+                    {"entrypoint": "sleep 60"})["result"]["job_id"]
+
+        def running():
+            info = get(f"/api/jobs/{jid2}")["result"]
+            return info["status"] == "RUNNING" or None
+
+        poll_until(running, timeout=90, desc="job running")
+        assert post(f"/api/jobs/{jid2}/stop")["result"]["stopped"]
+
+        def stopped():
+            return get(f"/api/jobs/{jid2}")["result"][
+                "status"] == "STOPPED" or None
+
+        poll_until(stopped, timeout=90, desc="job stopped")
+
+        # unknown job ids are 404s, and a metrics scrape on the SAME
+        # threaded server works while job routes are in use
+        try:
+            urllib.request.urlopen(base + "/api/jobs/nope", timeout=15)
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+        txt = urllib.request.urlopen(base + "/metrics",
+                                     timeout=15).read().decode()
+        assert "rtpu_scheduler_ready_queue_depth" in txt
+    finally:
+        stop_dashboard()
+
+
+# ---------------------------------------------------------------------------
 # train step telemetry
 # ---------------------------------------------------------------------------
 
