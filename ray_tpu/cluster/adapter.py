@@ -23,6 +23,7 @@ import logging
 import os
 import threading
 import time
+from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Set
 
@@ -107,6 +108,15 @@ class ClusterAdapter:
         # retires the forwarded entry so node death doesn't retry done work
         self._fwd_by_oid: Dict[bytes, tuple] = {}
         self._forwarded_lock = threading.Lock()
+        # forward-attempt tokens seen from peers (token -> [done_event,
+        # committed]): a forwarder whose reply was lost re-sends the SAME
+        # attempt to the SAME peer, and this dedupe makes the second
+        # delivery a no-op instead of a double execution. Keyed on a
+        # per-attempt token — NOT task_id — because legitimate
+        # re-executions (max_retries resubmit, lineage reconstruction)
+        # reuse the task_id and must be accepted.
+        self._accepted_specs: "OrderedDict[bytes, list]" = OrderedDict()
+        self._accepted_lock = threading.Lock()
         self._remote_actors: Dict[bytes, bytes] = {}  # actor_id -> node_id
         # streaming tasks forwarded with backpressure: task_id -> executing
         # node, so consumer-side acks relay to where the producer parks
@@ -195,9 +205,16 @@ class ClusterAdapter:
     def _heartbeat_loop(self):
         from ray_tpu.util.host_stats import host_stats
 
+        from ray_tpu.util import failpoints
+
         beat = 0
         while not self._stop.wait(HEARTBEAT_S):
             try:
+                if failpoints.hit("gcs.heartbeat"):
+                    # chaos: heartbeat blackout ≈ network partition — the
+                    # GCS will declare this node dead after node_timeout;
+                    # when beats resume, the heartbeat NACK re-registers
+                    continue
                 self.rt.reap_stale_pg_stages()
                 with self.rt.lock:
                     avail = dict(self.rt.avail)
@@ -269,6 +286,7 @@ class ClusterAdapter:
         self.gcs.call("subscribe", "nodes", timeout=10)
         self.gcs.call("subscribe", "objects", timeout=10)
         self.gcs.call("subscribe", "pgs", timeout=10)
+        self.gcs.call("subscribe", "failpoints", timeout=10)
         self.gcs.call("node_register", self.node_id, self.server.addr,
                       self.rt.resources("total"), self.is_scheduler,
                       dict(getattr(self.rt, "labels", {})), timeout=10)
@@ -276,6 +294,26 @@ class ClusterAdapter:
         # a (re)registered GCS starts with an empty task-event store:
         # reship our full local history
         self._task_ev_cursor = 0
+        # chaos plane, late-joiner path: pull the cluster-wide failpoint
+        # spec (durable in the GCS KV) so daemons booted or re-registered
+        # after failpoints.arm() are armed too
+        from ray_tpu.util import failpoints
+
+        failpoints.sync_from_kv(
+            lambda k, ns: self.gcs.call("kv_get", k, ns, timeout=10))
+        # GCS restart recovery (chaos: kill -9 mid-submit): the object
+        # directory is NOT durable and obj_ready is a cast, so anything
+        # that turned terminal during the outage is unknown to the rebuilt
+        # directory and its notification died with the old process.
+        # Re-advertise every locally terminal object (repopulates the
+        # directory + re-publishes), then re-query our watched set —
+        # subscription is already re-established above, so either the
+        # re-query or the re-published push delivers each result.
+        self._io.submit(self._readvertise_terminal)
+        with self._watch_lock:
+            watched = list(self._watched)
+        for b in watched:
+            self._io.submit(self._initial_query, b)
 
     def _on_gcs_reconnect(self):
         try:
@@ -283,16 +321,64 @@ class ClusterAdapter:
         except Exception:
             pass
 
+    def _readvertise_terminal(self) -> None:
+        """Rebuild the (restarted) GCS directory's view of this node:
+        re-cast obj_ready/obj_error for every locally terminal object we
+        can still serve — inline values (we hold the bytes), store-held
+        segments, and errors."""
+        try:
+            items = self.rt.gcs.all_objects()
+        except Exception:
+            return
+        for oid, st in items:
+            try:
+                if st.status == "READY":
+                    if st.inline is not None:
+                        self.gcs.cast("obj_ready", oid.binary(), st.inline,
+                                      None, st.size)
+                    elif self.rt.store.contains(oid):
+                        self.gcs.cast("obj_ready", oid.binary(), None,
+                                      self.node_id, st.size)
+                elif st.status == "ERROR" and st.error is not None:
+                    self.gcs.cast("obj_error", oid.binary(), st.error)
+            except Exception:
+                return  # connection dropped again: the next NACK retries
+
     # ------------------------------------------------------------------
     # peer RPC service (what other nodes may ask of this one)
     # ------------------------------------------------------------------
 
     def _serve_peer(self, method: str, args: tuple, ctx) -> Any:
+        if method in ("submit_spec", "submit_actor_spec"):
+            # chaos site: this node accepting forwarded work IS the lease
+            # grant (raise -> the head re-places; kill -> daemon death
+            # mid-gang-schedule)
+            from ray_tpu.util import failpoints
+
+            failpoints.hit("daemon.lease_grant", method)
         if method == "submit_spec":
-            self.rt.submit_spec(args[0])
+            dup, tok = self._begin_attempt(args[0])
+            if not dup:
+                try:
+                    self.rt.submit_spec(args[0])
+                except BaseException:
+                    # nothing was enqueued: release the token so the
+                    # error reply is authoritative (a later fresh forward
+                    # may still succeed) — never report a failed submit
+                    # as an accepted one
+                    self._abort_attempt(tok)
+                    raise
+                self._commit_attempt(tok)
             return True
         if method == "submit_actor_spec":
-            self.rt.submit_actor_task(args[0])
+            dup, tok = self._begin_attempt(args[0])
+            if not dup:
+                try:
+                    self.rt.submit_actor_task(args[0])
+                except BaseException:
+                    self._abort_attempt(tok)
+                    raise
+                self._commit_attempt(tok)
             return True
         if method == "pull_object":
             return self._serve_pull(args[0])
@@ -461,6 +547,23 @@ class ClusterAdapter:
             self._node_view_ts = 0.0  # invalidate the scheduler view
         elif channel == "pgs":
             self._io.submit(self._on_pg_event, payload)
+        elif channel == "failpoints":
+            self._io.submit(self._on_failpoints, payload)
+
+    def _on_failpoints(self, payload: dict) -> None:
+        """Cluster-wide chaos arming: apply in this process and relay to
+        this runtime's workers over their control pipes."""
+        from ray_tpu.util import failpoints
+
+        try:
+            if payload.get("op") == "disarm":
+                failpoints.clear()
+                failpoints._broadcast_local(self.rt, None)
+            else:
+                failpoints.apply_spec(payload["spec"])
+                failpoints._broadcast_local(self.rt, payload["spec"])
+        except Exception:
+            pass
 
     def _deliver(self, oid_b: bytes, state: dict):
         """Apply a terminal global state to the local gcs (fetch if big)."""
@@ -823,12 +926,19 @@ class ClusterAdapter:
                     0 if packing else 1,
                     -util if packing else util)
 
-        target = min(picks, key=key)
-        # decrement the cached view so a burst of submissions spreads across
-        # peers instead of piling onto one node until the next heartbeat
-        for k, v in res.items():
-            target["avail"][k] = target["avail"].get(k, 0.0) - v
-        return self._forward(target["node_id"], spec, reason=reason)
+        for target in sorted(picks, key=key):
+            # decrement the cached view so a burst of submissions spreads
+            # across peers instead of piling onto one node until the next
+            # heartbeat
+            for k, v in res.items():
+                target["avail"][k] = target["avail"].get(k, 0.0) - v
+            if self._forward(target["node_id"], spec, reason=reason):
+                return True
+            # handoff failed (peer died mid-lease-grant, chaos
+            # daemon.lease_grant): NEVER strand the spec on one dead pick —
+            # refresh the view and try the next candidate
+            self._node_view_ts = 0.0
+        return False
 
     def _dep_bytes_by_node(self, spec: dict) -> Dict[bytes, int]:
         """READY-segment bytes of the spec's direct ref args, per holder
@@ -989,11 +1099,18 @@ class ClusterAdapter:
         slots = self._feasible_slots(res)
         if not slots:
             return False
-        pick = slots[self._spread_rr % len(slots)]
+        start = self._spread_rr % len(slots)
         self._spread_rr += 1
-        if pick["node_id"] == self.node_id:
-            return False
-        return self._forward(pick["node_id"], spec, reason="strategy")
+        # a failed handoff (peer died mid-lease-grant) rotates to the next
+        # feasible slot instead of stranding the spec in the local queue
+        for off in range(len(slots)):
+            pick = slots[(start + off) % len(slots)]
+            if pick["node_id"] == self.node_id:
+                return False
+            if self._forward(pick["node_id"], spec, reason="strategy"):
+                return True
+            self._node_view_ts = 0.0
+        return False
 
     def _place_random(self, spec: dict, res: Dict[str, float]) -> bool:
         """Uniform over feasible nodes including this one (reference
@@ -1004,12 +1121,16 @@ class ClusterAdapter:
         import random as _random
 
         slots = self._feasible_slots(res)
-        if not slots:
-            return False
-        pick = _random.choice(slots)
-        if pick["node_id"] == self.node_id:
-            return False
-        return self._forward(pick["node_id"], spec, reason="strategy")
+        _random.shuffle(slots)
+        # same failed-handoff fallback as spread: walk the (shuffled)
+        # feasible slots until one accepts, stop at a local slot
+        for pick in slots:
+            if pick["node_id"] == self.node_id:
+                return False
+            if self._forward(pick["node_id"], spec, reason="strategy"):
+                return True
+            self._node_view_ts = 0.0
+        return False
 
     def _record_forward(self, node_id: bytes, spec: dict) -> None:
         """Bookkeeping after handing a spec to a peer: failure-retry map,
@@ -1032,14 +1153,106 @@ class ClusterAdapter:
         self.watch_many([ObjectID(b) for b in spec["return_ids"]],
                         fetch=False)
 
+    def _begin_attempt(self, spec: dict):
+        """Receiver half of the lost-reply handshake: pop the forwarder's
+        per-attempt token and claim it, so a re-sent delivery (reply lost,
+        spec possibly already enqueued) is a no-op. A duplicate arriving
+        while the first delivery's submit is STILL RUNNING (the forwarder
+        timed out with the original queued behind it on the RPC pool)
+        waits for that outcome instead of guessing: committed -> report
+        duplicate, aborted -> re-claim and run the submit itself — a
+        duplicate must never acknowledge a submit that then fails.
+        Token-less specs (direct actor routing) always accept. Returns
+        ``(duplicate, token)``."""
+        tok = spec.pop("_fwd_attempt", None)
+        if tok is None:
+            return False, None
+        while True:
+            with self._accepted_lock:
+                ent = self._accepted_specs.get(tok)
+                if ent is None:
+                    ent = [threading.Event(), False]  # [done, committed]
+                    self._accepted_specs[tok] = ent
+                    if len(self._accepted_specs) > 4096:
+                        # trim SETTLED entries only (oldest first): an
+                        # in-flight entry evicted here would let a parked
+                        # duplicate re-claim mid-submit (double enqueue)
+                        # or orphan its commit; settled ones are safe —
+                        # their re-send window (≤10s) is long gone by the
+                        # time 4096 newer attempts have arrived
+                        for k in list(self._accepted_specs):
+                            if len(self._accepted_specs) <= 4096:
+                                break
+                            if self._accepted_specs[k][0].is_set():
+                                del self._accepted_specs[k]
+                    return False, tok
+            ent[0].wait(60)
+            with self._accepted_lock:
+                if self._accepted_specs.get(tok) is not ent:
+                    continue  # aborted: re-claim on the next pass
+                if ent[1]:
+                    return True, tok  # first delivery enqueued it
+            if ent[0].is_set():
+                continue  # abort raced the get: re-claim
+            # still in flight after 60s: a local enqueue stuck that long
+            # means the node is melted — report the near-certain outcome
+            return True, tok
+
+    def _commit_attempt(self, tok) -> None:
+        if tok is None:
+            return
+        with self._accepted_lock:
+            ent = self._accepted_specs.get(tok)
+        if ent is not None:
+            ent[1] = True
+            ent[0].set()
+
+    def _abort_attempt(self, tok) -> None:
+        if tok is None:
+            return
+        with self._accepted_lock:
+            ent = self._accepted_specs.pop(tok, None)
+        if ent is not None:
+            ent[0].set()
+
+    def _call_with_attempt(self, peer, method: str, spec: dict) -> bool:
+        """Deliver a spec to a peer under the lost-reply handshake.
+
+        A TRANSPORT failure is ambiguous (never delivered vs delivered-
+        but-reply-lost), so re-send the SAME per-attempt token to the
+        SAME peer once — the receiver's dedupe (:meth:`_begin_attempt`)
+        makes the re-send safe either way. A remote handler exception is
+        a definite reply (nothing enqueued: the receiver releases the
+        token on failure) and connection-refused means nothing was
+        delivered — neither re-sends. A partitioned-but-alive peer can
+        still double-execute after False is returned; without leases that
+        window is inherent, and the GCS declares such a node dead (and
+        evicts it from the candidate view) at node_timeout anyway. The
+        re-send timeout is short: the common re-send target is a dead or
+        wedged peer, and the candidate-walk callers pay this cost per
+        candidate."""
+        wire = dict(spec)
+        wire["_fwd_attempt"] = os.urandom(8)
+        try:
+            peer.call(method, wire, timeout=30)
+            return True
+        except ConnectionRefusedError:
+            return False  # never delivered
+        except (TimeoutError, ConnectionError, EOFError, OSError):
+            try:
+                peer.call(method, wire, timeout=10)
+                return True
+            except Exception:
+                return False
+        except Exception:
+            return False  # peer replied with an error: nothing enqueued
+
     def _forward(self, node_id: bytes, spec: dict,
                  reason: str = "resources") -> bool:
         peer = self._peer(node_id)
         if peer is None:
             return False
-        try:
-            peer.call("submit_spec", spec, timeout=30)
-        except Exception:
+        if not self._call_with_attempt(peer, "submit_spec", spec):
             return False
         try:
             # spillback decision record (reference scheduler spillback
@@ -1087,9 +1300,29 @@ class ClusterAdapter:
             raise ValueError(
                 f"placement group infeasible under {strategy}: {last_err}")
         failed = [i for i in range(len(bundles)) if i not in committed]
-        self.gcs.call("pg_register", pg_id, bundles, strategy,
-                      [committed.get(i) for i in range(len(bundles))],
-                      self.node_id, timeout=30)
+        # registration is retried through a GCS outage (chaos: kill -9 in
+        # the reserve->commit window): the bundles are already committed
+        # on their nodes, and an unregistered group would strand those
+        # reservations until the stage reaper — never park them forever
+        reg_err = None
+        for attempt in range(5):
+            try:
+                self.gcs.call("pg_register", pg_id, bundles, strategy,
+                              [committed.get(i) for i in range(len(bundles))],
+                              self.node_id, timeout=30)
+                reg_err = None
+                break
+            except Exception as e:
+                reg_err = e
+                time.sleep(0.5 * (attempt + 1))
+        if reg_err is not None:
+            for nid in set(committed.values()):
+                try:
+                    self._pg_call(nid, "pg_release", pg_id)
+                except Exception:
+                    pass
+            raise OSError(
+                f"placement group registration failed: {reg_err}")
         with self._pg_lock:
             self._pg_nodes[pg_id] = {i: committed.get(i)
                                      for i in range(len(bundles))}
@@ -1249,6 +1482,12 @@ class ClusterAdapter:
                 except Exception:
                     pass
             return None
+        # chaos site: the window between phase 1 (resources staged on every
+        # node) and phase 2 (commit) — a GCS/creator death here is what the
+        # 2-phase protocol + stage reaper must absorb
+        from ray_tpu.util import failpoints
+
+        failpoints.hit("adapter.pg.before_commit")
         committed: Dict[int, bytes] = {}
         for nid, bmap in per_node.items():
             done = False
@@ -1453,13 +1692,12 @@ class ClusterAdapter:
         for rid in spec["return_ids"]:
             self.rt.gcs.ensure_object(ObjectID(rid))
         peer = self._peer(node_id)
-        ok = False
-        if peer is not None:
-            try:
-                peer.call("submit_actor_spec", spec, timeout=30)
-                ok = True
-            except Exception:
-                ok = False
+        # lost-reply handshake matters most here: actor calls are
+        # non-idempotent, so an ambiguous transport failure re-sends the
+        # SAME attempt token (the receiver dedupes) instead of failing a
+        # call the peer may already be executing
+        ok = (peer is not None
+              and self._call_with_attempt(peer, "submit_actor_spec", spec))
         if not ok:
             self._fail_returns(spec, ActorDiedError(
                 f"actor's node {node_id.hex()[:8]} unreachable"))

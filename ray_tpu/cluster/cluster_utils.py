@@ -121,19 +121,25 @@ class Cluster:
     def add_node(self, *, num_cpus: float = 2,
                  resources: Optional[Dict[str, float]] = None,
                  labels: Optional[Dict[str, str]] = None,
+                 env: Optional[Dict[str, str]] = None,
                  wait: bool = True) -> int:
-        """Boot a node daemon subprocess; returns a handle id for kill_node."""
+        """Boot a node daemon subprocess; returns a handle id for kill_node.
+
+        ``env``: extra environment for the daemon (chaos tests arm
+        per-daemon failpoints by exporting ``RTPU_FAILPOINTS``)."""
         import json
 
         node_idx = self._next_node
         self._next_node += 1
+        full_env = self._env()
+        full_env.update(env or {})
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu.cluster.node_daemon",
              "--gcs", self.address, "--authkey", self.authkey,
              "--num-cpus", str(num_cpus),
              "--resources", json.dumps(resources or {}),
              "--labels", json.dumps(labels or {})],
-            env=self._env(), stdout=subprocess.DEVNULL,
+            env=full_env, stdout=subprocess.DEVNULL,
             stderr=subprocess.STDOUT,
         )
         self._node_procs[node_idx] = proc

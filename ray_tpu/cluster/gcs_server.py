@@ -780,6 +780,23 @@ class GcsService:
     def rpc_ping(self, ctx):
         return "pong"
 
+    # -- chaos plane ----------------------------------------------------
+
+    def rpc_fp_arm(self, ctx, spec: str):
+        """Arm failpoints in the GCS SERVER process itself (sites like
+        rpc.server.dispatch live here); cluster-wide distribution rides
+        the ``failpoints`` pubsub channel + KV, not this call."""
+        from ray_tpu.util import failpoints
+
+        failpoints.apply_spec(spec)
+        return True
+
+    def rpc_fp_disarm(self, ctx):
+        from ray_tpu.util import failpoints
+
+        failpoints.clear()
+        return True
+
     # ------------------------------------------------------------------
 
     def serve(self, host: str, port: int, authkey: bytes) -> RpcServer:

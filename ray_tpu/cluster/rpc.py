@@ -248,6 +248,10 @@ class ServerConn:
             except Exception:
                 pass
         try:
+            from ray_tpu.util import failpoints
+
+            if failpoints.hit("rpc.server.dispatch", method):
+                return  # chaos: swallow the request; the caller times out
             payload = self.server._handler(method, args, self)
             ok = True
         except BaseException as e:  # noqa: BLE001 — shipped to caller
@@ -416,6 +420,17 @@ class RpcClient:
         return False
 
     def call(self, method: str, *args, timeout: Optional[float] = None) -> Any:
+        """Request/reply. ``timeout=None`` applies the default deadline
+        (``RTPU_RPC_DEFAULT_TIMEOUT_S``): an un-deadlined call into a
+        wedged peer would park this thread forever, and every such parked
+        thread is a recovery hole (chaos ISSUE 5). Call sites that truly
+        need a longer wait pass it explicitly; a non-positive configured
+        default restores the unbounded wait."""
+        if timeout is None:
+            from ray_tpu import config as _cfg
+
+            t = float(_cfg.get("rpc_default_timeout_s"))
+            timeout = t if t > 0 else None
         req_id = next(self._ids)
         ev = threading.Event()
         box: list = []
@@ -436,6 +451,11 @@ class RpcClient:
         return payload
 
     def _send_counted(self, msg) -> None:
+        from ray_tpu.util import failpoints
+
+        if failpoints.hit("rpc.client.send",
+                          msg[2] if msg[0] == "req" else msg[1]):
+            return  # chaos: drop this request/cast on the floor
         # self._conn must be read INSIDE the send lock: the reconnect
         # path swaps it under the same lock
         buf = ForkingPickler.dumps(msg)

@@ -105,6 +105,11 @@ _knob("gcs_free_grace_s", float, 10.0,
 _knob("gcs_max_task_events", int, 50_000,
       "cluster-wide task event buffer size (reference GcsTaskManager "
       "store)", "cluster/gcs_server.py")
+_knob("rpc_default_timeout_s", float, 60.0,
+      "deadline applied to cluster RPC call() when the caller passes no "
+      "timeout — a wedged peer must surface TimeoutError, never block a "
+      "thread forever (generous: 2-vCPU CI boxes stall for seconds under "
+      "load); <= 0 restores the unbounded wait", "cluster/rpc.py")
 _knob("pull_chunk_bytes", int, 4 << 20,
       "chunk size for node-to-node object transfer",
       "cluster/adapter.py")
@@ -137,6 +142,10 @@ _knob("data_exchange_run_bytes", int, 32 << 20,
 _knob("data_exchange_target_rows", int, 250_000,
       "rows per output block emitted by a streaming reducer",
       "data/streaming.py")
+_knob("data_exchange_retries", int, 2,
+      "times a Dataset plan re-executes from lineage (sources are never "
+      "freed) when a streaming-exchange reducer actor dies before any "
+      "output was consumed; 0 = surface ActorDiedError", "data/dataset.py")
 
 # -- ops / models -----------------------------------------------------------
 _knob("attn_impl", str, "",
@@ -169,6 +178,11 @@ _knob("task_ring", int, 2048,
 _knob("serve_max_body", int, 64 << 20,
       "max HTTP request body bytes accepted by the serve proxy",
       "serve/proxy.py")
+_knob("serve_request_retries", int, 3,
+      "times a DeploymentHandle re-routes one request after the replica "
+      "it was sent to died (each retry reports the death so the "
+      "controller replaces the replica); 0 = surface ActorDiedError",
+      "serve/handle.py")
 
 # -- bench / watch ----------------------------------------------------------
 _knob("pool_prestart", int, 4,

@@ -207,6 +207,11 @@ class StoreClient:
         bytes)."""
         import time as _time
 
+        from ray_tpu.util import failpoints
+
+        # chaos site: a raised seal failure surfaces as a store write
+        # error (the producing task errors; retry_exceptions re-runs it)
+        failpoints.hit("store.seal")
         m = _store_metrics()
         size = serialization.serialized_size(data, buffers)
         t0 = _time.perf_counter()

@@ -121,6 +121,13 @@ class RemoteFunction:
             self._options = {**self._options, "runtime_env": renv}
         num_returns = self._options.get("num_returns", 1)
         streaming = num_returns in ("streaming", "dynamic")
+        # retry_exceptions shares the max_retries budget (reference
+        # semantics) — opting in without an explicit max_retries gets the
+        # reference default of 3 instead of the fail-fast 0, so
+        # @remote(retry_exceptions=True) is never silently inert
+        max_retries = self._options.get("max_retries")
+        if max_retries is None:
+            max_retries = 3 if self._options.get("retry_exceptions") else 0
         spec = ts.make_task_spec(
             self._fn_hash,
             enc_args,
@@ -128,10 +135,13 @@ class RemoteFunction:
             num_returns=1 if streaming else int(num_returns),
             resources=_normalize_resources(self._options),
             name=self._options.get("name", self.__name__),
-            max_retries=int(self._options.get("max_retries", 0)),
+            max_retries=int(max_retries),
             placement_group_id=pg,
             bundle_index=bundle_index,
             runtime_env=self._options.get("runtime_env"),
+            # True = retry any application error; a list/tuple of exception
+            # types retries only those (reference retry_exceptions forms)
+            retry_exceptions=self._options.get("retry_exceptions", False),
         )
         if nested_refs:
             spec["borrowed"] = nested_refs

@@ -107,6 +107,10 @@ class _WorkerState:
     def send(self, msg):
         if self.conn is None:
             raise OSError("worker not connected yet")
+        from ray_tpu.util import failpoints
+
+        if failpoints.hit("pipe.send", msg[0]):
+            return  # chaos: drop this driver->worker control message
         # pre-pickle so the framed byte count is known (what conn.send
         # does internally anyway — same reducer, no extra copy)
         from multiprocessing.reduction import ForkingPickler
@@ -956,6 +960,14 @@ class DriverRuntime:
     def _handle_msg(self, ws: _WorkerState, msg):
         kind = msg[0]
         if kind == "ready":
+            # chaos plane: workers spawned after failpoints.arm() must be
+            # armed too, before their first dispatch
+            specs = getattr(self, "_fp_specs", None)
+            if specs is not None:
+                try:
+                    ws.send(("fp", specs))
+                except (OSError, BrokenPipeError):
+                    pass
             with self.lock:
                 was_starting = ws.status == "starting"
                 if was_starting:
@@ -995,22 +1007,33 @@ class DriverRuntime:
             logger.warning("dropping done for unknown task %s from worker %s",
                            task_id_b.hex()[:8], ws.worker_id.hex()[:8])
             return
-        for entry in results:
-            rid, rkind, payload = entry[0], entry[1], entry[2]
-            oid = ObjectID(rid)
-            # refs nested in the RESULT: pin them against the return
-            # object's lifetime BEFORE marking ready (a consumer must
-            # never observe the outer ready while inner refs are freeable)
-            if len(entry) > 3 and entry[3]:
-                self._pin_result_refs(rid, entry[3])
-            if rkind == "i":
-                self.gcs.mark_ready(oid, inline=payload)
-            elif rkind == "s":
-                # payload = segment size (directory needs it so peers can
-                # pick chunked vs whole-blob pulls)
-                self.gcs.mark_ready(oid, size=payload or 0)
-            else:
-                self.gcs.mark_error(oid, payload)
+        failed = bool(results and results[0][1] == "e")
+        # retry_exceptions (reference ``@ray.remote(retry_exceptions=...)``):
+        # an APPLICATION failure resubmits the task instead of surfacing,
+        # while retries last. Plain tasks only — actor calls mutate state
+        # and streaming tasks already announced yields; cancelled tasks
+        # must surface TaskCancelledError, never re-run.
+        retrying = (failed and spec["type"] == ts.TASK
+                    and spec.get("retry_exceptions")
+                    and spec.get("retries_left", 0) > 0
+                    and not spec.get("streaming")
+                    and spec["task_id"] not in self.cancelled)
+        rex = spec.get("retry_exceptions")
+        if retrying and isinstance(rex, bytes):
+            # reference list form (cloudpickled tuple of types, see
+            # make_task_spec): retry ONLY those — anything else is
+            # intentionally fatal and must surface. The shipped payload
+            # wraps the user exception in TaskError; match the cause.
+            try:
+                err = cloudpickle.loads(results[0][2])
+                cause = getattr(err, "cause", err)
+                retrying = isinstance(cause, cloudpickle.loads(rex))
+            except Exception:
+                retrying = False
+        if retrying:
+            spec["retries_left"] = spec.get("retries_left", 0) - 1
+        else:
+            self._apply_done_results(results)
         fire = []
         with self._stream_cv:
             self._stream_consumed.pop(task_id_b, None)
@@ -1057,9 +1080,7 @@ class DriverRuntime:
                              "tid": tid_lane, "cat": "task_phase"})
                     t += d
         if spec is not None and start is not None and self._flight_enabled:
-            self._record_flight(spec, ws, start, phases,
-                                failed=bool(results and results[0][1] == "e"))
-        failed = bool(results and results[0][1] == "e")
+            self._record_flight(spec, ws, start, phases, failed=failed)
         with self.lock:
             if not ws.inflight_specs:
                 ws.current = None
@@ -1100,7 +1121,31 @@ class DriverRuntime:
             self._mark_actor_dead_and_flush(
                 ActorID(spec["actor_id"]), "creation task failed", results[0][2]
             )
+        if retrying:
+            logger.info("retrying task %s after application error "
+                        "(%d retries left)", task_id_b.hex()[:8],
+                        spec.get("retries_left", 0))
+            self._enqueue_ready(spec)
         self._pump()
+
+    def _apply_done_results(self, results) -> None:
+        """Publish one done message's results to the object directory."""
+        for entry in results:
+            rid, rkind, payload = entry[0], entry[1], entry[2]
+            oid = ObjectID(rid)
+            # refs nested in the RESULT: pin them against the return
+            # object's lifetime BEFORE marking ready (a consumer must
+            # never observe the outer ready while inner refs are freeable)
+            if len(entry) > 3 and entry[3]:
+                self._pin_result_refs(rid, entry[3])
+            if rkind == "i":
+                self.gcs.mark_ready(oid, inline=payload)
+            elif rkind == "s":
+                # payload = segment size (directory needs it so peers can
+                # pick chunked vs whole-blob pulls)
+                self.gcs.mark_ready(oid, size=payload or 0)
+            else:
+                self.gcs.mark_error(oid, payload)
 
     # ------------------------------------------------------------------
     # task-lifecycle flight recorder

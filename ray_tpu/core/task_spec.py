@@ -95,10 +95,19 @@ def make_task_spec(
     bundle_index: int = -1,
     scheduling_strategy: Any = None,
     runtime_env: Optional[dict] = None,
+    retry_exceptions: Any = False,
 ) -> dict:
     task_id = TaskID.from_random()
+    if isinstance(retry_exceptions, (list, tuple)):
+        # list form (retry only these exception types): cloudpickle the
+        # tuple so the spec stays plain-pickle-safe on every transport
+        # (control pipe, cluster RPC) even for __main__-defined types;
+        # an empty list means "never retry" and must stay falsy
+        retry_exceptions = (cloudpickle.dumps(tuple(retry_exceptions))
+                            if retry_exceptions else False)
     return {
         "type": TASK,
+        "retry_exceptions": retry_exceptions,
         "runtime_env": runtime_env,
         "task_id": task_id.binary(),
         "fn_hash": fn_hash,

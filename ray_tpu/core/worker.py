@@ -143,6 +143,10 @@ class WorkerRuntime:
     # -- transport --------------------------------------------------------
 
     def _send(self, msg):
+        from ray_tpu.util import failpoints
+
+        if failpoints.hit("worker.pipe.send", msg[0]):
+            return  # chaos: drop this worker->driver control message
         with self._send_lock:
             self.conn.send(msg)
 
@@ -215,6 +219,17 @@ class WorkerRuntime:
                         self._replies[req_id] = (msg[2], msg[3])
                 if ev is not None:
                     ev.set()
+            elif kind == "fp":
+                # chaos plane: driver-pushed failpoint arm/disarm
+                from ray_tpu.util import failpoints
+
+                if msg[1] is None:
+                    failpoints.clear()
+                else:
+                    try:
+                        failpoints.apply_spec(msg[1])
+                    except ValueError:
+                        pass
             elif kind == "shutdown":
                 os._exit(0)
 
@@ -766,9 +781,13 @@ class WorkerRuntime:
                 phases["store_result"] = time.perf_counter() - t2
             return r
 
+        from ray_tpu.util import failpoints
+
         try:
             # inside the try: a bad runtime_env (missing working_dir...)
             # must fail THIS task, not crash the worker process
+            failpoints.hit("worker.exec",
+                           spec.get("name") or spec.get("method"))
             undo_env = self._apply_runtime_env(spec)
             args = [self._decode_arg(a, phases) for a in spec["args"]]
             kwargs = {k: self._decode_arg(v, phases)
@@ -841,6 +860,8 @@ class WorkerRuntime:
                 results = enc(value, streaming=bool(spec.get("streaming")))
             else:
                 raise ValueError(f"unknown task type {ttype}")
+            failpoints.hit("worker.exec.before_result",
+                           spec.get("name") or spec.get("method"))
             if phases is None:
                 self._send(("done", spec["task_id"], results))
             else:

@@ -191,9 +191,36 @@ class Dataset:
     # -- execution --------------------------------------------------------
 
     def iter_block_refs(self) -> Iterator[Any]:
-        source = (self._source() if callable(self._source)
-                  else iter(self._source))
-        return execute_streaming(source, self._ops, self._options)
+        return self._iter_with_recovery()
+
+    def _iter_with_recovery(self) -> Iterator[Any]:
+        """Execute the plan, re-executing it from lineage when an exchange
+        reducer (or map-pool) actor dies before ANY output block was
+        consumed. The plan's sources survive every execution — only
+        ephemeral intermediates are freed — so a fresh run reproduces the
+        result; past the first yield a failure must surface (a partially
+        consumed stream cannot be transparently respliced)."""
+        from ray_tpu import config as _config
+        from ray_tpu.core.exceptions import ActorDiedError
+
+        retries = int(_config.get("data_exchange_retries"))
+        attempt = 0
+        while True:
+            source = (self._source() if callable(self._source)
+                      else iter(self._source))
+            stream = execute_streaming(source, self._ops, self._options)
+            try:
+                first = next(stream)
+            except StopIteration:
+                return
+            except ActorDiedError:
+                if attempt >= retries:
+                    raise
+                attempt += 1
+                continue
+            yield first
+            yield from stream
+            return
 
     def iter_blocks(self) -> Iterator[Block]:
         for ref in self.iter_block_refs():

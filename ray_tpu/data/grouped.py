@@ -82,6 +82,26 @@ def _reduce_map_groups(key: str, fn_blob: bytes, *parts: Block):
     return [fn(sub) for _, sub in _group_block(merged, key)]
 
 
+def _exchange_refs_with_recovery(kind: str, args: dict, dataset) -> List[Any]:
+    """Drive one streaming groupby exchange to completion; a reducer-actor
+    death re-runs the WHOLE exchange from the dataset's lineage (same
+    recovery contract as ``Dataset._iter_with_recovery`` — the groupby
+    entry points consume fully, so a restart can never duplicate output)."""
+    from ray_tpu import config
+    from ray_tpu.core.exceptions import ActorDiedError
+    from ray_tpu.data.streaming import run_exchange
+
+    retries = int(config.get("data_exchange_retries"))
+    for attempt in range(retries + 1):
+        try:
+            return list(run_exchange(kind, dict(args),
+                                     dataset.iter_block_refs()))
+        except ActorDiedError:
+            if attempt >= retries:
+                raise
+    raise AssertionError("unreachable")
+
+
 class GroupedData:
     def __init__(self, dataset, key: str):
         self._dataset = dataset
@@ -100,11 +120,9 @@ class GroupedData:
         rows ever return to the driver."""
         from ray_tpu.data.block import block_from_rows
         from ray_tpu.data.dataset import Dataset
-        from ray_tpu.data.streaming import run_exchange
 
         rows: List[Dict[str, Any]] = []
-        for ref in run_exchange(kind, args,
-                                self._dataset.iter_block_refs()):
+        for ref in _exchange_refs_with_recovery(kind, args, self._dataset):
             rows.extend(ray_tpu.get(ref))
         rows.sort(key=lambda r: r[self._key])
         return Dataset([ray_tpu.put(block_from_rows(rows))])
@@ -205,12 +223,10 @@ class GroupedData:
         from ray_tpu.data.dataset import Dataset
 
         if self._streaming():
-            from ray_tpu.data.streaming import run_exchange
-
-            refs = list(run_exchange(
+            refs = _exchange_refs_with_recovery(
                 "groupby_groups",
                 {"key": self._key, "fn_blob": _cp.dumps(fn)},
-                self._dataset.iter_block_refs()))
+                self._dataset)
             return Dataset(refs)
 
         out = self._exchange(_reduce_map_groups, _cp.dumps(fn))

@@ -491,7 +491,13 @@ class _ExchangeScheduler:
             p.unacked = len(p.refs)
 
         def retire_ack(ack) -> None:
+            from ray_tpu.util import failpoints
+
             p, owner = acks.pop(ack)
+            # chaos site: ack retirement — delay throttles the window
+            # (backpressure under a slow driver); raise simulates a
+            # reducer-side ingest failure surfacing here
+            failpoints.hit("data.exchange.ack", owner)
             rows, nbytes = ray_tpu.get(ack)  # raises on reducer error
             self.stats["blocks"] += 1
             self.stats["bytes"] += nbytes

@@ -112,6 +112,23 @@ class ServeController:
         while len(replicas) > target:
             self._kill(replicas.pop())
 
+    def report_replica_death(self, name: str, actor_id: bytes) -> int:
+        """Router-reported replica death (the reference's health-check /
+        unhealthy-replica path, pull-free: handles observe ActorDiedError
+        on the request they routed). Drop the dead replica, reconcile a
+        replacement up to the target count, and bump the version so every
+        handle refreshes its routing table."""
+        entry = self._deployments.get(name)
+        if entry is None:
+            return self._version
+        before = len(entry["replicas"])
+        entry["replicas"] = [r for r in entry["replicas"]
+                             if r._actor_id.binary() != actor_id]
+        if len(entry["replicas"]) != before:
+            self._reconcile(name)
+            self._version += 1
+        return self._version
+
     def _kill(self, replica) -> None:
         import ray_tpu
 

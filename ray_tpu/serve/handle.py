@@ -30,19 +30,49 @@ class _AppRefSentinel:
 
 class DeploymentResponse:
     """Future-like wrapper over the underlying ObjectRef (reference
-    ``DeploymentResponse``)."""
+    ``DeploymentResponse``). ``retry`` (set by the issuing handle) re-routes
+    the request to another replica when this one died before replying —
+    the router half of Serve's replica fault tolerance (the controller
+    replaces the dead replica; see ``ServeController.report_replica_death``)."""
 
-    def __init__(self, ref, on_done=None):
+    def __init__(self, ref, on_done=None, retry=None):
         self._ref = ref
         self._on_done = on_done
+        self._retry = retry
         self._result = None
         self._done = False
 
     def result(self, timeout_s: Optional[float] = None):
+        import time
+
         import ray_tpu
+        from ray_tpu.core.exceptions import ActorDiedError
 
         if not self._done:
-            self._result = ray_tpu.get(self._ref, timeout=timeout_s)
+            # ONE deadline across every retry: a re-route must not restart
+            # the caller's timeout (each attempt gets what remains)
+            deadline = (None if timeout_s is None
+                        else time.monotonic() + timeout_s)
+            while True:
+                remaining = (None if deadline is None
+                             else max(0.0, deadline - time.monotonic()))
+                try:
+                    self._result = ray_tpu.get(self._ref, timeout=remaining)
+                    break
+                except ActorDiedError:
+                    if self._retry is None:
+                        raise
+                    # reports the death, waits for a live replica, re-issues;
+                    # raises (ending the loop) when retries are exhausted —
+                    # the retry closes the in-flight accounting itself, so
+                    # neutralize on_done/retry before propagating (a repeat
+                    # result() call must not double-decrement)
+                    try:
+                        self._ref = self._retry()
+                    except BaseException:
+                        self._retry = None
+                        self._on_done = None
+                        raise
             self._done = True
             if self._on_done:
                 self._on_done()
@@ -60,27 +90,47 @@ class DeploymentResponseGenerator:
     """Iterates a streaming deployment call, yielding RESULTS as the
     replica produces them (reference streaming DeploymentResponse)."""
 
-    def __init__(self, ref_gen, on_done=None):
+    def __init__(self, ref_gen, on_done=None, retry=None):
         self._ref_gen = ref_gen
         self._on_done = on_done
+        self._retry = retry
         self._finished = False
+        self._yielded = False
 
     def __iter__(self):
         return self
 
     def __next__(self):
         import ray_tpu
+        from ray_tpu.core.exceptions import ActorDiedError
 
-        try:
-            ref = next(self._ref_gen)
-        except StopIteration:
-            self._finish()
-            raise
-        try:
-            return ray_tpu.get(ref)
-        except BaseException:
-            self._finish()
-            raise
+        while True:
+            try:
+                ref = next(self._ref_gen)
+                val = ray_tpu.get(ref)
+            except StopIteration:
+                self._finish()
+                raise
+            except ActorDiedError:
+                # replica died: re-route, but only while the stream is
+                # still splice-able (nothing yielded yet) — a half-consumed
+                # stream cannot be transparently resumed on a new replica
+                if self._retry is None or self._yielded:
+                    self._finish()
+                    raise
+                try:
+                    self._ref_gen = self._retry()
+                except BaseException:
+                    # exhausted: the retry closed the accounting itself
+                    self._on_done = None
+                    self._finish()
+                    raise
+                continue
+            except BaseException:
+                self._finish()
+                raise
+            self._yielded = True
+            return val
 
     def _finish(self):
         if not self._finished:
@@ -118,14 +168,16 @@ class DeploymentHandle:
             self._controller = ray_tpu.get_actor("SERVE_CONTROLLER")
         return self._controller
 
-    def _refresh(self, force: bool = False):
+    def _refresh(self, force: bool = False,
+                 timeout: Optional[float] = None):
         import ray_tpu
 
         ctrl = self._get_controller()
-        version = ray_tpu.get(ctrl.get_version.remote())
+        version = ray_tpu.get(ctrl.get_version.remote(), timeout=timeout)
         if force or version != self._version or not self._replicas:
             info = ray_tpu.get(
-                ctrl.get_routing_info.remote(self.deployment_name))
+                ctrl.get_routing_info.remote(self.deployment_name),
+                timeout=timeout)
             if info is None:
                 raise KeyError(
                     f"deployment {self.deployment_name!r} not found")
@@ -174,22 +226,73 @@ class DeploymentHandle:
         h._max_ongoing = self._max_ongoing
         return h
 
-    def remote(self, *args, **kwargs):
+    def _issue(self, args, kwargs):
+        """Pick a replica and dispatch one request to it."""
         self._refresh()
         idx = self._pick_replica()
         replica = self._replicas[idx]
         self._delta[idx] = self._delta.get(idx, 0) + 1
+        call = replica.handle_request
+        if self._stream:
+            call = call.options(num_returns="streaming")
+        return idx, replica, call.remote(self._method, args, kwargs)
 
-        def _done(i=idx):
+    def _replica_died(self, replica) -> None:
+        """Report a dead replica to the controller (which drops it from the
+        routing table and reconciles a replacement) and force-refresh this
+        handle's view so the re-issue routes to a live replica."""
+        import ray_tpu
+
+        try:
+            ctrl = self._get_controller()
+            ray_tpu.get(ctrl.report_replica_death.remote(
+                self.deployment_name, replica._actor_id.binary()),
+                timeout=10)
+        except Exception:
+            pass  # controller unreachable: the forced refresh still helps
+        try:
+            self._refresh(force=True, timeout=10)
+        except Exception:
+            # dead/wedged controller must not break the retry path: the
+            # cached replica list may still name a live replica, and the
+            # bounded retry budget decides the outcome either way
+            pass
+
+    def remote(self, *args, **kwargs):
+        from ray_tpu import config as _cfg
+
+        state = {}
+        state["idx"], state["replica"], ref = self._issue(args, kwargs)
+        retries = [int(_cfg.get("serve_request_retries"))]
+
+        def _done():
+            i = state["idx"]
             self._delta[i] = self._delta.get(i, 0) - 1
             self._report_metrics()
 
+        def _retry():
+            # called when the routed-to replica died before replying:
+            # report + re-route (bounded — a deployment whose replicas
+            # keep dying must eventually surface the error)
+            retries[0] -= 1
+            if retries[0] < 0:
+                from ray_tpu.core.exceptions import ActorDiedError
+
+                _done()  # the request is terminal: release its slot
+                raise ActorDiedError(
+                    f"deployment {self.deployment_name!r}: request still "
+                    "failing after replica-death retries")
+            self._delta[state["idx"]] = (
+                self._delta.get(state["idx"], 0) - 1)
+            self._replica_died(state["replica"])
+            state["idx"], state["replica"], new_ref = self._issue(
+                args, kwargs)
+            return new_ref
+
         if self._stream:
-            ref_gen = replica.handle_request.options(
-                num_returns="streaming").remote(self._method, args, kwargs)
-            return DeploymentResponseGenerator(ref_gen, on_done=_done)
-        ref = replica.handle_request.remote(self._method, args, kwargs)
-        return DeploymentResponse(ref, on_done=_done)
+            return DeploymentResponseGenerator(ref, on_done=_done,
+                                               retry=_retry)
+        return DeploymentResponse(ref, on_done=_done, retry=_retry)
 
     def _report_metrics(self):
         try:

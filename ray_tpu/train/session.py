@@ -127,6 +127,14 @@ class _Session:
             if os.path.abspath(checkpoint.path) != os.path.abspath(dest):
                 checkpoint.to_directory(dest)
             ckpt_path = os.path.dirname(dest)
+            # completion marker, written AFTER the rank dir landed: a gang
+            # killed mid-persist leaves a torn checkpoint_N, and resume
+            # (trainer._latest_checkpoint) must skip it — only checkpoints
+            # marked by every rank are resumable
+            marker = os.path.join(
+                ckpt_path, f".rank_{self.context.world_rank}.ok")
+            with open(marker, "w"):
+                pass
         # step telemetry: each report is one user-loop step — inter-report
         # wall time + well-known keys land in the metrics registry (and
         # federate to the head /metrics); never fails the report

@@ -129,7 +129,8 @@ class DataParallelTrainer(BaseTrainer):
                 last_error = e
                 executor.shutdown()
                 # resume the retry from the latest persisted checkpoint
-                latest = _latest_checkpoint(trial_dir)
+                latest = _latest_checkpoint(
+                    trial_dir, self.scaling_config.num_workers)
                 if latest:
                     start_ckpt = latest
         raise TrainingFailedError(
@@ -226,9 +227,25 @@ class JaxTrainer(DataParallelTrainer):
                          backend_config=backend, **kwargs)
 
 
-def _latest_checkpoint(trial_dir: str) -> Optional[str]:
+def _latest_checkpoint(trial_dir: str,
+                       world_size: int = 1) -> Optional[str]:
+    """Newest checkpoint that every rank finished persisting. A gang that
+    died mid-persist (chaos: worker SIGKILL during report) leaves a torn
+    checkpoint_N — rank dirs missing, partial, or (worst) fully copied
+    but unverifiable — so resume accepts ONLY checkpoints carrying every
+    rank's ``.rank_R.ok`` marker (written by session.report after the
+    copy). Rank-dir presence alone proves nothing: the kill can land
+    after the copies and before the first marker."""
     if not os.path.isdir(trial_dir):
         return None
-    cands = sorted(d for d in os.listdir(trial_dir)
-                   if d.startswith("checkpoint_"))
-    return os.path.join(trial_dir, cands[-1]) if cands else None
+    for name in sorted((d for d in os.listdir(trial_dir)
+                        if d.startswith("checkpoint_")), reverse=True):
+        path = os.path.join(trial_dir, name)
+        try:
+            entries = os.listdir(path)
+        except OSError:
+            continue
+        if all(f".rank_{r}.ok" in entries
+               for r in range(max(world_size, 1))):
+            return path
+    return None

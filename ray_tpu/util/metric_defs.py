@@ -255,6 +255,15 @@ _def("rtpu_daemon_uptime_seconds", "gauge",
      "node daemon uptime (sampled)", component="cluster")
 
 # ---------------------------------------------------------------------------
+# failpoints (util/failpoints.py)
+# ---------------------------------------------------------------------------
+
+_def("rtpu_failpoints_fired_total", "counter",
+     "chaos failpoints that fired in this process (test/chaos plane; "
+     "always 0 in production unless RTPU_FAILPOINTS arms a site)",
+     tag_keys=("site",), component="failpoints")
+
+# ---------------------------------------------------------------------------
 # lock contention profiler (util/contention.py)
 # ---------------------------------------------------------------------------
 

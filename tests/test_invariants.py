@@ -96,3 +96,42 @@ def test_serialization_stays_cloudpickle_first():
     src = (ROOT / "ray_tpu" / "core" / "serialization.py").read_text()
     cp = src.find("cloudpickle.dumps")
     assert cp != -1, "serialization.py no longer uses cloudpickle.dumps?"
+
+
+def test_cluster_plane_blocking_waits_have_deadlines():
+    """Chaos-plane invariant (ISSUE 5): a wedged peer must surface a
+    timeout, never park a thread forever. In ``cluster/`` that means
+
+    - blocking pipe reads (``.recv()``) live ONLY in rpc.py's dedicated
+      reader machinery (``_recv_framed`` + the polled handshake) — every
+      caller waits on an Event with a deadline instead;
+    - no bare ``<event>.wait()`` without a timeout argument.
+    """
+    cluster = ROOT / "ray_tpu" / "cluster"
+    recv_sites = {}
+    for path in sorted(cluster.rglob("*.py")):
+        for n, line in _code_lines(path):
+            if re.search(r"\.recv\(\)", line):
+                recv_sites.setdefault(path.name, []).append(n)
+    assert set(recv_sites) <= {"rpc.py"}, (
+        f"blocking .recv() outside rpc.py: {recv_sites}; cluster-plane "
+        "reads go through rpc.py's reader thread + deadline-capable "
+        "call() (RTPU_RPC_DEFAULT_TIMEOUT_S), never a raw recv loop")
+    assert len(recv_sites.get("rpc.py", [])) <= 2, (
+        f"rpc.py grew new blocking .recv() sites: {recv_sites['rpc.py']}; "
+        "only _recv_framed and the polled _client_handshake may block on "
+        "a socket read")
+
+    bare_waits = []
+    for path in sorted(cluster.rglob("*.py")):
+        for n, line in _code_lines(path):
+            # subprocess reaps after an explicit kill (cluster_utils
+            # shutdown paths) are not peer waits; events/conditions are
+            if re.search(r"\b(ev|event|_stop|cv|cond)\w*\.wait\(\s*\)",
+                         line):
+                bare_waits.append(f"{path.name}:{n}: {line.strip()}")
+    assert not bare_waits, (
+        "un-deadlined event waits in cluster/:\n  "
+        + "\n  ".join(bare_waits)
+        + "\npass a timeout (and loop) so a wedged peer cannot park the "
+        "thread forever")
