@@ -1,0 +1,22 @@
+# Developer entry points. (The native store has its own Makefile under
+# native/; `make -C native`.)
+
+PY ?= python
+
+.PHONY: lint lint-fix-docs test native
+
+# graftlint over the package: pure-ast, no jax import, <10 s on this box.
+# JAX_PLATFORMS=cpu is belt-and-braces for the axon sitecustomize (the
+# CLI also pins an already-imported jax to cpu before any device query).
+lint:
+	JAX_PLATFORMS=cpu $(PY) -m ray_tpu.devtools.graftlint
+
+# regenerate the README rule catalog after adding/changing rules
+lint-fix-docs:
+	JAX_PLATFORMS=cpu $(PY) -m ray_tpu.devtools.graftlint --update README.md
+
+test:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
+
+native:
+	$(MAKE) -C native

@@ -360,7 +360,7 @@ class GcsService:
 
     # -- object directory ----------------------------------------------
 
-    def _obj(self, oid: bytes) -> _GlobalObject:
+    def _obj_locked(self, oid: bytes) -> _GlobalObject:
         o = self.objects.get(oid)
         if o is None:
             o = _GlobalObject()
@@ -370,7 +370,7 @@ class GcsService:
     def rpc_obj_ready(self, ctx, oid: bytes, inline: Optional[bytes],
                       node_id: Optional[bytes], size: int = 0):
         with self.lock:
-            o = self._obj(oid)
+            o = self._obj_locked(oid)
             if o.status == ERROR:
                 return False
             o.status = READY
@@ -393,7 +393,7 @@ class GcsService:
 
     def rpc_obj_error(self, ctx, oid: bytes, err: bytes):
         with self.lock:
-            o = self._obj(oid)
+            o = self._obj_locked(oid)
             o.status = ERROR
             o.error = err
             o.t_terminal = time.monotonic()
@@ -445,7 +445,7 @@ class GcsService:
 
                 from ray_tpu.core.exceptions import ObjectLostError
 
-                o = self._obj(oid)
+                o = self._obj_locked(oid)
                 o.status = ERROR
                 o.error = cloudpickle.dumps(ObjectLostError(
                     f"object {oid.hex()[:16]} was freed (refcount reached "
@@ -455,7 +455,7 @@ class GcsService:
                 o.was_pinned = True
                 lost = True
             else:
-                o = self._obj(oid)
+                o = self._obj_locked(oid)
                 o.pins.add(node_id)
                 o.was_pinned = True
                 self._free_candidates.pop(oid, None)

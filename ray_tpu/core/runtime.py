@@ -296,11 +296,16 @@ class _Zygote:
             if ev == "ready":
                 self._ready.set()
             elif ev == "spawned":
-                c = self._children.get(msg["wid"])
+                with self._lock:
+                    c = self._children.get(msg["wid"])
                 if c is not None:
                     c._on_spawned(msg["pid"])
             elif ev == "exit":
-                c = self._children.pop(msg["wid"], None)
+                # map mutation under the same lock spawn() inserts with;
+                # the child callback runs outside it (it only sets events,
+                # but lock scope stays minimal on principle)
+                with self._lock:
+                    c = self._children.pop(msg["wid"], None)
                 if c is not None:
                     c._on_exit(msg.get("status", -1))
         self.dead = True  # stdout EOF: zygote gone; proxies self-probe
@@ -858,7 +863,7 @@ class DriverRuntime:
         self._drop_worker_pins(ws)
         with self.lock:
             if not ws.released:
-                self._release(ws.held)
+                self._release_locked(ws.held)
             spec = ws.current
             inflight = list(ws.inflight_specs.values())
             ws.inflight_specs.clear()
@@ -928,11 +933,11 @@ class DriverRuntime:
             # node — accounting catches up as other work finishes)
             res = create_spec.get("resources") or {}
             with self.lock:
-                held = self._acquire(res, create_spec.get("pg"),
+                held = self._acquire_locked(res, create_spec.get("pg"),
                                      create_spec.get("bundle_index", -1))
                 if held is None:
                     held = dict(res)
-                    self._acquire_forced(held)
+                    self._acquire_forced_locked(held)
                 new_ws.held = held
         else:
             self._mark_actor_dead_and_flush(ActorID(aid), "process died", err)
@@ -1093,10 +1098,10 @@ class DriverRuntime:
                 # there is nothing to release either. Death/kill releases
                 # via _on_worker_death.
                 if ws.released:
-                    self._acquire_forced(ws.held)
+                    self._acquire_forced_locked(ws.held)
             else:
                 if not ws.released:
-                    self._release(ws.held)
+                    self._release_locked(ws.held)
                 ws.held = {}
             ws.released = False
             if spec is not None and spec["type"] == ts.ACTOR_CREATE:
@@ -1230,13 +1235,13 @@ class DriverRuntime:
         elif op == "blocked":
             with self.lock:
                 if not ws.released and ws.current is not None:
-                    self._release(ws.held)
+                    self._release_locked(ws.held)
                     ws.released = True
             self._pump()
         elif op == "unblocked":
             with self.lock:
                 if ws.released:
-                    self._acquire_forced(ws.held)
+                    self._acquire_forced_locked(ws.held)
                     ws.released = False
         elif op == "kill_actor":
             self.kill_actor(args[0], args[1])
@@ -1375,6 +1380,9 @@ class DriverRuntime:
     def _drain_local_pin_releases(self) -> None:
         while True:
             try:
+                # graftlint: disable=unguarded-shared-write -- deque ops are
+                # GIL-atomic; the drain is deliberately lock-free (GC-safety
+                # design, refqueue.py: __del__ hooks must take no locks)
                 b = self._local_pin_releases.popleft()
             except IndexError:
                 return
@@ -1449,6 +1457,8 @@ class DriverRuntime:
     def _flush_ref_casts(self) -> None:
         """Ship queued pin/unpin transitions to the directory, in order."""
         if self.cluster is None:
+            # graftlint: disable=unguarded-shared-write -- OrderedCastFlusher
+            # is internally synchronized (atomic deque + try-lock flusher)
             self._cast_flusher.clear()
             return
         self._cast_flusher.flush()
@@ -1708,7 +1718,7 @@ class DriverRuntime:
             )
         return all(self.avail.get(k, 0.0) >= v for k, v in res.items())
 
-    def _acquire(self, res: Dict[str, float], pg: Optional[bytes], bundle: int) -> Optional[Dict[str, float]]:
+    def _acquire_locked(self, res: Dict[str, float], pg: Optional[bytes], bundle: int) -> Optional[Dict[str, float]]:
         if not self._can_acquire(res, pg, bundle):
             return None
         if pg is not None:
@@ -1728,7 +1738,7 @@ class DriverRuntime:
             self.avail[k] = self.avail.get(k, 0.0) - v
         return dict(res)
 
-    def _release(self, held: Dict[str, float]) -> None:
+    def _release_locked(self, held: Dict[str, float]) -> None:
         if not held:
             return
         pg = held.get("__pg__")
@@ -1747,7 +1757,7 @@ class DriverRuntime:
                 continue
             self.avail[k] = self.avail.get(k, 0.0) + v
 
-    def _acquire_forced(self, held: Dict[str, float]) -> None:
+    def _acquire_forced_locked(self, held: Dict[str, float]) -> None:
         pg = held.get("__pg__")
         if pg is not None:
             pgs = self.pgs.get(pg)
@@ -2033,7 +2043,7 @@ class DriverRuntime:
                             self.gcs.mark_error(ObjectID(rid), err)
                         continue
                     res = spec.get("resources") or {}
-                    held = self._acquire(res, spec.get("pg"), spec.get("bundle_index", -1))
+                    held = self._acquire_locked(res, spec.get("pg"), spec.get("bundle_index", -1))
                     if held is None:
                         self.ready_tasks.append(spec)
                         continue
@@ -2065,7 +2075,7 @@ class DriverRuntime:
                         continue
                     ws = self._find_idle_pool_worker_locked()
                     if ws is None:
-                        self._release(held)
+                        self._release_locked(held)
                         self.ready_tasks.append(spec)
                         continue
                     ws.held = held

@@ -180,6 +180,9 @@ class WorkerRuntime:
         self._ref_casts.flush()
         while True:
             try:
+                # graftlint: disable=unguarded-shared-write -- deque ops are
+                # GIL-atomic; drain is deliberately lock-free (refqueue.py:
+                # __del__ hooks must take no locks)
                 b = self._pending_pin_releases.popleft()
             except IndexError:
                 return

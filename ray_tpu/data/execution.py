@@ -275,9 +275,7 @@ def is_ephemeral(ref) -> bool:
 
 
 def _ensure_ref(x):
-    from ray_tpu.core.object_ref import ObjectRef
-
-    if isinstance(x, ObjectRef):
+    if isinstance(x, ray_tpu.ObjectRef):
         return x
     ref = ray_tpu.put(x)
     # the caller handed a raw Block: the executor owns this ref and may

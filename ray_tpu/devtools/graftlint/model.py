@@ -1,0 +1,122 @@
+"""graftlint data model: findings, rules, and the rule registry.
+
+A *rule* is a named check over the analyzed project (see
+``engine.Project``). Rules are grouped into *families* — the unit
+``tests/test_invariants.py`` asserts on — and every rule must ship at
+least one positive and one negative fixture under
+``tests/graftlint_fixtures/<rule>/`` (self-checked by
+``tests/test_graftlint.py``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Iterable, Iterator, List
+
+#: rule families (stable names: test_invariants keys off them)
+FAMILY_LOCKS = "locks"
+FAMILY_JAX = "jax"
+FAMILY_LAYERING = "layering"
+FAMILY_INVARIANTS = "invariants"
+FAMILY_FAILPOINTS = "failpoints"
+FAMILY_META = "meta"
+
+FAMILIES = (FAMILY_LOCKS, FAMILY_JAX, FAMILY_LAYERING, FAMILY_INVARIANTS,
+            FAMILY_FAILPOINTS, FAMILY_META)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One violation. Rendered as ``path:line RULE message``."""
+
+    path: str  # display path (repo-relative when detectable)
+    line: int
+    rule: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line} {self.rule} {self.message}"
+
+    def sort_key(self):
+        return (self.path, self.line, self.rule, self.message)
+
+
+class Rule:
+    """Base class. Subclasses set ``name``/``family``/``summary`` and
+    implement :meth:`check`.
+
+    ``summary`` is the one-line catalog entry (README table); keep it a
+    statement of the invariant, not of the implementation.
+    """
+
+    name: str = ""
+    family: str = ""
+    summary: str = ""
+    #: rules about suppressions themselves must not be suppressible —
+    #: otherwise 'disable=all' with no reason silences its own finding
+    suppressible: bool = True
+
+    def check(self, project) -> Iterator[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+    def finding(self, module, line: int, message: str) -> Finding:
+        return Finding(module.display, line, self.name, message)
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def register(cls):
+    """Class decorator: instantiate and register a rule."""
+    inst = cls()
+    if not inst.name or not inst.family or not inst.summary:
+        raise ValueError(f"rule {cls.__name__} missing name/family/summary")
+    if inst.name in _REGISTRY:
+        raise ValueError(f"duplicate rule name {inst.name}")
+    if inst.family not in FAMILIES:
+        raise ValueError(f"rule {inst.name}: unknown family {inst.family}")
+    _REGISTRY[inst.name] = inst
+    return cls
+
+
+def _load_rule_modules() -> None:
+    # import for registration side effect; cheap (stdlib-only modules)
+    from ray_tpu.devtools.graftlint import (  # noqa: F401
+        rules_failpoints,
+        rules_invariants,
+        rules_jax,
+        rules_layering,
+        rules_locks,
+        rules_meta,
+    )
+
+
+def all_rules() -> List[Rule]:
+    _load_rule_modules()
+    return [_REGISTRY[k] for k in sorted(_REGISTRY)]
+
+
+def get_rule(name: str) -> Rule:
+    _load_rule_modules()
+    return _REGISTRY[name]
+
+
+def rule_names() -> List[str]:
+    return [r.name for r in all_rules()]
+
+
+def select_rules(names: Iterable[str] = (),
+                 families: Iterable[str] = ()) -> List[Rule]:
+    """Rules filtered by explicit names and/or families (empty = all)."""
+    rules = all_rules()
+    names, families = set(names), set(families)
+    unknown = names - {r.name for r in rules}
+    if unknown:
+        raise KeyError(f"unknown rule(s): {sorted(unknown)}")
+    bad_fams = families - set(FAMILIES)
+    if bad_fams:
+        raise KeyError(f"unknown family(ies): {sorted(bad_fams)}")
+    if not names and not families:
+        return rules
+    return [r for r in rules
+            if r.name in names or r.family in families]

@@ -1,0 +1,107 @@
+"""Failpoint-site rule (family ``failpoints``).
+
+The chaos plane (ISSUE 5) is only as trustworthy as its site catalog:
+``tests/test_chaos_matrix.py`` arms sites by name, so a typo'd,
+duplicated, or undocumented site silently turns a regression test into a
+no-op. The docstring of ``util/failpoints.py`` is the canonical list;
+this rule keeps code and catalog bidirectionally in sync.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from collections import defaultdict
+from typing import Dict, Iterator, List, Set, Tuple
+
+from ray_tpu.devtools.graftlint.engine import Project
+from ray_tpu.devtools.graftlint.model import (
+    FAMILY_FAILPOINTS,
+    Finding,
+    Rule,
+    register,
+)
+
+_SITE_LINE = re.compile(r"^\s{4}([a-z0-9_.]+)\s{2,}\S")
+
+
+def documented_sites(failpoints_source: str) -> Set[str]:
+    """Parse the ``Sites`` block of util/failpoints.py's docstring."""
+    tree = ast.parse(failpoints_source)
+    doc = ast.get_docstring(tree) or ""
+    sites: Set[str] = set()
+    in_block = False
+    for line in doc.splitlines():
+        if line.startswith("Sites"):
+            in_block = True
+            continue
+        if in_block:
+            m = _SITE_LINE.match(line)
+            if m:
+                sites.add(m.group(1))
+            elif line.strip() and not line.startswith(" "):
+                break  # next top-level section
+    return sites
+
+
+@register
+class FailpointSites(Rule):
+    name = "failpoint-sites"
+    family = FAMILY_FAILPOINTS
+    summary = ("every failpoints.hit(name) site uses a unique literal "
+               "name that appears in util/failpoints.py's documented "
+               "site list (and every documented site still exists)")
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        fp_mod = project.module("ray_tpu/util/failpoints.py")
+        documented = (documented_sites(fp_mod.source)
+                      if fp_mod is not None else None)
+        sites: Dict[str, List[Tuple]] = defaultdict(list)
+        for mod in project.modules:
+            if mod.scope_rel == "ray_tpu/util/failpoints.py":
+                continue
+            for cs in mod.calls:
+                is_hit = (cs.fq == "ray_tpu.util.failpoints.hit"
+                          or (cs.parts and len(cs.parts) >= 2
+                              and cs.parts[-2:] == ("failpoints", "hit")))
+                if not is_hit:
+                    continue
+                if not cs.node.args or not isinstance(
+                        cs.node.args[0], ast.Constant) or not isinstance(
+                        cs.node.args[0].value, str):
+                    yield self.finding(
+                        mod, cs.line,
+                        "failpoints.hit() with a non-literal site name — "
+                        "sites must be greppable string literals (the "
+                        "docstring catalog and chaos matrix key off them)")
+                    continue
+                sites[cs.node.args[0].value].append((mod, cs.line))
+        for name, uses in sorted(sites.items()):
+            if len(uses) > 1:
+                locs = ", ".join(f"{m.display}:{ln}" for m, ln in uses)
+                for m, ln in uses:
+                    yield self.finding(
+                        m, ln,
+                        f"failpoint site '{name}' is hit from "
+                        f"{len(uses)} call sites ({locs}) — site names "
+                        f"are unique per call site so times=/once= "
+                        f"budgets stay attributable; add a suffixed name")
+            if documented is not None and name not in documented:
+                m, ln = uses[0]
+                yield self.finding(
+                    m, ln,
+                    f"failpoint site '{name}' is not in util/"
+                    f"failpoints.py's documented Sites list — add it "
+                    f"there (the docstring is the canonical catalog the "
+                    f"chaos matrix authors read)")
+        # stale-doc direction needs full-tree knowledge (whole_package);
+        # given that, it must fire even when ZERO hit() sites remain —
+        # that is the fully-stale-catalog case
+        if documented is not None and fp_mod is not None \
+                and project.whole_package:
+            for name in sorted(documented - set(sites)):
+                yield self.finding(
+                    fp_mod, 1,
+                    f"documented failpoint site '{name}' has no "
+                    f"failpoints.hit call site left in the tree — "
+                    f"remove it from the Sites list or restore the site")

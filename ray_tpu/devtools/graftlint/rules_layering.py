@@ -1,0 +1,63 @@
+"""Layering-seam rule (family ``layering``).
+
+The portability seam from CLAUDE.md: everything ML-level builds ONLY on
+the public task/actor/object API — the same property that lets the
+reference's libraries (data/train/tune/serve/rllib, pure Python over L3)
+run anywhere the core runs. One private import quietly couples a library
+to driver internals and the seam is gone.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from ray_tpu.devtools.graftlint.engine import Project
+from ray_tpu.devtools.graftlint.model import (
+    FAMILY_LAYERING,
+    Finding,
+    Rule,
+    register,
+)
+
+#: subpackages on the ML side of the seam
+ML_LAYERS = ("data", "train", "tune", "serve", "rllib")
+
+#: import prefixes the ML layers may use. The seam bans core/cluster
+#: *internals*; public exception types and the util/ surface (state API,
+#: metrics, placement groups...) are part of the contract.
+ALLOWED_PREFIXES = (
+    "ray_tpu.core.exceptions",
+)
+
+
+def _banned(fq: str) -> bool:
+    if not fq.startswith(("ray_tpu.core", "ray_tpu.cluster")):
+        return False
+    return not any(fq == p or fq.startswith(p + ".")
+                   for p in ALLOWED_PREFIXES)
+
+
+@register
+class LayeringSeam(Rule):
+    name = "layering-seam"
+    family = FAMILY_LAYERING
+    summary = ("data/train/tune/serve/rllib import only the public "
+               "task/actor/object API (top-level ray_tpu), util/, and "
+               "sibling libraries — never core.*/cluster.* internals "
+               "(except core.exceptions)")
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        prefixes = tuple(f"ray_tpu/{p}/" for p in ML_LAYERS)
+        for mod in project.modules:
+            if not mod.scope_rel.startswith(prefixes):
+                continue
+            for line, fq in mod.all_import_nodes:
+                if _banned(fq):
+                    layer = mod.scope_rel.split("/")[1]
+                    yield self.finding(
+                        mod, line,
+                        f"ray_tpu.{layer} imports {fq} — ML libraries "
+                        f"build ONLY on the public task/actor/object API "
+                        f"(CLAUDE.md portability seam); use the ray_tpu "
+                        f"top-level API or add a public accessor to "
+                        f"ray_tpu.util")

@@ -1,0 +1,189 @@
+"""Lock-discipline / race-detection rules (family ``locks``).
+
+The static twin of ``util/contention.py``'s runtime profiler: the r8
+contention hunt proved the driver control plane is GIL-serialized CPU
+under ONE coarse lock per component — so the two ways to lose are (a)
+touching that shared state *off* the lock (a race the profiler can't
+see) and (b) doing slow/blocking work *on* it (latency every other
+thread pays). Both are lexically visible, so both are lint rules.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Iterator
+
+from ray_tpu.devtools.graftlint.engine import Project
+from ray_tpu.devtools.graftlint.model import (
+    FAMILY_LOCKS,
+    Finding,
+    Rule,
+    register,
+)
+
+#: caller-holds-the-lock convention: ``_*_locked`` methods are guarded by
+#: contract (their call sites are checked instead, being under a lock)
+_LOCKED_SUFFIX = "_locked"
+
+#: attribute writes in these methods are single-threaded setup/teardown
+#: even when the method is publicly callable
+_LIFECYCLE = {"__init__", "__del__", "__enter__", "__exit__"}
+
+
+def _is_guard_context(write, ci) -> bool:
+    """True when a write site is considered lock-protected."""
+    if write.locks:
+        return True
+    if write.method.endswith(_LOCKED_SUFFIX):
+        return True
+    return False
+
+
+@register
+class UnguardedSharedWrite(Rule):
+    name = "unguarded-shared-write"
+    family = FAMILY_LOCKS
+    summary = ("in a class that runs threads, an attribute written under a "
+               "lock somewhere must never be written bare elsewhere "
+               "(outside __init__-only setup)")
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for mod in project.modules:
+            for ci in mod.classes.values():
+                if not ci.thread_targets:
+                    continue  # single-threaded class: nothing to race
+                init_only = ci.init_only()
+                thread_reach = ci.thread_reachable()
+                # which locks guard each attribute (from guarded writes)
+                guards = defaultdict(set)
+                for w in ci.writes:
+                    if w.locks:
+                        guards[w.attr].update(w.locks)
+                    elif w.method.endswith(_LOCKED_SUFFIX):
+                        guards[w.attr].add("<caller-held lock>")
+                for w in ci.writes:
+                    if w.attr not in guards or _is_guard_context(w, ci):
+                        continue
+                    if w.in_nested_func:
+                        continue  # closures: execution context unknown
+                    if w.method in _LIFECYCLE or w.method in init_only:
+                        continue
+                    locks = ", ".join(sorted(guards[w.attr]))
+                    ctx = ("thread entry "
+                           if w.method in thread_reach else "method ")
+                    yield self.finding(
+                        mod, w.line,
+                        f"{ci.name}.{w.attr} is written under {locks} "
+                        f"elsewhere but bare in {ctx}{w.method}() — "
+                        f"racy against the class's "
+                        f"{'/'.join(sorted(ci.thread_targets))} thread(s); "
+                        f"take the lock or mark the site "
+                        f"# graftlint: disable={self.name} -- <why safe>")
+
+
+@register
+class LockOrderInversion(Rule):
+    name = "lock-order-inversion"
+    family = FAMILY_LOCKS
+    summary = ("two locks of one class acquired in both nesting orders "
+               "(directly or one call away) are a deadlock candidate")
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for mod in project.modules:
+            for ci in mod.classes.values():
+                pairs = {}  # (outer, inner) -> first line observed
+                # direct lexical nesting
+                for outer, inner, line, _via in ci.lock_pairs:
+                    if outer != inner:
+                        pairs.setdefault((outer, inner), line)
+                # one call level: under L, call self.m() where m acquires K
+                for cs in mod.calls:
+                    if not cs.locks or not cs.parts:
+                        continue
+                    if cs.parts[0] != "self" or len(cs.parts) != 2:
+                        continue
+                    callee = ci.methods.get(cs.parts[1])
+                    if callee is None or cs.func.split(".")[0] != ci.name:
+                        continue
+                    held = cs.locks
+                    for k in callee.acquires:
+                        if k not in held:
+                            for outer in held:
+                                if outer != k:
+                                    pairs.setdefault((outer, k), cs.line)
+                for (a, b), line in sorted(pairs.items()):
+                    if (b, a) in pairs and a < b:
+                        other = pairs[(b, a)]
+                        yield self.finding(
+                            mod, line,
+                            f"{ci.name} acquires {a} then {b} here but "
+                            f"{b} then {a} at line {other} — inconsistent "
+                            f"order deadlocks under contention; pick one "
+                            f"order (or drop to one lock)")
+
+
+#: dotted-call tails that block the calling thread
+_BLOCKING_TAILS = {"recv", "recv_bytes", "recv_into", "accept", "connect",
+                   "call"}
+_BLOCKING_FQ = {"time.sleep", "select.select"}
+
+
+@register
+class BlockingUnderLock(Rule):
+    name = "blocking-under-lock"
+    family = FAMILY_LOCKS
+    summary = ("no blocking call (sleep, pipe/socket recv/accept/connect, "
+               "rpc call(), event wait) while holding a lock — every "
+               "other thread on that lock stalls behind the I/O")
+
+    def _cond_base_held(self, mod, ci, recv_parts, locks) -> bool:
+        """cv.wait() while holding cv's base lock is the one LEGITIMATE
+        wait-under-lock (the wait releases it)."""
+        if ci is None or not recv_parts or recv_parts[0] != "self" \
+                or len(recv_parts) != 2:
+            return False
+        info = ci.locks.get(recv_parts[1])
+        if info is None or info.kind != "cond":
+            return False
+        self_key = f"self.{recv_parts[1]}"
+        if self_key in locks:
+            return True
+        return info.cond_base is not None and f"self.{info.cond_base}" in locks
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for mod in project.modules:
+            for cs in mod.calls:
+                if not cs.locks:
+                    continue
+                held = ", ".join(sorted(cs.locks))
+                if cs.fq in _BLOCKING_FQ:
+                    yield self.finding(
+                        mod, cs.line,
+                        f"{cs.fq}() while holding {held} — move the "
+                        f"sleep/IO outside the lock (queue under the "
+                        f"lock, ship outside: see runtime's "
+                        f"OrderedCastFlusher pattern)")
+                    continue
+                if not cs.parts or len(cs.parts) < 2:
+                    continue
+                tail = cs.parts[-1]
+                cls_name = cs.func.split(".")[0]
+                ci = mod.classes.get(cls_name)
+                if tail == "wait":
+                    recv = cs.parts[:-1]
+                    if self._cond_base_held(mod, ci, list(recv), cs.locks):
+                        continue
+                    yield self.finding(
+                        mod, cs.line,
+                        f"{'.'.join(cs.parts)}() while holding {held} — "
+                        f"a wait on anything but a Condition built on the "
+                        f"held lock parks every thread contending for "
+                        f"{held}; wait outside the lock with a deadline")
+                elif tail in _BLOCKING_TAILS:
+                    yield self.finding(
+                        mod, cs.line,
+                        f"{'.'.join(cs.parts)}() while holding {held} — "
+                        f"pipe/RPC I/O under a lock serializes the "
+                        f"control plane (r8: the driver lock IS the hot "
+                        f"path); send/recv outside, publish results under "
+                        f"the lock")

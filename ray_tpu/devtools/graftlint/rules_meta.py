@@ -1,0 +1,49 @@
+"""Lint-hygiene rules about graftlint itself (family ``meta``).
+
+Suppressions are the pressure valve that replaces a baseline file: a
+violation judged intentional stays visible in the tree next to its
+justification. That only works if every suppression really carries a
+reason and names a real rule — otherwise it rots into exactly the silent
+baseline entry the satellite spec bans.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from ray_tpu.devtools.graftlint.engine import Project
+from ray_tpu.devtools.graftlint.model import (
+    FAMILY_META,
+    Finding,
+    Rule,
+    register,
+    rule_names,
+)
+
+
+@register
+class BareSuppression(Rule):
+    name = "bare-suppression"
+    family = FAMILY_META
+    suppressible = False  # a bare 'disable=all' must not silence this
+    summary = ("every '# graftlint: disable=RULE' carries '-- <reason>' "
+               "and names a real rule — intentional violations are "
+               "justified in place, never silently baselined")
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        known = set(rule_names()) | {"all"}
+        for mod in project.modules:
+            for sup in mod.suppressions:
+                if not sup.reason:
+                    yield self.finding(
+                        mod, sup.comment_line,
+                        f"suppression of {', '.join(sup.rules)} has no "
+                        f"reason — write '# graftlint: "
+                        f"disable={','.join(sup.rules)} -- <why this is "
+                        f"safe>'")
+                for r in sup.rules:
+                    if r not in known:
+                        yield self.finding(
+                            mod, sup.comment_line,
+                            f"suppression names unknown rule '{r}' — it "
+                            f"suppresses nothing; see --list-rules")

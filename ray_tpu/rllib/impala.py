@@ -224,7 +224,6 @@ class IMPALA(Algorithm):
             # aggregation tree: fan batches over aggregator actors,
             # round-robin; weights ship once as a shared ref
             import ray_tpu
-            from ray_tpu.core.runtime import _get_runtime
 
             w_ref = ray_tpu.put(weights)
             refs = []
@@ -242,7 +241,7 @@ class IMPALA(Algorithm):
                 # a weights blob per step would accumulate forever (no
                 # distributed refcounting): free it even when an
                 # aggregator died mid-step
-                _get_runtime().free([w_ref.id.binary()])
+                ray_tpu.free(w_ref)
             return {k: np.concatenate([o[k] for o in outs])
                     for k in outs[0]}
         from ray_tpu.rllib.rl_module import RLModuleSpec

@@ -193,11 +193,11 @@ class DeploymentHandle:
     def _load_view(self) -> List[float]:
         now = time.monotonic()
         if now - self._depth_ts > _DEPTH_TTL_S:
-            from ray_tpu.core.runtime import _get_runtime
+            from ray_tpu.util import state
 
             try:
                 ids = [r._actor_id.binary() for r in self._replicas]
-                self._depths = _get_runtime().actor_queue_depths(ids)
+                self._depths = state.actor_queue_depths(ids)
                 self._delta = {i: 0 for i in range(len(self._replicas))}
                 self._depth_ts = now
             except Exception:

@@ -26,6 +26,13 @@ def list_nodes() -> List[Dict[str, Any]]:
     return ray_tpu.nodes()
 
 
+def actor_queue_depths(actor_ids: List[bytes]) -> List[int]:
+    """Pending-call depth per actor (same order as ``actor_ids``) — the
+    public surface serve's load-aware routing reads; libraries must not
+    reach into the runtime for this (layering seam)."""
+    return _gcs().actor_queue_depths(actor_ids)
+
+
 def list_actors(filters: Optional[List] = None) -> List[Dict[str, Any]]:
     rt = _gcs()
     out = []

@@ -1,0 +1,16 @@
+"""One full-tree graftlint pass shared by test_invariants.py and
+test_graftlint.py — the analysis dominates the cost (a few seconds on
+this throttled box), the rule passes are cheap, so the suite pays for it
+once. Not a test module (leading underscore keeps pytest away)."""
+
+from functools import lru_cache
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parents[1]
+
+
+@lru_cache(maxsize=1)
+def tree_findings():
+    from ray_tpu.devtools import graftlint
+
+    return tuple(graftlint.lint([ROOT / "ray_tpu"], root=ROOT))

@@ -1,0 +1,5 @@
+# graftlint: path=ray_tpu/core/fake_sched.py
+"""Offender: an ad-hoc metrics Counter in core/ (skips metric_defs)."""
+from ray_tpu.util import metrics
+
+TASKS = metrics.Counter("rtpu_fake_tasks_total", "ad-hoc!")

@@ -1,0 +1,9 @@
+# graftlint: path=ray_tpu/core/fake_sched.py
+"""Compliant: built-ins come from metric_defs.get; collections.Counter
+is not a metric (the old regex flagged it)."""
+from collections import Counter
+
+from ray_tpu.util import metric_defs
+
+TASKS = metric_defs.get("rtpu_scheduler_tasks_submitted_total")
+WORDS = Counter()

@@ -1,0 +1,6 @@
+"""Offender: a suppression with no reason, and one naming a bogus rule."""
+import os
+
+CORES = os.cpu_count()  # graftlint: disable=layering-seam
+FLAGS = os.environ  # graftlint: disable=not-a-real-rule -- misspelled
+HOME = os.curdir  # graftlint: disable=all

@@ -1,0 +1,5 @@
+"""Compliant: every suppression carries its justification."""
+import os
+
+# graftlint: disable=layering-seam -- example only; this line is clean
+CORES = os.cpu_count()

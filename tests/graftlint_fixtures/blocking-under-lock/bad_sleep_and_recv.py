@@ -1,0 +1,15 @@
+"""Offender: sleeps and does pipe I/O while holding the lock."""
+import threading
+import time
+
+
+class Stalls:
+    def __init__(self, conn):
+        self.lock = threading.Lock()
+        self.conn = conn
+        self.last = None
+
+    def poll(self):
+        with self.lock:
+            time.sleep(0.5)
+            self.last = self.conn.recv()

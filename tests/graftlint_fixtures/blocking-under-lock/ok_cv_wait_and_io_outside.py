@@ -1,0 +1,19 @@
+"""Compliant: the only wait under the lock is on a Condition built on
+that lock (which releases it); I/O happens outside."""
+import threading
+import time
+
+
+class Polite:
+    def __init__(self, conn):
+        self.lock = threading.Lock()
+        self.cv = threading.Condition(self.lock)
+        self.conn = conn
+        self.last = None
+
+    def poll(self):
+        msg = self.conn.recv()
+        time.sleep(0.01)
+        with self.lock:
+            self.last = msg
+            self.cv.wait(1.0)

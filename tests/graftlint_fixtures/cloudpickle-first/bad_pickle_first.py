@@ -1,0 +1,12 @@
+# graftlint: path=ray_tpu/core/serialization.py
+"""Offender: plain pickle tried before cloudpickle."""
+import pickle
+
+import cloudpickle
+
+
+def serialize(obj):
+    try:
+        return pickle.dumps(obj)
+    except Exception:
+        return cloudpickle.dumps(obj)

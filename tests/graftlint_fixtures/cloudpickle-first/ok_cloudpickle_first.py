@@ -1,0 +1,8 @@
+# graftlint: path=ray_tpu/core/serialization.py
+"""Compliant: cloudpickle first (plain pickle serializes __main__
+functions by reference and breaks workers)."""
+import cloudpickle
+
+
+def serialize(obj):
+    return cloudpickle.dumps(obj)

@@ -1,0 +1,20 @@
+"""Offender: two call sites share one name; one site name is dynamic."""
+from ray_tpu.util import failpoints
+
+
+def send(msg):
+    if failpoints.hit("fake.send"):
+        return
+    _push(msg)
+
+
+def resend(msg, name):
+    if failpoints.hit("fake.send"):
+        return
+    if failpoints.hit(name):
+        return
+    _push(msg)
+
+
+def _push(msg):
+    pass

@@ -1,0 +1,7 @@
+# graftlint: path=ray_tpu/core/fake_helper.py
+"""Offender: a try/except-guarded import is STILL module scope — every
+zygote worker boot pays it."""
+try:
+    import jax
+except ImportError:
+    jax = None

@@ -1,0 +1,9 @@
+# graftlint: path=ray_tpu/core/fake_helper.py
+"""Compliant: jax deferred to the function that needs it."""
+import os
+
+
+def norm(x):
+    import jax.numpy as jnp
+
+    return jnp.linalg.norm(x) + len(os.sep)

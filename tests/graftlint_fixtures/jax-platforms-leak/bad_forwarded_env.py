@@ -1,0 +1,14 @@
+# graftlint: path=ray_tpu/core/fake_spawner.py
+"""Offender: forwards the driver's JAX_PLATFORMS into a worker env."""
+import os
+
+
+def worker_env():
+    env = {k: v for k, v in os.environ.items()
+           if k in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    env.setdefault("PATH", "/usr/bin")
+    return env
+
+
+def platform_flag():
+    return os.environ.get("JAX_PLATFORMS", "")

@@ -1,0 +1,10 @@
+# graftlint: path=ray_tpu/core/fake_spawner.py
+"""Compliant: workers get an explicit literal platform, never the
+driver's env value."""
+import os
+
+
+def worker_env():
+    env = {"PATH": os.environ.get("PATH", "")}
+    env["JAX_PLATFORMS"] = "cpu"
+    return env

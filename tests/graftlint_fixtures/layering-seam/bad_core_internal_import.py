@@ -1,0 +1,8 @@
+# graftlint: path=ray_tpu/serve/fake_router.py
+"""Offender: an ML-layer module reaching into runtime internals."""
+
+
+def depths(ids):
+    from ray_tpu.core.runtime import _get_runtime
+
+    return _get_runtime().actor_queue_depths(ids)

@@ -1,0 +1,8 @@
+# graftlint: path=ray_tpu/serve/__init__.py
+"""Offender: a package __init__ reaching into runtime internals via a
+RELATIVE import (resolves against the package itself)."""
+from ..core.runtime import _get_runtime
+
+
+def depths(ids):
+    return _get_runtime().actor_queue_depths(ids)

@@ -1,0 +1,22 @@
+"""Offender: a->b->c in one method vs c->a in another — the inversion is
+between NON-adjacent locks in the chain (a,c)."""
+import threading
+
+
+class Chain:
+    def __init__(self):
+        self.a_lock = threading.Lock()
+        self.b_lock = threading.Lock()
+        self.c_lock = threading.Lock()
+        self.x = 0
+
+    def one(self):
+        with self.a_lock:
+            with self.b_lock:
+                with self.c_lock:
+                    self.x = 1
+
+    def other(self):
+        with self.c_lock:
+            with self.a_lock:
+                self.x = 2

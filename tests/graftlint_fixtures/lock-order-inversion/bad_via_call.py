@@ -1,0 +1,23 @@
+"""Offender one call away: other() holds b and calls a helper that
+acquires a, while one() nests a->b directly."""
+import threading
+
+
+class ViaCall:
+    def __init__(self):
+        self.a_lock = threading.Lock()
+        self.b_lock = threading.Lock()
+        self.x = 0
+
+    def one(self):
+        with self.a_lock:
+            with self.b_lock:
+                self.x = 1
+
+    def other(self):
+        with self.b_lock:
+            self._helper()
+
+    def _helper(self):
+        with self.a_lock:
+            self.x = 2

@@ -1,0 +1,19 @@
+"""Compliant: both methods take a before b."""
+import threading
+
+
+class Ordered:
+    def __init__(self):
+        self.a_lock = threading.Lock()
+        self.b_lock = threading.Lock()
+        self.x = 0
+
+    def one(self):
+        with self.a_lock:
+            with self.b_lock:
+                self.x = 1
+
+    def other(self):
+        with self.a_lock:
+            with self.b_lock:
+                self.x = 2

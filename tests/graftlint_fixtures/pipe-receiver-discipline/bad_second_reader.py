@@ -1,0 +1,18 @@
+# graftlint: path=ray_tpu/core/worker.py
+"""Offender: a second conn.recv() call site outside _recv_loop."""
+
+
+class WorkerRuntime:
+    def __init__(self, conn):
+        self.conn = conn
+
+    def _recv_loop(self):
+        while True:
+            msg = self.conn.recv()
+            self._dispatch(msg)
+
+    def _dispatch(self, msg):
+        pass
+
+    def wait_reply(self):
+        return self.conn.recv()

@@ -1,0 +1,21 @@
+# graftlint: path=ray_tpu/core/worker.py
+"""Compliant: only _recv_loop reads the pipe; replies arrive via events
+the reader sets."""
+import threading
+
+
+class WorkerRuntime:
+    def __init__(self, conn):
+        self.conn = conn
+        self.reply_ev = threading.Event()
+        self.reply = None
+
+    def _recv_loop(self):
+        while True:
+            msg = self.conn.recv()
+            self.reply = msg
+            self.reply_ev.set()
+
+    def wait_reply(self, timeout):
+        self.reply_ev.wait(timeout)
+        return self.reply

@@ -1,0 +1,12 @@
+"""Offender: alias-imported raw kernel called outside ops/, and a local
+function reaching it handed to jax.grad."""
+import jax
+from ray_tpu.ops.flash_pallas import flash_attention_pallas as fap
+
+
+def loss(q, k, v):
+    return fap(q, k, v).sum()
+
+
+def train_step(q, k, v):
+    return jax.grad(loss)(q, k, v)

@@ -1,0 +1,11 @@
+# graftlint: path=ray_tpu/cluster/fake_client.py
+"""Offender: a cluster-plane thread parked forever on a bare wait."""
+import threading
+
+
+class Client:
+    def __init__(self):
+        self.reply_event = threading.Event()
+
+    def call(self):
+        self.reply_event.wait()

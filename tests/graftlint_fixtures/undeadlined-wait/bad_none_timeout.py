@@ -1,0 +1,15 @@
+# graftlint: path=ray_tpu/cluster/fake_client.py
+"""Offender: wait(None)/wait(timeout=None) still parks forever."""
+import threading
+
+
+class Client:
+    def __init__(self):
+        self.reply_event = threading.Event()
+        self.done_ev = threading.Event()
+
+    def call(self):
+        self.reply_event.wait(None)
+
+    def call2(self):
+        self.done_ev.wait(timeout=None)

@@ -1,0 +1,17 @@
+# graftlint: path=ray_tpu/cluster/fake_client.py
+"""Compliant: every wait carries a deadline and loops."""
+import threading
+
+
+class Client:
+    def __init__(self):
+        self.reply_event = threading.Event()
+        self.stopped = False
+
+    def call(self, timeout=60.0):
+        import time
+
+        deadline = time.monotonic() + timeout
+        while not self.reply_event.wait(0.5):
+            if time.monotonic() > deadline:
+                raise TimeoutError("peer wedged")

@@ -1,0 +1,17 @@
+"""Offender: counter is lock-guarded in the thread loop, bare in bump()."""
+import threading
+
+
+class Racy:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.counter = 0
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while True:
+            with self.lock:
+                self.counter += 1
+
+    def bump(self):
+        self.counter += 1

@@ -1,0 +1,22 @@
+"""Compliant: every post-init write happens under the lock (or in a
+_locked caller-holds-the-lock helper)."""
+import threading
+
+
+class Tidy:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.counter = 0
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while True:
+            with self.lock:
+                self._bump_locked()
+
+    def _bump_locked(self):
+        self.counter += 1
+
+    def bump(self):
+        with self.lock:
+            self.counter += 1

@@ -1,0 +1,15 @@
+"""Offender: the timed block_until_ready pattern at MODULE scope (a
+bench script, no enclosing function)."""
+import time
+
+import jax
+
+
+def _work():
+    return jax.numpy.zeros(8)
+
+
+t0 = time.monotonic()
+out = _work()
+jax.block_until_ready(out)
+ELAPSED = time.monotonic() - t0

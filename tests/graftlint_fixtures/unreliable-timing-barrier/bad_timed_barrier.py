@@ -1,0 +1,11 @@
+"""Offender: block_until_ready as the completion barrier in timed code."""
+import time
+
+import jax
+
+
+def bench_step(fn, x):
+    t0 = time.monotonic()
+    out = fn(x)
+    jax.block_until_ready(out)
+    return time.monotonic() - t0

@@ -1,0 +1,16 @@
+"""Compliant: timing uses a device_get of a scalar data-dependent on all
+the work (block_until_ready appears only in untimed warmup)."""
+import time
+
+import jax
+
+
+def warmup(fn, x):
+    jax.block_until_ready(fn(x))
+
+
+def bench_step(fn, x):
+    t0 = time.monotonic()
+    out = fn(x)
+    jax.device_get(out.sum())
+    return time.monotonic() - t0

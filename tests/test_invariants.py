@@ -1,137 +1,118 @@
-"""Static architecture-invariant checks (CI/tooling satellite, ISSUE 3).
+"""Architecture invariants, enforced by graftlint (ISSUE 6).
 
-These greps encode invariants from CLAUDE.md that a reviewer can't see
-break in a diff hunk:
+This file used to be a pile of regex greps; it is now a thin runner over
+``ray_tpu.devtools.graftlint`` — one test per rule family, each failing
+with ``path:line RULE message`` findings. The AST rules are alias-aware
+and multi-line-safe where the greps were not, and every rule carries
+positive/negative fixtures under ``tests/graftlint_fixtures/``
+(self-checked in test_graftlint.py).
 
-- ONE receiver thread demuxes each worker pipe — a second ``conn.recv()``
-  call site races the demux and corrupts the reply routing.
-- Differentiating raw attention kernels OOMs real HBM: training attention
-  must go through ``ray_tpu.ops.flash_attention`` (memory-efficient VJP),
-  never ``flash_attention_pallas``/``blockwise_attention`` directly.
+What the families guard (CLAUDE.md "Architecture invariants"):
+
+- ``locks``       unguarded shared-state writes, lock-order inversions,
+                  and blocking calls held under driver/GCS locks — the
+                  static twin of util/contention.py's runtime profiler.
+- ``jax``         memory-safe attention VJPs, honest TPU timing
+                  barriers, JAX_PLATFORMS hygiene, and the 1.9 s/worker
+                  module-scope-jax-import tax.
+- ``layering``    data/train/tune/serve/rllib build ONLY on the public
+                  task/actor/object API (the portability seam).
+- ``invariants``  one-receiver-thread pipes, cloudpickle-first
+                  serialization, metric_defs-only metrics,
+                  deadline-capable cluster waits.
+- ``failpoints``  the chaos-plane site catalog stays unique, literal,
+                  and documented.
+- ``meta``        every inline suppression names a real rule and
+                  carries a reason (no silent baselines).
 """
 
-import re
 from pathlib import Path
+
+import pytest
+
+from ray_tpu.devtools import graftlint
 
 ROOT = Path(__file__).resolve().parents[1]
 
 
-def _code_lines(path: Path):
-    """Source lines with comments stripped (keeps strings; good enough for
-    call-site greps)."""
-    for n, line in enumerate(path.read_text().splitlines(), 1):
-        yield n, line.split("#", 1)[0]
+@pytest.fixture(scope="module")
+def tree_findings():
+    """One full-tree lint shared by every family test AND by
+    test_graftlint.py (the analysis pass dominates the cost; rules are
+    cheap) — see tests/_graftlint_tree.py."""
+    from _graftlint_tree import tree_findings as shared
+
+    findings = shared()
+    by_family = {fam: [] for fam in graftlint.FAMILIES}
+    rule_family = {r.name: r.family for r in graftlint.all_rules()}
+    for f in findings:
+        by_family.setdefault(rule_family.get(f.rule, "meta"), []).append(f)
+    return by_family
 
 
-def test_single_receiver_per_worker_pipe():
-    """CLAUDE.md invariant: one receiver thread per worker demuxes the
-    pipe (replies vs execs) — never add a second ``conn.recv()`` site."""
-    worker = ROOT / "ray_tpu" / "core" / "worker.py"
-    sites = [(n, line) for n, line in _code_lines(worker)
-             if re.search(r"\bconn\.recv\(\)", line)]
-    assert len(sites) == 1, (
-        f"worker.py has {len(sites)} conn.recv() call sites {sites}; the "
-        "one-receiver-thread invariant (CLAUDE.md 'Architecture "
-        "invariants') allows only _recv_loop to read the pipe — route new "
-        "message kinds through it instead of adding a reader")
-
-    runtime = ROOT / "ray_tpu" / "core" / "runtime.py"
-    sites = [(n, line) for n, line in _code_lines(runtime)
-             if re.search(r"\bconn\.recv(_bytes)?\(\)", line)]
-    # allowed: the _accept_loop "hello" handshake (before the reader
-    # exists) and the per-worker _reader_loop itself (recv_bytes + loads,
-    # so the pipe byte counters see the framed size)
-    assert len(sites) <= 2, (
-        f"runtime.py has {len(sites)} conn.recv() call sites {sites}; "
-        "only the _accept_loop handshake and _reader_loop may read a "
-        "worker pipe (CLAUDE.md one-receiver-thread invariant)")
+def _assert_clean(by_family, family, hint):
+    findings = by_family[family]
+    rendered = "\n  ".join(f.render() for f in findings)
+    assert not findings, (
+        f"graftlint family '{family}' found violations:\n  {rendered}\n"
+        f"{hint}")
 
 
-def test_no_raw_attention_kernels_outside_ops():
-    """CLAUDE.md invariant: ALL training attention routes through
-    ``ray_tpu.ops.flash_attention`` (it carries the memory-efficient
-    custom VJP); calling the raw kernels from a differentiated path saves
-    every probability block as a residual (~50 GB at llama-250M scale)."""
-    offenders = []
-    for path in sorted((ROOT / "ray_tpu").rglob("*.py")):
-        rel = path.relative_to(ROOT)
-        if rel.parts[:2] == ("ray_tpu", "ops"):
-            continue  # the kernels' home (impl + dispatch) is exempt
-        for n, line in _code_lines(path):
-            if re.search(r"\b(flash_attention_pallas|blockwise_attention)"
-                         r"\s*\(", line):
-                offenders.append(f"{rel}:{n}: {line.strip()}")
-    assert not offenders, (
-        "direct raw-attention kernel call(s) outside ray_tpu/ops:\n  "
-        + "\n  ".join(offenders)
-        + "\nroute attention through ray_tpu.ops.flash_attention — the "
-        "raw kernels have no memory-efficient VJP and OOM real HBM when "
-        "differentiated (CLAUDE.md 'Architecture invariants')")
+def test_lock_discipline(tree_findings):
+    """Unguarded writes to lock-managed attributes, inverted lock
+    orders, and blocking calls (sleep/recv/rpc-call/wait) under a lock.
+    r8 proved the driver control plane is ~1-2 ms of GIL-serialized CPU
+    per task under ONE lock — blocking it blocks everyone."""
+    _assert_clean(
+        tree_findings, "locks",
+        "take the lock (or use the _locked-suffix caller-holds-lock "
+        "convention); judged-intentional lock-free sites need "
+        "'# graftlint: disable=... -- reason'")
 
 
-def test_core_metrics_only_via_metric_defs():
-    """ISSUE 4 satellite: ``util/metric_defs.py`` is the single source of
-    truth for built-in metrics — core/cluster modules must not create
-    ad-hoc ``Counter(``/``Gauge(``/``Histogram(`` instances (they'd skip
-    the help/prefix/uniqueness invariants and the generated README
-    table). User-facing metric creation stays in util/metrics.py."""
-    offenders = []
-    for sub in ("core", "cluster"):
-        for path in sorted((ROOT / "ray_tpu" / sub).rglob("*.py")):
-            rel = path.relative_to(ROOT)
-            for n, line in _code_lines(path):
-                if re.search(r"\b(Counter|Gauge|Histogram)\s*\(", line):
-                    offenders.append(f"{rel}:{n}: {line.strip()}")
-    assert not offenders, (
-        "ad-hoc metric construction in core/cluster modules:\n  "
-        + "\n  ".join(offenders)
-        + "\ndefine the metric in ray_tpu/util/metric_defs.py and fetch "
-        "it with metric_defs.get(name) instead")
+def test_jax_tpu_discipline(tree_findings):
+    """Raw attention kernels outside ops/ (no memory-efficient VJP —
+    ~50 GB of residuals at llama-250M scale), block_until_ready as a
+    timing barrier (acks early through the axon tunnel), JAX_PLATFORMS
+    leaking into worker envs (chip fights), and module-scope jax imports
+    in zygote-imported core/cluster modules (~1.9 s per worker boot)."""
+    _assert_clean(
+        tree_findings, "jax",
+        "route attention through ray_tpu.ops.flash_attention; time with "
+        "a data-dependent device_get; set explicit per-worker platforms")
 
 
-def test_serialization_stays_cloudpickle_first():
-    """CLAUDE.md invariant: ``serialization.serialize`` must try
-    cloudpickle FIRST (plain pickle serializes ``__main__`` functions by
-    reference and breaks workers)."""
-    src = (ROOT / "ray_tpu" / "core" / "serialization.py").read_text()
-    cp = src.find("cloudpickle.dumps")
-    assert cp != -1, "serialization.py no longer uses cloudpickle.dumps?"
+def test_layering_seam(tree_findings):
+    """ML libraries import only the public task/actor/object API, util/,
+    and each other — the seam that keeps them portable (CLAUDE.md)."""
+    _assert_clean(
+        tree_findings, "layering",
+        "use the ray_tpu top-level API or add a public accessor to "
+        "ray_tpu.util (e.g. util.state.actor_queue_depths)")
 
 
-def test_cluster_plane_blocking_waits_have_deadlines():
-    """Chaos-plane invariant (ISSUE 5): a wedged peer must surface a
-    timeout, never park a thread forever. In ``cluster/`` that means
+def test_ported_invariants(tree_findings):
+    """AST ports of the old regex greps: single pipe receiver thread,
+    cloudpickle-first serialize, metric_defs-only metric creation in
+    core/cluster, deadline-capable cluster-plane waits."""
+    _assert_clean(
+        tree_findings, "invariants",
+        "see the rule messages — each names the CLAUDE.md invariant and "
+        "the compliant pattern")
 
-    - blocking pipe reads (``.recv()``) live ONLY in rpc.py's dedicated
-      reader machinery (``_recv_framed`` + the polled handshake) — every
-      caller waits on an Event with a deadline instead;
-    - no bare ``<event>.wait()`` without a timeout argument.
-    """
-    cluster = ROOT / "ray_tpu" / "cluster"
-    recv_sites = {}
-    for path in sorted(cluster.rglob("*.py")):
-        for n, line in _code_lines(path):
-            if re.search(r"\.recv\(\)", line):
-                recv_sites.setdefault(path.name, []).append(n)
-    assert set(recv_sites) <= {"rpc.py"}, (
-        f"blocking .recv() outside rpc.py: {recv_sites}; cluster-plane "
-        "reads go through rpc.py's reader thread + deadline-capable "
-        "call() (RTPU_RPC_DEFAULT_TIMEOUT_S), never a raw recv loop")
-    assert len(recv_sites.get("rpc.py", [])) <= 2, (
-        f"rpc.py grew new blocking .recv() sites: {recv_sites['rpc.py']}; "
-        "only _recv_framed and the polled _client_handshake may block on "
-        "a socket read")
 
-    bare_waits = []
-    for path in sorted(cluster.rglob("*.py")):
-        for n, line in _code_lines(path):
-            # subprocess reaps after an explicit kill (cluster_utils
-            # shutdown paths) are not peer waits; events/conditions are
-            if re.search(r"\b(ev|event|_stop|cv|cond)\w*\.wait\(\s*\)",
-                         line):
-                bare_waits.append(f"{path.name}:{n}: {line.strip()}")
-    assert not bare_waits, (
-        "un-deadlined event waits in cluster/:\n  "
-        + "\n  ".join(bare_waits)
-        + "\npass a timeout (and loop) so a wedged peer cannot park the "
-        "thread forever")
+def test_failpoint_site_catalog(tree_findings):
+    """Every failpoints.hit() site: unique literal name, documented in
+    util/failpoints.py's Sites list; no stale documented sites."""
+    _assert_clean(
+        tree_findings, "failpoints",
+        "add new sites to the Sites block of util/failpoints.py; "
+        "suffix names when instrumenting a second call site")
+
+
+def test_suppression_hygiene(tree_findings):
+    """Inline disables must name real rules and carry reasons — the
+    no-silent-baseline rule that keeps the other families honest."""
+    _assert_clean(
+        tree_findings, "meta",
+        "write '# graftlint: disable=<rule> -- <why this is safe>'")
