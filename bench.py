@@ -856,6 +856,32 @@ def _core_microbench() -> dict:
 
         out["tasks_per_s"] = best_of(3, tasks_trial)
 
+        # tracing on/off A/B on the SAME warm process tree (ISSUE 7
+        # bench guard): the off number re-measures right before the on
+        # number so a disabled-path cost regression (span() must stay
+        # one dict get) or an enabled-path span-cost blowup both surface
+        # in the JSON line. enable_tracing reaches the live workers over
+        # their control pipes — no respawn between the two sides.
+        try:
+            from ray_tpu.util import tracing as _tracing
+
+            t_off = best_of(3, tasks_trial)
+            try:
+                _tracing.enable_tracing()
+                t_on = best_of(3, tasks_trial)
+            finally:
+                # a failed on-trial must not leave tracing armed for the
+                # rest of the microbench (it would corrupt every later
+                # number this guard exists to protect)
+                _tracing.disable_tracing()
+            out["tracing_overhead"] = {
+                "tasks_per_s_off": t_off,
+                "tasks_per_s_on": t_on,
+                "on_off_ratio": round(t_on / t_off, 3) if t_off else None,
+            }
+        except Exception as e:
+            out["tracing_overhead"] = {"error": str(e)}
+
         @ray_tpu.remote
         class A:
             def f(self):

@@ -187,8 +187,31 @@ def main():
     phases = summarize_tasks().get("noop", {}).get("phases", {})
     out["noop_phases_ms"] = {k: {"p50": v["p50_ms"], "p99": v["p99_ms"]}
                              for k, v in phases.items()}
+
+    # -- D: trace-plane critical path (ISSUE 7 acceptance): rerun the
+    # multi-client shape with tracing armed and let the per-task segment
+    # breakdown say where the wall time goes — the r8 root cause
+    # (GIL-serialized driver control-plane CPU) should print as the
+    # dominant driver_submit/transit share, from trace data alone.
+    from ray_tpu.util import tracing
+    from ray_tpu.util.state import summarize_critical_path
+    from ray_tpu.util.trace_store import format_breakdown
+
+    tracing.enable_tracing()
+    tclients = [BatchClient.options(num_cpus=0).remote()
+                for _ in range(2)]
+    ray_tpu.get([c.small_value_batch.remote(10) for c in tclients])
+    ray_tpu.get([c.small_value_batch.remote(250) for c in tclients])
+    time.sleep(2.0)  # let the worker span pushes drain
+    cp = summarize_critical_path()
+    out["critical_path"] = cp
+    tracing.disable_tracing()
+    for c in tclients:
+        ray_tpu.kill(c)
+
     out["loadavg_end"] = os.getloadavg()
     ray_tpu.shutdown()
+    print(format_breakdown(cp), file=__import__("sys").stderr)
     print(json.dumps(out, indent=1))
 
 

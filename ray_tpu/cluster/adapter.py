@@ -133,6 +133,7 @@ class ClusterAdapter:
         self._pull_io = ThreadPoolExecutor(max_workers=PULL_CONCURRENCY,
                                            thread_name_prefix="cluster-pull")
         self._task_ev_cursor = 0  # next local task event to ship to GCS
+        self._trace_ev_cursor = 0  # next TraceStore span to ship to GCS
         # (size, locations) cache for dependency-locality scoring: fan-outs
         # of one big ref to N tasks pay one directory lookup, not N.
         # _obj_info_down_until: circuit breaker — while the GCS is not
@@ -259,6 +260,19 @@ class ClusterAdapter:
                     if self.gcs.call("task_events", self.node_id, batch,
                                      cur, timeout=5):
                         self._task_ev_cursor = cur + len(batch)
+                # trace plane rides the same beats: this node's span ring
+                # (driver/daemon process) + its workers' pushed batches,
+                # shipped as acked deltas from the TraceStore cursor
+                self.rt.collect_trace_spans()
+                tb, tstart = self.rt.trace_store.since(
+                    self._trace_ev_cursor)
+                if tb:
+                    if self.gcs.call("trace_events", self.node_id, tb,
+                                     tstart, timeout=5):
+                        self._trace_ev_cursor = tstart + len(tb)
+                        from ray_tpu.util import tracing as _tracing
+
+                        _tracing.note_push()
             except Exception:
                 pass
 
@@ -287,6 +301,7 @@ class ClusterAdapter:
         self.gcs.call("subscribe", "objects", timeout=10)
         self.gcs.call("subscribe", "pgs", timeout=10)
         self.gcs.call("subscribe", "failpoints", timeout=10)
+        self.gcs.call("subscribe", "tracing", timeout=10)
         self.gcs.call("node_register", self.node_id, self.server.addr,
                       self.rt.resources("total"), self.is_scheduler,
                       dict(getattr(self.rt, "labels", {})), timeout=10)
@@ -301,6 +316,13 @@ class ClusterAdapter:
 
         failpoints.sync_from_kv(
             lambda k, ns: self.gcs.call("kv_get", k, ns, timeout=10))
+        # trace plane, late-joiner path: daemons booted or re-registered
+        # after enable_tracing() pull the arming payload from the KV
+        from ray_tpu.util import tracing
+
+        tracing.sync_from_kv(
+            lambda k, ns: self.gcs.call("kv_get", k, ns, timeout=10))
+        self._trace_ev_cursor = 0
         # GCS restart recovery (chaos: kill -9 mid-submit): the object
         # directory is NOT durable and obj_ready is a cast, so anything
         # that turned terminal during the outage is unknown to the rebuilt
@@ -549,6 +571,20 @@ class ClusterAdapter:
             self._io.submit(self._on_pg_event, payload)
         elif channel == "failpoints":
             self._io.submit(self._on_failpoints, payload)
+        elif channel == "tracing":
+            self._io.submit(self._on_tracing, payload)
+
+    def _on_tracing(self, payload: dict) -> None:
+        """Cluster-wide tracing arm/disarm: apply in this process and
+        relay to this runtime's workers over their control pipes (the
+        enable_tracing() mid-session path for remote nodes)."""
+        from ray_tpu.util import tracing
+
+        try:
+            tracing.apply_remote(payload)
+            tracing.broadcast_local(self.rt, payload)
+        except Exception:
+            pass
 
     def _on_failpoints(self, payload: dict) -> None:
         """Cluster-wide chaos arming: apply in this process and relay to

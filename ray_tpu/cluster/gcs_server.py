@@ -112,6 +112,12 @@ class GcsService:
         # per-node high-water mark of received task-event sequence numbers
         # (dedup for cursor rewinds after node re-registration)
         self._task_ev_seq: Dict[bytes, int] = {}
+        # trace plane: collected spans shipped on node heartbeats (same
+        # cursor+dedup contract as task_events); head /api/traces and
+        # state.list_spans pull via rpc_trace_events_get
+        self.trace_events = deque(
+            maxlen=int(config.get("gcs_max_trace_events")))
+        self._trace_ev_seq: Dict[bytes, int] = {}
         # metrics federation: latest [(origin_labels, records)] payload per
         # node, replaced wholesale on each carrying heartbeat (idempotent;
         # reference metrics-agent -> head pipeline role). Head /metrics
@@ -349,6 +355,7 @@ class GcsService:
                          "functions": len(self.functions),
                          "pgs": len(self.pgs),
                          "task_events": len(self.task_events),
+                         "trace_events": len(self.trace_events),
                          "free_candidates": len(self._free_candidates),
                          "tombstones": len(self._freed_tombstones)}
                 alive = sum(1 for e in self.nodes.values() if e.alive)
@@ -540,6 +547,31 @@ class GcsService:
             return []
         with self.lock:
             evs = list(self.task_events)
+        return evs[-limit:]
+
+    def rpc_trace_events(self, ctx, node_id: bytes, events, start_seq=None):
+        """Batched spans from a node's TraceStore (trace-plane twin of
+        rpc_task_events — same cursor semantics: ``start_seq`` is the
+        sender's absolute index of events[0], re-registration rewinds are
+        deduped against the per-node high-water mark)."""
+        with self.lock:
+            if start_seq is not None:
+                seen = self._trace_ev_seq.get(node_id, 0)
+                skip = max(0, seen - start_seq)
+                if skip >= len(events):
+                    return True
+                events = events[skip:]
+                start_seq += skip
+                self._trace_ev_seq[node_id] = start_seq + len(events)
+            self.trace_events.extend(events)
+        return True
+
+    def rpc_trace_events_get(self, ctx, limit: int = 10000):
+        limit = int(limit)
+        if limit <= 0:
+            return []
+        with self.lock:
+            evs = list(self.trace_events)
         return evs[-limit:]
 
     def rpc_metrics_get(self, ctx, exclude_node: Optional[bytes] = None):

@@ -173,6 +173,20 @@ _knob("task_ring", int, 2048,
       "recent task lifecycle records kept in the driver's flight-recorder "
       "ring (feeds state.summarize_tasks per-phase percentiles)",
       "core/runtime.py")
+_knob("trace_ring", int, 8192,
+      "per-process span ring capacity (trace plane recording side); "
+      "overflow before collection drops the oldest span and counts "
+      "rtpu_trace_spans_dropped_total", "util/tracing.py")
+_knob("trace_push_interval_s", float, 1.0,
+      "min seconds between a worker's batched span pushes over the "
+      "control pipe (the trace twin of metrics_push_interval_s)",
+      "core/worker.py")
+_knob("trace_store_max", int, 65536,
+      "spans retained by a runtime's TraceStore (head query surface; "
+      "daemons buffer here between heartbeats)", "util/trace_store.py")
+_knob("gcs_max_trace_events", int, 65536,
+      "cluster-wide span buffer size in the GCS (trace twin of "
+      "gcs_max_task_events)", "cluster/gcs_server.py")
 
 # -- serve ------------------------------------------------------------------
 _knob("serve_max_body", int, 64 << 20,

@@ -423,6 +423,15 @@ class DriverRuntime:
         # the per-task observe cost stays a few microseconds.
         self.task_ring: deque = deque(maxlen=int(config.get("task_ring")))
         self._flight_enabled = bool(config.get("flight_recorder"))
+        # trace plane (receiver side): workers' span batches and this
+        # process's own ring land here; on a node daemon the heartbeat
+        # ships deltas to the GCS, on the head state.list_spans() reads it
+        from ray_tpu.util.trace_store import TraceStore
+
+        self.trace_store = TraceStore()
+        # arming payload for workers spawned after enable_tracing()
+        # (delivered on dial-back, like _fp_specs)
+        self._trace_push = None
         self._phase_hist = None
         self._phase_keys: Dict[str, tuple] = {}
         self._status_keys = {False: (("status", "ok"),),
@@ -973,6 +982,14 @@ class DriverRuntime:
                     ws.send(("fp", specs))
                 except (OSError, BrokenPipeError):
                     pass
+            # trace plane: workers spawned after enable_tracing() must be
+            # armed before their first dispatch, like failpoints above
+            tpush = getattr(self, "_trace_push", None)
+            if tpush is not None:
+                try:
+                    ws.send(("trace", tpush))
+                except (OSError, BrokenPipeError):
+                    pass
             with self.lock:
                 was_starting = ws.status == "starting"
                 if was_starting:
@@ -1264,6 +1281,17 @@ class DriverRuntime:
                 {"worker_id": wid, "node_id": self.node_id.hex()[:8],
                  "component": "worker"},
                 args[0])
+        elif op == "spans":
+            # trace plane: batched span push from the worker — pure deque
+            # appends into the bounded TraceStore, safe on this thread
+            try:
+                self.trace_store.ingest(
+                    args[0],
+                    {"worker_id": ws.worker_id.hex()[:8],
+                     "node_id": self.node_id.hex()[:8],
+                     "component": "worker"})
+            except Exception:
+                pass
         elif op == "free":
             # full free path (directory + store + CLUSTER publication):
             # a worker-initiated free must reach holder nodes too, or the
@@ -1889,7 +1917,6 @@ class DriverRuntime:
             self.cluster.publish_fn(h, blob)
 
     def submit_spec(self, spec: dict) -> List[ObjectRef]:
-        tid = TaskID(spec["task_id"])
         # flight-recorder stamp (setdefault: retries/reconstruction and
         # forwarded specs keep the ORIGINAL submit time)
         spec.setdefault("lc_submit", time.time())
@@ -1897,7 +1924,35 @@ class DriverRuntime:
             self._m_submitted._inc_key(self._type_keys[spec["type"]])
         except Exception:
             pass
-        self._trace_submit(spec)
+        return self._traced_submit(spec, self._submit_spec_inner)
+
+    def _traced_submit(self, spec: dict, inner) -> List[ObjectRef]:
+        """Trace the DRIVER-SIDE submit work itself (reference
+        tracing_helper role; near-zero cost when disabled): the span
+        brackets dependency resolution + pinning + enqueue — the
+        GIL-serialized control-plane CPU the multi-client inversion
+        pays — so summarize_critical_path can print it per task. A spec
+        that already carries trace_ctx was stamped by the submitting
+        worker; the driver-side handling becomes a CHILD span."""
+        from ray_tpu.util import tracing
+
+        if not tracing.tracing_enabled():
+            return inner(spec)
+        name = spec.get("name") or spec.get("method") or "task"
+        parent = spec.get("trace_ctx")
+        attrs = {"task_id": spec["task_id"].hex()}
+        if parent:
+            cm = tracing.span(f"driver.submit::{name}", attrs,
+                              parent=parent)
+        else:
+            cm = tracing.span(f"submit::{name}", attrs)
+        with cm as tp:
+            if tp is not None:
+                spec["trace_ctx"] = tp
+            return inner(spec)
+
+    def _submit_spec_inner(self, spec: dict) -> List[ObjectRef]:
+        tid = TaskID(spec["task_id"])
         deps = ts.arg_refs(spec["args"], spec["kwargs"])
         self._pin_args(spec)
         if self.cluster is not None and self.cluster.maybe_forward_task(spec):
@@ -1935,6 +1990,10 @@ class DriverRuntime:
             self._m_submitted._inc_key(self._type_keys[spec["type"]])
         except Exception:
             pass
+        # same driver-side submit span as submit_spec (actor-call path)
+        return self._traced_submit(spec, self._submit_actor_inner)
+
+    def _submit_actor_inner(self, spec: dict) -> List[ObjectRef]:
         self._pin_args(spec)
         if (self.cluster is not None
                 and self.gcs.get_actor(ActorID(spec["actor_id"])) is None
@@ -2241,20 +2300,7 @@ class DriverRuntime:
     def create_actor(self, spec: dict):
         self.submit_spec(spec)
 
-    def _trace_submit(self, spec: dict) -> None:
-        """Record a submit span + propagate W3C context in the spec
-        (reference tracing_helper role); near-zero cost when disabled."""
-        from ray_tpu.util import tracing
-
-        if not tracing.tracing_enabled() or spec.get("trace_ctx"):
-            return  # worker-side submit already stamped + spanned it
-        name = spec.get("name") or spec.get("method") or "task"
-        with tracing.span(f"submit::{name}",
-                          {"task_id": spec["task_id"].hex()}) as tp:
-            spec["trace_ctx"] = tp
-
     def submit_actor_task(self, spec: dict) -> List[ObjectRef]:
-        self._trace_submit(spec)
         return self._submit_actor_spec(spec)
 
     def ensure_fn(self, h: str, blob: bytes):
@@ -2416,6 +2462,22 @@ class DriverRuntime:
 
     def timeline(self):
         return list(self.timeline_events)
+
+    def collect_trace_spans(self) -> None:
+        """Drain this PROCESS's span ring into the runtime's TraceStore
+        with origin labels — called at query time (state.list_spans) and
+        before each heartbeat ships trace deltas, so driver/daemon spans
+        join their workers' pushed batches."""
+        from ray_tpu.util import tracing
+
+        batch = tracing.drain_ring()
+        if not batch:
+            return
+        comp = "driver"
+        if self.cluster is not None and not self.cluster.is_scheduler:
+            comp = "raylet"
+        self.trace_store.ingest(
+            batch, {"node_id": self.node_id.hex()[:8], "component": comp})
 
     def shutdown(self):
         from ray_tpu.core import object_ref as _object_ref

@@ -110,6 +110,11 @@ class WorkerRuntime:
         self._metrics_last_push = 0.0
         self._metrics_interval: Optional[float] = None
         self._wmetrics = None
+        # trace plane (sender side): finished spans accumulate in this
+        # process's bounded ring and ride the pipe as batched casts,
+        # rate-limited like the metric delta push
+        self._trace_last_push = 0.0
+        self._trace_interval: Optional[float] = None
         try:
             from ray_tpu import config as _cfg
 
@@ -233,6 +238,19 @@ class WorkerRuntime:
                         failpoints.apply_spec(msg[1])
                     except ValueError:
                         pass
+            elif kind == "trace":
+                # trace plane: driver-pushed mid-session arm/disarm —
+                # workers spawned before enable_tracing() learn here
+                from ray_tpu.util import tracing
+
+                if msg[1] is not None:
+                    tracing.apply_remote(msg[1])
+                    if not msg[1].get("enabled"):
+                        # disarm: ship the ring's tail NOW — the push
+                        # loop stops looking once tracing is off, and
+                        # the last interval's spans (the end of the
+                        # traced workload) must not strand here
+                        self._push_spans_now()
             elif kind == "shutdown":
                 os._exit(0)
 
@@ -957,6 +975,42 @@ class WorkerRuntime:
         except Exception:
             pass
 
+    def _maybe_push_spans(self) -> None:
+        """Drain this process's span ring to the driver as a batched cast
+        (the trace-plane hop for worker processes; driver ingests into its
+        TraceStore with this worker's origin labels). One dict get when
+        tracing is disabled; rate-limited otherwise."""
+        from ray_tpu.util import tracing
+
+        if not tracing.tracing_enabled():
+            return
+        now = time.monotonic()
+        if self._trace_interval is None:
+            try:
+                from ray_tpu import config as _cfg
+
+                self._trace_interval = float(
+                    _cfg.get("trace_push_interval_s"))
+            except Exception:
+                self._trace_interval = 1.0
+        if now - self._trace_last_push < self._trace_interval:
+            return
+        self._trace_last_push = now
+        self._push_spans_now()
+
+    def _push_spans_now(self) -> None:
+        """Drain the ring and ship it as one cast — THE span-push hop,
+        shared by the rate-limited loop and the disarm-time tail flush."""
+        from ray_tpu.util import tracing
+
+        try:
+            batch = tracing.drain_ring()
+            if batch:
+                self.cast("spans", batch)
+                tracing.note_push()
+        except Exception:
+            pass
+
     def main_loop(self):
         self._start_receiver()
         self._send(("ready",))
@@ -969,9 +1023,11 @@ class WorkerRuntime:
                 # idle: bounded staleness for __del__-deferred ref drops
                 self._drain_ref_drops()
                 self._maybe_push_metrics()
+                self._maybe_push_spans()
                 continue
             self._drain_ref_drops()
             self._maybe_push_metrics()
+            self._maybe_push_spans()
             conc = (self.actor_concurrency.get(spec.get("actor_id", b""), 1)
                     if spec["type"] == ts.ACTOR_METHOD else 1)
             if (spec["type"] == ts.ACTOR_METHOD

@@ -41,11 +41,29 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
     def do_GET(self):  # noqa: N802 — stdlib API
+        from urllib.parse import parse_qs
+
         from ray_tpu.util import state as st
 
         from ray_tpu.serve import config_api as serve_rest
 
+        path, _, query = self.path.partition("?")
+        params = parse_qs(query)
+
+        def _p(name, default=None):
+            vals = params.get(name)
+            return vals[0] if vals else default
+
         routes = {
+            # trace plane (reference tracing/timeline pipeline role)
+            "/api/traces": lambda: st.list_spans(
+                limit=int(_p("limit", 10000))),
+            "/api/critical_path": lambda: st.summarize_critical_path(
+                trace_id=_p("trace_id")),
+            # unified Perfetto/Chrome-trace export (spans + task phases
+            # + lock waits + train steps): save the JSON body and load it
+            # in ui.perfetto.dev
+            "/api/perfetto": st.export_perfetto,
             "/api/nodes": st.list_nodes,
             "/api/actors": st.list_actors,
             "/api/tasks": st.list_tasks,
@@ -67,7 +85,7 @@ class _Handler(BaseHTTPRequestHandler):
             "/api/timeline": _timeline_events,
         }
         try:
-            if self.path == "/metrics":
+            if path == "/metrics":
                 body = _metrics_text().encode()
                 self.send_response(200)
                 self.send_header("Content-Type",
@@ -76,7 +94,7 @@ class _Handler(BaseHTTPRequestHandler):
                 self.end_headers()
                 self.wfile.write(body)
                 return
-            if self.path == "/":
+            if path == "/":
                 from ray_tpu.dashboard_ui import INDEX_HTML
 
                 body = INDEX_HTML.encode()
@@ -86,11 +104,11 @@ class _Handler(BaseHTTPRequestHandler):
                 self.end_headers()
                 self.wfile.write(body)
                 return
-            if self.path == "/api":
+            if path == "/api":
                 payload = {"endpoints": sorted(routes) + ["/metrics"]}
-            elif self.path in routes:
-                payload = routes[self.path]()
-            elif (m := _JOB_ID_RE.match(self.path)) and \
+            elif path in routes:
+                payload = routes[path]()
+            elif (m := _JOB_ID_RE.match(path)) and \
                     m.group(2) in (None, "/logs"):
                 job_id = m.group(1)
                 try:

@@ -82,13 +82,19 @@ def _exchange_metrics():
 def _exchange_partition(block: Block, n_red: int, kind: str, args: dict,
                         part_idx: int) -> List[Block]:
     """Split one input block into ``n_red`` per-partition blocks."""
-    if kind.startswith("groupby"):
-        from ray_tpu.data.grouped import _partition_by_key
+    from ray_tpu.util import tracing
 
-        return _partition_by_key(block, args["key"], n_red)
-    from ray_tpu.data.execution import _shuffle_partition
+    # map-stage span: with tracing armed, each exchange stage shows up on
+    # the unified timeline as map -> (forwarded actor calls) -> reduce
+    with tracing.span("data.exchange::map",
+                      {"kind": kind, "part": part_idx}):
+        if kind.startswith("groupby"):
+            from ray_tpu.data.grouped import _partition_by_key
 
-    return _shuffle_partition(block, n_red, kind, args, part_idx)
+            return _partition_by_key(block, args["key"], n_red)
+        from ray_tpu.data.execution import _shuffle_partition
+
+        return _shuffle_partition(block, n_red, kind, args, part_idx)
 
 
 # ---------------------------------------------------------------------------
@@ -234,6 +240,14 @@ class _ExchangeReducer:
                   block: Block) -> Tuple[int, int]:
         """Consume one partition block; returns (rows, bytes) as the ack
         the scheduler's backpressure window waits on."""
+        from ray_tpu.util import tracing
+
+        with tracing.span("data.exchange::reduce",
+                          {"kind": self._kind, "part": part}):
+            return self._add_block_inner(part, order_key, block)
+
+    def _add_block_inner(self, part: int, order_key: int,
+                         block: Block) -> Tuple[int, int]:
         st = self._state(part)
         rows = block_num_rows(block)
         nbytes = block_size_bytes(block)

@@ -58,11 +58,41 @@ def _cmd_job_list(args) -> int:
 
 
 def _cmd_timeline(args) -> int:
+    """Chrome-trace export. ``--perfetto`` writes the UNIFIED timeline
+    (cluster-federated spans + flight-recorder task phases + lock-wait
+    slices + train-step telemetry, one process row per node, one thread
+    track per worker) — load it in ui.perfetto.dev. ``--url`` fetches the
+    same document from a running head's ``/api/perfetto`` endpoint, so no
+    in-process session is needed."""
     import ray_tpu
 
+    perfetto = getattr(args, "perfetto", None)
+    if perfetto:
+        out = perfetto
+        url = getattr(args, "url", None)
+        if url:
+            import urllib.request
+
+            with urllib.request.urlopen(
+                    url.rstrip("/") + "/api/perfetto", timeout=60) as resp:
+                doc = json.loads(resp.read()).get("result", {})
+            with open(out, "w") as f:
+                json.dump(doc, f)
+        else:
+            if not ray_tpu.is_initialized():
+                print("no active session; pass --url http://<head>:8265 "
+                      "to export from a running head's dashboard")
+                return 1
+            from ray_tpu.util.state import export_perfetto
+
+            doc = export_perfetto(out)
+        n = len(doc.get("traceEvents", []))
+        print(f"wrote {out} ({n} events) — open in ui.perfetto.dev")
+        return 0
     if not ray_tpu.is_initialized():
         print("no active session in this process; timeline must be "
-              "exported by the driver (ray_tpu.timeline(filename=...))")
+              "exported by the driver (ray_tpu.timeline(filename=...)) — "
+              "or use --perfetto --url against a running head")
         return 1
     out = args.output or "timeline.json"
     ray_tpu.timeline(filename=out)
@@ -266,6 +296,14 @@ def main(argv=None) -> int:
 
     tl = sub.add_parser("timeline", help="export chrome trace")
     tl.add_argument("--output", "-o", default=None)
+    tl.add_argument("--perfetto", metavar="OUT.json", default=None,
+                    help="write the unified cluster timeline (spans + "
+                         "task phases + lock waits + train steps) for "
+                         "ui.perfetto.dev")
+    tl.add_argument("--url", default=None,
+                    help="with --perfetto: fetch from a running head's "
+                         "dashboard (http://host:8265) instead of an "
+                         "in-process session")
 
     mem = sub.add_parser("memory", help="object-store refcount dump "
                                         "(reference `ray memory` role)")

@@ -46,7 +46,7 @@ class DeploymentResponse:
         import time
 
         import ray_tpu
-        from ray_tpu.core.exceptions import ActorDiedError
+        from ray_tpu.core.exceptions import ActorDiedError, GetTimeoutError
 
         if not self._done:
             # ONE deadline across every retry: a re-route must not restart
@@ -73,6 +73,23 @@ class DeploymentResponse:
                         self._retry = None
                         self._on_done = None
                         raise
+                except GetTimeoutError:
+                    # NOT terminal: the replica is still executing this
+                    # request — keep the routing slot held and the span
+                    # open (a later result() call may still complete it)
+                    raise
+                except Exception:
+                    # terminal failure (replica raised): the request is
+                    # over — release its routing slot and finish its
+                    # request span exactly once, then surface.
+                    # (Exception, NOT BaseException: a KeyboardInterrupt
+                    # in the waiting caller does not end the request —
+                    # the replica is still executing it.)
+                    cb = self._on_done
+                    self._on_done = None
+                    if cb:
+                        cb()
+                    raise
             self._done = True
             if self._on_done:
                 self._on_done()
@@ -260,15 +277,40 @@ class DeploymentHandle:
 
     def remote(self, *args, **kwargs):
         from ray_tpu import config as _cfg
+        from ray_tpu.util import tracing
 
+        # Trace chain (ISSUE 7): a manual request span covers the FULL
+        # request lifetime (result()/stream drain happen on other threads,
+        # where the thread-local span() context cannot be held open); the
+        # route span below brackets replica selection + dispatch, so the
+        # actor-call submit/execute spans nest under it and
+        # summarize_critical_path(trace_id) reconciles route -> queue ->
+        # execute -> stream against the measured latency.
+        req_span = tracing.manual_span(
+            "serve.handle::request", {"deployment": self.deployment_name})
         state = {}
-        state["idx"], state["replica"], ref = self._issue(args, kwargs)
+        if req_span is None:
+            state["idx"], state["replica"], ref = self._issue(args, kwargs)
+        else:
+            try:
+                with tracing.span("serve.handle::route",
+                                  {"deployment": self.deployment_name},
+                                  parent=req_span.traceparent):
+                    state["idx"], state["replica"], ref = self._issue(
+                        args, kwargs)
+            except BaseException as e:
+                # a failed dispatch still records its request span (the
+                # route span's parent must exist in the trace)
+                req_span.finish(error=repr(e))
+                raise
         retries = [int(_cfg.get("serve_request_retries"))]
 
         def _done():
             i = state["idx"]
             self._delta[i] = self._delta.get(i, 0) - 1
             self._report_metrics()
+            if req_span is not None:
+                req_span.finish({"replica_idx": state["idx"]})
 
         def _retry():
             # called when the routed-to replica died before replying:

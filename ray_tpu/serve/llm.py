@@ -257,11 +257,29 @@ class LLMDeployment:
 
     def __call__(self, prompt_tokens, max_new_tokens: int = 16,
                  eos: Optional[int] = None):
+        from ray_tpu.util import tracing
+
         q: "queue.Queue[Any]" = queue.Queue()
-        req = self.engine.submit(prompt_tokens, max_new_tokens,
-                                 q.put_nowait, eos=eos)
-        self._wake.set()
+        # manual spans (not span()): this is a generator — a thread-local
+        # span context held across a yield would leak onto whatever the
+        # worker thread runs next (graftlint tracing-context-capture).
+        # queue = admission wait to the FIRST token (slot contention +
+        # prefill); stream = the whole token stream — the per-request
+        # latency decomposition SLO admission control needs (ISSUE 7).
+        stream_span = tracing.manual_span(
+            "serve.llm::stream", {"prompt_tokens": len(prompt_tokens),
+                                  "max_new_tokens": max_new_tokens})
+        queue_span = tracing.manual_span(
+            "serve.llm::queue", {},
+            parent=stream_span.traceparent if stream_span else None)
+        req = None
+        produced = 0
         try:
+            # submit INSIDE the try: a dead engine must still finish the
+            # admission span (it is the SLO signal for failed admission)
+            req = self.engine.submit(prompt_tokens, max_new_tokens,
+                                     q.put_nowait, eos=eos)
+            self._wake.set()
             while True:
                 try:
                     tok = q.get(timeout=120.0)
@@ -270,15 +288,27 @@ class LLMDeployment:
                         "llm decode loop produced no token for 120s"
                         + (f" (loop error: {self._error!r})"
                            if self._error else ""))
+                if queue_span is not None:
+                    queue_span.finish()
+                    queue_span = None
                 if tok is None:
                     return
                 if isinstance(tok, BaseException):
                     raise RuntimeError(f"llm decode loop failed: {tok!r}")
+                produced += 1
                 yield tok
         finally:
             # client stopped consuming (disconnect / GC'd generator):
             # free the slot instead of generating into an orphan queue
-            self.engine.cancel(req)
+            if req is not None:
+                self.engine.cancel(req)
+            if queue_span is not None:
+                # failed/abandoned BEFORE the first token: the admission
+                # wait still gets recorded (it is the SLO signal), marked
+                # as never having produced
+                queue_span.finish(error="no token produced")
+            if stream_span is not None:
+                stream_span.finish({"tokens": produced})
 
     def stats(self) -> Dict[str, Any]:
         return dict(self.engine.stats)

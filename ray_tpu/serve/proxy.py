@@ -172,12 +172,29 @@ class HTTPProxy:
             except json.JSONDecodeError:
                 arg = body.decode()
         loop = asyncio.get_event_loop()
+        # manual span, not span(): the await hands this coroutine's frame
+        # back to the loop, so a thread-local span context must not stay
+        # open across it (graftlint tracing-context-capture)
+        from ray_tpu.util import tracing
+
+        ms = tracing.manual_span("serve.proxy::request", {"route": name})
         try:
-            resp = handle.remote(arg) if arg is not None else handle.remote()
+            # tracing.context: the handle's request span must parent
+            # under the proxy span (one reconciled trace per HTTP
+            # request), and handle.remote reads the thread-local ctx
+            with tracing.context(ms.traceparent if ms else None):
+                resp = (handle.remote(arg) if arg is not None
+                        else handle.remote())
             result = await loop.run_in_executor(None, resp.result)
             return "200 OK", {"result": result}
         except Exception as e:  # noqa: BLE001
+            if ms is not None:
+                ms.finish(error=repr(e))
+                ms = None
             return "500 Internal Server Error", {"error": str(e)}
+        finally:
+            if ms is not None:
+                ms.finish()
 
     async def _route_streaming(self, method: str, path: str, body: bytes,
                                writer: asyncio.StreamWriter):
@@ -202,25 +219,37 @@ class HTTPProxy:
             b"HTTP/1.1 200 OK\r\nContent-Type: application/x-ndjson\r\n"
             b"Transfer-Encoding: chunked\r\nConnection: close\r\n\r\n")
         loop = asyncio.get_event_loop()
-        gen = (handle.options(stream=True).remote(arg) if arg is not None
-               else handle.options(stream=True).remote())
-        it = iter(gen)
+        from ray_tpu.util import tracing
 
-        def _next():
-            try:
-                return True, next(it)
-            except StopIteration:
-                return False, None
+        ms = tracing.manual_span("serve.proxy::stream", {"route": name})
+        items = 0
+        try:
+            with tracing.context(ms.traceparent if ms else None):
+                gen = (handle.options(stream=True).remote(arg)
+                       if arg is not None
+                       else handle.options(stream=True).remote())
+            it = iter(gen)
 
-        while True:
-            more, item = await loop.run_in_executor(None, _next)
-            if not more:
-                break
-            chunk = (json.dumps({"result": item}) + "\n").encode()
-            writer.write(f"{len(chunk):x}\r\n".encode() + chunk + b"\r\n")
+            def _next():
+                try:
+                    return True, next(it)
+                except StopIteration:
+                    return False, None
+
+            while True:
+                more, item = await loop.run_in_executor(None, _next)
+                if not more:
+                    break
+                items += 1
+                chunk = (json.dumps({"result": item}) + "\n").encode()
+                writer.write(f"{len(chunk):x}\r\n".encode() + chunk
+                             + b"\r\n")
+                await writer.drain()
+            writer.write(b"0\r\n\r\n")
             await writer.drain()
-        writer.write(b"0\r\n\r\n")
-        await writer.drain()
+        finally:
+            if ms is not None:
+                ms.finish({"items": items})
 
     def _run(self):
         self._loop = asyncio.new_event_loop()

@@ -37,7 +37,17 @@ class ReplicaActor:
             fn = self._callable
         else:
             fn = getattr(self._callable, method_name or "__call__")
-        out = fn(*args, **kwargs)
+        from ray_tpu.util import tracing
+
+        if not tracing.tracing_enabled():
+            out = fn(*args, **kwargs)
+        else:
+            # nests under the worker's execute span (thread-local), so
+            # the serve request trace separates replica user-code time
+            # from the actor-call machinery around it
+            with tracing.span("serve.replica::execute",
+                              {"method": method_name or "__call__"}):
+                out = fn(*args, **kwargs)
         if inspect.iscoroutine(out):
             import asyncio
 

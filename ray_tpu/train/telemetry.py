@@ -96,6 +96,21 @@ class StepTelemetry:
                 sample_hbm = self._steps % self._HBM_SAMPLE_EVERY in (0, 1)
             if sample_hbm:
                 self.sample_hbm()
+            # trace plane: the step also lands as a span, so TPU step
+            # telemetry joins the driver's unified Perfetto timeline
+            from ray_tpu.util import tracing
+
+            if tracing.tracing_enabled():
+                end = time.time_ns()
+                attrs: Dict[str, Any] = {"steps": max(1, int(steps))}
+                if tokens is not None:
+                    attrs["tokens"] = float(tokens)
+                if mfu is not None:
+                    attrs["mfu"] = float(mfu)
+                tracing.record_span(
+                    "train::step",
+                    end - int(step_time_s * max(1, int(steps)) * 1e9),
+                    end, attrs)
         except Exception:
             pass  # telemetry must never fail a train step
 
@@ -122,6 +137,12 @@ class StepTelemetry:
             with self._lock:
                 self._last["compiles"] = (self._last.get("compiles", 0) + 1)
                 self._last["last_compile_s"] = round(seconds, 3)
+            from ray_tpu.util import tracing
+
+            if tracing.tracing_enabled():
+                end = time.time_ns()
+                tracing.record_span("train::compile",
+                                    end - int(seconds * 1e9), end)
         except Exception:
             pass
 

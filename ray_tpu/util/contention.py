@@ -31,6 +31,7 @@ return raw ``threading`` locks with zero overhead).
 from __future__ import annotations
 
 import threading
+import time
 from time import perf_counter
 from typing import Dict, Optional
 
@@ -38,6 +39,10 @@ from typing import Dict, Optional
 #: bounce the histogram's own lock); the unlocked accumulators still see
 #: them.
 HISTOGRAM_MIN_WAIT_S = 5e-5
+
+#: contended waits at least this long also become ``lock::<name>`` spans
+#: when tracing is armed (contention slices on the Perfetto timeline)
+TRACE_MIN_WAIT_S = 1e-3
 
 
 class _LockStats:
@@ -134,6 +139,19 @@ class _TimedLockBase:
         if wait >= HISTOGRAM_MIN_WAIT_S:
             try:
                 _wait_hist()._observe_key(self._hist_key, wait)
+            except Exception:
+                pass
+        if wait >= TRACE_MIN_WAIT_S:
+            # contention slice on the unified timeline: only waits long
+            # enough to be visible at trace zoom, only when tracing is
+            # armed (uncontended/short paths never reach here)
+            try:
+                from ray_tpu.util import tracing
+
+                if tracing.tracing_enabled():
+                    end = time.time_ns()
+                    tracing.record_span(f"lock::{st.name}",
+                                        end - int(wait * 1e9), end)
             except Exception:
                 pass
         return ok

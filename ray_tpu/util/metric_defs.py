@@ -199,7 +199,7 @@ _def("rtpu_gcs_pubsub_messages_total", "counter",
      "subscriber per publish)", tag_keys=("channel",), component="gcs")
 _def("rtpu_gcs_table_size", "gauge",
      "GCS table entry counts (objects/nodes/actors/kv/functions/pgs/"
-     "task_events/free_candidates/tombstones; sampled)",
+     "task_events/trace_events/free_candidates/tombstones; sampled)",
      tag_keys=("table",), component="gcs")
 _def("rtpu_gcs_nodes_alive", "gauge",
      "cluster nodes currently alive (sampled)", component="gcs")
@@ -262,6 +262,21 @@ _def("rtpu_failpoints_fired_total", "counter",
      "chaos failpoints that fired in this process (test/chaos plane; "
      "always 0 in production unless RTPU_FAILPOINTS arms a site)",
      tag_keys=("site",), component="failpoints")
+
+# ---------------------------------------------------------------------------
+# trace plane (util/tracing.py -> util/trace_store.py)
+# ---------------------------------------------------------------------------
+
+_def("rtpu_trace_spans_total", "counter",
+     "spans recorded into this process's trace ring (0 unless "
+     "RTPU_TRACING armed)", component="tracing")
+_def("rtpu_trace_spans_dropped_total", "counter",
+     "spans evicted from the bounded trace ring before collection "
+     "(raise RTPU_TRACE_RING or shorten the push interval)",
+     component="tracing")
+_def("rtpu_trace_push_batches_total", "counter",
+     "span batches shipped toward the head (worker control-pipe pushes "
+     "+ node heartbeat rides)", component="tracing")
 
 # ---------------------------------------------------------------------------
 # lock contention profiler (util/contention.py)

@@ -132,6 +132,72 @@ def list_task_events(limit: int = 1000) -> List[Dict[str, Any]]:
     return out
 
 
+def list_spans(limit: int = 10000,
+               filters: Optional[List] = None) -> List[Dict[str, Any]]:
+    """Collected trace spans — cluster-wide in cluster mode (every node
+    ships its TraceStore deltas on the heartbeat; reference
+    tracing-plane/GcsTaskManager role), head-local otherwise. Each span
+    carries trace/span/parent ids, epoch-nano timestamps, attributes, and
+    origin labels (node_id / worker_id / component). Empty unless tracing
+    is armed (``enable_tracing()`` / ``RTPU_TRACING=1``)."""
+    rt = _gcs()
+    try:
+        rt.collect_trace_spans()
+    except Exception:
+        pass
+    if rt.cluster is not None:
+        try:
+            evs = rt.cluster.gcs.call("trace_events_get", int(limit),
+                                      timeout=10)
+            if evs:
+                return _apply_filters(evs, filters)
+        except Exception:
+            pass
+    return _apply_filters(rt.trace_store.snapshot(int(limit)), filters)
+
+
+def summarize_critical_path(trace_id: Optional[str] = None,
+                            limit: int = 5000) -> Dict[str, Any]:
+    """Attribute end-to-end wall time to per-process segments.
+
+    With ``trace_id``: sweep that trace's spans (serve request chain,
+    task graph) into segments that sum exactly to the end-to-end time.
+    Without: aggregate the flight-recorder ring per task — driver submit
+    CPU (from submit spans, when tracing is armed), queue, lease, worker
+    phases, and transit — the printed form of the multi-client
+    control-plane ceiling (r8 root cause)."""
+    from ray_tpu.util import trace_store as _ts
+
+    rt = _gcs()
+    spans = list_spans(limit=100_000)
+    if trace_id is not None:
+        sel = [s for s in spans if s.get("trace_id") == trace_id]
+        return _ts.critical_path_for_trace(sel)
+    ring = list(getattr(rt, "task_ring", ()) or ())[-int(limit):]
+    return _ts.critical_path_for_tasks(ring, spans)
+
+
+def export_perfetto(filename: Optional[str] = None) -> Dict[str, Any]:
+    """Unified Perfetto/Chrome-trace document: collected spans (incl.
+    lock-contention waits and train-step telemetry) merged with the
+    flight recorder's task-phase slices, one process row per node and one
+    thread track per worker. Write to ``filename`` and load it in
+    ui.perfetto.dev / chrome://tracing. Supersedes the driver-only
+    ``ray_tpu.timeline()`` export."""
+    from ray_tpu.util import trace_store as _ts
+
+    rt = _gcs()
+    spans = list_spans(limit=200_000)
+    events = _all_task_events(rt)
+    doc = _ts.build_perfetto(spans, events)
+    if filename:
+        import json
+
+        with open(filename, "w") as f:
+            json.dump(doc, f)
+    return doc
+
+
 def _percentile(sorted_vals: List[float], q: float) -> float:
     """Nearest-rank percentile over a pre-sorted list."""
     import math

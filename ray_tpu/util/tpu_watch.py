@@ -39,6 +39,185 @@ import time
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 DEFAULT_LOG = os.path.join(_REPO, "TPU_WATCH_LOG.jsonl")
 DEFAULT_CACHE = os.path.join(_REPO, "BENCH_TPU_LAST_GOOD.json")
+DEFAULT_PIDFILE = os.path.join(_REPO, ".tpu_watch.pid")
+
+
+# ---------------------------------------------------------------------------
+# single-instance hygiene (ISSUE 7 satellite): CLAUDE.md says start the
+# watcher every session, so starting must be IDEMPOTENT — a live watcher
+# is adopted (pidfile rewritten), duplicates are killed, and --status
+# answers "is one running?" without side effects. r10 found three
+# 7-12h-old leaked watchers, each with its own jax-importing probe
+# children contending for the 2 cores.
+# ---------------------------------------------------------------------------
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+
+
+def _cmdline(pid: int) -> str:
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            return f.read().replace(b"\0", b" ").decode("utf-8", "replace")
+    except OSError:
+        return ""
+
+
+def _is_watcher(pid: int) -> bool:
+    """A long-running watcher parent — NOT its --numerics/--status
+    children, not the bench/sweep children (different cmdlines), not a
+    bounded one-shot refresh (--iterations), and not a WRAPPER process
+    (`timeout ... python -m ...`, `bash -c '... tpu_watch ...'`) that
+    merely carries the module string in its cmdline (the pkill -f
+    self-match class CLAUDE.md warns about) — only direct python
+    invocations are adoptable/killable."""
+    cl = _cmdline(pid)
+    if ("ray_tpu.util.tpu_watch" not in cl or "--numerics" in cl
+            or "--status" in cl or "--iterations" in cl):
+        return False
+    first = cl.split()[0] if cl.split() else ""
+    return "python" in os.path.basename(first)
+
+
+def find_watchers(exclude: int = -1):
+    """Pids of running watcher parents, oldest (lowest start) first."""
+    out = []
+    for ent in os.listdir("/proc"):
+        if not ent.isdigit():
+            continue
+        pid = int(ent)
+        if pid == exclude or pid == os.getpid():
+            continue
+        if _is_watcher(pid):
+            out.append(pid)
+    return sorted(out)
+
+
+def read_pidfile(path: str):
+    try:
+        with open(path) as f:
+            return int(f.read().strip())
+    except (OSError, ValueError):
+        return None
+
+
+def write_pidfile(path: str, pid: int) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(str(pid))
+    os.replace(tmp, path)
+
+
+def watcher_status(pidfile: str = DEFAULT_PIDFILE,
+                   log_path: str = DEFAULT_LOG,
+                   cache_path: str = DEFAULT_CACHE,
+                   scan=find_watchers) -> dict:
+    """One dict answering "is a watcher running, and what has it seen?"
+    (``--status``). ``scan`` is injectable for tests."""
+    pid = read_pidfile(pidfile)
+    pid_ok = pid is not None and _pid_alive(pid) and _is_watcher(pid)
+    others = [p for p in scan() if p != pid]
+    last = None
+    try:
+        with open(log_path, "rb") as f:
+            tail = f.readlines()[-1]
+        last = json.loads(tail)
+    except (OSError, IndexError, json.JSONDecodeError):
+        pass
+    cache_age = None
+    try:
+        with open(cache_path) as f:
+            cache_age = round(time.time() - json.load(f)["ts"])
+    except Exception:
+        pass
+    return {
+        "running": pid_ok or bool(others),
+        "pid": pid if pid_ok else (others[0] if others else None),
+        "pidfile_stale": pid is not None and not pid_ok,
+        "unadopted_watchers": others,
+        "last_log": last,
+        "cache_age_s": cache_age,
+    }
+
+
+def ensure_single_instance(pidfile: str, force: bool,
+                           scan=find_watchers) -> bool:
+    """Idempotent-start gate. Returns True when THIS process should
+    proceed to watch (pidfile now holds our pid). With a live watcher
+    already running: adopt it into the pidfile, kill any duplicates, and
+    return False. ``--force`` kills everything found and starts fresh.
+
+    The whole decision runs under an O_EXCL gate lock (failpoints'
+    once=PATH election pattern): two near-simultaneous starts must not
+    each scan, see the other mid-gate, mutually "adopt", and BOTH exit —
+    leaving no watcher at all. A lock older than 60s is a crashed gate
+    and is broken."""
+    import signal
+
+    lock = pidfile + ".lock"
+    lock_fd = None
+    deadline = time.monotonic() + 75.0
+    while lock_fd is None:
+        try:
+            lock_fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except OSError:
+            try:
+                if time.time() - os.path.getmtime(lock) > 60.0:
+                    os.unlink(lock)  # crashed gate: break the lock
+                    continue
+            except OSError:
+                continue  # holder just finished; retry the open
+            if time.monotonic() > deadline:
+                # a healthy holder decides for both of us; defer to it
+                print("tpu_watch start gate busy; deferring to the "
+                      "concurrent starter")
+                return False
+            time.sleep(0.5)
+    try:
+        return _gate_decision_locked(pidfile, force, scan, signal)
+    finally:
+        os.close(lock_fd)
+        try:
+            os.unlink(lock)
+        except OSError:
+            pass
+
+
+def _gate_decision_locked(pidfile: str, force: bool, scan, signal) -> bool:
+    pid = read_pidfile(pidfile)
+    keep = pid if (pid is not None and _pid_alive(pid)
+                   and _is_watcher(pid)) else None
+    others = [p for p in scan() if p != keep]
+    if force:
+        for p in ([keep] if keep else []) + others:
+            try:
+                os.kill(p, signal.SIGTERM)
+            except OSError:
+                pass
+        keep, others = None, []
+    if keep is None and others:
+        keep = others.pop(0)  # adopt the stalest leaked watcher
+    # duplicates beyond the adopted one are leaks: kill them
+    for p in others:
+        try:
+            os.kill(p, signal.SIGTERM)
+        except OSError:
+            pass
+    if keep is not None:
+        write_pidfile(pidfile, keep)
+        print(f"tpu_watch already running (pid {keep}); adopted into "
+              f"{pidfile}"
+              + (f", killed {len(others)} duplicate(s)" if others else ""))
+        return False
+    write_pidfile(pidfile, os.getpid())
+    return True
 
 
 def _now_iso() -> str:
@@ -433,11 +612,41 @@ def main(argv=None) -> int:
     ap.add_argument("--iterations", type=int, default=None)
     ap.add_argument("--numerics", action="store_true",
                     help="(child mode) run the on-chip numerics check")
+    ap.add_argument("--pidfile", default=DEFAULT_PIDFILE)
+    ap.add_argument("--status", action="store_true",
+                    help="report whether a watcher is running (exit 0) "
+                         "or not (exit 1), plus last probe + cache age")
+    ap.add_argument("--force", action="store_true",
+                    help="kill any running watcher(s) and start fresh")
     args = ap.parse_args(argv)
     if args.numerics:
         numerics_child()
         return 0
-    watch(args.interval, args.log, args.cache, args.refresh, args.iterations)
+    if args.status:
+        st = watcher_status(args.pidfile, args.log, args.cache)
+        print(json.dumps(st, indent=1))
+        return 0 if st["running"] else 1
+    if args.iterations is not None:
+        # bounded one-shot (e.g. the CLAUDE.md cache refresh:
+        # --iterations 1 --refresh 0): runs regardless of a background
+        # watcher — the gate must never silently no-op an explicit
+        # refresh (and never kill it as a "duplicate": _is_watcher
+        # excludes --iterations cmdlines)
+        watch(args.interval, args.log, args.cache, args.refresh,
+              args.iterations)
+        return 0
+    if not ensure_single_instance(args.pidfile, args.force):
+        return 0
+    try:
+        watch(args.interval, args.log, args.cache, args.refresh,
+              args.iterations)
+    finally:
+        # only remove OUR pidfile (an adopter may have rewritten it)
+        if read_pidfile(args.pidfile) == os.getpid():
+            try:
+                os.unlink(args.pidfile)
+            except OSError:
+                pass
     return 0
 
 

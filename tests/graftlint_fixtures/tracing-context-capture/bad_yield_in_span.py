@@ -1,0 +1,11 @@
+"""Positive fixture: generator suspends inside a thread-local span."""
+
+from ray_tpu.util import tracing
+
+
+def stream(items):
+    with tracing.span("demo.stream::tokens"):
+        for item in items:
+            # suspended here, the span context leaks onto whatever this
+            # thread runs next
+            yield item

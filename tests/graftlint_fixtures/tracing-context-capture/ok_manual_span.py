@@ -1,0 +1,23 @@
+"""Negative fixture: generators re-enter through the manual-span API;
+nested defs inside a span body are other frames."""
+
+from ray_tpu.util import tracing
+
+
+def stream(items):
+    span = tracing.manual_span("demo.stream::tokens")
+    try:
+        for item in items:
+            yield item
+    finally:
+        if span is not None:
+            span.finish()
+
+
+def run(fn):
+    with tracing.span("demo.run::call"):
+        # a nested generator DEF does not suspend this frame
+        def inner():
+            yield 1
+
+        return fn(inner)

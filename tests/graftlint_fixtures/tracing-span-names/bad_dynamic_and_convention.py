@@ -1,0 +1,15 @@
+"""Positive fixture: dynamic span names and convention violations."""
+
+from ray_tpu.util import tracing
+
+
+def record(section, name):
+    # non-literal name: the catalog/analyzers can't grep it
+    with tracing.span("phase_" + section):
+        pass
+    # f-string without a literal '<layer>::' prefix head
+    with tracing.span(f"{section}::work"):
+        pass
+    # literal, but not '<layer>::<what>'
+    with tracing.span("justaname"):
+        pass

@@ -1,0 +1,13 @@
+"""Negative fixture: literal names + literal-prefix f-strings are fine."""
+
+from ray_tpu.util import tracing
+
+
+def record(task):
+    with tracing.span("demo.layer::thing", {"task": task}):
+        pass
+    # dynamic suffix behind a literal '<layer>::' prefix
+    with tracing.span(f"demo.submit::{task}"):
+        pass
+    end = 2
+    tracing.record_span("demo.layer::other", 1, end)

@@ -1407,3 +1407,70 @@ def test_sqlite_store_client_unit(tmp_path):
     f.save(snap)
     assert f.load()["kv"] == snap["kv"]
     assert make_store_client(None) is None
+
+
+def test_trace_spans_cross_processes_and_nodes(cluster):
+    """ISSUE 7: one trace id spans >= 3 processes (driver submit ->
+    worker execute -> nested submit -> second worker) and >= 2 nodes,
+    collected over worker pipe pushes + GCS-heartbeat shipping. Tracing
+    is armed MID-SESSION, so the daemon (booted un-armed) must learn via
+    the KV/pubsub push and relay to its workers (satellite fix)."""
+    from ray_tpu.util import state, tracing
+
+    cluster.add_node(num_cpus=2, resources={"side": 2})
+    _init(cluster)
+    tracing.enable_tracing()
+    try:
+        @ray_tpu.remote(resources={"side": 1})
+        def traced_inner(x):
+            return x + 1
+
+        @ray_tpu.remote(resources={"side": 1})
+        def traced_outer():
+            return ray_tpu.get(traced_inner.remote(1), timeout=60)
+
+        assert ray_tpu.get(traced_outer.remote(), timeout=90) == 2
+
+        def full_trace():
+            # fresh work keeps worker pushes + heartbeats flowing
+            try:
+                ray_tpu.get(traced_outer.remote(), timeout=90)
+                spans = state.list_spans(limit=100_000)
+            except ConnectionError:
+                return None
+            outers = [s for s in spans
+                      if s["name"] == "execute::traced_outer"]
+            for o in reversed(outers):
+                trace = [s for s in spans
+                         if s["trace_id"] == o["trace_id"]]
+                if not any(s["name"] == "execute::traced_inner"
+                           for s in trace):
+                    continue
+                pids = {(s.get("attributes") or {}).get("process.pid")
+                        for s in trace}
+                nodes = {s.get("node_id") for s in trace
+                         if s.get("node_id")}
+                if len(pids - {None}) >= 3 and len(nodes) >= 2:
+                    return trace
+            return None
+
+        deadline = time.monotonic() + 90
+        trace = None
+        while time.monotonic() < deadline and trace is None:
+            trace = full_trace()
+            if trace is None:
+                time.sleep(0.5)
+        assert trace is not None, \
+            "no trace spanning >=3 processes and >=2 nodes arrived"
+        # the nested submit happened INSIDE the outer execute
+        outer_exec = next(s for s in trace
+                          if s["name"] == "execute::traced_outer")
+        inner_sub = [s for s in trace
+                     if s["name"] == "submit::traced_inner"]
+        assert inner_sub
+        assert inner_sub[0]["parent_span_id"] == outer_exec["span_id"]
+    finally:
+        tracing.disable_tracing()
+        tracing._reset_for_tests()
+        import os as _os
+        _os.environ.pop("RTPU_TRACING", None)

@@ -568,3 +568,68 @@ def test_llm_continuous_batching_deployment(rt_serve):
         want = [int(x) for x in np.asarray(g[0, len(pr):])]
         assert results[i] == want, (i, results[i], want)
     serve.delete("llm_cb")
+
+
+def test_request_trace_chain_and_critical_path(rt_serve):
+    """ISSUE 7: one handle request produces the full route -> (queue gap)
+    -> actor-call execute -> replica-execute span chain under ONE trace
+    id, and summarize_critical_path(trace_id) attributes the request's
+    end-to-end time to segments that sum to it exactly."""
+    from ray_tpu.util import state, tracing
+
+    tracing.enable_tracing()
+    try:
+        @serve.deployment
+        def traced_echo(x):
+            time.sleep(0.05)
+            return x
+
+        handle = serve.run(traced_echo.bind())
+        assert handle.remote(7).result() == 7
+
+        def chain():
+            # keep issuing so worker span pushes fire promptly; each
+            # request produces its own complete chain
+            handle.remote(1).result()
+            spans = state.list_spans()
+            reqs = [s for s in spans
+                    if s["name"] == "serve.handle::request"]
+            for req in reversed(reqs):
+                trace = [s for s in spans
+                         if s["trace_id"] == req["trace_id"]]
+                names = {s["name"] for s in trace}
+                if ("serve.handle::route" in names
+                        and "serve.replica::execute" in names
+                        and any(n.startswith("execute::")
+                                for n in names)):
+                    return trace
+            return None
+
+        deadline = time.monotonic() + 60
+        trace = None
+        while time.monotonic() < deadline and trace is None:
+            trace = chain()
+            if trace is None:
+                time.sleep(0.3)
+        assert trace is not None, "no complete request span chain arrived"
+
+        res = state.summarize_critical_path(
+            trace_id=trace[0]["trace_id"])
+        segs = res["segments"]
+        assert segs, res
+        # segments reconcile exactly against the end-to-end time
+        total = sum(s["ms"] for s in segs.values())
+        assert total == pytest.approx(res["end_to_end_ms"], abs=0.01)
+        # the replica's user code (50ms sleep) is attributed, not lost in
+        # a gap — generous bound for a loaded 2-vCPU box
+        replica = [v["ms"] for k, v in segs.items()
+                   if k.startswith("serve.replica::execute")]
+        assert replica and replica[0] >= 30.0, segs
+        # end-to-end is the request span: at least the replica sleep
+        assert res["end_to_end_ms"] >= 40.0
+    finally:
+        tracing.disable_tracing()
+        from ray_tpu.util import tracing as _t
+        _t._reset_for_tests()
+        import os as _os
+        _os.environ.pop("RTPU_TRACING", None)
