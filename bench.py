@@ -882,6 +882,30 @@ def _core_microbench() -> dict:
         except Exception as e:
             out["tracing_overhead"] = {"error": str(e)}
 
+        # profiling on/off A/B on the SAME warm process tree (ISSUE 9
+        # bench guard, same contract as tracing_overhead): the disarmed
+        # number re-measures right before the armed one so a
+        # disarmed-path regression (profiling_enabled() must stay one
+        # dict get) or an armed-at-default-Hz sampler cost > the 10%
+        # acceptance bound both surface in the JSON line.
+        try:
+            from ray_tpu.util import profiling as _profiling
+
+            p_off = best_of(3, tasks_trial)
+            try:
+                _profiling.enable_profiling()
+                p_on = best_of(3, tasks_trial)
+            finally:
+                _profiling.disable_profiling()
+            out["profiling_overhead"] = {
+                "tasks_per_s_off": p_off,
+                "tasks_per_s_on": p_on,
+                "on_off_ratio": round(p_on / p_off, 3) if p_off else None,
+                "hz": _profiling._hz(),
+            }
+        except Exception as e:
+            out["profiling_overhead"] = {"error": str(e)}
+
         @ray_tpu.remote
         class A:
             def f(self):

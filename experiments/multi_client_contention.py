@@ -209,9 +209,54 @@ def main():
     for c in tclients:
         ray_tpu.kill(c)
 
+    # -- E: profiling-plane driver attribution (ISSUE 9 acceptance):
+    # run state.profile(seconds=2) DURING the multi-client shape and let
+    # the merged samples name the control-plane functions the driver
+    # burns its GIL-serialized CPU in (submit / pipe send / refpin
+    # paths by self-time) — the direct input to ROADMAP item 1.
+    import sys
+    import threading
+
+    from ray_tpu.util import state as _state
+
+    pclients = [BatchClient.options(num_cpus=0).remote()
+                for _ in range(2)]
+    ray_tpu.get([c.small_value_batch.remote(10) for c in pclients])
+    done = threading.Event()
+
+    def _drive():
+        try:
+            while not done.is_set():
+                ray_tpu.get([c.small_value_batch.remote(250)
+                             for c in pclients], timeout=120)
+        except Exception:
+            pass
+
+    driver_thread = threading.Thread(target=_drive, daemon=True)
+    driver_thread.start()
+    prof = _state.profile(seconds=2.0)
+    done.set()
+    driver_thread.join(timeout=120)
+    for c in pclients:
+        ray_tpu.kill(c)
+    top_driver = (prof.get("top_self_by_component") or {}).get(
+        "driver", [])
+    out["profile"] = {
+        "total_samples": prof["total_samples"],
+        "idle_samples": prof["idle_samples"],
+        "processes": len(prof["processes"]),
+        "top_driver_self": top_driver[:12],
+    }
+    print("§E driver control-plane self-time "
+          f"({prof['total_samples']} busy samples, "
+          f"{len(prof['processes'])} processes):", file=sys.stderr)
+    for row in top_driver[:12]:
+        print(f"  {row['self_pct']:5.1f}%  {row['self_samples']:>6}  "
+              f"{row['function']}", file=sys.stderr)
+
     out["loadavg_end"] = os.getloadavg()
     ray_tpu.shutdown()
-    print(format_breakdown(cp), file=__import__("sys").stderr)
+    print(format_breakdown(cp), file=sys.stderr)
     print(json.dumps(out, indent=1))
 
 

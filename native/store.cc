@@ -409,6 +409,30 @@ void rtpu_stats(Store* s, uint64_t* capacity, uint64_t* used,
   if (num_objects) *num_objects = s->hdr->num_objects;
 }
 
+// Fragmentation report for `ray_tpu memory`: walk the free list under the
+// arena lock and report block count, total free bytes, and the largest
+// contiguous free block (the biggest object the arena can still take
+// without eviction).
+void rtpu_frag_stats(Store* s, uint64_t* free_blocks, uint64_t* free_bytes,
+                     uint64_t* largest_free) {
+  Locker lk(s->hdr);
+  uint64_t blocks = 0, total = 0, largest = 0;
+  uint64_t cur = s->hdr->free_head;
+  // the free list is bounded by arena_size/kAlign entries; the guard
+  // caps pathological (corrupt-header) walks instead of spinning
+  uint64_t guard = s->hdr->arena_size / kAlign + 2;
+  while (cur != 0 && blocks < guard) {
+    FreeBlock* blk = reinterpret_cast<FreeBlock*>(s->base + cur);
+    blocks++;
+    total += blk->size;
+    if (blk->size > largest) largest = blk->size;
+    cur = blk->next;
+  }
+  if (free_blocks) *free_blocks = blocks;
+  if (free_bytes) *free_bytes = total;
+  if (largest_free) *largest_free = largest;
+}
+
 uint8_t* rtpu_base(Store* s) { return s->base; }
 
 }  // extern "C"

@@ -74,6 +74,9 @@ def load_store_lib() -> Optional[ctypes.CDLL]:
         lib.rtpu_evict.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         lib.rtpu_stats.argtypes = [ctypes.c_void_p] + \
             [ctypes.POINTER(ctypes.c_uint64)] * 3
+        if hasattr(lib, "rtpu_frag_stats"):  # absent in a pre-r11 .so
+            lib.rtpu_frag_stats.argtypes = [ctypes.c_void_p] + \
+                [ctypes.POINTER(ctypes.c_uint64)] * 3
         lib.rtpu_base.restype = ctypes.c_void_p
         lib.rtpu_base.argtypes = [ctypes.c_void_p]
         _lib = lib
@@ -260,6 +263,21 @@ class NativeArena:
                              ctypes.byref(used), ctypes.byref(num))
         return {"capacity": cap.value, "used": used.value,
                 "num_objects": num.value}
+
+    def frag_stats(self) -> dict:
+        """Free-list occupancy/fragmentation: block count, total free
+        bytes, and the largest contiguous free block (the biggest object
+        the arena still fits without eviction)."""
+        if not hasattr(self._lib, "rtpu_frag_stats"):
+            return {}
+        blocks = ctypes.c_uint64()
+        free_b = ctypes.c_uint64()
+        largest = ctypes.c_uint64()
+        self._lib.rtpu_frag_stats(self._store, ctypes.byref(blocks),
+                                  ctypes.byref(free_b),
+                                  ctypes.byref(largest))
+        return {"free_blocks": blocks.value, "free_bytes": free_b.value,
+                "largest_free_bytes": largest.value}
 
     def close(self) -> None:
         if self._store:

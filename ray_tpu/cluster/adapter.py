@@ -134,6 +134,7 @@ class ClusterAdapter:
                                            thread_name_prefix="cluster-pull")
         self._task_ev_cursor = 0  # next local task event to ship to GCS
         self._trace_ev_cursor = 0  # next TraceStore span to ship to GCS
+        self._profile_ev_cursor = 0  # next ProfileStore batch to ship
         # (size, locations) cache for dependency-locality scoring: fan-outs
         # of one big ref to N tasks pay one directory lookup, not N.
         # _obj_info_down_until: circuit breaker — while the GCS is not
@@ -273,6 +274,19 @@ class ClusterAdapter:
                         from ray_tpu.util import tracing as _tracing
 
                         _tracing.note_push()
+                # profiling plane rides the same beats: this node's
+                # sampler window (driver/daemon process) + its workers'
+                # pushed batches, shipped as acked ProfileStore deltas
+                self.rt.collect_profile_batches()
+                pb, pstart = self.rt.profile_store.since(
+                    self._profile_ev_cursor)
+                if pb:
+                    if self.gcs.call("profile_events", self.node_id, pb,
+                                     pstart, timeout=5):
+                        self._profile_ev_cursor = pstart + len(pb)
+                        from ray_tpu.util import profiling as _profiling
+
+                        _profiling.note_push()
             except Exception:
                 pass
 
@@ -302,6 +316,7 @@ class ClusterAdapter:
         self.gcs.call("subscribe", "pgs", timeout=10)
         self.gcs.call("subscribe", "failpoints", timeout=10)
         self.gcs.call("subscribe", "tracing", timeout=10)
+        self.gcs.call("subscribe", "profiling", timeout=10)
         self.gcs.call("node_register", self.node_id, self.server.addr,
                       self.rt.resources("total"), self.is_scheduler,
                       dict(getattr(self.rt, "labels", {})), timeout=10)
@@ -323,6 +338,12 @@ class ClusterAdapter:
         tracing.sync_from_kv(
             lambda k, ns: self.gcs.call("kv_get", k, ns, timeout=10))
         self._trace_ev_cursor = 0
+        # profiling plane, late-joiner path: same contract as tracing
+        from ray_tpu.util import profiling
+
+        profiling.sync_from_kv(
+            lambda k, ns: self.gcs.call("kv_get", k, ns, timeout=10))
+        self._profile_ev_cursor = 0
         # GCS restart recovery (chaos: kill -9 mid-submit): the object
         # directory is NOT durable and obj_ready is a cast, so anything
         # that turned terminal during the outage is unknown to the rebuilt
@@ -573,6 +594,27 @@ class ClusterAdapter:
             self._io.submit(self._on_failpoints, payload)
         elif channel == "tracing":
             self._io.submit(self._on_tracing, payload)
+        elif channel == "profiling":
+            self._io.submit(self._on_profiling, payload)
+
+    def _on_profiling(self, payload: dict) -> None:
+        """Cluster-wide profiler arm/disarm AND live stack-dump requests
+        (the `ray_tpu stack` py-spy role, cluster-wide): a ``stackdump``
+        op collects this node's live stacks (its process + its workers)
+        and replies to the GCS; an arming payload applies here and
+        relays to this runtime's workers over their control pipes."""
+        from ray_tpu.util import profiling
+
+        try:
+            if payload.get("op") == "stackdump":
+                stacks = self.rt.dump_stacks(timeout=2.0)
+                self.gcs.call("stack_reply", payload.get("req"),
+                              self.node_id, stacks, timeout=10)
+                return
+            profiling.apply_remote(payload)
+            profiling.broadcast_local(self.rt, payload)
+        except Exception:
+            pass
 
     def _on_tracing(self, payload: dict) -> None:
         """Cluster-wide tracing arm/disarm: apply in this process and

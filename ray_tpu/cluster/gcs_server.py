@@ -118,6 +118,15 @@ class GcsService:
         self.trace_events = deque(
             maxlen=int(config.get("gcs_max_trace_events")))
         self._trace_ev_seq: Dict[bytes, int] = {}
+        # profiling plane: profile batches shipped on node heartbeats
+        # (same cursor+dedup contract); head state.profile() pulls via
+        # rpc_profile_events_get. Stack-dump request/reply rendezvous for
+        # the cluster-wide `ray_tpu stack` (py-spy role).
+        self.profile_events = deque(
+            maxlen=int(config.get("gcs_max_profile_events")))
+        self._profile_ev_seq: Dict[bytes, int] = {}
+        self._stack_req_seq = 0
+        self._stack_replies: Dict[int, Dict[str, Any]] = {}
         # metrics federation: latest [(origin_labels, records)] payload per
         # node, replaced wholesale on each carrying heartbeat (idempotent;
         # reference metrics-agent -> head pipeline role). Head /metrics
@@ -356,6 +365,7 @@ class GcsService:
                          "pgs": len(self.pgs),
                          "task_events": len(self.task_events),
                          "trace_events": len(self.trace_events),
+                         "profile_events": len(self.profile_events),
                          "free_candidates": len(self._free_candidates),
                          "tombstones": len(self._freed_tombstones)}
                 alive = sum(1 for e in self.nodes.values() if e.alive)
@@ -573,6 +583,68 @@ class GcsService:
         with self.lock:
             evs = list(self.trace_events)
         return evs[-limit:]
+
+    def rpc_profile_events(self, ctx, node_id: bytes, events,
+                           start_seq=None):
+        """Batched profile batches from a node's ProfileStore
+        (profiling-plane twin of rpc_trace_events — same acked-cursor/
+        dedup contract against the per-node high-water mark)."""
+        rx = time.time()
+        with self.lock:
+            if start_seq is not None:
+                seen = self._profile_ev_seq.get(node_id, 0)
+                skip = max(0, seen - start_seq)
+                if skip >= len(events):
+                    return True
+                events = events[skip:]
+                start_seq += skip
+                self._profile_ev_seq[node_id] = start_seq + len(events)
+            for ev in events:
+                # re-stamp arrival with THIS clock: the sender's _rx is
+                # its own (possibly skewed) wall clock, and the head's
+                # window filter needs a receiver-side reference
+                ev["_rx"] = rx
+            self.profile_events.extend(events)
+        return True
+
+    def rpc_profile_events_get(self, ctx, limit: int = 2048):
+        limit = int(limit)
+        if limit <= 0:
+            return []
+        with self.lock:
+            evs = list(self.profile_events)
+        return evs[-limit:]
+
+    # -- live cluster-wide stack dumps (`ray_tpu stack` py-spy role) ----
+
+    def rpc_stack_request(self, ctx):
+        """Start a cluster-wide stack dump: publish the request on the
+        ``profiling`` channel (every node's adapter collects its process
+        + workers and calls stack_reply) and return the request id the
+        caller later passes to stack_collect."""
+        with self.lock:
+            self._stack_req_seq += 1
+            req_id = self._stack_req_seq
+            self._stack_replies[req_id] = {}
+            # bound: keep only the most recent requests
+            while len(self._stack_replies) > 8:
+                self._stack_replies.pop(min(self._stack_replies))
+        self._publish("profiling", {"op": "stackdump", "req": req_id})
+        return req_id
+
+    def rpc_stack_reply(self, ctx, req_id: int, node_id: bytes, stacks):
+        with self.lock:
+            bucket = self._stack_replies.get(req_id)
+            if bucket is not None:
+                bucket[node_id.hex()[:8]] = stacks
+        return True
+
+    def rpc_stack_collect(self, ctx, req_id: int):
+        """{node_id: {proc_label: {thread: collapsed_stack}}} gathered so
+        far for a stack_request id (callers poll until enough nodes
+        answered or their own deadline passes)."""
+        with self.lock:
+            return dict(self._stack_replies.get(req_id) or {})
 
     def rpc_metrics_get(self, ctx, exclude_node: Optional[bytes] = None):
         """Flattened [(origin_labels, records)] across nodes for the head

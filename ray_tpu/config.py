@@ -187,6 +187,28 @@ _knob("trace_store_max", int, 65536,
 _knob("gcs_max_trace_events", int, 65536,
       "cluster-wide span buffer size in the GCS (trace twin of "
       "gcs_max_task_events)", "cluster/gcs_server.py")
+_knob("profile_hz", float, 67.0,
+      "sampling-profiler frequency per process when armed "
+      "(RTPU_PROFILING); the sampler walks sys._current_frames at this "
+      "rate", "util/profiling.py")
+_knob("profile_table_max", int, 4096,
+      "max unique (thread, stack) keys aggregated per process between "
+      "collection drains; overflow drops new stacks and counts "
+      "rtpu_profile_samples_dropped_total", "util/profiling.py")
+_knob("profile_push_interval_s", float, 1.0,
+      "min seconds between a worker's batched profile pushes over the "
+      "control pipe (the profile twin of trace_push_interval_s)",
+      "core/worker.py")
+_knob("profile_store_max", int, 2048,
+      "profile batches retained by a runtime's ProfileStore (head query "
+      "surface; daemons buffer here between heartbeats)",
+      "util/profiling.py")
+_knob("gcs_max_profile_events", int, 4096,
+      "cluster-wide profile-batch buffer size in the GCS (profile twin "
+      "of gcs_max_trace_events)", "cluster/gcs_server.py")
+_knob("obj_meta_max", int, 100_000,
+      "object creation-metadata entries (owner/age/call-site) kept by "
+      "the driver for `ray_tpu memory` forensics", "core/runtime.py")
 
 # -- serve ------------------------------------------------------------------
 _knob("serve_max_body", int, 64 << 20,

@@ -504,6 +504,38 @@ class StoreClient:
             pass
         return total
 
+    def report(self) -> dict:
+        """Arena occupancy/fragmentation report for `ray_tpu memory` /
+        ``state.store_report()``: backend, capacity/used/object counts,
+        free-list fragmentation (native arena), file-segment bytes, live
+        view pins, and spill-directory bytes."""
+        out: dict = {
+            "backend": "arena" if self._arena is not None else "file",
+            "capacity_bytes": int(config.get("store_capacity")),
+            "file_segment_bytes": self._file_bytes,
+            "view_pins": len(self._pins),
+            "spill_dir_bytes": self.spill_dir_bytes(),
+        }
+        if self._arena is not None:
+            try:
+                st = self._arena.stats()
+                out["arena_used_bytes"] = st["used"]
+                out["arena_objects"] = st["num_objects"]
+                frag = self._arena.frag_stats()
+                if frag:
+                    out.update(frag)
+                    cap = st["used"] + frag["free_bytes"]
+                    # fragmentation = how much of the free space is NOT
+                    # reachable by the single largest allocation
+                    out["fragmentation_pct"] = round(
+                        100.0 * (1.0 - frag["largest_free_bytes"]
+                                 / max(1, frag["free_bytes"])), 1)
+                    out["occupancy_pct"] = round(
+                        100.0 * st["used"] / max(1, cap), 1)
+            except Exception:
+                pass
+        return out
+
     def contains_spilled(self, obj_id: ObjectID) -> bool:
         return os.path.exists(_spill_path(self.session, obj_id))
 

@@ -20,7 +20,7 @@ import os
 import threading
 import time
 import uuid
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional
 
 import cloudpickle
@@ -432,6 +432,24 @@ class DriverRuntime:
         # arming payload for workers spawned after enable_tracing()
         # (delivered on dial-back, like _fp_specs)
         self._trace_push = None
+        # profiling plane (receiver side): workers' profile batches and
+        # this process's own sampler window land here; daemons ship
+        # deltas on the heartbeat, the head merges at state.profile()
+        from ray_tpu.util import profiling as _profiling
+
+        self.profile_store = _profiling.ProfileStore()
+        self._profile_push = None
+        # env-armed boot (RTPU_PROFILING=1 before init): resolving here
+        # starts this process's sampler; one dict get when disarmed
+        _profiling.profiling_enabled()
+        # live cluster-wide stack dumps (`ray_tpu stack` py-spy role):
+        # workers reply to a "stackdump" push with a "stacks" cast
+        self._stack_replies: Dict[bytes, dict] = {}
+        # object-memory forensics: creation metadata per object id
+        # (owner process, wall-clock birth, optional call-site when the
+        # profiler is armed) — bounded FIFO, pure dict work on hot paths
+        self._obj_meta: "OrderedDict[bytes, dict]" = OrderedDict()
+        self._obj_meta_cap = int(config.get("obj_meta_max"))
         self._phase_hist = None
         self._phase_keys: Dict[str, tuple] = {}
         self._status_keys = {False: (("status", "ok"),),
@@ -516,6 +534,7 @@ class DriverRuntime:
             lambda b: self._pin_delta(b, 1),
             self._deferred_unpins.append)
         self.gcs.on_terminal = self._release_arg_pins
+        self._janitor_wake = threading.Event()  # never set; idle-typed wait
         threading.Thread(target=self._ref_janitor_loop, daemon=True,
                          name="rtpu-ref-janitor").start()
 
@@ -990,6 +1009,13 @@ class DriverRuntime:
                     ws.send(("trace", tpush))
                 except (OSError, BrokenPipeError):
                     pass
+            # profiling plane: same replay for enable_profiling()
+            ppush = getattr(self, "_profile_push", None)
+            if ppush is not None:
+                try:
+                    ws.send(("prof", ppush))
+                except (OSError, BrokenPipeError):
+                    pass
             with self.lock:
                 was_starting = ws.status == "starting"
                 if was_starting:
@@ -1055,7 +1081,8 @@ class DriverRuntime:
         if retrying:
             spec["retries_left"] = spec.get("retries_left", 0) - 1
         else:
-            self._apply_done_results(results)
+            self._apply_done_results(
+                results, owner="worker:" + ws.worker_id.hex()[:8])
         fire = []
         with self._stream_cv:
             self._stream_consumed.pop(task_id_b, None)
@@ -1150,11 +1177,13 @@ class DriverRuntime:
             self._enqueue_ready(spec)
         self._pump()
 
-    def _apply_done_results(self, results) -> None:
+    def _apply_done_results(self, results, owner: str = "") -> None:
         """Publish one done message's results to the object directory."""
         for entry in results:
             rid, rkind, payload = entry[0], entry[1], entry[2]
             oid = ObjectID(rid)
+            if owner:
+                self._note_obj_meta(rid, owner)
             # refs nested in the RESULT: pin them against the return
             # object's lifetime BEFORE marking ready (a consumer must
             # never observe the outer ready while inner refs are freeable)
@@ -1232,6 +1261,9 @@ class DriverRuntime:
                 # refs nested in the stored value: owner-pinned until the
                 # outer object is freed
                 self._pin_result_refs(args[0], args[3])
+            self._note_obj_meta(
+                args[0], "worker:" + ws.worker_id.hex()[:8],
+                args[4] if len(args) > 4 else None)
             self.gcs.mark_ready(oid, inline=args[1], size=size)
         elif op == "submit":
             if self.cluster is not None:
@@ -1292,6 +1324,21 @@ class DriverRuntime:
                      "component": "worker"})
             except Exception:
                 pass
+        elif op == "prof":
+            # profiling plane: batched profile push from the worker —
+            # pure deque appends into the bounded ProfileStore
+            try:
+                self.profile_store.ingest(
+                    args[0],
+                    {"worker_id": ws.worker_id.hex()[:8],
+                     "node_id": self.node_id.hex()[:8],
+                     "component": "worker"})
+            except Exception:
+                pass
+        elif op == "stacks":
+            # live stack-dump reply (`ray_tpu stack` py-spy role)
+            self._stack_replies[ws.worker_id.binary()] = {
+                "ts": time.monotonic(), "stacks": args[0]}
         elif op == "free":
             # full free path (directory + store + CLUSTER publication):
             # a worker-initiated free must reach holder nodes too, or the
@@ -1493,9 +1540,11 @@ class DriverRuntime:
 
     def _ref_janitor_loop(self) -> None:
         """Bound unpin staleness on an otherwise-idle driver: __del__ only
-        queues; this drains every couple of seconds."""
+        queues; this drains every couple of seconds. Event.wait, not
+        time.sleep: the sampling profiler cannot see C-level sleeps, so a
+        time.sleep here would read as 2s of busy driver CPU per tick."""
         while not self._shutdown:
-            time.sleep(2.0)
+            self._janitor_wake.wait(2.0)
             try:
                 self._drain_deferred_unpins()
                 self._drain_local_pin_releases()
@@ -2229,12 +2278,27 @@ class DriverRuntime:
     # public API surface (driver)
     # ------------------------------------------------------------------
 
+    def _note_obj_meta(self, oid_b: bytes, owner: str,
+                       site: Optional[str] = None) -> None:
+        """Record creation metadata for `ray_tpu memory` forensics:
+        owner process, birth time, and (when the profiler is armed) the
+        creating call-site. Bounded FIFO; pure dict work."""
+        meta = self._obj_meta
+        meta[oid_b] = {"owner": owner, "ts": time.time(), "site": site}
+        while len(meta) > self._obj_meta_cap:
+            meta.popitem(last=False)
+
     def put(self, value: Any) -> ObjectRef:
         oid = ObjectID.from_random()
         from ray_tpu.core.object_ref import collect_serialized_refs
 
         with collect_serialized_refs() as nested:
             inline, size = self.store.put(oid, value)
+        from ray_tpu.util import profiling as _prof
+
+        self._note_obj_meta(
+            oid.binary(), "driver",
+            _prof.caller_site() if _prof.profiling_enabled() else None)
         # ref BEFORE publishing ready: the pin cast precedes obj_ready on
         # the same connection, so the directory never sees this entry
         # terminal-and-unpinned
@@ -2439,6 +2503,7 @@ class DriverRuntime:
         for b in ids:
             oid = ObjectID(b)
             self.gcs.drop_object(oid)
+            self._obj_meta.pop(b, None)
             self.store.delete(oid)
             if self.cluster is not None:
                 self.cluster.gcs.cast("obj_drop", b)
@@ -2478,6 +2543,65 @@ class DriverRuntime:
             comp = "raylet"
         self.trace_store.ingest(
             batch, {"node_id": self.node_id.hex()[:8], "component": comp})
+
+    def collect_profile_batches(self) -> None:
+        """Drain this PROCESS's sampler window into the runtime's
+        ProfileStore with origin labels — called at query time
+        (state.profile) and before each heartbeat ships profile deltas,
+        so driver/daemon samples join their workers' pushed batches."""
+        from ray_tpu.util import profiling
+
+        batches = profiling.drain_batches()
+        if not batches:
+            return
+        comp = "driver"
+        if self.cluster is not None and not self.cluster.is_scheduler:
+            comp = "raylet"
+        self.profile_store.ingest(
+            batches,
+            {"node_id": self.node_id.hex()[:8], "component": comp})
+
+    def dump_stacks(self, timeout: float = 2.0) -> Dict[str, dict]:
+        """Live python stacks of this process AND every live worker
+        (`ray_tpu stack` py-spy role): push a ``stackdump`` to each
+        worker, wait for the ``stacks`` reply casts, and merge with this
+        process's own ``sys._current_frames()`` walk. Workers that miss
+        the deadline are reported as pending."""
+        from ray_tpu.util import profiling
+
+        asked = []
+        t_req = time.monotonic()
+        with self.lock:
+            workers = list(self.workers.values())
+        for ws in workers:
+            if ws.status == "dead" or ws.conn is None:
+                continue
+            try:
+                ws.send(("stackdump",))
+                asked.append(ws.worker_id.binary())
+            except Exception:
+                pass
+        comp = "driver"
+        if self.cluster is not None and not self.cluster.is_scheduler:
+            comp = "raylet"
+        out = {f"{comp}/{os.getpid()}": profiling.current_stacks()}
+        deadline = time.monotonic() + timeout
+        pending = set(asked)
+        while pending and time.monotonic() < deadline:
+            for wid in list(pending):
+                rep = self._stack_replies.get(wid)
+                if rep is not None and rep["ts"] >= t_req:
+                    pending.discard(wid)
+            if pending:
+                profiling.idle_sleep(0.02)
+        for wid in asked:
+            rep = self._stack_replies.get(wid)
+            label = f"worker:{wid.hex()[:8]}"
+            if rep is not None and rep["ts"] >= t_req:
+                out[label] = rep["stacks"]
+            else:
+                out[label] = {"<pending>": "no reply within timeout"}
+        return out
 
     def shutdown(self):
         from ray_tpu.core import object_ref as _object_ref

@@ -115,6 +115,10 @@ class WorkerRuntime:
         # rate-limited like the metric delta push
         self._trace_last_push = 0.0
         self._trace_interval: Optional[float] = None
+        # profiling plane (sender side): the sampler's aggregated window
+        # rides the pipe as batched casts on the same cadence pattern
+        self._profile_last_push = 0.0
+        self._profile_interval: Optional[float] = None
         try:
             from ray_tpu import config as _cfg
 
@@ -251,6 +255,27 @@ class WorkerRuntime:
                         # the last interval's spans (the end of the
                         # traced workload) must not strand here
                         self._push_spans_now()
+            elif kind == "prof":
+                # profiling plane: driver-pushed mid-session arm/disarm —
+                # apply_remote starts/stops this process's sampler
+                from ray_tpu.util import profiling
+
+                if msg[1] is not None:
+                    profiling.apply_remote(msg[1])
+                    if not msg[1].get("enabled"):
+                        # disarm: ship the table's tail NOW (the push
+                        # loop stops looking once profiling is off)
+                        self._push_profile_now()
+            elif kind == "stackdump":
+                # live stack request (`ray_tpu stack` py-spy role): walk
+                # sys._current_frames on THIS receiver thread (pure
+                # frame-graph reads, no locks) and cast the reply back
+                from ray_tpu.util import profiling
+
+                try:
+                    self.cast("stacks", profiling.current_stacks())
+                except Exception:
+                    pass
             elif kind == "shutdown":
                 os._exit(0)
 
@@ -300,8 +325,18 @@ class WorkerRuntime:
         # (advisor r3: results/puts previously leaked this pin)
         with collect_serialized_refs() as nested:
             inline, size = self.store.put(obj_id, value)
-        self.cast("put", obj_id.binary(), inline, size,
-                  list(nested) or None)
+        # creation call-site for `ray_tpu memory` forensics rides the
+        # existing cast, captured only while the profiler is armed
+        from ray_tpu.util import profiling
+
+        site = (profiling.caller_site()
+                if profiling.profiling_enabled() else None)
+        if site is None:
+            self.cast("put", obj_id.binary(), inline, size,
+                      list(nested) or None)
+        else:
+            self.cast("put", obj_id.binary(), inline, size,
+                      list(nested) or None, site)
         return ObjectRef(obj_id)
 
     def put_parts(self, data: bytes, buffers) -> ObjectRef:
@@ -1011,6 +1046,44 @@ class WorkerRuntime:
         except Exception:
             pass
 
+    def _maybe_push_profile(self) -> None:
+        """Drain this process's profile table to the driver as a batched
+        cast, rate-limited (the profile twin of _maybe_push_spans). One
+        dict get when profiling is disarmed; also the lazy start point
+        for the sampler in env-armed workers (zygote children restart
+        theirs here after fork)."""
+        from ray_tpu.util import profiling
+
+        if not profiling.profiling_enabled():
+            return
+        profiling.ensure_sampler()
+        now = time.monotonic()
+        if self._profile_interval is None:
+            try:
+                from ray_tpu import config as _cfg
+
+                self._profile_interval = float(
+                    _cfg.get("profile_push_interval_s"))
+            except Exception:
+                self._profile_interval = 1.0
+        if now - self._profile_last_push < self._profile_interval:
+            return
+        self._profile_last_push = now
+        self._push_profile_now()
+
+    def _push_profile_now(self) -> None:
+        """Drain the table and ship it as one cast — THE profile-push
+        hop, shared by the rate-limited loop and the disarm tail flush."""
+        from ray_tpu.util import profiling
+
+        try:
+            batches = profiling.drain_batches()
+            if batches:
+                self.cast("prof", batches)
+                profiling.note_push()
+        except Exception:
+            pass
+
     def main_loop(self):
         self._start_receiver()
         self._send(("ready",))
@@ -1024,10 +1097,12 @@ class WorkerRuntime:
                 self._drain_ref_drops()
                 self._maybe_push_metrics()
                 self._maybe_push_spans()
+                self._maybe_push_profile()
                 continue
             self._drain_ref_drops()
             self._maybe_push_metrics()
             self._maybe_push_spans()
+            self._maybe_push_profile()
             conc = (self.actor_concurrency.get(spec.get("actor_id", b""), 1)
                     if spec["type"] == ts.ACTOR_METHOD else 1)
             if (spec["type"] == ts.ACTOR_METHOD

@@ -14,6 +14,11 @@ which is forbidden — it is threaded and jax-laden):
 
 - the zygote is SINGLE-THREADED at every fork (requests are served from a
   select() loop; child reaping is WNOHANG polling, not a reaper thread);
+  this includes the observability planes: the zygote never arms the
+  tracing or profiling modules (a sampler thread here would make every
+  fork unsafe), and ``util/profiling.py``'s at-fork hook resets the
+  child's sampler handle so an armed worker restarts its own sampler
+  from its main loop after the fork;
 - it never imports jax or user code, so no locks, no CUDA/TPU handles;
 - each child closes the zygote's control fds, redirects stdio to its own
   log file, and then runs the exact same ``worker.main`` that an exec'd

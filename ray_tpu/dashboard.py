@@ -73,6 +73,20 @@ class _Handler(BaseHTTPRequestHandler):
             "/api/summary/tasks": st.summarize_tasks,
             "/api/summary/actors": st.summarize_actors,
             "/api/summary/objects": st.summarize_objects,
+            # profiling plane (cluster-wide sampling profiler; ?seconds=
+            # arms a temporary window, ?fmt=speedscope|collapsed picks
+            # the export; default = merged summary with top_self)
+            "/api/profile": lambda: _profile_route(st, _p),
+            # live cluster-wide python stacks (`ray_tpu stack` py-spy
+            # role; needs no arming)
+            "/api/stack": lambda: st.stack(
+                timeout=float(_p("timeout", 3.0))),
+            # object-memory forensics (`ray_tpu memory` analog)
+            "/api/memory": lambda: st.memory_summary(
+                limit=int(_p("limit", 10000)),
+                min_size=int(_p("min_size", 0))),
+            # arena occupancy/fragmentation report (native store)
+            "/api/store": st.store_report,
             # task-lifecycle flight recorder (recent per-phase records)
             "/api/task_events": st.list_task_events,
             # lock-contention profiler (this process's hot locks)
@@ -199,6 +213,19 @@ class _Handler(BaseHTTPRequestHandler):
                              {"result": serve_rest.serve_rest_delete()})
         except Exception as e:  # noqa: BLE001
             self._json_reply(500, {"error": str(e)})
+
+
+def _profile_route(st, _p):
+    """GET /api/profile: seconds (temporary arming window), component
+    filter, fmt=summary|collapsed|speedscope."""
+    seconds = _p("seconds")
+    seconds = float(seconds) if seconds is not None else None
+    fmt = _p("fmt", "summary")
+    if fmt == "speedscope":
+        return st.export_speedscope(seconds=seconds)
+    if fmt == "collapsed":
+        return st.profile_collapsed(seconds=seconds)
+    return st.profile(seconds=seconds, component=_p("component"))
 
 
 def _jobs_list():

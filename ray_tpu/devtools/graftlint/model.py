@@ -88,6 +88,7 @@ def _load_rule_modules() -> None:
         rules_layering,
         rules_locks,
         rules_meta,
+        rules_profiling,
         rules_tracing,
     )
 

@@ -1,0 +1,155 @@
+"""Profiling-plane discipline rules (family ``invariants``).
+
+The sampling profiler (ISSUE 9, ``util/profiling.py``) observes every
+instrumented runtime path from a background thread at ~67 Hz. That only
+stays safe while the sampler is a pure OBSERVER: if its loop acquired a
+TimedLock/TimedRLock-wrapped runtime lock it could deadlock against the
+very contention it exists to measure; if it hit a failpoint it could
+fire chaos inside the sampler; if it recorded spans it would recurse
+into the instrumented tracing path and profile itself. This rule makes
+that contract lexical.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set
+
+from ray_tpu.devtools.graftlint.engine import Project, dotted_parts
+from ray_tpu.devtools.graftlint.model import (
+    FAMILY_INVARIANTS,
+    Finding,
+    Rule,
+    register,
+)
+
+#: function names treated as the sampler's code path wherever they live
+_SAMPLER_FUNCS = {"_sample_loop", "_sample_once"}
+#: callables whose result is an instrumented (timed) lock
+_TIMED_FACTORIES = {"timed_lock", "timed_rlock", "TimedLock", "TimedRLock"}
+#: span-recording entry points of the tracing plane
+_SPAN_FNS = {"span", "manual_span", "record_span"}
+
+
+def _timed_lock_attrs(tree: ast.AST) -> Set[str]:
+    """Attribute/variable names assigned from a timed-lock factory
+    anywhere in the module (``self.lock = timed_lock(...)``,
+    ``LOCK = TimedRLock(...)``)."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or \
+                not isinstance(node.value, ast.Call):
+            continue
+        parts = dotted_parts(node.value.func)
+        if not parts or parts[-1] not in _TIMED_FACTORIES:
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Attribute):
+                out.add(t.attr)
+            elif isinstance(t, ast.Name):
+                out.add(t.id)
+    return out
+
+
+def _sampler_scopes(tree: ast.AST) -> List[ast.AST]:
+    """Function bodies that ARE the sampler: ``_sample_loop`` /
+    ``_sample_once`` anywhere, plus every method of a class whose name
+    contains ``Sampler``."""
+    scopes: List[ast.AST] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and "Sampler" in node.name:
+            scopes.extend(
+                n for n in node.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name in _SAMPLER_FUNCS:
+            scopes.append(node)
+    return scopes
+
+
+def _lock_target(expr: ast.AST) -> Optional[str]:
+    """The lock name when ``expr`` is ``self.X`` / bare ``X`` (with-item
+    or ``.acquire()`` receiver), else None."""
+    parts = dotted_parts(expr)
+    if not parts:
+        return None
+    if parts[0] == "self" and len(parts) == 2:
+        return parts[1]
+    if len(parts) == 1:
+        return parts[0]
+    return None
+
+
+@register
+class ProfilerSamplerDiscipline(Rule):
+    name = "profiler-sampler-discipline"
+    family = FAMILY_INVARIANTS
+    summary = ("the sampling profiler's loop (_sample_loop/_sample_once "
+               "and *Sampler* methods) stays observer-only: it may not "
+               "acquire TimedLock/TimedRLock-wrapped locks, hit "
+               "failpoints, or record tracing spans — it must never "
+               "deadlock against or recurse into the instrumented paths "
+               "it measures")
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for mod in project.modules:
+            timed = _timed_lock_attrs(mod.tree)
+            seen_lines: Set[int] = set()
+            for scope in _sampler_scopes(mod.tree):
+                for node in ast.walk(scope):
+                    for f in self._check_node(mod, node, timed):
+                        if f.line not in seen_lines:
+                            seen_lines.add(f.line)
+                            yield f
+
+    def _check_node(self, mod, node: ast.AST,
+                    timed: Set[str]) -> Iterator[Finding]:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                lk = _lock_target(item.context_expr)
+                if lk and lk in timed:
+                    yield self.finding(
+                        mod, node.lineno,
+                        f"sampler loop acquires timed lock '{lk}' — the "
+                        "profiler must stay observer-only (a "
+                        "TimedLock/TimedRLock here can deadlock against "
+                        "the contention it measures and records "
+                        "rtpu_lock_* metrics from inside the sampler); "
+                        "use a plain threading.Lock private to the "
+                        "sampler")
+            return
+        if not isinstance(node, ast.Call):
+            return
+        parts = dotted_parts(node.func)
+        if not parts:
+            return
+        if parts[-1] == "acquire":
+            lk = _lock_target(node.func.value) \
+                if isinstance(node.func, ast.Attribute) else None
+            if lk and lk in timed:
+                yield self.finding(
+                    mod, node.lineno,
+                    f"sampler loop calls {lk}.acquire() on a timed "
+                    "lock — observer-only discipline (see "
+                    "profiler-sampler-discipline)")
+        elif parts[-1] == "hit" and (len(parts) == 1
+                                     or parts[-2] == "failpoints"):
+            yield self.finding(
+                mod, node.lineno,
+                "sampler loop hits a failpoint site — the chaos plane "
+                "must never fire inside the profiler (a delay/raise "
+                "here stalls or kills sampling for the whole process)")
+        elif parts[-1] in _SPAN_FNS and len(parts) >= 2 \
+                and parts[-2] == "tracing":
+            yield self.finding(
+                mod, node.lineno,
+                f"sampler loop records a tracing {parts[-1]}() — the "
+                "profiler would recurse into the instrumented trace "
+                "path and profile itself; profile data leaves via "
+                "drain_batches(), not spans")
+        elif parts[-1] in _TIMED_FACTORIES:
+            yield self.finding(
+                mod, node.lineno,
+                f"sampler loop constructs {parts[-1]}() — sampler-"
+                "private locks must be plain threading.Lock "
+                "(observer-only discipline)")

@@ -100,12 +100,30 @@ def _cmd_timeline(args) -> int:
     return 0
 
 
+def _fetch_api(url: str, path: str):
+    import urllib.request
+
+    with urllib.request.urlopen(url.rstrip("/") + path,
+                                timeout=120) as resp:
+        return json.loads(resp.read()).get("result")
+
+
 def _cmd_memory(args) -> int:
-    """Object-store refcount dump (reference ``ray memory`` role). With
-    --address, dumps the cluster GCS object directory; otherwise dumps the
-    in-process driver's view (requires an active session)."""
+    """Object-memory forensics (reference ``ray memory`` role): every
+    live object with size, owner, pin count + reasons, age, and the
+    creating call-site when the profiler was armed. Three sources:
+    --url fetches a running head's ``/api/memory``; --address dumps the
+    cluster GCS object directory; otherwise the in-process driver's
+    forensic view (requires an active session)."""
     rows = None
-    if args.address:
+    report = None
+    if getattr(args, "url", None):
+        rows = _fetch_api(args.url, f"/api/memory?limit={args.limit}")
+        try:
+            report = _fetch_api(args.url, "/api/store")
+        except Exception:
+            report = None
+    elif args.address:
         from ray_tpu.cluster.rpc import RpcClient
 
         cli = RpcClient(args.address, args.authkey.encode())
@@ -117,21 +135,90 @@ def _cmd_memory(args) -> int:
         import ray_tpu
 
         if not ray_tpu.is_initialized():
-            print("no active session; pass --address <gcs> --authkey <key> "
-                  "to inspect a cluster, or run inside a driver")
+            print("no active session; pass --url http://<head>:8265 or "
+                  "--address <gcs> --authkey <key>, or run inside a "
+                  "driver")
             return 1
-        from ray_tpu.util.state import list_objects
+        from ray_tpu.util.state import memory_summary, store_report
 
-        rows = [dict(r, pins="-", locations="-")
-                for r in list_objects()[:args.limit]]
+        rows = memory_summary(limit=args.limit)
+        report = store_report()
     total = sum(r["size"] or 0 for r in rows)
     print(f"{'OBJECT_ID':34} {'STATUS':8} {'SIZE':>12} {'PINS':>5} "
-          f"{'LOCS':>5}")
+          f"{'AGE_S':>8} {'OWNER':16} REASONS")
     for r in sorted(rows, key=lambda r: -(r["size"] or 0)):
+        reasons = ",".join(r.get("reasons") or ()) or "-"
+        if r.get("call_site"):
+            reasons += f"  @ {r['call_site']}"
+        age = r.get("age_s")
         print(f"{r['object_id'][:32]:34} {r['status']:8} "
               f"{r['size'] or 0:>12} {r.get('pins', '-'):>5} "
-              f"{r.get('locations', '-'):>5}")
+              f"{age if age is not None else '-':>8} "
+              f"{str(r.get('owner', '-'))[:16]:16} {reasons}")
     print(f"-- {len(rows)} objects, {total / 1e6:.1f} MB total")
+    if report:
+        frag = (f", fragmentation {report['fragmentation_pct']}% "
+                f"(largest free {report.get('largest_free_bytes', 0) >> 20}"
+                f" MiB over {report.get('free_blocks', '?')} blocks)"
+                if "fragmentation_pct" in report else "")
+        print(f"store[{report['backend']}]: "
+              f"{report.get('arena_used_bytes', 0) >> 20} MiB in arena, "
+              f"{report['file_segment_bytes'] >> 20} MiB file segments, "
+              f"{report['spill_dir_bytes'] >> 20} MiB spilled{frag}")
+    return 0
+
+
+def _cmd_profile(args) -> int:
+    """Cluster-wide CPU profile (the profiling plane): sample for
+    --seconds (arming temporarily if needed) and write speedscope JSON /
+    collapsed stacks, or print the merged top-self summary. --url runs
+    against a running head's ``/api/profile`` — no in-process session
+    needed."""
+    fmt = ("speedscope" if (args.output or "").endswith(".json")
+           else args.fmt)
+    if args.url:
+        q = f"/api/profile?fmt={fmt}"
+        if args.seconds is not None:
+            q += f"&seconds={args.seconds}"
+        doc = _fetch_api(args.url, q)
+    else:
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            print("no active session; pass --url http://<head>:8265 to "
+                  "profile a running head")
+            return 1
+        from ray_tpu.util import state
+
+        if fmt == "speedscope":
+            doc = state.export_speedscope(seconds=args.seconds)
+        elif fmt == "collapsed":
+            doc = state.profile_collapsed(seconds=args.seconds)
+        else:
+            doc = state.profile(seconds=args.seconds)
+    if args.output:
+        with open(args.output, "w") as f:
+            if isinstance(doc, str):
+                f.write(doc)
+            else:
+                json.dump(doc, f)
+        print(f"wrote {args.output} — open at https://speedscope.app"
+              if fmt == "speedscope" else f"wrote {args.output}")
+        return 0
+    if isinstance(doc, str):
+        print(doc)
+    elif fmt == "summary":
+        print(f"{doc['total_samples']} samples "
+              f"({doc['idle_samples']} idle) across "
+              f"{len(doc['processes'])} processes")
+        for comp, top in sorted(
+                (doc.get("top_self_by_component") or {}).items()):
+            print(f"[{comp}] top self-time:")
+            for row in top[:10]:
+                print(f"  {row['self_pct']:5.1f}%  "
+                      f"{row['self_samples']:>6}  {row['function']}")
+    else:
+        print(json.dumps(doc, indent=1))
     return 0
 
 
@@ -210,12 +297,26 @@ def _cmd_serve(args) -> int:
 
 
 def _cmd_stack(args) -> int:
-    """Dump python stacks of every live ray_tpu worker (reference
-    ``ray stack``, scripts.py:1830 — py-spy there, SIGUSR1+faulthandler
-    here: workers register the handler at startup and append to their
-    session log)."""
+    """Dump python stacks of every live ray_tpu process (reference
+    ``ray stack``, scripts.py:1830 — the py-spy role). With --url, a
+    LIVE cluster-wide dump through the profiling plane: the head walks
+    its own threads, pulls every worker over the control pipes, and
+    fans a GCS pubsub stack request to every daemon (and ITS workers).
+    Without, the local fallback: SIGUSR1+faulthandler into the session
+    logs (works with no dashboard, even on wedged drivers)."""
     import signal
     import time
+
+    if getattr(args, "url", None):
+        dump = _fetch_api(args.url, "/api/stack")
+        for node, procs in sorted((dump or {}).items()):
+            for proc, threads in sorted(procs.items()):
+                print(f"\n==== node {node} · {proc} ====")
+                for tname, stack in sorted(threads.items()):
+                    print(f"-- {tname}")
+                    for frame in stack.split(";"):
+                        print(f"   {frame}")
+        return 0
 
     signaled = []
     for pid_dir in os.listdir("/proc"):
@@ -305,16 +406,38 @@ def main(argv=None) -> int:
                          "dashboard (http://host:8265) instead of an "
                          "in-process session")
 
-    mem = sub.add_parser("memory", help="object-store refcount dump "
+    mem = sub.add_parser("memory", help="object-memory forensics "
                                         "(reference `ray memory` role)")
     mem.add_argument("--address", default=None,
                      help="GCS address host:port (cluster mode)")
     mem.add_argument("--authkey", default="",
                      help="cluster authkey (with --address)")
+    mem.add_argument("--url", default=None,
+                     help="fetch from a running head's dashboard "
+                          "(http://host:8265) instead of in-process")
     mem.add_argument("--limit", type=int, default=10000)
 
-    st = sub.add_parser("stack", help="dump python stacks of live workers")
+    st = sub.add_parser("stack", help="dump python stacks of live "
+                                      "ray_tpu processes (py-spy role)")
     st.add_argument("--limit", type=int, default=16)
+    st.add_argument("--url", default=None,
+                    help="live cluster-wide dump via a running head's "
+                         "dashboard (http://host:8265); default: local "
+                         "SIGUSR1 into session logs")
+
+    prof = sub.add_parser("profile",
+                          help="cluster-wide sampling profile "
+                               "(flamegraph/speedscope export)")
+    prof.add_argument("--seconds", type=float, default=2.0,
+                      help="sampling window; arms the profiler "
+                           "temporarily when not already armed")
+    prof.add_argument("--output", "-o", default=None,
+                      help="write here (.json => speedscope)")
+    prof.add_argument("--fmt", default="summary",
+                      choices=["summary", "speedscope", "collapsed"])
+    prof.add_argument("--url", default=None,
+                      help="profile a running head via its dashboard "
+                           "(http://host:8265)")
 
     up = sub.add_parser("up", help="launch a cluster from a yaml "
                                    "(reference `ray up` role)")
@@ -371,6 +494,8 @@ def main(argv=None) -> int:
         return _cmd_memory(args)
     if args.cmd == "stack":
         return _cmd_stack(args)
+    if args.cmd == "profile":
+        return _cmd_profile(args)
     if args.cmd == "up":
         from ray_tpu.autoscaler import launcher
 

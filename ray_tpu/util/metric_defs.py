@@ -199,7 +199,8 @@ _def("rtpu_gcs_pubsub_messages_total", "counter",
      "subscriber per publish)", tag_keys=("channel",), component="gcs")
 _def("rtpu_gcs_table_size", "gauge",
      "GCS table entry counts (objects/nodes/actors/kv/functions/pgs/"
-     "task_events/trace_events/free_candidates/tombstones; sampled)",
+     "task_events/trace_events/profile_events/free_candidates/"
+     "tombstones; sampled)",
      tag_keys=("table",), component="gcs")
 _def("rtpu_gcs_nodes_alive", "gauge",
      "cluster nodes currently alive (sampled)", component="gcs")
@@ -277,6 +278,22 @@ _def("rtpu_trace_spans_dropped_total", "counter",
 _def("rtpu_trace_push_batches_total", "counter",
      "span batches shipped toward the head (worker control-pipe pushes "
      "+ node heartbeat rides)", component="tracing")
+
+# ---------------------------------------------------------------------------
+# profiling plane (util/profiling.py)
+# ---------------------------------------------------------------------------
+
+_def("rtpu_profile_samples_total", "counter",
+     "stack samples aggregated into this process's profile table "
+     "(busy + idle; 0 unless RTPU_PROFILING armed)",
+     component="profiling")
+_def("rtpu_profile_samples_dropped_total", "counter",
+     "samples dropped because the bounded profile table was full of "
+     "unique stacks (raise RTPU_PROFILE_TABLE_MAX or shorten the push "
+     "interval)", component="profiling")
+_def("rtpu_profile_push_batches_total", "counter",
+     "profile batches shipped toward the head (worker control-pipe "
+     "pushes + node heartbeat rides)", component="profiling")
 
 # ---------------------------------------------------------------------------
 # lock contention profiler (util/contention.py)

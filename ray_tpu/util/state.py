@@ -156,6 +156,179 @@ def list_spans(limit: int = 10000,
     return _apply_filters(rt.trace_store.snapshot(int(limit)), filters)
 
 
+def _collect_profile_batches(rt) -> List[Dict[str, Any]]:
+    """Every collected profile batch visible from this head: the local
+    ProfileStore (this process's sampler + its workers' pushes) plus —
+    in cluster mode — the GCS buffer (every node's heartbeat-shipped
+    deltas; the local store is the superset of what this node shipped,
+    so GCS batches from OUR node id are dropped to avoid double counts)."""
+    try:
+        rt.collect_profile_batches()
+    except Exception:
+        pass
+    local = rt.profile_store.snapshot()
+    if rt.cluster is None:
+        return local
+    me = rt.node_id.hex()[:8]
+    out = list(local)
+    try:
+        evs = rt.cluster.gcs.call("profile_events_get", 4096, timeout=10)
+        for b in evs or ():
+            if b.get("node_id") != me:
+                out.append(b)
+    except Exception:
+        pass
+    return out
+
+
+def _sample_window(rt, seconds: Optional[float]) -> Dict[str, Any]:
+    """THE shared arm→sample→disarm→collect sequence behind profile(),
+    profile_collapsed() and export_speedscope().
+
+    With ``seconds``: arm cluster-wide if not already armed (disarming
+    again after — the disarm tail-flushes worker tables over the pipe),
+    idle-sleep the window, then poll collection until the merged
+    PROCESS SET stops growing (two stable polls after a minimum settle)
+    — breaking on the first busy sample would return just the head's
+    own instantly-available batch while worker casts and daemon
+    heartbeat rides are still in flight. All waits are idle-typed so
+    the query never profiles itself."""
+    import time as _time
+
+    from ray_tpu.util import profiling
+
+    if seconds is None:
+        return profiling.merge_batches(_collect_profile_batches(rt))
+    since = _time.time()
+    armed_here = not profiling.profiling_enabled()
+    if armed_here:
+        profiling.enable_profiling()
+    profiling.idle_sleep(float(seconds))
+    if armed_here:
+        profiling.disable_profiling()
+    deadline = _time.monotonic() + 8.0
+    # minimum settle: one worker push interval + one heartbeat, so the
+    # window's tail batches have a chance to land before stability can
+    # possibly be declared
+    min_wait = _time.monotonic() + 1.5
+    prev_keys = None
+    merged = profiling.merge_batches([])
+    while _time.monotonic() < deadline:
+        merged = profiling.merge_batches(
+            _collect_profile_batches(rt), since=since)
+        keys = frozenset(merged["processes"])
+        if keys and keys == prev_keys and _time.monotonic() >= min_wait:
+            break
+        prev_keys = keys
+        profiling.idle_sleep(0.4)
+    return merged
+
+
+def profile(seconds: Optional[float] = None,
+            component: Optional[str] = None,
+            top_n: int = 20) -> Dict[str, Any]:
+    """Cluster-wide merged CPU profile (the profiling plane's query
+    surface; ``GET /api/profile``).
+
+    With ``seconds``: sample for that window — arming the profiler
+    cluster-wide for the duration if it isn't already armed
+    (``enable_profiling()`` semantics; disarmed again after) — then
+    merge every process's batches whose window overlaps it. Without:
+    merge everything collected since arming.
+
+    Returns per-(node, pid, component) sample totals plus ``top_self``
+    rankings (leaf-frame self-time — "which functions burn the CPU"),
+    overall and per component. The ``driver`` component's ranking is the
+    direct input to ROADMAP item 1 (the GIL-serialized control plane)."""
+    from ray_tpu.util import profiling
+
+    rt = _gcs()
+    merged = _sample_window(rt, seconds)
+    components = sorted({p["component"]
+                         for p in merged["processes"].values()})
+    out: Dict[str, Any] = {
+        "seconds": seconds,
+        "total_samples": merged["total"],
+        "idle_samples": merged["idle_total"],
+        "dropped_samples": merged["dropped"],
+        "processes": {
+            k: {"component": p["component"], "node_id": p["node_id"],
+                "pid": p["pid"], "samples": p["total"],
+                "idle_samples": p["idle_total"],
+                "threads": sorted(p["threads"])}
+            for k, p in sorted(merged["processes"].items())},
+        "top_self": profiling.top_self(merged, component=component,
+                                       n=top_n),
+        "top_self_by_component": {
+            c: profiling.top_self(merged, component=c, n=top_n)
+            for c in components},
+    }
+    return out
+
+
+def profile_collapsed(seconds: Optional[float] = None,
+                      include_idle: bool = False) -> str:
+    """Collapsed-stack text (``proc;thread;frames... N``) for
+    flamegraph.pl or a speedscope paste — the raw export twin of
+    :func:`profile`."""
+    from ray_tpu.util import profiling
+
+    merged = _sample_window(_gcs(), seconds)
+    return profiling.collapsed_text(merged, include_idle=include_idle)
+
+
+def export_speedscope(filename: Optional[str] = None,
+                      seconds: Optional[float] = None) -> Dict[str, Any]:
+    """Speedscope JSON document of the merged cluster profile (one
+    sampled profile per thread, weights summing to its sample count).
+    Write to ``filename`` and open it at https://speedscope.app."""
+    from ray_tpu.util import profiling
+
+    merged = _sample_window(_gcs(), seconds)
+    doc = profiling.speedscope_doc(merged)
+    if filename:
+        import json
+
+        with open(filename, "w") as f:
+            json.dump(doc, f)
+    return doc
+
+
+def stack(timeout: float = 3.0) -> Dict[str, Any]:
+    """LIVE python stacks of every process in the cluster (the
+    ``ray_tpu stack`` / ``ray stack`` py-spy role): this head and its
+    workers over the control pipes; in cluster mode every daemon (and
+    ITS workers) via a GCS ``profiling``-channel stack request. Needs no
+    arming. Returns ``{node: {proc: {thread: "root;...;leaf"}}}``."""
+    import time as _time
+
+    rt = _gcs()
+    me = rt.node_id.hex()[:8]
+    out: Dict[str, Any] = {}
+    if rt.cluster is not None:
+        try:
+            req = rt.cluster.gcs.call("stack_request", timeout=10)
+            deadline = _time.monotonic() + timeout
+            want = max(1, len([n for n in rt.cluster.node_info()
+                               if n.get("alive", n.get("Alive"))]))
+            replies: Dict[str, Any] = {}
+            while _time.monotonic() < deadline:
+                replies = rt.cluster.gcs.call("stack_collect", req,
+                                              timeout=10) or {}
+                if len(replies) >= want:
+                    break
+                from ray_tpu.util import profiling as _prof
+
+                _prof.idle_sleep(0.2)
+            out.update(replies)
+        except Exception:
+            pass
+    if me not in out:
+        # single-node mode, or the head's own pubsub reply lost the race
+        out[me] = rt.dump_stacks(timeout=min(2.0, timeout))
+    return out
+
+
 def summarize_critical_path(trace_id: Optional[str] = None,
                             limit: int = 5000) -> Dict[str, Any]:
     """Attribute end-to-end wall time to per-process segments.
@@ -265,6 +438,128 @@ def summarize_objects() -> Dict[str, Any]:
         "in_plasma": sum(1 for o in objs if o["in_plasma"]),
         "inline": sum(1 for o in objs if not o["in_plasma"]),
     }
+
+
+# ---------------------------------------------------------------------------
+# object-memory forensics (`ray_tpu memory` — reference `ray memory` role)
+# ---------------------------------------------------------------------------
+
+
+def _pin_indexes(rt):
+    """One pass over the driver's reference machinery: (pin counts,
+    arg-pinned set, nested-pinned set) snapshotted under the ref lock so
+    the per-object reason lookup below is O(1)."""
+    with rt._ref_lock:
+        pins = dict(rt._pin_total)
+        arg_pinned = {b for deps in rt._arg_pins.values() for b in deps}
+        nested_pinned = {b for nested in rt._result_ref_pins.values()
+                         for b in nested}
+    return pins, arg_pinned, nested_pinned
+
+
+def _pin_reasons(rt, oid_b: bytes, pins, arg_pinned,
+                 nested_pinned) -> List[str]:
+    """Why an object is alive: ``create-ref`` (live ObjectRef pins —
+    driver-local or worker borrows), ``arg-pin`` (argument of an
+    in-flight task), ``nested-pin`` (referenced inside another stored
+    object), ``lineage`` (reconstructable: its producing task spec is
+    retained), ``spilled`` (bytes on disk, not shm)."""
+    reasons = []
+    if pins.get(oid_b, 0) > 0:
+        reasons.append("create-ref")
+    if oid_b in arg_pinned:
+        reasons.append("arg-pin")
+    if oid_b in nested_pinned:
+        reasons.append("nested-pin")
+    if oid_b in rt._lineage:
+        reasons.append("lineage")
+    try:
+        from ray_tpu.core.ids import ObjectID as _OID
+
+        if rt.store.contains_spilled(_OID(oid_b)):
+            reasons.append("spilled")
+    except Exception:
+        pass
+    return reasons
+
+
+def memory_summary(limit: int = 10000,
+                   min_size: int = 0) -> List[Dict[str, Any]]:
+    """Per-object forensic rows (the ``ray memory`` analog): id, status,
+    size, inline-vs-shm, owner process, pin count + reasons, age, and —
+    when the profiler was armed at creation — the creating call-site.
+    Largest first."""
+    import time as _time
+
+    rt = _gcs()
+    now = _time.time()
+    pins, arg_pinned, nested_pinned = _pin_indexes(rt)
+    rows = []
+    for oid, st in rt.gcs.all_objects():
+        size = st.size or 0
+        if size < min_size:
+            continue
+        b = oid.binary()
+        meta = rt._obj_meta.get(b) or {}
+        rows.append({
+            "object_id": oid.hex(),
+            "status": st.status,
+            "size": size,
+            "in_plasma": st.inline is None,
+            "owner_node": rt.node_id.hex()[:8],
+            "owner": meta.get("owner") or "?",
+            "pins": pins.get(b, 0),
+            "reasons": _pin_reasons(rt, b, pins, arg_pinned,
+                                    nested_pinned),
+            "age_s": (round(now - meta["ts"], 1)
+                      if meta.get("ts") else None),
+            "call_site": meta.get("site"),
+        })
+    rows.sort(key=lambda r: -r["size"])
+    return rows[:limit]
+
+
+#: last snapshot taken by snapshot_objects()/diff_objects() (the leak-
+#: detector baseline)
+_obj_snapshot: Optional[Dict[str, Dict[str, Any]]] = None
+
+
+def snapshot_objects() -> Dict[str, Dict[str, Any]]:
+    """Record (and return) the current object population as the baseline
+    for :func:`diff_objects` — call before the workload under suspicion."""
+    global _obj_snapshot
+    _obj_snapshot = {r["object_id"]: r for r in memory_summary()}
+    return _obj_snapshot
+
+
+def diff_objects(prev: Optional[Dict[str, Dict[str, Any]]] = None
+                 ) -> Dict[str, Any]:
+    """Leak detector: diff the live object population against ``prev``
+    (default: the last :func:`snapshot_objects` baseline). Objects that
+    appeared and are still pinned are the leak suspects — each row
+    carries its pin reasons and creation call-site (when the profiler
+    was armed), which is what names the leaker."""
+    global _obj_snapshot
+    if prev is None:
+        prev = _obj_snapshot or {}
+    cur = {r["object_id"]: r for r in memory_summary()}
+    _obj_snapshot = cur
+    added = [r for oid, r in cur.items() if oid not in prev]
+    removed = [r for oid, r in prev.items() if oid not in cur]
+    leaked = [r for r in added if r["pins"] > 0 or r["reasons"]]
+    return {
+        "added": added,
+        "removed": removed,
+        "leak_suspects": sorted(leaked, key=lambda r: -r["size"]),
+        "net_bytes": (sum(r["size"] for r in added)
+                      - sum(r["size"] for r in removed)),
+    }
+
+
+def store_report() -> Dict[str, Any]:
+    """This node's object-store occupancy/fragmentation report (native
+    arena free-list walk + file segments + spill dir)."""
+    return _gcs().store.report()
 
 
 def _apply_filters(records: List[Dict[str, Any]],

@@ -1,0 +1,31 @@
+"""Positive fixture: a sampler loop that violates observer-only
+discipline — acquires a timed lock, hits a failpoint, records a span,
+and constructs a timed lock inside the loop."""
+
+import threading
+
+from ray_tpu.util import failpoints, tracing
+from ray_tpu.util.contention import timed_lock
+
+
+class StackSampler:
+    def __init__(self):
+        self.table_lock = timed_lock("sampler.table")
+        self._stop = threading.Event()
+
+    def _sample_once(self):
+        failpoints.hit("sampler.tick")
+        with tracing.span("profiling.demo::sample"):
+            pass
+        with self.table_lock:
+            pass
+
+    def _sample_loop(self):
+        extra = timed_lock("sampler.extra")
+        while not self._stop.is_set():
+            self.table_lock.acquire()
+            try:
+                self._sample_once()
+            finally:
+                self.table_lock.release()
+        return extra

@@ -1,0 +1,35 @@
+"""Negative fixture: an observer-only sampler — plain private lock,
+no failpoints, no spans; timed locks exist in the module but only
+NON-sampler code touches them."""
+
+import threading
+
+from ray_tpu.util.contention import timed_lock
+
+
+class StackSampler:
+    def __init__(self):
+        self._table_lock = threading.Lock()  # plain: sampler-private
+        self._table = {}
+        self._stop = threading.Event()
+
+    def _sample_once(self):
+        with self._table_lock:
+            self._table["k"] = self._table.get("k", 0) + 1
+
+    def _sample_loop(self):
+        while not self._stop.is_set():
+            self._sample_once()
+            self._stop.wait(0.015)
+
+
+class Runtime:
+    """Instrumented runtime code MAY use timed locks — only the
+    sampler's own scope is constrained."""
+
+    def __init__(self):
+        self.lock = timed_lock("driver.lock")
+
+    def dispatch(self):
+        with self.lock:
+            return 1

@@ -1474,3 +1474,73 @@ def test_trace_spans_cross_processes_and_nodes(cluster):
         tracing._reset_for_tests()
         import os as _os
         _os.environ.pop("RTPU_TRACING", None)
+
+
+def test_profile_merges_nodes_and_pids_with_components(cluster):
+    """ISSUE 9 acceptance: one state.profile() merge contains stacks
+    from >= 2 nodes and >= 3 pids with correct component labels —
+    worker batches over control-pipe pushes, the daemon's own sampler
+    window over GCS-heartbeat ProfileStore deltas, the head's locally.
+    Armed MID-SESSION, so the daemon (booted un-armed) must learn via
+    the KV/pubsub push and relay to its workers."""
+    from conftest import poll_until
+    from ray_tpu.util import profiling, state
+
+    cluster.add_node(num_cpus=2, resources={"side": 2})
+    _init(cluster)
+    _wait_nodes(2)
+    profiling.enable_profiling()
+    try:
+        @ray_tpu.remote(resources={"side": 1})
+        def spin_side(sec):
+            t = time.monotonic() + sec
+            x = 0
+            while time.monotonic() < t:
+                x += 1
+            return x
+
+        @ray_tpu.remote(num_cpus=1)
+        def spin_local(sec):
+            t = time.monotonic() + sec
+            x = 0
+            while time.monotonic() < t:
+                x += 1
+            return x
+
+        # warm both nodes' workers so arming reached them
+        ray_tpu.get([spin_side.remote(0.05), spin_local.remote(0.05)],
+                    timeout=120)
+
+        def merged_wide_enough():
+            # fresh short spins keep worker pushes + heartbeats flowing
+            ray_tpu.get([spin_side.remote(0.4), spin_local.remote(0.4)],
+                        timeout=120)
+            prof = state.profile()
+            procs = prof["processes"]
+            nodes = {p["node_id"] for p in procs.values()}
+            pids = {(p["node_id"], p["pid"]) for p in procs.values()}
+            comps = {p["component"] for p in procs.values()}
+            top_w = prof["top_self_by_component"].get("worker", [])
+            if len(nodes) >= 2 and len(pids) >= 3 \
+                    and {"driver", "worker", "raylet"} <= comps \
+                    and any("spin_" in r["function"] for r in top_w):
+                return prof
+            return None
+
+        prof = poll_until(merged_wide_enough, timeout=90, interval=0.5,
+                          desc="profile merge spanning >=2 nodes, "
+                               ">=3 pids, driver+worker components")
+        procs = prof["processes"]
+        # component labels are correct per origin: worker batches carry
+        # worker@, the daemon's own sampler reports raylet@, the head
+        # driver@ — and every process row carries actual samples
+        for key, p in procs.items():
+            assert key.startswith(f"{p['component']}@")
+            assert p["samples"] + p["idle_samples"] > 0
+        assert any(p["component"] == "raylet" for p in procs.values()), \
+            "daemon's own sampler batches never arrived via heartbeat"
+    finally:
+        profiling.disable_profiling()
+        profiling._reset_for_tests()
+        import os as _os
+        _os.environ.pop("RTPU_PROFILING", None)

@@ -335,7 +335,6 @@ def test_pip_runtime_env_venv_isolation_and_cache(rt_rob, tmp_path,
     into a cached per-hash venv; the task imports it, the driver env is
     untouched, and the second use hits the cache (no reinstall)."""
     import importlib
-    import time as _t
 
     wheel_dir = str(tmp_path / "wheels")
     _build_tiny_wheel(wheel_dir)
@@ -366,7 +365,10 @@ def test_pip_runtime_env_venv_isolation_and_cache(rt_rob, tmp_path,
     # a task WITHOUT the env cannot see the package (undo worked). The
     # assertion is only meaningful on the worker that APPLIED the env, so
     # retry until the scheduler lands the probe on that same pid (any
-    # other worker is trivially isolated).
+    # other worker is trivially isolated). poll_until, not a fixed-count
+    # loop: under suite load the probe can land elsewhere for many
+    # seconds straight (r10 flake — 2 vCPUs, every pool worker busy),
+    # and transient ConnectionErrors must retry rather than fail.
     @ray_tpu.remote
     def cannot_import():
         try:
@@ -375,13 +377,14 @@ def test_pip_runtime_env_venv_isolation_and_cache(rt_rob, tmp_path,
         except ImportError:
             return os.getpid(), "isolated"
 
-    for _ in range(60):
+    from conftest import poll_until
+
+    def _probe_venv_worker():
         pid, status = ray_tpu.get(cannot_import.remote(), timeout=60)
-        if pid == pkg_pid:
-            break
-        _t.sleep(0.05)
-    else:
-        pytest.fail(f"probe never landed on the pip-env worker {pkg_pid}")
+        return status if pid == pkg_pid else None
+
+    status = poll_until(_probe_venv_worker, timeout=90, interval=0.05,
+                        desc=f"probe landing on pip-env worker {pkg_pid}")
     assert status == "isolated"
 
     # second use hits the cache: .ready mtime unchanged, and fast
