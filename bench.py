@@ -964,6 +964,94 @@ def _core_microbench() -> dict:
 
         out["multi_client_tasks_async_per_s"] = best_of(3, multi_task_trial)
 
+        # multi-client control-plane cost detail (ISSUE 10 acceptance:
+        # pipe messages/task <= 2.5 from 5.0 after coalescing): frames +
+        # driver CPU around one multi-client run
+        try:
+            import resource as _resource
+
+            from ray_tpu.util.metrics import registry_records as _rr
+
+            def _pipe_msg_total():
+                total = 0.0
+                for rec in _rr():
+                    if rec["name"] != "rtpu_pipe_messages_total":
+                        continue
+                    for _k, v in rec["samples"]:
+                        total += v if not isinstance(v, tuple) else v[2]
+                return total
+
+            _ru0 = _resource.getrusage(_resource.RUSAGE_SELF)
+            _cpu0 = _ru0.ru_utime + _ru0.ru_stime
+            _m0 = _pipe_msg_total()
+            _t0 = time.perf_counter()
+            ray_tpu.get([c.small_value_batch.remote(250) for c in clients])
+            _wall = time.perf_counter() - _t0
+            _ru1 = _resource.getrusage(_resource.RUSAGE_SELF)
+            _n = 500.0
+            out["multi_client_detail"] = {
+                "pipe_msgs_per_task": round(
+                    (_pipe_msg_total() - _m0) / _n, 2),
+                "driver_cpu_us_per_task": round(
+                    (_ru1.ru_utime + _ru1.ru_stime - _cpu0) / _n * 1e6, 1),
+                "rate_per_s": round(_n / _wall, 1),
+            }
+        except Exception as e:
+            out["multi_client_detail"] = {"error": str(e)}
+
+        # -- compiled execution plane (ISSUE 10): same-container A/B of a
+        # 2-stage actor pipeline — compiled-DAG pipelined invocations vs
+        # the equivalent per-call actor-call chain loop ------------------
+        try:
+            from ray_tpu.dag import InputNode
+
+            @ray_tpu.remote
+            class Stage:
+                def __init__(self, k):
+                    self.k = k
+
+                def apply(self, x):
+                    return x + self.k
+
+            s1, s2 = Stage.remote(1), Stage.remote(100)
+            ray_tpu.get([s1.apply.remote(0), s2.apply.remote(0)])  # warm
+
+            def chain_trial(n=200):
+                t0 = time.perf_counter()
+                for i in range(n):
+                    ray_tpu.get(s2.apply.remote(s1.apply.remote(i)))
+                return n / (time.perf_counter() - t0)
+
+            chain_rate = best_of(3, chain_trial)
+
+            with InputNode() as inp:
+                dag = s2.apply.bind(s1.apply.bind(inp))
+            compiled = dag.experimental_compile(max_in_flight=8)
+            assert compiled.execute(0).get(timeout=60) == 101  # warm
+
+            def compiled_trial(n=2000):
+                t0 = time.perf_counter()
+                # execute() self-backpressures at max_in_flight, draining
+                # completed results into their futures — full pipelining
+                futs = [compiled.execute(i, timeout=120)
+                        for i in range(n)]
+                vals = [f.get(timeout=120) for f in futs]
+                assert vals[-1] == n - 1 + 101
+                return n / (time.perf_counter() - t0)
+
+            compiled_rate = best_of(3, compiled_trial)
+            compiled.teardown()
+            ray_tpu.kill(s1)
+            ray_tpu.kill(s2)
+            out["compiled_dag"] = {
+                "compiled_pipelined_per_s": compiled_rate,
+                "actor_chain_per_s": chain_rate,
+                "speedup": (round(compiled_rate / chain_rate, 1)
+                            if chain_rate else None),
+            }
+        except Exception as e:
+            out["compiled_dag"] = {"error": str(e)}
+
         @ray_tpu.remote
         def nn_work(actors, n):
             ray_tpu.get([actors[i % len(actors)].f.remote()

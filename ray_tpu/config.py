@@ -72,6 +72,16 @@ _knob("worker_zygote", _bool, True,
       "spawn workers by forking a pre-warmed single-threaded fork-server "
       "(~5ms) instead of exec'ing a fresh interpreter (~0.15s); the "
       "fork-server never imports jax or user code", "core/runtime.py")
+_knob("pipe_coalesce_us", int, 200,
+      "Nagle-style flush window (microseconds) for worker->driver cast "
+      "coalescing: fire-and-forget casts (submit, refpin, put, metric "
+      "pushes) buffer up to this long and ship as ONE framed batch, and "
+      "every latency-sensitive send (done/req) piggybacks the pending "
+      "casts in its own frame; 0 disables buffering (casts still "
+      "piggyback)", "core/worker.py")
+_knob("dag_max_in_flight", int, 8,
+      "default overlapping invocations a compiled DAG admits "
+      "(ring-channel slots = max_in_flight + 1)", "dag/compiled_dag.py")
 
 # -- object store -----------------------------------------------------------
 _knob("native_store", _bool, True,

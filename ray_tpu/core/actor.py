@@ -17,44 +17,58 @@ from ray_tpu.core.remote_function import _normalize_resources, _pg_options
 
 
 class ActorMethod:
-    def __init__(self, actor_id: ActorID, method_name: str, options: Optional[Dict] = None):
+    def __init__(self, actor_id: ActorID, method_name: str,
+                 options: Optional[Dict] = None, tmpl_cache: Optional[Dict] = None):
         self._actor_id = actor_id
         self._method_name = method_name
         self._options = dict(options or {})
+        # submit fast-path (r13): the handle-owned template cache —
+        # ActorMethod objects are born per attribute access, so the
+        # invariant spec parts cache on the HANDLE, keyed by the
+        # spec-shaping options (a changed option set is a different key,
+        # never a stale template)
+        self._tmpl_cache = tmpl_cache if tmpl_cache is not None else {}
 
     def options(self, **new_options):
-        return ActorMethod(self._actor_id, self._method_name, {**self._options, **new_options})
+        return ActorMethod(self._actor_id, self._method_name,
+                           {**self._options, **new_options},
+                           self._tmpl_cache)
+
+    def _template(self) -> Dict:
+        num_returns = self._options.get("num_returns", 1)
+        streaming = num_returns in ("streaming", "dynamic")
+        bp = self._options.get("_generator_backpressure_num_objects")
+        key = (self._method_name, num_returns, bp)
+        tmpl = self._tmpl_cache.get(key)
+        if tmpl is None:
+            tmpl = ts.make_actor_method_template(
+                self._actor_id.binary(),
+                self._method_name,
+                num_returns=1 if streaming else int(num_returns),
+                streaming=streaming,
+                stream_backpressure=int(bp) if streaming and bp else 0,
+            )
+            self._tmpl_cache[key] = tmpl
+        return tmpl
 
     def remote(self, *args, **kwargs):
         from ray_tpu.core.runtime import _get_runtime
 
         rt = _get_runtime()
         enc_args, enc_kwargs, nested_refs = ts.encode_args(args, kwargs, rt)
-        num_returns = self._options.get("num_returns", 1)
-        streaming = num_returns in ("streaming", "dynamic")
-        spec = ts.make_actor_method_spec(
-            self._actor_id.binary(),
-            self._method_name,
-            enc_args,
-            enc_kwargs,
-            num_returns=1 if streaming else int(num_returns),
-        )
+        spec = ts.spec_from_template(self._template(), enc_args, enc_kwargs)
         if nested_refs:
             spec["borrowed"] = nested_refs
-        if streaming:
+        if spec.get("streaming"):
             from ray_tpu.core.object_ref import ObjectRefGenerator
 
-            spec["streaming"] = True
-            bp = self._options.get("_generator_backpressure_num_objects")
-            if bp:
-                spec["stream_backpressure"] = int(bp)
             refs = rt.submit_actor_task(spec)
             return ObjectRefGenerator(
                 spec["task_id"], refs[0],
                 backpressured=bool(spec.get("stream_backpressure")),
                 owner=getattr(rt, "cluster_node_id", None))
         refs = rt.submit_actor_task(spec)
-        return refs[0] if num_returns == 1 else refs
+        return refs[0] if self._options.get("num_returns", 1) == 1 else refs
 
     def __call__(self, *a, **kw):
         raise TypeError(
@@ -76,11 +90,15 @@ class ActorHandle:
     def __init__(self, actor_id: ActorID, method_options: Optional[Dict[str, Dict]] = None):
         object.__setattr__(self, "_actor_id", actor_id)
         object.__setattr__(self, "_method_options", method_options or {})
+        # per-handle spec-template cache shared by every ActorMethod this
+        # handle hands out (r13 submit fast-path)
+        object.__setattr__(self, "_tmpl_cache", {})
 
     def __getattr__(self, name: str):
         if name.startswith("_") and name != "__rtpu_call__":
             raise AttributeError(name)
-        return ActorMethod(self._actor_id, name, self._method_options.get(name))
+        return ActorMethod(self._actor_id, name,
+                           self._method_options.get(name), self._tmpl_cache)
 
     def __repr__(self):
         return f"ActorHandle({self._actor_id.hex()})"

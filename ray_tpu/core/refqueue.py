@@ -36,10 +36,17 @@ class OrderedCastFlusher:
     appended strictly before the winner's release), so nothing strands.
     """
 
-    def __init__(self, send: Callable):
+    def __init__(self, send: Callable, batch: bool = False):
         self._q: deque = deque()
         self._flush_lock = threading.Lock()
-        self._send = send  # called once per item; exceptions swallowed
+        # batch=False: ``send`` is called once per item.
+        # batch=True:  ``send`` receives the LIST of items drained in one
+        # pass — the worker ships refpin transitions as a single
+        # ``refpins`` cast instead of one pipe message per transition
+        # (r13 control-message coalescing; order inside the list is the
+        # transition order).
+        self._send = send  # exceptions swallowed
+        self._batch = batch
 
     def append(self, item) -> None:
         self._q.append(item)
@@ -52,6 +59,19 @@ class OrderedCastFlusher:
             if not self._flush_lock.acquire(blocking=False):
                 return
             try:
+                if self._batch:
+                    items = []
+                    while True:
+                        try:
+                            items.append(self._q.popleft())
+                        except IndexError:
+                            break
+                    if items:
+                        try:
+                            self._send(items)
+                        except Exception:
+                            pass
+                    continue
                 while True:
                     try:
                         item = self._q.popleft()

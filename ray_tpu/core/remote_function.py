@@ -79,6 +79,12 @@ class RemoteFunction:
         self._options = dict(options or {})
         self._fn_blob = ts.pickle_fn(fn)
         self._fn_hash = ts.fn_digest(self._fn_blob)
+        # submit fast-path (r13): the spec template + function-table
+        # registration are cached per (function, option-set) — this
+        # instance IS that key (``options()`` returns a fresh instance,
+        # so a changed option set can never reuse a stale template)
+        self._tmpl = None
+        self._tmpl_rt = None
         self.__name__ = getattr(fn, "__name__", "remote_fn")
         self.__doc__ = getattr(fn, "__doc__", None)
 
@@ -101,16 +107,21 @@ class RemoteFunction:
         rf._options = merged
         rf._fn_blob = self._fn_blob
         rf._fn_hash = self._fn_hash
+        rf._tmpl = None       # fresh option set -> fresh template
+        rf._tmpl_rt = None
         rf.__name__ = self.__name__
         rf.__doc__ = self.__doc__
         return rf
 
-    def remote(self, *args, **kwargs):
-        from ray_tpu.core.runtime import _get_runtime
-
-        rt = _get_runtime()
+    def _template(self, rt) -> Dict[str, Any]:
+        """The cached invariant spec parts for this (function, option-set)
+        against ``rt`` — resources/pg/strategy/retry normalization and
+        runtime_env packaging run ONCE, not per submission. Keyed on the
+        runtime identity so an init/shutdown cycle (or a worker-side
+        clone) rebuilds and re-registers."""
+        if self._tmpl is not None and self._tmpl_rt is rt:
+            return self._tmpl
         rt.ensure_fn(self._fn_hash, self._fn_blob)
-        enc_args, enc_kwargs, nested_refs = ts.encode_args(args, kwargs, rt)
         pg, bundle_index = _pg_options(self._options)
         renv = self._options.get("runtime_env")
         if renv:
@@ -128,10 +139,9 @@ class RemoteFunction:
         max_retries = self._options.get("max_retries")
         if max_retries is None:
             max_retries = 3 if self._options.get("retry_exceptions") else 0
-        spec = ts.make_task_spec(
+        bp = self._options.get("_generator_backpressure_num_objects")
+        self._tmpl = ts.make_task_template(
             self._fn_hash,
-            enc_args,
-            enc_kwargs,
             num_returns=1 if streaming else int(num_returns),
             resources=_normalize_resources(self._options),
             name=self._options.get("name", self.__name__),
@@ -142,31 +152,37 @@ class RemoteFunction:
             # True = retry any application error; a list/tuple of exception
             # types retries only those (reference retry_exceptions forms)
             retry_exceptions=self._options.get("retry_exceptions", False),
+            streaming=streaming,
+            # producer pauses when this many yields are unconsumed
+            # (reference generator_waiter.cc)
+            stream_backpressure=int(bp) if streaming and bp else 0,
+            strategy=_strategy_spec(self._options),
         )
+        self._tmpl_rt = rt
+        return self._tmpl
+
+    def remote(self, *args, **kwargs):
+        from ray_tpu.core.runtime import _get_runtime
+
+        rt = _get_runtime()
+        tmpl = self._template(rt)
+        enc_args, enc_kwargs, nested_refs = ts.encode_args(args, kwargs, rt)
+        spec = ts.spec_from_template(tmpl, enc_args, enc_kwargs)
         if nested_refs:
             spec["borrowed"] = nested_refs
-        strat = _strategy_spec(self._options)
-        if strat is not None:
-            spec["strategy"] = strat
-        if streaming:
+        if spec.get("streaming"):
             # the declared return becomes the end sentinel; yields surface
             # as they are produced (reference ObjectRefGenerator,
             # _raylet.pyx:273)
             from ray_tpu.core.object_ref import ObjectRefGenerator
 
-            spec["streaming"] = True
-            bp = self._options.get("_generator_backpressure_num_objects")
-            if bp:
-                # producer pauses when this many yields are unconsumed
-                # (reference generator_waiter.cc)
-                spec["stream_backpressure"] = int(bp)
             refs = rt.submit(spec)
             return ObjectRefGenerator(
                 spec["task_id"], refs[0],
                 backpressured=bool(spec.get("stream_backpressure")),
                 owner=getattr(rt, "cluster_node_id", None))
         refs = rt.submit(spec)
-        if num_returns == 1:
+        if self._options.get("num_returns", 1) == 1:
             return refs[0]
         return refs
 
@@ -182,6 +198,8 @@ def _rebuild_remote_function(fn_blob: bytes, options: Dict[str, Any]) -> RemoteF
     rf._options = options
     rf._fn_blob = fn_blob
     rf._fn_hash = ts.fn_digest(fn_blob)
+    rf._tmpl = None
+    rf._tmpl_rt = None
     rf.__name__ = getattr(rf._function, "__name__", "remote_fn")
     rf.__doc__ = getattr(rf._function, "__doc__", None)
     return rf

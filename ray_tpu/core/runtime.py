@@ -58,7 +58,8 @@ def _pipe_metrics():
 
     return {"sent": md.get("rtpu_pipe_sent_bytes_total"),
             "recv": md.get("rtpu_pipe_recv_bytes_total"),
-            "msgs": md.get("rtpu_pipe_messages_total")}
+            "msgs": md.get("rtpu_pipe_messages_total"),
+            "batch": md.get("rtpu_pipe_batch_messages")}
 
 
 def _set_runtime(rt):
@@ -869,14 +870,21 @@ class DriverRuntime:
                 m = _pipe_metrics()
                 m["recv"]._inc_key((), len(buf))
                 m["msgs"]._inc_key(_RECV_KEY)
+                if msg[0] == "batch":
+                    m["batch"].observe(len(msg[1]))
             except Exception:
                 pass
-            try:
-                self._handle_msg(ws, msg)
-            except Exception:
-                import traceback
+            # r13 coalescing: workers ship bursts of casts (and the
+            # piggybacked urgent message) as ONE framed batch. Each
+            # sub-message keeps its own error isolation — one bad cast
+            # must not swallow the piggybacked done/req behind it.
+            for sub in (msg[1] if msg[0] == "batch" else (msg,)):
+                try:
+                    self._handle_msg(ws, sub)
+                except Exception:
+                    import traceback
 
-                traceback.print_exc()
+                    traceback.print_exc()
 
     def _on_worker_death(self, ws: _WorkerState):
         with self.lock:
@@ -1302,6 +1310,11 @@ class DriverRuntime:
                                  args[2] if len(args) > 2 else None)
         elif op == "refpin":
             self.worker_ref_delta(ws, args[0], args[1])
+        elif op == "refpins":
+            # batched borrow transitions (r13 coalescing): list order IS
+            # transition order, applied sequentially
+            for oid_b, d in args[0]:
+                self.worker_ref_delta(ws, oid_b, d)
         elif op == "metrics":
             # batched metric-delta push from the worker (federation): pure
             # dict merges — safe on this receiver thread
@@ -2659,6 +2672,15 @@ class DriverRuntime:
         except OSError:
             pass
         StoreClient.cleanup_session(self.session)
+        # compiled-DAG channels of this session (rings a leaked/undeleted
+        # CompiledDAG left behind — e.g. a handle cache never torn down)
+        import glob as _glob
+
+        for p in _glob.glob(f"/dev/shm/rtpu-chan-{self.session}-*"):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
 
 
 # ----------------------------------------------------------------------

@@ -154,6 +154,85 @@ def make_actor_create_spec(
     }
 
 
+def make_task_template(
+    fn_hash: str,
+    num_returns: int,
+    resources: Dict[str, float],
+    name: str = "",
+    max_retries: int = 0,
+    placement_group_id: Optional[bytes] = None,
+    bundle_index: int = -1,
+    runtime_env: Optional[dict] = None,
+    retry_exceptions: Any = False,
+    streaming: bool = False,
+    stream_backpressure: int = 0,
+    strategy: Any = None,
+) -> dict:
+    """Submit fast-path (r13): the per-(function, option-set) INVARIANT
+    part of a task spec, computed once and shallow-copied per call —
+    repeated submissions pay only arg encoding + fresh ids. The
+    ``retry_exceptions`` list form is cloudpickled here, once, instead of
+    per submission."""
+    if isinstance(retry_exceptions, (list, tuple)):
+        retry_exceptions = (cloudpickle.dumps(tuple(retry_exceptions))
+                            if retry_exceptions else False)
+    tmpl = {
+        "type": TASK,
+        "retry_exceptions": retry_exceptions,
+        "runtime_env": runtime_env,
+        "fn_hash": fn_hash,
+        "name": name,
+        "resources": resources,
+        "max_retries": max_retries,
+        "pg": placement_group_id,
+        "bundle_index": bundle_index,
+        "_num_returns": 1 if streaming else int(num_returns),
+    }
+    if streaming:
+        tmpl["streaming"] = True
+        if stream_backpressure:
+            tmpl["stream_backpressure"] = int(stream_backpressure)
+    if strategy is not None:
+        tmpl["strategy"] = strategy
+    return tmpl
+
+
+def spec_from_template(tmpl: dict, enc_args: list, enc_kwargs: dict) -> dict:
+    """Instantiate one submission from a cached template: fresh ids +
+    this call's encoded args on a shallow copy."""
+    spec = dict(tmpl)
+    n = spec.pop("_num_returns")
+    spec["task_id"] = TaskID.from_random().binary()
+    spec["args"] = enc_args
+    spec["kwargs"] = enc_kwargs
+    spec["return_ids"] = [ObjectID.from_random().binary()
+                          for _ in range(n)]
+    spec["retries_left"] = spec.get("max_retries", 0)
+    return spec
+
+
+def make_actor_method_template(
+    actor_id: bytes,
+    method_name: str,
+    num_returns: int = 1,
+    streaming: bool = False,
+    stream_backpressure: int = 0,
+) -> dict:
+    """Actor-call twin of :func:`make_task_template`."""
+    tmpl = {
+        "type": ACTOR_METHOD,
+        "actor_id": actor_id,
+        "method": method_name,
+        "resources": {},
+        "_num_returns": 1 if streaming else int(num_returns),
+    }
+    if streaming:
+        tmpl["streaming"] = True
+        if stream_backpressure:
+            tmpl["stream_backpressure"] = int(stream_backpressure)
+    return tmpl
+
+
 def make_actor_method_spec(
     actor_id: bytes,
     method_name: str,

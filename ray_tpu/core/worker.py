@@ -60,6 +60,23 @@ class WorkerRuntime:
         self._running_lock = threading.Lock()
         self._req_counter = itertools.count()
         self._send_lock = threading.Lock()
+        # Control-message coalescing (r13, ROADMAP item 1): fire-and-forget
+        # casts buffer here and ship as ONE framed batch — flushed by a
+        # Nagle-style window thread (RTPU_PIPE_COALESCE_US) or piggybacked
+        # onto the next latency-sensitive send (done/req/ready), whichever
+        # comes first. This is what turns the multi-client shape's ~5 pipe
+        # messages/task (submit cast + refpin transitions + get machinery)
+        # into ~2 frames/task of driver-side receive work.
+        from collections import deque as _cast_deque
+
+        self._cast_q: "_cast_deque" = _cast_deque()
+        self._cast_q_lock = threading.Lock()
+        self._flush_ev = threading.Event()
+        self._flusher_started = False
+        self._coalesce_s: Optional[float] = None
+        # serializes the rate-limited telemetry pushes: they run from the
+        # main loop AND from compiled-DAG exec loops (see push_telemetry)
+        self._push_lock = threading.Lock()
         # Borrowed-reference tracking (reference reference_count.h:61
         # "borrower" role): live ObjectRef instances in THIS worker pin the
         # object at the driver (which aggregates into node-level pins at
@@ -74,8 +91,10 @@ class WorkerRuntime:
         # and shipped outside it. Shared machinery: core/refqueue.py.
         from ray_tpu.core.refqueue import DeferredDrops, OrderedCastFlusher
 
+        # batch mode: one "refpins" cast per drain instead of one pipe
+        # message per 0<->1 transition (r13 control-message coalescing)
         self._ref_casts = OrderedCastFlusher(
-            lambda item: self.cast("refpin", item[0], item[1]))
+            lambda items: self.cast("refpins", items), batch=True)
         # store pins to drop once outside _refs_lock (see
         # _apply_ref_drop_locked); deque: append/popleft are atomic
         from collections import deque as _deque
@@ -151,16 +170,89 @@ class WorkerRuntime:
 
     # -- transport --------------------------------------------------------
 
-    def _send(self, msg):
+    def _dropped(self, msg) -> bool:
+        """THE chaos filter for worker->driver messages — every egress
+        path (deferred cast, piggyback, urgent) funnels each message
+        through this single ``worker.pipe.send`` site."""
         from ray_tpu.util import failpoints
 
-        if failpoints.hit("worker.pipe.send", msg[0]):
-            return  # chaos: drop this worker->driver control message
+        return failpoints.hit("worker.pipe.send", msg[0])
+
+    def _coalesce_window(self) -> float:
+        if self._coalesce_s is None:
+            try:
+                from ray_tpu import config as _cfg
+
+                self._coalesce_s = max(
+                    0.0, int(_cfg.get("pipe_coalesce_us")) / 1e6)
+            except Exception:
+                self._coalesce_s = 0.0
+        return self._coalesce_s
+
+    def _send_frame(self, msg=None) -> None:
+        """Ship pending casts (+ optionally ``msg``) as ONE frame.
+        Drain happens under the send lock, so frame order matches global
+        issue order — a cast enqueued before a done/req can never be
+        observed after it."""
         with self._send_lock:
-            self.conn.send(msg)
+            with self._cast_q_lock:
+                if self._cast_q:
+                    batch = list(self._cast_q)
+                    self._cast_q.clear()
+                else:
+                    batch = []
+            if msg is not None:
+                batch.append(msg)
+            if not batch:
+                return
+            self.conn.send(batch[0] if len(batch) == 1
+                           else ("batch", batch))
+
+    def _send(self, msg):
+        """Latency-sensitive send (done/req/ready/reply): goes out NOW,
+        piggybacking any buffered casts in the same frame."""
+        if self._dropped(msg):
+            return  # chaos: drop this worker->driver control message
+        self._send_frame(msg)
 
     def cast(self, op: str, *args):
-        self._send(("cast", op, args))
+        """Fire-and-forget cast: buffered for the coalescing window (or
+        the next urgent send), then shipped in a batch frame."""
+        msg = ("cast", op, args)
+        if self._dropped(msg):
+            return
+        if self._coalesce_window() <= 0:
+            self._send_frame(msg)
+            return
+        with self._cast_q_lock:
+            self._cast_q.append(msg)
+        if not self._flusher_started:
+            self._start_cast_flusher()
+        self._flush_ev.set()
+
+    def _start_cast_flusher(self) -> None:
+        with self._cast_q_lock:
+            if self._flusher_started:
+                return
+            self._flusher_started = True
+        t = threading.Thread(target=self._cast_flusher_loop, daemon=True,
+                             name="rtpu_cast_flusher")
+        t.start()
+
+    def _cast_flusher_loop(self) -> None:
+        """The Nagle window: after the first buffered cast, wait
+        ``RTPU_PIPE_COALESCE_US`` for more to accumulate, then flush them
+        as one frame (unless an urgent send piggybacked them first)."""
+        from ray_tpu.util import profiling
+
+        while True:
+            self._flush_ev.wait()
+            self._flush_ev.clear()
+            profiling.idle_sleep(self._coalesce_window())
+            try:
+                self._send_frame()
+            except (OSError, BrokenPipeError):
+                return  # pipe gone: the recv loop exits the process
 
     def _ref_added(self, oid_b: bytes) -> None:
         with self._refs_lock:
@@ -218,66 +310,73 @@ class WorkerRuntime:
                 msg = self.conn.recv()
             except (EOFError, OSError):
                 os._exit(0)
-            kind = msg[0]
-            if kind == "exec":
-                self._exec_queue.put(msg[1])
-            elif kind == "cancel":
-                self._deliver_cancel(msg[1])
-            elif kind == "reply":
-                req_id = msg[1]
-                with self._reply_lock:
-                    ev = self._reply_events.pop(req_id, None)
-                    if ev is not None:   # drop replies nobody awaits
-                        self._replies[req_id] = (msg[2], msg[3])
-                if ev is not None:
-                    ev.set()
-            elif kind == "fp":
-                # chaos plane: driver-pushed failpoint arm/disarm
-                from ray_tpu.util import failpoints
+            if msg[0] == "batch":
+                for sub in msg[1]:
+                    self._dispatch_recv(sub)
+            else:
+                self._dispatch_recv(msg)
 
-                if msg[1] is None:
-                    failpoints.clear()
-                else:
-                    try:
-                        failpoints.apply_spec(msg[1])
-                    except ValueError:
-                        pass
-            elif kind == "trace":
-                # trace plane: driver-pushed mid-session arm/disarm —
-                # workers spawned before enable_tracing() learn here
-                from ray_tpu.util import tracing
+    def _dispatch_recv(self, msg):
+        kind = msg[0]
+        if kind == "exec":
+            self._exec_queue.put(msg[1])
+        elif kind == "cancel":
+            self._deliver_cancel(msg[1])
+        elif kind == "reply":
+            req_id = msg[1]
+            with self._reply_lock:
+                ev = self._reply_events.pop(req_id, None)
+                if ev is not None:   # drop replies nobody awaits
+                    self._replies[req_id] = (msg[2], msg[3])
+            if ev is not None:
+                ev.set()
+        elif kind == "fp":
+            # chaos plane: driver-pushed failpoint arm/disarm
+            from ray_tpu.util import failpoints
 
-                if msg[1] is not None:
-                    tracing.apply_remote(msg[1])
-                    if not msg[1].get("enabled"):
-                        # disarm: ship the ring's tail NOW — the push
-                        # loop stops looking once tracing is off, and
-                        # the last interval's spans (the end of the
-                        # traced workload) must not strand here
-                        self._push_spans_now()
-            elif kind == "prof":
-                # profiling plane: driver-pushed mid-session arm/disarm —
-                # apply_remote starts/stops this process's sampler
-                from ray_tpu.util import profiling
-
-                if msg[1] is not None:
-                    profiling.apply_remote(msg[1])
-                    if not msg[1].get("enabled"):
-                        # disarm: ship the table's tail NOW (the push
-                        # loop stops looking once profiling is off)
-                        self._push_profile_now()
-            elif kind == "stackdump":
-                # live stack request (`ray_tpu stack` py-spy role): walk
-                # sys._current_frames on THIS receiver thread (pure
-                # frame-graph reads, no locks) and cast the reply back
-                from ray_tpu.util import profiling
-
+            if msg[1] is None:
+                failpoints.clear()
+            else:
                 try:
-                    self.cast("stacks", profiling.current_stacks())
-                except Exception:
+                    failpoints.apply_spec(msg[1])
+                except ValueError:
                     pass
-            elif kind == "shutdown":
-                os._exit(0)
+        elif kind == "trace":
+            # trace plane: driver-pushed mid-session arm/disarm —
+            # workers spawned before enable_tracing() learn here
+            from ray_tpu.util import tracing
+
+            if msg[1] is not None:
+                tracing.apply_remote(msg[1])
+                if not msg[1].get("enabled"):
+                    # disarm: ship the ring's tail NOW — the push
+                    # loop stops looking once tracing is off, and
+                    # the last interval's spans (the end of the
+                    # traced workload) must not strand here
+                    self._push_spans_now()
+        elif kind == "prof":
+            # profiling plane: driver-pushed mid-session arm/disarm —
+            # apply_remote starts/stops this process's sampler
+            from ray_tpu.util import profiling
+
+            if msg[1] is not None:
+                profiling.apply_remote(msg[1])
+                if not msg[1].get("enabled"):
+                    # disarm: ship the table's tail NOW (the push
+                    # loop stops looking once profiling is off)
+                    self._push_profile_now()
+        elif kind == "stackdump":
+            # live stack request (`ray_tpu stack` py-spy role): walk
+            # sys._current_frames on THIS receiver thread (pure
+            # frame-graph reads, no locks) and cast the reply back
+            from ray_tpu.util import profiling
+
+            try:
+                self.cast("stacks", profiling.current_stacks())
+            except Exception:
+                pass
+        elif kind == "shutdown":
+            os._exit(0)
 
     def request(self, op: str, *args, timeout: Optional[float] = None):
         """Request/reply over the pipe. Returns the payload, or the
@@ -1084,6 +1183,17 @@ class WorkerRuntime:
         except Exception:
             pass
 
+    def push_telemetry(self) -> None:
+        """Rate-limited metric/span/profile pushes, callable from ANY
+        thread: the main loop's idle ticks, and compiled-DAG exec loops —
+        whose occupying ``__rtpu_call__`` starves a concurrency-1 actor's
+        main loop, so without this hook a DAG actor's spans/metrics would
+        strand in its rings until teardown."""
+        with self._push_lock:
+            self._maybe_push_metrics()
+            self._maybe_push_spans()
+            self._maybe_push_profile()
+
     def main_loop(self):
         self._start_receiver()
         self._send(("ready",))
@@ -1095,14 +1205,10 @@ class WorkerRuntime:
             except _queue.Empty:
                 # idle: bounded staleness for __del__-deferred ref drops
                 self._drain_ref_drops()
-                self._maybe_push_metrics()
-                self._maybe_push_spans()
-                self._maybe_push_profile()
+                self.push_telemetry()
                 continue
             self._drain_ref_drops()
-            self._maybe_push_metrics()
-            self._maybe_push_spans()
-            self._maybe_push_profile()
+            self.push_telemetry()
             conc = (self.actor_concurrency.get(spec.get("actor_id", b""), 1)
                     if spec["type"] == ts.ACTOR_METHOD else 1)
             if (spec["type"] == ts.ACTOR_METHOD

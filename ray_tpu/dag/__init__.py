@@ -3,9 +3,11 @@
 Role analog: ``python/ray/dag`` (``dag_node.py``, ``compiled_dag_node.py:278``).
 Build a graph with ``InputNode`` and ``ActorMethod.bind``; ``execute`` runs
 it as ordinary actor calls; ``experimental_compile`` pre-allocates mutable
-shm channels per edge and starts an exec-loop thread inside each actor, so
-repeated invocations bypass task submission entirely — the driver writes
-the input channel and reads the output channel.
+shm RING channels per edge (``max_in_flight + 1`` slots) and starts an
+exec loop inside each actor, so repeated invocations bypass task
+submission entirely — the driver writes the input channel and reads the
+output channel, with up to ``max_in_flight`` invocations overlapping and
+strict FIFO result delivery.
 """
 
 from ray_tpu.dag.dag_node import (
@@ -14,7 +16,12 @@ from ray_tpu.dag.dag_node import (
     ClassMethodNode,
     FunctionNode,
 )
-from ray_tpu.dag.compiled_dag import CompiledDAG
+from ray_tpu.dag.compiled_dag import (
+    CompiledDAG,
+    CompiledDAGFuture,
+    DAGBackpressureError,
+    DAGExecutionError,
+)
 
 __all__ = [
     "DAGNode",
@@ -22,4 +29,7 @@ __all__ = [
     "ClassMethodNode",
     "FunctionNode",
     "CompiledDAG",
+    "CompiledDAGFuture",
+    "DAGBackpressureError",
+    "DAGExecutionError",
 ]

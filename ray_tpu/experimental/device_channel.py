@@ -6,16 +6,23 @@ Role analog: the reference's NCCL channels for DAG edges
 (``torch_tensor_type.py``). TPU-native shape of the idea:
 
 - an edge annotated :class:`DeviceTensorType` carries ONE jax array whose
-  payload bytes move through the channel's shm segment RAW (dtype/shape in
+  payload bytes move through the channel's ring slot RAW (dtype/shape in
   a tiny header) instead of the generic pickle path;
-- the reader materializes a ``jax.Array`` straight from the mapped segment:
+- the reader materializes a ``jax.Array`` straight from the mapped slot:
   zero-copy via dlpack on host-mapped backends (CPU — the consumer array
-  aliases the channel buffer, no copy at all), one H2D DMA on TPU
+  aliases the slot memory, no copy at all), one H2D DMA on TPU
   (``jax.device_put``; cross-process device memory can't be shared through
   host shm, so one hop is the floor — the reference pays the same in NCCL
   as a D2D hop);
 - non-tensor control values (teardown/error sentinels) fall back to the
   pickle path transparently.
+
+Zero-copy safety under pipelining (r13 ring rewrite): the ring's
+backpressure means a slot is only overwritten ``nslots`` values later,
+and the compiled DAG sizes every channel ``max_in_flight + 1`` slots —
+so a stage that consumes its input before the pipeline admits another
+``max_in_flight`` invocations (which FIFO result delivery enforces) can
+never observe its aliased array being clobbered.
 
 True chip-to-chip movement with NO host involvement belongs INSIDE a jit
 program over a mesh (ppermute/collectives — see ray_tpu.parallel); that is
@@ -27,17 +34,9 @@ from __future__ import annotations
 
 import pickle
 import struct
-import time
 from typing import Any, Optional
 
-from ray_tpu.core import serialization
-from ray_tpu.experimental.channel import (
-    Channel,
-    ChannelFullError,
-    ChannelTimeoutError,
-    _HEADER,
-    _SEQ,
-)
+from ray_tpu.experimental.channel import Channel
 
 _KIND_PICKLE = 0
 _KIND_TENSOR = 1
@@ -66,78 +65,62 @@ def _is_jax_array(value) -> bool:
 class DeviceChannel(Channel):
     """Channel whose payloads are jax arrays moved as raw device bytes."""
 
-    def write(self, value: Any) -> None:
+    def _encode(self, value: Any):
         if not _is_jax_array(value):
-            return self._write_parts(
-                _KIND_PICKLE, b"", pickle.dumps(value))
+            body = pickle.dumps(value)
+            return self._encode_parts(_KIND_PICKLE, b"", body, len(body))
         import numpy as np
 
         host = np.asarray(value)  # D2H (CPU backend: view, no copy)
         header = pickle.dumps((host.dtype.str, host.shape))
-        return self._write_parts(_KIND_TENSOR, header,
-                                 host.tobytes() if not host.flags["C_CONTIGUOUS"]
-                                 else host, nbytes=host.nbytes)
+        body = (host if host.flags["C_CONTIGUOUS"] else host.tobytes())
+        return self._encode_parts(_KIND_TENSOR, header, body, host.nbytes)
 
-    def _write_parts(self, kind: int, header: bytes, body,
-                     nbytes: Optional[int] = None) -> None:
-        import numpy as np
-
-        nbytes = len(body) if nbytes is None else nbytes
-        pad = (-(_HEADER.size + _PREFIX.size + len(header))) % _ALIGN
+    def _encode_parts(self, kind: int, header: bytes, body, nbytes: int):
+        # pad so the body lands 64B-aligned in the mapped file regardless
+        # of which slot it goes to (slot payload offsets are themselves
+        # multiples of the slot stride; align relative to the file start
+        # by padding to the next _ALIGN boundary past the headers)
+        pad = (-(_PREFIX.size + len(header))) % _ALIGN
         total = _PREFIX.size + len(header) + pad + nbytes
-        if total > self.capacity:
-            raise ChannelFullError(
-                f"payload {total}B exceeds channel capacity {self.capacity}B")
-        seq, _ = _HEADER.unpack_from(self._mm, 0)
-        _SEQ.pack_into(self._mm, 0, seq + 1)               # odd: writing
-        _SEQ.pack_into(self._mm, 8, total)
-        off = _HEADER.size
-        _PREFIX.pack_into(self._mm, off, kind, len(header), pad)
-        off += _PREFIX.size
-        self._mm[off:off + len(header)] = header
-        off += len(header) + pad
-        view = np.frombuffer(self._mm, np.uint8, nbytes, off)
-        if isinstance(body, (bytes, bytearray)):
-            view[:] = np.frombuffer(body, np.uint8)
-        else:
-            view[:] = np.asarray(body, order="C").reshape(-1).view(np.uint8)
-        del view
-        _SEQ.pack_into(self._mm, 0, seq + 2)               # even: ready
+
+        def fill(mm, off):
+            import numpy as np
+
+            _PREFIX.pack_into(mm, off, kind, len(header), pad)
+            o = off + _PREFIX.size
+            mm[o:o + len(header)] = header
+            o += len(header) + pad
+            view = np.frombuffer(mm, np.uint8, nbytes, o)
+            if isinstance(body, (bytes, bytearray)):
+                view[:] = np.frombuffer(body, np.uint8)
+            else:
+                view[:] = np.asarray(body, order="C").reshape(-1).view(
+                    np.uint8)
+            del view
+
+        return total, fill
 
     def read(self, timeout: Optional[float] = None) -> Any:
-        deadline = None if timeout is None else time.monotonic() + timeout
-        spins = 0
-        while True:
-            seq, size = _HEADER.unpack_from(self._mm, 0)
-            if seq % 2 == 0 and seq > self._last_read_seq:
-                value = self._decode(size)
-                seq2, _ = _HEADER.unpack_from(self._mm, 0)
-                if seq2 == seq:          # seqlock validate
-                    self._last_read_seq = seq
-                    return value
-            spins += 1
-            if spins < 1000:
-                continue
-            if deadline is not None and time.monotonic() > deadline:
-                raise ChannelTimeoutError(
-                    f"channel {self.name} read timed out after {timeout}s")
-            time.sleep(0.0002)
+        off, size = self._wait_slot(timeout)
+        value = self._decode(off, size)
+        self._advance()
+        return value
 
-    def _decode(self, size: int):
+    def _decode(self, off: int, size: int):
         import numpy as np
 
-        off = _HEADER.size
         kind, hsize, pad = _PREFIX.unpack_from(self._mm, off)
-        off += _PREFIX.size
-        header = bytes(self._mm[off:off + hsize])
-        off += hsize + pad
+        o = off + _PREFIX.size
+        header = bytes(self._mm[o:o + hsize])
+        o += hsize + pad
         body_size = size - _PREFIX.size - hsize - pad
         if kind == _KIND_PICKLE:
-            return pickle.loads(bytes(self._mm[off:off + body_size]))
+            return pickle.loads(bytes(self._mm[o:o + body_size]))
         dtype_str, shape = pickle.loads(header)
         dtype = np.dtype(dtype_str)
         host = np.frombuffer(self._mm, dtype, body_size // dtype.itemsize,
-                             off).reshape(shape)
+                             o).reshape(shape)
         # the backend query below must honor JAX_PLATFORMS first: a
         # site-pinned TPU plugin would otherwise try to claim the chip from
         # a CPU worker and can hang when the tunnel is unclaimable
@@ -147,10 +130,10 @@ class DeviceChannel(Channel):
         import jax
 
         if jax.default_backend() == "cpu":
-            # zero-copy: the consumer jax array aliases the channel segment
-            # (single-slot channels are consume-before-next-write, so the
-            # writer cannot clobber a value the reader is still using in a
-            # correctly-driven DAG)
+            # zero-copy: the consumer jax array aliases the slot memory
+            # (ring backpressure + FIFO-bounded admission mean the writer
+            # cannot clobber this slot while a correctly-driven DAG stage
+            # still uses the value — see module docstring)
             try:
                 return jax.dlpack.from_dlpack(host)
             except Exception:

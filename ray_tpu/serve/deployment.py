@@ -29,6 +29,12 @@ class DeploymentConfig:
     ray_actor_options: Dict[str, Any] = field(default_factory=dict)
     user_config: Optional[Dict[str, Any]] = None
     health_check_period_s: float = 10.0
+    # compiled execution plane (r13): steady-state requests route through
+    # a compiled DAG per replica (shm channels, no per-call task
+    # submission); replicas get a second concurrency slot so control
+    # calls (health checks, reconfigure) stay reachable while the DAG
+    # exec loop occupies the first
+    compiled: bool = False
 
 
 class Deployment:
@@ -43,7 +49,8 @@ class Deployment:
                 max_ongoing_requests: Optional[int] = None,
                 autoscaling_config=None,
                 ray_actor_options: Optional[Dict[str, Any]] = None,
-                user_config: Optional[Dict[str, Any]] = None) -> "Deployment":
+                user_config: Optional[Dict[str, Any]] = None,
+                compiled: Optional[bool] = None) -> "Deployment":
         import copy
 
         cfg = copy.deepcopy(self.config)
@@ -59,6 +66,8 @@ class Deployment:
             cfg.ray_actor_options = ray_actor_options
         if user_config is not None:
             cfg.user_config = user_config
+        if compiled is not None:
+            cfg.compiled = bool(compiled)
         return Deployment(self.func_or_class, name or self.name, cfg)
 
     def bind(self, *args, **kwargs) -> "Application":
@@ -89,7 +98,7 @@ class Application:
 def deployment(func_or_class=None, *, name: Optional[str] = None,
                num_replicas: int = 1, max_ongoing_requests: int = 8,
                autoscaling_config=None, ray_actor_options=None,
-               user_config=None):
+               user_config=None, compiled: bool = False):
     """``@serve.deployment`` decorator (reference ``serve/api.py``)."""
 
     def wrap(fc):
@@ -101,6 +110,7 @@ def deployment(func_or_class=None, *, name: Optional[str] = None,
                                 else autoscaling_config),
             ray_actor_options=ray_actor_options or {},
             user_config=user_config,
+            compiled=compiled,
         )
         return Deployment(fc, name or fc.__name__, cfg)
 

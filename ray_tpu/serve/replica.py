@@ -54,6 +54,12 @@ class ReplicaActor:
             out = asyncio.get_event_loop().run_until_complete(out)
         return out
 
+    def handle_request_packed(self, request):
+        """Compiled-DAG entry point (r13): the DAG edge carries ONE value,
+        so the (method, args, kwargs) triple arrives packed."""
+        method_name, args, kwargs = request
+        return self.handle_request(method_name, args, kwargs)
+
     def reconfigure(self, user_config: Dict[str, Any]):
         self._user_config = user_config
         if hasattr(self._callable, "reconfigure"):

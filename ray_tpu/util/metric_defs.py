@@ -114,6 +114,28 @@ _def("rtpu_pipe_recv_bytes_total", "counter",
 _def("rtpu_pipe_messages_total", "counter",
      "control-pipe messages by direction (sent/recv, driver side)",
      tag_keys=("direction",), component="scheduler")
+_def("rtpu_pipe_batch_messages", "histogram",
+     "control messages per coalesced pipe frame (worker-side Nagle "
+     "window RTPU_PIPE_COALESCE_US + piggybacked urgent sends; observed "
+     "at driver receive)",
+     boundaries=(2, 3, 5, 8, 13, 21, 34, 55, 89), component="scheduler")
+
+# compiled execution plane (dag/compiled_dag.py + experimental/channel.py)
+_def("rtpu_dag_executions_total", "counter",
+     "compiled-DAG invocations admitted (execute/execute_async)",
+     component="dag")
+_def("rtpu_dag_inflight", "gauge",
+     "compiled-DAG invocations admitted but not yet resolved to their "
+     "future (delta-updated; aggregates across every DAG in the "
+     "process)", component="dag")
+_def("rtpu_channel_read_wait_seconds", "histogram",
+     "time a compiled-DAG channel read waited past its spin budget for "
+     "the next ring slot (recorded only when a wait backed off)",
+     boundaries=_LAT_FAST, component="dag")
+_def("rtpu_channel_write_wait_seconds", "histogram",
+     "time a compiled-DAG channel write waited for ring backpressure "
+     "(slowest reader cursor) to clear",
+     boundaries=_LAT_FAST, component="dag")
 
 # worker pool / zygote (spawn path)
 _def("rtpu_worker_pool_size", "gauge",

@@ -35,6 +35,8 @@ rule keeps this catalog and the call sites bidirectionally in sync —
     submit::<task>          task/actor-call submission, origin process
     driver.submit::<task>   driver control-plane CPU handling a submit
     execute::<task>         worker-side task/actor-method execution
+    dag::execute            compiled-DAG invocation admission (driver)
+    dag::stage              one compiled-DAG stage method inside an actor
     serve.handle::request   end-to-end serve request (manual span)
     serve.handle::route     replica selection + dispatch in the handle
     serve.replica::execute  user callable execution inside the replica

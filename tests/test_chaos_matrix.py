@@ -8,7 +8,7 @@ here as its regression test. Sites live in ``ray_tpu/util/failpoints.py``;
 ``RTPU_FAILPOINTS=0`` disables the whole plane.
 
 Quick subset (tier-1, unmarked): worker kill mid-exec, store seal failure,
-Serve replica death. Everything else — including every multi-node case —
+Serve replica death, compiled-DAG actor death. Everything else — including every multi-node case —
 is ``slow``. Deadlines are generous (2-vCPU CI box, CLAUDE.md deflake
 rules: retried transient-connection polls, no tight wall-clock asserts).
 """
@@ -41,7 +41,8 @@ def _token(tmp_path, name):
 
 
 # ---------------------------------------------------------------------------
-# quick subset (tier-1): worker kill, seal failure, serve replica death
+# quick subset (tier-1): worker kill, seal failure, serve replica death,
+# compiled-DAG actor death
 # ---------------------------------------------------------------------------
 
 def test_worker_kill_mid_exec_task_graph(chaos_rt):
@@ -105,6 +106,50 @@ def test_serve_replica_death_rerouted_and_replaced(chaos_rt):
         assert deps["Echo"]["num_replicas"] == 2  # dead one was replaced
     finally:
         serve.shutdown()
+
+
+def test_compiled_dag_actor_death_mid_loop(chaos_rt):
+    """Kill an actor participating in a compiled DAG mid-loop: the next
+    get() surfaces DAGExecutionError promptly (loop-ref death detection,
+    not a channel-read timeout), the broken DAG refuses new admissions,
+    and teardown unlinks every shm channel."""
+    import os as _os
+
+    from ray_tpu.dag import DAGExecutionError, InputNode
+
+    @ray_tpu.remote
+    class St:
+        def bump(self, x):
+            return x + 1
+
+    a, b = St.remote(), St.remote()
+    with InputNode() as inp:
+        dag = b.bump.bind(a.bump.bind(inp))
+    compiled = dag.experimental_compile(max_in_flight=4)
+    paths = [ch.path for ch in compiled._channels]
+    try:
+        assert compiled.execute(1).get(timeout=60) == 3
+        ray_tpu.kill(a)
+        # wait for the death to land in the directory (the loop ref
+        # resolves to ActorDiedError) so the race where stage `a` still
+        # processes the next input can't make the test flake
+        poll_until(
+            lambda: len(ray_tpu.wait(
+                compiled._loop_refs,
+                num_returns=len(compiled._loop_refs), timeout=0.1)[0]) >= 1,
+            timeout=30, desc="dead actor's exec-loop ref resolved")
+        fut = compiled.execute(2)
+        t0 = time.monotonic()
+        with pytest.raises(DAGExecutionError):
+            fut.get(timeout=60)
+        assert time.monotonic() - t0 < 30, "death surfaced via timeout, " \
+            "not detection"
+        with pytest.raises(DAGExecutionError):
+            compiled.execute(3)   # broken pipeline refuses new work
+    finally:
+        compiled.teardown()
+    assert not any(_os.path.exists(p) for p in paths), \
+        "teardown left shm channels linked"
 
 
 # ---------------------------------------------------------------------------

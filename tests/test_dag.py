@@ -140,7 +140,226 @@ def test_compiled_dag_error_propagates(rt_dag):
         compiled.teardown()
 
 
-def test_compiled_dag_backpressure(rt_dag):
+def test_channel_ring_backlog_and_writer_backpressure():
+    """Ring semantics: several unread values queue in slot order; a full
+    ring blocks the writer (bounded -> ChannelFullError) until the
+    slowest reader's cursor advances."""
+    from ray_tpu.experimental.channel import Channel, ChannelFullError
+
+    name = uuid.uuid4().hex[:8]
+    ch = Channel(name, capacity=1 << 12, create=True, slots=4)
+    try:
+        reader = Channel(name, create=False)
+        for i in range(3):
+            ch.write(i)
+        assert [reader.read(timeout=5) for _ in range(3)] == [0, 1, 2]
+        for i in range(10):          # ring wraps across many cycles
+            ch.write(("wrap", i))
+            assert reader.read(timeout=5) == ("wrap", i)
+        for i in range(4):           # fill every slot
+            ch.write(i)
+        with pytest.raises(ChannelFullError):
+            ch.write(99, timeout=0.2)
+        assert reader.read(timeout=5) == 0   # frees one slot
+        ch.write(99, timeout=5)
+        assert [reader.read(timeout=5) for _ in range(4)] == [1, 2, 3, 99]
+    finally:
+        ch.unlink()
+
+
+def test_channel_unregistered_ring_is_bounded():
+    """Before any reader registers, the ring itself bounds in-flight
+    writes — a writer can never lap values a future reader is entitled
+    to."""
+    from ray_tpu.experimental.channel import Channel, ChannelFullError
+
+    name = uuid.uuid4().hex[:8]
+    ch = Channel(name, capacity=1 << 12, create=True, slots=3)
+    try:
+        for i in range(3):
+            ch.write(i)
+        with pytest.raises(ChannelFullError):
+            ch.write(3, timeout=0.2)
+        reader = Channel(name, create=False)
+        assert reader.read(timeout=5) == 0   # backlog intact from value 0
+    finally:
+        ch.unlink()
+
+
+def test_compiled_dag_pipelined_fifo_and_out_of_order_get(rt_dag):
+    """max_in_flight admissions overlap; results map to THEIR invocation
+    strictly FIFO even when futures are awaited out of order."""
+    @ray_tpu.remote
+    class Stage:
+        def apply(self, x):
+            return x * 10
+
+    s = Stage.remote()
+    with InputNode() as inp:
+        dag = s.apply.bind(inp)
+    compiled = dag.experimental_compile(max_in_flight=8)
+    try:
+        futs = [compiled.execute(i) for i in range(8)]
+        # out-of-order: awaiting the LAST future buffers results 0..6
+        # into their own futures
+        assert futs[7].get(timeout=60) == 70
+        assert [futs[i].get(timeout=60) for i in range(7)] == [
+            i * 10 for i in range(7)]
+        # a second pipelined wave reuses the same rings
+        futs = [compiled.execute(i) for i in range(8)]
+        assert [f.get(timeout=60) for f in futs] == [
+            i * 10 for i in range(8)]
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_dag_pipeline_throughput_overlaps_stages(rt_dag):
+    """A 2-stage pipeline with pipelining admits the whole wave before
+    draining — all results arrive, in order."""
+    @ray_tpu.remote
+    class Stage:
+        def __init__(self, k):
+            self.k = k
+
+        def apply(self, x):
+            return x + self.k
+
+    s1, s2 = Stage.remote(1), Stage.remote(100)
+    with InputNode() as inp:
+        dag = s2.apply.bind(s1.apply.bind(inp))
+    compiled = dag.experimental_compile(max_in_flight=4)
+    try:
+        for _ in range(3):  # several waves
+            futs = [compiled.execute(i) for i in range(4)]
+            assert [f.get(timeout=60) for f in futs] == [
+                i + 101 for i in range(4)]
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_dag_concurrent_producers_fifo(rt_dag):
+    """Two threads drive the same compiled DAG: admission order pairs
+    each future with ITS result (the drive lock serializes admission and
+    whoever drains settles futures for everyone)."""
+    import threading
+
+    @ray_tpu.remote
+    class Stage:
+        def apply(self, x):
+            return x + 1
+
+    s = Stage.remote()
+    with InputNode() as inp:
+        dag = s.apply.bind(inp)
+    compiled = dag.experimental_compile(max_in_flight=8)
+    errors = []
+
+    def drive(tid):
+        try:
+            for i in range(15):
+                x = tid * 1000 + i
+                got = compiled.execute(x).get(timeout=60)
+                if got != x + 1:
+                    errors.append((x, got))
+        except BaseException as e:  # noqa: BLE001 — collected for assert
+            errors.append(repr(e))
+
+    try:
+        threads = [threading.Thread(target=drive, args=(t,))
+                   for t in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_dag_error_isolation_under_pipelining(rt_dag):
+    """An error in invocation k surfaces on future k only — slots k-1 and
+    k+1 resolve to their own correct results."""
+    @ray_tpu.remote
+    class Failer:
+        def boom(self, x):
+            if x == 13:
+                raise ValueError("unlucky")
+            return x
+
+    f = Failer.remote()
+    with InputNode() as inp:
+        dag = f.boom.bind(inp)
+    compiled = dag.experimental_compile(max_in_flight=4)
+    try:
+        futs = [compiled.execute(x) for x in (1, 13, 2)]
+        from ray_tpu.dag import DAGExecutionError
+
+        assert futs[0].get(timeout=60) == 1
+        with pytest.raises(DAGExecutionError):
+            futs[1].get(timeout=60)
+        assert futs[2].get(timeout=60) == 2
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_dag_backpressure_error(rt_dag):
+    """A full pipeline (max_in_flight admissions outstanding) makes
+    execute() block for a completion and raise DAGBackpressureError past
+    its deadline — the shm-layer ChannelFullError never leaks."""
+    @ray_tpu.remote
+    class Slow:
+        def apply(self, x):
+            time.sleep(1.5)
+            return x
+
+    s = Slow.remote()
+    with InputNode() as inp:
+        dag = s.apply.bind(inp)
+    compiled = dag.experimental_compile(max_in_flight=2)
+    try:
+        f1 = compiled.execute(1)
+        f2 = compiled.execute(2)
+        from ray_tpu.dag import DAGBackpressureError, DAGExecutionError
+
+        with pytest.raises(DAGBackpressureError):
+            compiled.execute(3, timeout=0.2)
+        assert issubclass(DAGBackpressureError, DAGExecutionError)
+        assert f1.get(timeout=60) == 1
+        assert f2.get(timeout=60) == 2
+        # slots freed: the same admission now succeeds
+        assert compiled.execute(3, timeout=60).get(timeout=60) == 3
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_dag_execute_async(rt_dag):
+    """Asyncio drivers (serve replicas) admit and await without blocking
+    their loop."""
+    import asyncio
+
+    @ray_tpu.remote
+    class Stage:
+        def apply(self, x):
+            return x * 3
+
+    s = Stage.remote()
+    with InputNode() as inp:
+        dag = s.apply.bind(inp)
+    compiled = dag.experimental_compile(max_in_flight=4)
+
+    async def drive():
+        futs = [await compiled.execute_async(i) for i in range(4)]
+        return [await f for f in futs]
+
+    try:
+        assert asyncio.run(drive()) == [0, 3, 6, 9]
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_dag_teardown_unlinks_channels(rt_dag):
+    import os
+
     @ray_tpu.remote
     class S:
         def f(self, x):
@@ -150,16 +369,10 @@ def test_compiled_dag_backpressure(rt_dag):
     with InputNode() as inp:
         dag = s.f.bind(inp)
     compiled = dag.experimental_compile()
-    try:
-        fut = compiled.execute(1)
-        from ray_tpu.dag.compiled_dag import DAGExecutionError
-
-        with pytest.raises(DAGExecutionError):
-            compiled.execute(2)          # previous result unconsumed
-        assert fut.get(timeout=30) == 1
-        assert compiled.execute(2).get(timeout=30) == 2
-    finally:
-        compiled.teardown()
+    assert compiled.execute(7).get(timeout=30) == 7
+    paths = [ch.path for ch in compiled._channels]
+    compiled.teardown()
+    assert not any(os.path.exists(p) for p in paths)
 
 
 def test_compiled_dag_teardown_frees_actor(rt_dag):

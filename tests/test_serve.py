@@ -633,3 +633,52 @@ def test_request_trace_chain_and_critical_path(rt_serve):
         _t._reset_for_tests()
         import os as _os
         _os.environ.pop("RTPU_TRACING", None)
+
+
+def test_compiled_deployment_steady_state_and_replica_death(rt_serve):
+    """compiled=True routes steady-state requests through a per-replica
+    compiled DAG (no per-call task submission); killing a replica falls
+    back to a normally-routed call with no caller-visible error, and the
+    controller reconciles a replacement."""
+    from conftest import poll_until
+
+    @serve.deployment(num_replicas=2, compiled=True)
+    class Echo:
+        def __init__(self, base):
+            self.base = base
+
+        def __call__(self, x):
+            return self.base + x
+
+        def describe(self):
+            return "echo"
+
+    handle = serve.run(Echo.bind(100))
+    # steady state: many requests, all correct, DAGs built per replica
+    results = [handle.remote(i) for i in range(30)]
+    assert [r.result(timeout_s=60) for r in results] == [
+        100 + i for i in range(30)]
+    assert handle._dags, "compiled path built no DAGs"
+    # non-default method CLONE stays on the compiled plane (options()
+    # must carry _compiled; the response type proves the routing)
+    from ray_tpu.serve.handle import CompiledDeploymentResponse
+
+    resp = handle.describe.remote()
+    assert isinstance(resp, CompiledDeploymentResponse), type(resp)
+    assert resp.result(timeout_s=60) == "echo"
+
+    # replica death: requests keep succeeding (broken-DAG fallback
+    # re-routes + reports), controller replaces the dead replica
+    victim = handle._replicas[0]
+    ray_tpu.kill(victim)
+    vals = [handle.remote(i).result(timeout_s=60) for i in range(20)]
+    assert vals == [100 + i for i in range(20)]
+
+    ctrl = ray_tpu.get_actor("SERVE_CONTROLLER")
+    deps = poll_until(
+        lambda: (ray_tpu.get(ctrl.list_deployments.remote())
+                 if ray_tpu.get(
+                     ctrl.list_deployments.remote())["Echo"][
+                         "num_replicas"] == 2 else None),
+        timeout=60, desc="controller reconciled replacement replica")
+    assert deps["Echo"]["num_replicas"] == 2
