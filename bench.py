@@ -63,6 +63,10 @@ def main() -> None:
     # path), so it survives any TPU trouble — round 1 lost these numbers
     # because the TPU crash happened first.
     detail["core_microbench"] = _core_microbench()
+    # Native-driver A/B (r14): same-container off/on comparison of the
+    # GIL-free control-pipe engine + parallel data plane — the only
+    # numbers that mean anything on container-throttled boxes.
+    detail["native_pipe"] = _native_pipe_ab()
     # Streaming-shuffle bench (r6): out-of-core sort throughput + peak
     # RSS, so exchange regressions (a stage starting to materialize)
     # show up in the BENCH trajectory.
@@ -817,6 +821,161 @@ def _data_shuffle_bench() -> dict:
             else:
                 os.environ[k] = v
     return out
+
+
+def _native_pipe_ab() -> dict:
+    """Same-container off/on A/B of the native driver (r14 tentpole):
+    tasks/s, single- and multi-client shapes with pipe messages/task and
+    driver-CPU/task (the r13 431 µs baseline comparator), and put GB/s
+    against a PRE-WARMED arena (CLAUDE.md: the cold-arena zero-fill is a
+    one-time cost that would otherwise drown the copy-path signal).
+    Each mode boots a fresh runtime; everything else is identical."""
+    import resource as _resource
+
+    import numpy as np
+
+    import ray_tpu
+
+    def _pipe_msg_total():
+        from ray_tpu.util.metrics import registry_records as _rr
+
+        total = 0.0
+        for rec in _rr():
+            if rec["name"] != "rtpu_pipe_messages_total":
+                continue
+            for _k, v in rec["samples"]:
+                total += v if not isinstance(v, tuple) else v[2]
+        return total
+
+    def one_mode(on: bool) -> dict:
+        out: dict = {}
+        os.environ["RTPU_NATIVE_PIPE"] = "1" if on else "0"
+        started = False
+        try:
+            ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+            started = True
+
+            @ray_tpu.remote
+            def noop():
+                return None
+
+            for _ in range(3):
+                ray_tpu.get([noop.remote() for _ in range(60)])
+            if on:
+                from ray_tpu.core.runtime import _get_runtime
+
+                # dialed-back workers only: a replenishment spawn
+                # mid-boot legitimately has no engine yet
+                live = [ws for ws in _get_runtime().workers.values()
+                        if ws.status != "dead" and ws.conn is not None]
+                out["engine_attached"] = bool(live) and all(
+                    ws.npipe is not None for ws in live)
+
+            n = 600
+
+            def tasks_trial():
+                t0 = time.perf_counter()
+                ray_tpu.get([noop.remote() for _ in range(n)])
+                return n / (time.perf_counter() - t0)
+
+            out["tasks_per_s"] = round(
+                max(tasks_trial() for _ in range(3)), 1)
+
+            @ray_tpu.remote
+            class BatchClient:
+                def small_value_batch(self, k):
+                    ray_tpu.get([noop.remote() for _ in range(k)])
+                    return k
+
+            clients = [BatchClient.remote() for _ in range(2)]
+            ray_tpu.get([c.small_value_batch.remote(10) for c in clients])
+            best = None
+            for _ in range(3):
+                ru0 = _resource.getrusage(_resource.RUSAGE_SELF)
+                cpu0 = ru0.ru_utime + ru0.ru_stime
+                m0 = _pipe_msg_total()
+                t0 = time.perf_counter()
+                ray_tpu.get(
+                    [c.small_value_batch.remote(250) for c in clients])
+                wall = time.perf_counter() - t0
+                ru1 = _resource.getrusage(_resource.RUSAGE_SELF)
+                rec = {
+                    "rate_per_s": round(500.0 / wall, 1),
+                    "driver_cpu_us_per_task": round(
+                        (ru1.ru_utime + ru1.ru_stime - cpu0) / 500.0
+                        * 1e6, 1),
+                    "pipe_msgs_per_task": round(
+                        (_pipe_msg_total() - m0) / 500.0, 2),
+                }
+                if best is None or rec["rate_per_s"] > best["rate_per_s"]:
+                    best = rec
+            out["multi_client"] = best
+
+            # put bandwidth, warm arena first (one throwaway burst of the
+            # same footprint pre-faults the extents the timed burst hits)
+            arr = np.random.default_rng(0).standard_normal(1 << 20)
+            for _ in range(16):
+                ray_tpu.put(arr)
+            rates = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                refs = [ray_tpu.put(arr) for _ in range(16)]
+                rates.append(
+                    16 * arr.nbytes / (time.perf_counter() - t0) / 1e9)
+                del refs
+            out["put_gb_per_s_warm"] = round(max(rates), 2)
+
+            @ray_tpu.remote
+            def do_put(nbytes, times):
+                data = np.zeros(nbytes // 8)
+                for _ in range(times):
+                    ray_tpu.put(data)
+                return times * nbytes
+
+            ray_tpu.get(do_put.remote(1 << 16, 1))
+
+            def multi_put_trial(nbytes=8 << 20, times=4, m=2):
+                t0 = time.perf_counter()
+                ray_tpu.get([do_put.remote(nbytes, times)
+                             for _ in range(m)])
+                return m * times * nbytes / (time.perf_counter() - t0) / 1e9
+
+            out["multi_client_put_gb_per_s"] = round(
+                max(multi_put_trial() for _ in range(3)), 2)
+            for c in clients:
+                ray_tpu.kill(c)
+        except Exception as e:  # the bench must never die on the A/B
+            out["error"] = str(e)[:300]
+        finally:
+            if started:
+                try:
+                    ray_tpu.shutdown()
+                except Exception:
+                    pass
+        return out
+
+    saved = os.environ.get("RTPU_NATIVE_PIPE")
+    try:
+        result = {"off": one_mode(False), "on": one_mode(True)}
+    finally:
+        if saved is None:
+            os.environ.pop("RTPU_NATIVE_PIPE", None)
+        else:
+            os.environ["RTPU_NATIVE_PIPE"] = saved
+    try:
+        on, off = result["on"], result["off"]
+        result["summary"] = {
+            "tasks_ratio_on_off": round(
+                on["tasks_per_s"] / off["tasks_per_s"], 3),
+            "multi_vs_single_client_on": round(
+                on["multi_client"]["rate_per_s"] / on["tasks_per_s"], 3),
+            "driver_cpu_delta_us": round(
+                on["multi_client"]["driver_cpu_us_per_task"]
+                - off["multi_client"]["driver_cpu_us_per_task"], 1),
+        }
+    except Exception:
+        pass
+    return result
 
 
 def _core_microbench() -> dict:

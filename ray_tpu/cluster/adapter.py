@@ -51,6 +51,11 @@ GCS_UNAVAILABLE = object()
 # and at most PULL_CONCURRENCY big pulls run at once (pull admission).
 PULL_CHUNK_BYTES = int(config.get("pull_chunk_bytes"))
 PULL_CONCURRENCY = int(config.get("pull_concurrency"))
+# chunk-fetch threads per big pull (r14 data plane): chunks of ONE object
+# stream concurrently over the peer RPC (the client demuxes replies by
+# request id, so concurrent calls share the connection) into disjoint
+# offsets of the preallocated segment.
+PULL_PARALLEL = max(1, int(config.get("pull_parallel")))
 
 # dependency-locality scheduling (reference hybrid_scheduling_policy.h:50
 # + scorer.h roles): ship the task to its data when the data is big.
@@ -81,6 +86,38 @@ def _transfer_metrics():
         "heartbeats": md.get("rtpu_cluster_heartbeats_total"),
         "hb_rtt": md.get("rtpu_cluster_heartbeat_rtt_seconds"),
     }
+
+
+def pull_chunks(call, oid_b: bytes, size: int, writer, *,
+                chunk: int = 4 << 20, parallel: int = 1,
+                timeout: float = 60.0) -> bool:
+    """Fetch one object's chunks through ``call("pull_chunk", ...)`` into
+    an offset-addressed ``writer`` (``IncomingObject`` shape), up to
+    ``parallel`` chunks in flight. Standalone so tests can drive it with
+    a stub peer; the RpcClient's request-id demux makes concurrent
+    ``call``s on one connection safe. Returns False on any short/missing
+    chunk (the caller aborts the receive)."""
+    offsets = list(range(0, size, chunk))
+
+    def fetch(off: int) -> bool:
+        ln = min(chunk, size - off)
+        blob = call("pull_chunk", oid_b, off, ln, timeout=timeout)
+        if blob is None or len(blob) != ln:
+            return False
+        writer.write(off, blob)
+        _transfer_metrics()["pulled"].inc(ln)
+        return True
+
+    try:
+        if parallel <= 1 or len(offsets) <= 1:
+            return all(fetch(off) for off in offsets)
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=min(parallel, len(offsets)),
+                                thread_name_prefix="pull-chunk") as pool:
+            return all(pool.map(fetch, offsets))
+    except Exception:
+        return False
 
 
 class ClusterAdapter:
@@ -744,25 +781,20 @@ class ClusterAdapter:
     def _fetch_chunked(self, oid: ObjectID, peer: RpcClient,
                        size: int) -> bool:
         """Stream one object in PULL_CHUNK_BYTES pieces straight into a
-        preallocated segment. Peak extra memory per end is one chunk (+
-        RPC framing), not the object size. Runs on _pull_io, whose size is
-        the concurrent-pull admission cap."""
+        preallocated segment, PULL_PARALLEL chunks in flight (disjoint
+        offsets; the receive writer is offset-addressed so concurrent
+        writers never overlap). Peak extra memory per end is one chunk
+        per fetch thread. Runs on _pull_io, whose size is the
+        concurrent-pull admission cap."""
         w = self.rt.store.begin_receive(oid, size)
         if w is None:  # already present locally
             self.rt.gcs.mark_ready(oid, size=size)
             return True
-        off = 0
+        if not pull_chunks(peer.call, oid.binary(), size, w,
+                           chunk=PULL_CHUNK_BYTES, parallel=PULL_PARALLEL):
+            w.abort()
+            return False
         try:
-            while off < size:
-                ln = min(PULL_CHUNK_BYTES, size - off)
-                blob = peer.call("pull_chunk", oid.binary(), off, ln,
-                                 timeout=60)
-                if blob is None or len(blob) != ln:
-                    w.abort()
-                    return False
-                w.write(off, blob)
-                _transfer_metrics()["pulled"].inc(ln)
-                off += ln
             w.seal()
         except Exception:
             w.abort()

@@ -82,6 +82,17 @@ _knob("pipe_coalesce_us", int, 200,
 _knob("dag_max_in_flight", int, 8,
       "default overlapping invocations a compiled DAG admits "
       "(ring-channel slots = max_in_flight + 1)", "dag/compiled_dag.py")
+_knob("native_pipe", _bool, True,
+      "drive each worker control pipe through the GIL-free C++ engine "
+      "(framing, batch pack/unpack, send coalescing and refpin "
+      "bookkeeping run in native threads; falls back to the Python "
+      "reader/sender when the .so is missing or stale)",
+      "core/runtime.py")
+_knob("pipe_native_coalesce_us", int, 0,
+      "optional Nagle window for the NATIVE driver->worker sender; 0 "
+      "(default) relies on natural coalescing — everything enqueued "
+      "while the previous write was in flight ships as one batch frame",
+      "core/runtime.py")
 
 # -- object store -----------------------------------------------------------
 _knob("native_store", _bool, True,
@@ -101,6 +112,23 @@ _knob("store_prefault_bytes", str, str(512 << 20),
       "page faults cap cold tmpfs writes at ~2 GB/s on this class of box "
       "vs ~7.5 GB/s warm); '0' disables, 'all' populates the whole arena",
       "_native/__init__.py")
+_knob("store_parallel_copy_bytes", int, 4 << 20,
+      "payload size at or above which store writes/reads use the native "
+      "multi-threaded memcpy (N slicing threads, GIL released); 0 "
+      "disables the parallel path", "core/serialization.py")
+_knob("store_copy_threads", int, 0,
+      "threads for the parallel memcpy path (0 = auto: hardware "
+      "concurrency, capped at 8)", "core/serialization.py")
+_knob("spill_compression", str, "auto",
+      "codec for the disk spill path: auto (native lz4, zlib when the "
+      ".so is unavailable) | lz4 | zlib | off. Files carry a "
+      "self-describing header; readers handle every codec plus legacy "
+      "raw files", "core/spill_codec.py")
+_knob("spill_compress_max_bytes", int, 512 << 20,
+      "objects larger than this spill RAW (mmap-servable): a compressed "
+      "spill read with no shm headroom must inflate to heap, so the cap "
+      "bounds that worst case; 0 = compress everything",
+      "core/spill_codec.py")
 
 # -- cluster ----------------------------------------------------------------
 _knob("gcs_max_objects", int, 200_000,
@@ -126,6 +154,10 @@ _knob("pull_chunk_bytes", int, 4 << 20,
 _knob("pull_concurrency", int, 2,
       "max concurrent big-object pulls per node (admission control, "
       "reference PullManager role)", "cluster/adapter.py")
+_knob("pull_parallel", int, 2,
+      "chunk-fetch threads per big-object pull (chunks of one object "
+      "stream concurrently over the peer RPC into disjoint offsets of "
+      "the preallocated segment); 1 = serial", "cluster/adapter.py")
 _knob("locality_min_bytes", int, 1 << 20,
       "objects at least this big attract dependency-locality placement",
       "cluster/adapter.py")

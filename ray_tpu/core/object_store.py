@@ -243,10 +243,24 @@ class StoreClient:
         spill = (arena_used + self._file_bytes + size
                  > self._spill_threshold)
         if spill:
+            # spill path: STREAM the serialized layout through the codec
+            # (native lz4 / zlib) block by block — disk bandwidth is the
+            # spill ceiling, so bytes saved are wall time saved on BOTH
+            # the spill and the later restore, and peak extra heap stays
+            # one block (spills fire exactly when memory is tight)
+            from ray_tpu.core import spill_codec
+
             os.makedirs(_spill_dir(self.session), exist_ok=True)
             path = _spill_path(self.session, obj_id)
-        else:
-            path = _seg_path(self.session, obj_id)
+            spill_codec.write_spill_stream(
+                path, size,
+                serialization.iter_serialized_blocks(
+                    data, buffers, spill_codec.BLOCK_RAW))
+            m["spilled_bytes"].inc(size)  # logical, as always
+            m["spilled_objects"].inc()
+            self._note_put(m, "spill", size, t0)
+            return None, size
+        path = _seg_path(self.session, obj_id)
         fd = os.open(path, os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
         try:
             os.ftruncate(fd, size)
@@ -255,12 +269,8 @@ class StoreClient:
         finally:
             os.close(fd)
         mm.close()
-        if spill:
-            m["spilled_bytes"].inc(size)
-            m["spilled_objects"].inc()
-        else:
-            self._file_bytes += size
-        self._note_put(m, "spill" if spill else "file", size, t0)
+        self._file_bytes += size
+        self._note_put(m, "file", size, t0)
         return None, size
 
     @staticmethod
@@ -334,7 +344,26 @@ class StoreClient:
             # spill file between the exists check and the open, in which
             # case the segment path exists again
             mm = None
+            fd_kind = -1
             for path in (seg, spilled, seg):
+                if path == spilled:
+                    from ray_tpu.core import spill_codec
+
+                    if spill_codec.is_compressed(path):
+                        # restore was refused (no shm headroom): inflate
+                        # to a HEAP buffer and serve zero-copy views off
+                        # it (fd == -3 pin; liveness via the numpy-base
+                        # refcount, exactly like the arena pin)
+                        blob = spill_codec.read_bytes(path)
+                        if blob is None:
+                            continue
+                        import numpy as _np
+
+                        mm = _np.frombuffer(blob, dtype=_np.uint8)
+                        size = len(blob)
+                        fd_kind = -3
+                        _store_metrics()["spill_read_bytes"].inc(size)
+                        break
                 try:
                     fd = os.open(path, os.O_RDONLY)
                 except FileNotFoundError:
@@ -353,9 +382,11 @@ class StoreClient:
                 existing = self._pins.get(obj_id)
                 if existing is not None:
                     pinned = existing
-                    mm.close()
+                    if fd_kind == -1:
+                        mm.close()
                 else:
-                    pinned = _Pinned(mm, -1, size)
+                    pinned = _Pinned(mm, fd_kind, size,
+                                     baseline=2 if fd_kind == -3 else 0)
                     self._pins[obj_id] = pinned
         value = serialization.read_from(memoryview(pinned.mm))
         try:
@@ -384,11 +415,18 @@ class StoreClient:
         # seg -> spill -> seg: tolerate a concurrent restore unlinking the
         # spill file between candidates
         for path in (seg, spilled, seg):
-            try:
-                with open(path, "rb") as f:
-                    data = f.read()
-            except FileNotFoundError:
-                continue
+            if path == spilled:
+                from ray_tpu.core import spill_codec
+
+                data = spill_codec.read_bytes(path)  # codec-aware
+                if data is None:
+                    continue
+            else:
+                try:
+                    with open(path, "rb") as f:
+                        data = f.read()
+                except FileNotFoundError:
+                    continue
             if path == spilled:
                 _store_metrics()["spill_read_bytes"].inc(len(data))
             return data
@@ -410,12 +448,19 @@ class StoreClient:
         seg = _seg_path(self.session, obj_id)
         spilled = _spill_path(self.session, obj_id)
         for path in (seg, spilled, seg):
-            try:
-                with open(path, "rb") as f:
-                    f.seek(offset)
-                    data = f.read(length)
-            except FileNotFoundError:
-                continue
+            if path == spilled:
+                from ray_tpu.core import spill_codec
+
+                data = spill_codec.read_range(path, offset, length)
+                if data is None:
+                    continue
+            else:
+                try:
+                    with open(path, "rb") as f:
+                        f.seek(offset)
+                        data = f.read(length)
+                except FileNotFoundError:
+                    continue
             if path == spilled:
                 _store_metrics()["spill_read_bytes"].inc(len(data))
             return data
@@ -462,6 +507,14 @@ class StoreClient:
                     return
                 del self._pins[obj_id]
                 self._arena.release(obj_id.binary())
+                return
+            if pinned.fd == -3:
+                # heap pin (decompressed spill served without restore):
+                # same refcount liveness guard; nothing to unmap — the
+                # buffer dies with the pin
+                if sys.getrefcount(pinned.mm) > pinned.baseline:
+                    return
+                del self._pins[obj_id]
                 return
             try:
                 pinned.mm.close()
@@ -570,10 +623,11 @@ class StoreClient:
         seg = _seg_path(self.session, obj_id)
         if os.path.exists(seg):
             return True
+        from ray_tpu.core import spill_codec
+
         path = _spill_path(self.session, obj_id)
-        try:
-            size = os.stat(path).st_size
-        except OSError:
+        size = spill_codec.raw_size(path)  # LOGICAL size (codec-aware)
+        if size is None:
             return False  # not spilled here
         # headroom gate on the ACCURATE cross-process accounting, not this
         # client's O(1) running total: the process serving a peer pull has
@@ -635,21 +689,13 @@ class StoreClient:
     @staticmethod
     def _copy_file_into(path: str, buf, size: int,
                         chunk: int = 8 << 20) -> bool:
-        """Copy a spill file into a writable buffer in bounded chunks —
-        restoring a multi-GB object (the serve path runs this inside a
-        chunked peer pull) must never materialize it in this heap."""
-        off = 0
-        try:
-            with open(path, "rb") as f:
-                while off < size:
-                    data = f.read(min(chunk, size - off))
-                    if not data:
-                        return False  # truncated under us
-                    buf[off:off + len(data)] = data
-                    off += len(data)
-        except OSError:
-            return False
-        return off == size
+        """Decompress/copy a spill file into a writable buffer in bounded
+        chunks — restoring a multi-GB object (the serve path runs this
+        inside a chunked peer pull) must never materialize it in this
+        heap. ``size`` is the LOGICAL object size (spill_codec.raw_size)."""
+        from ray_tpu.core import spill_codec
+
+        return spill_codec.read_into(path, buf, size, chunk=chunk)
 
     @staticmethod
     def cleanup_session(session: str) -> None:

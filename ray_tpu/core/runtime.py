@@ -52,6 +52,12 @@ _SENT_KEY = (("direction", "sent"),)
 _RECV_KEY = (("direction", "recv"),)
 _SPAWN_KEYS = {"zygote": (("mode", "zygote"),), "exec": (("mode", "exec"),)}
 
+#: wire magic of a packed worker->driver refpin frame (parsed natively by
+#: the pipe engine; the Python fallback reader understands it too)
+_REFPIN_MAGIC = b"RTP1"
+#: wire magic of a native-coalesced driver->worker batch frame
+_BATCH_MAGIC = b"RTB1"
+
 
 def _pipe_metrics():
     from ray_tpu.util import metric_defs as md
@@ -59,7 +65,9 @@ def _pipe_metrics():
     return {"sent": md.get("rtpu_pipe_sent_bytes_total"),
             "recv": md.get("rtpu_pipe_recv_bytes_total"),
             "msgs": md.get("rtpu_pipe_messages_total"),
-            "batch": md.get("rtpu_pipe_batch_messages")}
+            "batch": md.get("rtpu_pipe_batch_messages"),
+            "nsend": md.get("rtpu_pipe_native_send_seconds"),
+            "ndrain": md.get("rtpu_pipe_native_drain_messages")}
 
 
 def _set_runtime(rt):
@@ -78,7 +86,7 @@ class _WorkerState:
         "worker_id", "proc", "conn", "kind", "status", "current",
         "held", "actor_id", "reader", "released", "send_lock", "log_path",
         "pending_spec", "inflight_specs", "pinned", "spawn_ts",
-        "spawn_mode",
+        "spawn_mode", "npipe", "sent_ctr", "native_pin_q",
     )
 
     def __init__(self, worker_id: WorkerID, proc, kind: str):
@@ -87,6 +95,18 @@ class _WorkerState:
         self.worker_id = worker_id
         self.proc = proc  # subprocess.Popen
         self.conn = None  # attached when the worker dials back
+        # GIL-free pipe engine for this connection (None = Python path).
+        # Once attached, the engine owns every read/write on the fd; the
+        # Connection object only keeps the fd alive.
+        self.npipe = None
+        self.sent_ctr = 0  # 1-in-64 sampling of the nsend histogram
+        # refpin transitions surfaced by the engine, pending application
+        # (appended lock-free by _native_cb_refpins, drained by THIS
+        # connection's reader thread — per-worker so no other reader can
+        # steal a +1 and apply it after a later 'done' in our burst)
+        from collections import deque as _wdeque
+
+        self.native_pin_q: "_wdeque" = _wdeque()
         self.kind = kind  # "pool" | "actor"
         self.status = "starting"  # starting | idle | busy | dead
         self.current: Optional[dict] = None
@@ -117,6 +137,28 @@ class _WorkerState:
         from multiprocessing.reduction import ForkingPickler
 
         buf = ForkingPickler.dumps(msg)
+        np_ = self.npipe
+        if np_ is not None:
+            # GIL-free fast path: the engine frames and writes inline
+            # (nonblocking) or hands off to its sender thread when the
+            # socket backs up. NO per-message Python metric work here —
+            # the engine counts natively and the runtime's collector
+            # reconciles rtpu_pipe_* at exposition time; only a sampled
+            # 1-in-64 enqueue-latency observation stays on this path.
+            self.sent_ctr += 1
+            if self.sent_ctr & 63:
+                if not np_.send(buf):
+                    raise OSError("native pipe closed (worker gone)")
+                return
+            t0 = time.perf_counter()
+            if not np_.send(buf):
+                raise OSError("native pipe closed (worker gone)")
+            try:
+                _pipe_metrics()["nsend"]._observe_key(
+                    (), time.perf_counter() - t0)
+            except Exception:
+                pass
+            return
         with self.send_lock:
             self.conn.send_bytes(buf)
         try:
@@ -612,6 +654,14 @@ class DriverRuntime:
         g_argpin = metric_defs.get("rtpu_refcount_arg_pin_entries")
         g_lin = metric_defs.get("rtpu_lineage_entries")
         g_linb = metric_defs.get("rtpu_lineage_bytes")
+        g_nframes = metric_defs.get("rtpu_pipe_native_frames")
+        g_nmsgs = metric_defs.get("rtpu_pipe_native_messages")
+        g_ntrans = metric_defs.get("rtpu_pipe_native_refpin_transitions")
+        # last reconciled native totals per worker id (the engine counts
+        # bytes/messages off-GIL; the rtpu_pipe_* counters are advanced by
+        # the DELTA here so scrapes stay correct with zero per-message
+        # Python cost on the native path)
+        native_seen: Dict[bytes, dict] = {}
 
         def collect():
             if self._shutdown:
@@ -620,13 +670,67 @@ class DriverRuntime:
             g_ready.set(len(self.ready_tasks))
             inflight = 0
             pool = {"starting": 0, "idle": 0, "busy": 0}
+            nstats = {"sent_frames": 0, "sent_msgs": 0, "recv_frames": 0,
+                      "recv_msgs": 0, "refpin_transitions": 0}
+            native_any = False
+            live_wids = set()
             for ws in list(self.workers.values()):
                 inflight += len(ws.inflight_specs)
                 if ws.status in pool:
                     pool[ws.status] += 1
+                if ws.npipe is not None:
+                    native_any = True
+                    live_wids.add(ws.worker_id.binary())
+                    try:
+                        st = ws.npipe.stats()
+                        if not st:
+                            st = native_seen.get(ws.worker_id.binary(), {})
+                        for k in nstats:
+                            nstats[k] += st.get(k, 0)
+                        last = native_seen.setdefault(
+                            ws.worker_id.binary(), {})
+                        d_sb = st.get("sent_bytes", 0) - last.get(
+                            "sent_bytes", 0)
+                        d_sm = st.get("sent_msgs", 0) - last.get(
+                            "sent_msgs", 0)
+                        d_rb = st.get("recv_bytes", 0) - last.get(
+                            "recv_bytes", 0)
+                        # FRAMES, not sub-messages: the Python reader
+                        # counts one "message" per received frame (a
+                        # coalesced batch counts once, its size going to
+                        # rtpu_pipe_batch_messages) — keep the native
+                        # reconciliation on the same definition so the
+                        # off/on msgs-per-task A/B stays comparable
+                        d_rm = st.get("recv_frames", 0) - last.get(
+                            "recv_frames", 0)
+                        if d_sb or d_sm or d_rb or d_rm:
+                            m = _pipe_metrics()
+                            m["sent"]._inc_key((), d_sb)
+                            m["recv"]._inc_key((), d_rb)
+                            m["msgs"]._inc_key(_SENT_KEY, d_sm)
+                            m["msgs"]._inc_key(_RECV_KEY, d_rm)
+                        native_seen[ws.worker_id.binary()] = dict(st)
+                    except Exception:
+                        pass
             g_inflight.set(inflight)
             for k, v in pool.items():
                 g_pool.set(v, tags={"state": k})
+            # prune reconciliation state for departed workers (their
+            # final deltas were taken while they were still listed)
+            for wid in list(native_seen):
+                if wid not in live_wids:
+                    del native_seen[wid]
+            if native_any:
+                # monotonic-within-a-worker-set counters, sampled (the
+                # contention-stats pattern): mean msgs/frame is the
+                # coalescing factor the A/B bench reads
+                g_nframes.set(nstats["sent_frames"],
+                              tags={"direction": "sent"})
+                g_nframes.set(nstats["recv_frames"],
+                              tags={"direction": "recv"})
+                g_nmsgs.set(nstats["sent_msgs"], tags={"direction": "sent"})
+                g_nmsgs.set(nstats["recv_msgs"], tags={"direction": "recv"})
+                g_ntrans.set(nstats["refpin_transitions"])
             g_pending.set(sum(
                 len(i.pending_queue)
                 for i in list(self.gcs.actors.values())))
@@ -707,9 +811,31 @@ class DriverRuntime:
                 conn.close()
                 continue
             ws.conn = conn
-            reader = threading.Thread(target=self._reader_loop, args=(ws,), daemon=True)
+            ws.npipe = self._attach_native_pipe(conn)
+            target = (self._native_reader_loop if ws.npipe is not None
+                      else self._reader_loop)
+            reader = threading.Thread(target=target, args=(ws,), daemon=True)
             ws.reader = reader
             reader.start()
+
+    def _attach_native_pipe(self, conn):
+        """The GIL-free engine for one worker connection, or None (kill
+        switch RTPU_NATIVE_PIPE=0, missing/stale .so — hasattr-gated like
+        rtpu_frag_stats, so a pre-pipe .so degrades to the Python path
+        instead of crashing)."""
+        if not config.get("native_pipe"):
+            return None
+        try:
+            from ray_tpu import _native
+
+            if not _native.pipe_engine_available():
+                return None
+            return _native.NativePipe(
+                conn.fileno(),
+                coalesce_us=int(config.get("pipe_native_coalesce_us")))
+        except Exception:
+            logger.exception("native pipe attach failed; Python pipe path")
+            return None
 
     def _zygote(self):
         """The fork-server spawner (see core/zygote.py), started lazily.
@@ -862,6 +988,11 @@ class DriverRuntime:
                 # recv_bytes + loads == conn.recv() internals, with the
                 # framed size in hand for the pipe byte counters
                 buf = ws.conn.recv_bytes()
+                if buf[:4] == _REFPIN_MAGIC:
+                    # packed borrow transitions (workers ship these
+                    # whether or not the driver's native engine loaded)
+                    self._apply_refpin_frame(ws, buf[4:])
+                    continue
                 msg = _pickle.loads(buf)
             except (EOFError, OSError):
                 self._on_worker_death(ws)
@@ -885,6 +1016,131 @@ class DriverRuntime:
                     import traceback
 
                     traceback.print_exc()
+
+    def _apply_refpin_frame(self, ws: _WorkerState, payload: bytes) -> None:
+        """Python-fallback twin of the native refpin table: parse a packed
+        (id[16] + i8 delta)* frame and apply each transition in order."""
+        import struct as _struct
+
+        for oid_b, d in _struct.iter_unpack("<16sb", payload):
+            self.worker_ref_delta(ws, oid_b, d)
+
+    def _native_reader_loop(self, ws: _WorkerState):
+        """Drain thread over the GIL-free engine: the engine's receiver
+        thread already did the length-prefix reads, batch unpacking and
+        refpin bookkeeping; this thread wakes per BURST (not per message),
+        unpickles, and dispatches. Refpin-transition records go through
+        the lock-free ``_native_cb_*`` callback and are applied at the
+        explicit drain point below — never inside the callback."""
+        import pickle as _pickle
+
+        from ray_tpu import _native
+
+        np_ = ws.npipe
+        # metric handles hoisted out of the wake loop (a test's
+        # clear_registry orphans them at worst — lost samples, not
+        # errors; the byte/message counters are reconciled freshly by
+        # the exposition collector either way), and the drain-shape
+        # histogram is sampled 1-in-16 wakes
+        try:
+            m = _pipe_metrics()
+        except Exception:
+            m = None
+        wakes = 0
+        while True:
+            recs = np_.drain(timeout=0.5)
+            if recs is None:  # EOF: worker gone (all records delivered)
+                if ws.native_pin_q:
+                    self._drain_native_pins(ws)
+                try:
+                    # stop the engine's sender thread now (join happens at
+                    # driver shutdown — never from this drain thread);
+                    # drain_pins in the death path below still works
+                    np_.shutdown()
+                except Exception:
+                    pass
+                self._on_worker_death(ws)
+                return
+            if not recs:
+                if ws.native_pin_q:
+                    self._drain_native_pins(ws)
+                continue
+            wakes += 1
+            if m is not None and not (wakes & 15):
+                try:
+                    m["ndrain"].observe(len(recs))
+                except Exception:
+                    pass
+            for typ, payload in recs:
+                if typ == _native.REC_REFPINS:
+                    # queue (lock-free callback contract) AND drain
+                    # IMMEDIATELY: transitions must apply in record order
+                    # relative to the messages around them — a +1 borrow
+                    # deferred past a later 'done' in the same burst
+                    # would re-open the 1->0->1 unpin race the worker
+                    # prevents by sending pins first
+                    self._native_cb_refpins(ws, payload)
+                    self._drain_native_pins(ws)
+                    continue
+                try:
+                    msg = _pickle.loads(payload)
+                except Exception:
+                    # a mis-framed/corrupt record must be LOUD — if it
+                    # carried a done, its caller is now hung (rate limit:
+                    # one line per drop burst is fine at this severity)
+                    logger.exception(
+                        "dropping unpicklable pipe record from worker "
+                        "%s (%d bytes)", ws.worker_id.hex()[:8],
+                        len(payload))
+                    continue
+                if msg[0] == "batch":
+                    try:
+                        if m is not None:
+                            m["batch"].observe(len(msg[1]))
+                    except Exception:
+                        pass
+                    subs = msg[1]
+                else:
+                    subs = (msg,)
+                for sub in subs:
+                    try:
+                        self._handle_msg(ws, sub)
+                    except Exception:
+                        import traceback
+
+                        traceback.print_exc()
+            # the drain point: apply queued transitions with locks allowed
+            if ws.native_pin_q:
+                self._drain_native_pins(ws)
+
+    def _native_cb_refpins(self, ws: _WorkerState, payload: bytes) -> None:
+        """Callback the native receiver drain hands packed refpin
+        transitions to. MUST stay lock-free (graftlint
+        native-callback-lock-discipline): it only appends to the
+        CONNECTION's pending queue; ``_drain_native_pins`` applies at
+        the reader's drain point."""
+        # graftlint: deque append is GIL-atomic; no locks by contract
+        ws.native_pin_q.append(payload)
+
+    def _drain_native_pins(self, ws: _WorkerState) -> None:
+        """Apply refpin transitions queued by the native callback (the
+        only place they may take ``_ref_lock``-family locks). The queue
+        is per-worker and drained only by that connection's reader
+        thread, so a +1 borrow can never be applied after a LATER 'done'
+        of the same burst (another reader stealing from a shared queue
+        could be preempted holding the +1 while this thread releases the
+        matching arg pin)."""
+        import struct as _struct
+
+        while True:
+            try:
+                payload = ws.native_pin_q.popleft()
+            except IndexError:
+                return
+            for oid_b, d in _struct.iter_unpack("<16sb", payload):
+                # per-worker bookkeeping lives in the NATIVE table (see
+                # _drop_worker_pins); only the node-level pin moves here
+                self._pin_delta(oid_b, d)
 
     def _on_worker_death(self, ws: _WorkerState):
         with self.lock:
@@ -1609,6 +1865,19 @@ class DriverRuntime:
         for oid_b, n in pins.items():
             for _ in range(n):
                 self._pin_delta(oid_b, -1)
+        if ws.npipe is not None:
+            # the native engine owns this connection's borrow table;
+            # drain-and-clear it so a dead worker's pins release exactly
+            # like the Python-path ws.pinned above
+            try:
+                native_pins = ws.npipe.drain_pins()
+            except Exception:
+                native_pins = []
+            for oid_b, n in native_pins:
+                # a positive native count surfaced exactly ONE +1
+                # transition to _pin_total (0<->1 semantics): undo it once
+                if n > 0:
+                    self._pin_delta(oid_b, -1)
 
     # ------------------------------------------------------------------
     # lineage reconstruction
@@ -2659,6 +2928,14 @@ class DriverRuntime:
                     ws.proc.wait(0.5)
                 except Exception:
                     ws.proc.kill()
+        for ws in workers:
+            # reclaim the native engines' threads (never from their own
+            # drain thread — this is the driver's shutdown caller)
+            if ws.npipe is not None:
+                try:
+                    ws.npipe.close()
+                except Exception:
+                    pass
         with self._zygote_lock:
             if self._zygote_obj is not None:
                 self._zygote_obj.close()

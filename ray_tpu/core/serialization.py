@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import pickle
 import struct
-from typing import Any, List, Tuple
+from typing import Any, List, Optional, Tuple
 
 import cloudpickle
 
@@ -82,6 +82,65 @@ def serialized_size(data: bytes, buffers: List[pickle.PickleBuffer]) -> int:
     return off
 
 
+#: lazy state for the native multi-threaded copy path (r14 data plane):
+#: slices at or above RTPU_STORE_PARALLEL_COPY_BYTES go through
+#: _native.parallel_copy (N slicing threads, GIL released), targeting the
+#: measured single-thread memcpy ceiling in aggregate. 0 threshold or a
+#: missing .so disables it; the fallback is the plain slice assignment.
+_pcopy_min: Optional[int] = None
+_pcopy_threads = 0
+_pcopy_fn = None
+_pcopy_metrics = None
+
+
+def _parallel_copy_setup():
+    global _pcopy_min, _pcopy_threads, _pcopy_fn
+    from ray_tpu import config
+
+    _pcopy_min = int(config.get("store_parallel_copy_bytes"))
+    _pcopy_threads = int(config.get("store_copy_threads"))
+    if _pcopy_min > 0:
+        try:
+            from ray_tpu import _native
+
+            if _native.pipe_engine_available():
+                _pcopy_fn = _native.parallel_copy
+            else:
+                _pcopy_min = 0
+        except Exception:
+            _pcopy_min = 0
+    return _pcopy_min
+
+
+def _blit(mv: memoryview, off: int, raw) -> None:
+    """One serialized-buffer copy into the store segment; large slices
+    ride the native multi-threaded memcpy."""
+    nb = raw.nbytes
+    src = raw.cast("B") if raw.format != "B" or raw.ndim != 1 else raw
+    limit = _pcopy_min if _pcopy_min is not None else _parallel_copy_setup()
+    if limit and nb >= limit:
+        global _pcopy_metrics
+        try:
+            import time as _time
+
+            t0 = _time.perf_counter()
+            _pcopy_fn(mv[off:off + nb], src, _pcopy_threads)
+            dt = _time.perf_counter() - t0
+            if _pcopy_metrics is None:
+                from ray_tpu.util import metric_defs as _md
+
+                _pcopy_metrics = (
+                    _md.get(
+                        "rtpu_object_store_parallel_copy_bytes_total"),
+                    _md.get("rtpu_object_store_parallel_copy_seconds"))
+            _pcopy_metrics[0].inc(nb)
+            _pcopy_metrics[1].observe(dt)
+            return
+        except Exception:
+            pass  # any native hiccup falls back to the plain copy
+    mv[off:off + nb] = src
+
+
 def write_into(mv: memoryview, data: bytes, buffers: List[pickle.PickleBuffer]) -> int:
     """Writes the serialized object into ``mv``; returns bytes written."""
     n = len(buffers)
@@ -95,9 +154,54 @@ def write_into(mv: memoryview, data: bytes, buffers: List[pickle.PickleBuffer]) 
     for b in buffers:
         raw = b.raw()
         nb = raw.nbytes
-        mv[off : off + nb] = raw.cast("B") if raw.format != "B" or raw.ndim != 1 else raw
+        _blit(mv, off, raw)
         off = _pad(off + nb)
     return off
+
+
+def iter_serialized_blocks(data: bytes, buffers: List[pickle.PickleBuffer],
+                           block_size: int):
+    """Yield the exact ``write_into`` layout as successive bytes chunks of
+    ``block_size`` (last may be short) WITHOUT materializing the whole
+    object — the spill-write path streams these through the codec, so a
+    multi-GB spill's peak extra heap is one block, not the object
+    (the restore side has honored that bound all along)."""
+    n = len(buffers)
+    head = bytearray(_HDR.size + 8 * n)
+    _HDR.pack_into(head, 0, MAGIC, n, len(data))
+    off = _HDR.size
+    for b in buffers:
+        struct.pack_into("<Q", head, off, b.raw().nbytes)
+        off += 8
+    pos = len(head) + len(data)
+
+    def pieces():
+        yield memoryview(head)
+        yield memoryview(data)
+        p = pos
+        yield memoryview(b"\x00" * (_pad(p) - p))
+        p = _pad(p)
+        for b in buffers:
+            raw = b.raw()
+            yield (raw.cast("B")
+                   if raw.format != "B" or raw.ndim != 1 else raw)
+            p += raw.nbytes
+            yield memoryview(b"\x00" * (_pad(p) - p))
+            p = _pad(p)
+
+    block = bytearray()
+    for mv in pieces():
+        o = 0
+        ln = len(mv)
+        while o < ln:
+            take = min(block_size - len(block), ln - o)
+            block += mv[o:o + take]
+            o += take
+            if len(block) == block_size:
+                yield bytes(block)
+                block.clear()
+    if block:
+        yield bytes(block)
 
 
 def read_from(mv: memoryview) -> Any:

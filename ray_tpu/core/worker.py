@@ -70,6 +70,11 @@ class WorkerRuntime:
         from collections import deque as _cast_deque
 
         self._cast_q: "_cast_deque" = _cast_deque()
+        # packed refpin transitions awaiting the same Nagle flush (the
+        # r13 pickled path buffered them inside _cast_q; the r14 packed
+        # path must keep that cadence or every 0<->1 transition pays its
+        # own frame + syscall)
+        self._refpin_buf: list = []
         self._cast_q_lock = threading.Lock()
         self._flush_ev = threading.Event()
         self._flusher_started = False
@@ -92,9 +97,11 @@ class WorkerRuntime:
         from ray_tpu.core.refqueue import DeferredDrops, OrderedCastFlusher
 
         # batch mode: one "refpins" cast per drain instead of one pipe
-        # message per 0<->1 transition (r13 control-message coalescing)
-        self._ref_casts = OrderedCastFlusher(
-            lambda items: self.cast("refpins", items), batch=True)
+        # message per 0<->1 transition (r13 control-message coalescing).
+        # With the native driver engine (r14) the batch ships as a PACKED
+        # binary frame the driver's C++ receiver applies off the GIL.
+        self._ref_casts = OrderedCastFlusher(self._ship_refpins, batch=True)
+        self._refpin_packed: Optional[bool] = None
         # store pins to drop once outside _refs_lock (see
         # _apply_ref_drop_locked); deque: append/popleft are atomic
         from collections import deque as _deque
@@ -193,7 +200,11 @@ class WorkerRuntime:
         """Ship pending casts (+ optionally ``msg``) as ONE frame.
         Drain happens under the send lock, so frame order matches global
         issue order — a cast enqueued before a done/req can never be
-        observed after it."""
+        observed after it. Buffered packed refpins go out FIRST (a +1
+        borrow must reach the driver before the done that releases the
+        matching arg pin), in their own binary frame."""
+        import struct as _struct
+
         with self._send_lock:
             with self._cast_q_lock:
                 if self._cast_q:
@@ -201,6 +212,12 @@ class WorkerRuntime:
                     self._cast_q.clear()
                 else:
                     batch = []
+                pins = self._refpin_buf
+                if pins:
+                    self._refpin_buf = []
+            if pins:
+                self.conn.send_bytes(b"RTP1" + b"".join(
+                    _struct.pack("<16sb", oid_b, d) for oid_b, d in pins))
             if msg is not None:
                 batch.append(msg)
             if not batch:
@@ -254,6 +271,36 @@ class WorkerRuntime:
             except (OSError, BrokenPipeError):
                 return  # pipe gone: the recv loop exits the process
 
+    def _ship_refpins(self, items) -> None:
+        """Ship one drained batch of borrow transitions. Packed wire form
+        ("RTP1" + (id[16] + i8)*) when the native-pipe plane is on — the
+        driver applies it without touching the interpreter (its Python
+        fallback reader parses the same frame); else the r13 pickled
+        ``refpins`` cast. Either way the transitions ride the SAME Nagle
+        cadence as ordinary casts (a frame per 0<->1 transition would
+        triple the multi-client frames/task)."""
+        if self._refpin_packed is None:
+            try:
+                from ray_tpu import config as _cfg
+
+                self._refpin_packed = bool(_cfg.get("native_pipe"))
+            except Exception:
+                self._refpin_packed = False
+        if not self._refpin_packed:
+            self.cast("refpins", items)
+            return
+        # the ONE worker->driver chaos filter covers this egress too
+        if self._dropped(("cast", "refpins", (items,))):
+            return
+        with self._cast_q_lock:
+            self._refpin_buf.extend(items)
+        if self._coalesce_window() <= 0:
+            self._send_frame()
+            return
+        if not self._flusher_started:
+            self._start_cast_flusher()
+        self._flush_ev.set()
+
     def _ref_added(self, oid_b: bytes) -> None:
         with self._refs_lock:
             before = self._ref_counts.get(oid_b, 0)
@@ -305,11 +352,26 @@ class WorkerRuntime:
         t.start()
 
     def _recv_loop(self):
+        import pickle as _pickle
+
         while True:
             try:
-                msg = self.conn.recv()
+                buf = self.conn.recv_bytes()
             except (EOFError, OSError):
                 os._exit(0)
+            if buf[:4] == b"RTB1":
+                # native-coalesced driver frame: magic + u32be count +
+                # (u32be len + pickle)* — the GIL-free sender packs every
+                # message queued during the previous write into one frame
+                n = int.from_bytes(buf[4:8], "big")
+                off = 8
+                for _ in range(n):
+                    ln = int.from_bytes(buf[off:off + 4], "big")
+                    off += 4
+                    self._dispatch_recv(_pickle.loads(buf[off:off + ln]))
+                    off += ln
+                continue
+            msg = _pickle.loads(buf)
             if msg[0] == "batch":
                 for sub in msg[1]:
                     self._dispatch_recv(sub)

@@ -187,3 +187,49 @@ class BlockingUnderLock(Rule):
                         f"control plane (r8: the driver lock IS the hot "
                         f"path); send/recv outside, publish results under "
                         f"the lock")
+
+
+@register
+class NativeCallbackLockDiscipline(Rule):
+    name = "native-callback-lock-discipline"
+    family = FAMILY_LOCKS
+    summary = ("``_native_cb_*`` callbacks (invoked from the native pipe "
+               "engine's receiver drain) must not acquire locks — not "
+               "directly and not one call away; append to the pending "
+               "queue and let the reader loop's drain point apply under "
+               "the driver.lock-family locks")
+
+    #: the callback naming convention: the native drain path invokes
+    #: exactly these; everything they touch must be lock-free
+    #: (deque.append / event.set), or a slow lock holder stalls the whole
+    #: connection's message intake
+    PREFIX = "_native_cb_"
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for mod in project.modules:
+            for ci in mod.classes.values():
+                for name, fi in ci.methods.items():
+                    if not name.startswith(self.PREFIX):
+                        continue
+                    for key in sorted(fi.acquires):
+                        yield self.finding(
+                            mod, fi.lineno,
+                            f"{ci.name}.{name}() acquires {key} — native "
+                            f"drain callbacks must stay lock-free: queue "
+                            f"the payload (deque.append is GIL-atomic) "
+                            f"and apply it at the reader loop's "
+                            f"_drain_native_pins() point")
+                    # one call level: callback -> self.m() where m locks
+                    for callee_name in sorted(fi.self_calls):
+                        callee = ci.methods.get(callee_name)
+                        if callee is None or not callee.acquires:
+                            continue
+                        locks = ", ".join(sorted(callee.acquires))
+                        yield self.finding(
+                            mod, fi.lineno,
+                            f"{ci.name}.{name}() calls "
+                            f"self.{callee_name}(), which acquires "
+                            f"{locks} — native drain callbacks must not "
+                            f"take driver.lock-family locks even "
+                            f"indirectly; post to the pending queue "
+                            f"instead")

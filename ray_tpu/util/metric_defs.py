@@ -120,6 +120,29 @@ _def("rtpu_pipe_batch_messages", "histogram",
      "at driver receive)",
      boundaries=(2, 3, 5, 8, 13, 21, 34, 55, 89), component="scheduler")
 
+# native pipe engine (driver side; see native/pipe.cc + _native.NativePipe)
+_def("rtpu_pipe_native_send_seconds", "histogram",
+     "driver-side enqueue latency per control message handed to the "
+     "GIL-free pipe engine (framing + write happen on its sender thread)",
+     boundaries=_LAT_FAST, component="scheduler")
+_def("rtpu_pipe_native_drain_messages", "histogram",
+     "records per native-engine drain wake on a driver reader thread "
+     "(one GIL acquisition services this many worker messages)",
+     boundaries=(1, 2, 3, 5, 8, 13, 21, 34, 55, 89),
+     component="scheduler")
+_def("rtpu_pipe_native_frames", "gauge",
+     "frames the native pipe engines wrote/read across live worker "
+     "connections, by direction (monotonic, sampled)",
+     tag_keys=("direction",), component="scheduler")
+_def("rtpu_pipe_native_messages", "gauge",
+     "messages packed into / split out of native pipe frames, by "
+     "direction (monotonic, sampled; messages/frames = the coalescing "
+     "factor)", tag_keys=("direction",), component="scheduler")
+_def("rtpu_pipe_native_refpin_transitions", "gauge",
+     "net 0<->1 borrow transitions the native refcount tables surfaced "
+     "to Python (deltas beyond these never touched the interpreter; "
+     "monotonic, sampled)", component="scheduler")
+
 # compiled execution plane (dag/compiled_dag.py + experimental/channel.py)
 _def("rtpu_dag_executions_total", "counter",
      "compiled-DAG invocations admitted (execute/execute_async)",
@@ -202,6 +225,23 @@ _def("rtpu_object_store_restored_objects_total", "counter",
 _def("rtpu_object_store_spill_read_bytes_total", "counter",
      "bytes served directly from spill files (reads + remote pulls that "
      "did not restore first)", component="object_store")
+_def("rtpu_object_store_spill_compressed_bytes_total", "counter",
+     "physical (compressed) bytes written to spill files — compare with "
+     "rtpu_object_store_spilled_bytes_total (logical) for the overall "
+     "spill compression factor", component="object_store")
+_def("rtpu_object_store_spill_compression_ratio", "histogram",
+     "logical/physical size ratio per compressed spill write (1.0 = "
+     "stored raw: incompressible or codec off)",
+     boundaries=(1.0, 1.1, 1.25, 1.5, 2, 3, 5, 10, 25),
+     component="object_store")
+_def("rtpu_object_store_parallel_copy_bytes_total", "counter",
+     "payload bytes moved by the native multi-threaded memcpy path "
+     "(large put/get slices past RTPU_STORE_PARALLEL_COPY_BYTES)",
+     component="object_store")
+_def("rtpu_object_store_parallel_copy_seconds", "histogram",
+     "wall time of native multi-threaded copies (bytes/seconds = "
+     "achieved aggregate memcpy bandwidth)",
+     boundaries=_LAT_FAST, component="object_store")
 _def("rtpu_object_store_spill_dir_bytes", "gauge",
      "bytes currently spilled to disk on this node (sampled)",
      component="object_store")

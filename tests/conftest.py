@@ -170,6 +170,26 @@ def poll_until(predicate, timeout=30.0, interval=0.2, desc="condition"):
         + (f"; last transient error: {last_exc!r}" if last_exc else ""))
 
 
+@pytest.fixture(scope="session", autouse=True)
+def _native_build_contract():
+    """The native extension is either fully loaded or cleanly fallen
+    back — never a silent half-state (r14 satellite): a .so that loads
+    but lacks the pipe-engine symbols after the automatic rebuild is a
+    broken build this suite refuses to paper over."""
+    from ray_tpu import _native
+
+    st = _native.native_status()
+    assert not st.get("stale"), (
+        f"native extension half-state {st}: the .so loaded but lacks the "
+        f"pipe engine after a rebuild attempt — run `make -C native` and "
+        f"check compiler output")
+    # loaded implies every feature family is bound; not loaded means the
+    # pure-Python fallbacks are active everywhere (a consistent state)
+    if st["loaded"]:
+        assert st["pipe"] and st["lz4"], st
+    yield
+
+
 @pytest.fixture
 def rt():
     import ray_tpu

@@ -1,0 +1,16 @@
+# graftlint: path=ray_tpu/core/runtime.py
+"""Offender: the callback reaches a lock one call away."""
+import threading
+
+
+class DriverRuntime:
+    def __init__(self):
+        self._ref_lock = threading.Lock()
+        self._pins = {}
+
+    def _apply_pin(self, payload):
+        with self._ref_lock:
+            self._pins[payload] = self._pins.get(payload, 0) + 1
+
+    def _native_cb_refpins(self, ws, payload):
+        self._apply_pin(payload)

@@ -1,0 +1,13 @@
+# graftlint: path=ray_tpu/core/runtime.py
+"""Offender: a native drain callback takes the driver's ref lock."""
+import threading
+
+
+class DriverRuntime:
+    def __init__(self):
+        self._ref_lock = threading.Lock()
+        self._pins = {}
+
+    def _native_cb_refpins(self, ws, payload):
+        with self._ref_lock:
+            self._pins[payload] = self._pins.get(payload, 0) + 1

@@ -1,0 +1,24 @@
+# graftlint: path=ray_tpu/core/runtime.py
+"""Compliant: the callback only queues; the reader loop's drain point
+applies the transitions under the ref lock."""
+import threading
+from collections import deque
+
+
+class DriverRuntime:
+    def __init__(self):
+        self._ref_lock = threading.Lock()
+        self._native_pin_q = deque()
+        self._pins = {}
+
+    def _native_cb_refpins(self, ws, payload):
+        self._native_pin_q.append((ws, payload))
+
+    def _drain_native_pins(self):
+        while True:
+            try:
+                ws, payload = self._native_pin_q.popleft()
+            except IndexError:
+                return
+            with self._ref_lock:
+                self._pins[payload] = self._pins.get(payload, 0) + 1

@@ -255,6 +255,39 @@ def test_delayed_and_dropped_control_pipe_messages(chaos_rt):
                        timeout=120) == [3 * i for i in range(30)]
 
 
+def test_pipe_send_failpoint_fires_on_native_path(chaos_rt):
+    """r14 satellite: the driver->worker chaos filter sits BEFORE the
+    native engine, so `pipe.send` keeps firing (and the workload keeps
+    its exactness) with the GIL-free pipe armed. Asserts the engine is
+    actually attached AND the failpoint actually fired — a silently
+    skipped filter would pass the correctness check alone."""
+    from ray_tpu.core.runtime import _get_runtime
+    from ray_tpu.util.metrics import registry_records
+
+    rt = _get_runtime()
+    failpoints.arm("pipe.send=delay:0.01@times=8")
+
+    @ray_tpu.remote
+    def mul(x):
+        return x * 7
+
+    assert ray_tpu.get([mul.remote(i) for i in range(24)],
+                       timeout=120) == [7 * i for i in range(24)]
+    # checked AFTER the workload: prestarted workers attach their engine
+    # on dial-back, so an at-init check would race the accept loop
+    native = [ws for ws in rt.workers.values()
+              if ws.status != "dead" and ws.npipe is not None]
+    if not native:
+        pytest.skip("native pipe engine not active (no .so / killed)")
+    fired = 0.0
+    for rec in registry_records():
+        if rec["name"] == "rtpu_failpoints_fired_total":
+            for key, v in rec["samples"]:
+                if dict(key).get("site") == "pipe.send":
+                    fired += v
+    assert fired >= 8, f"pipe.send fired {fired} times on the native path"
+
+
 @pytest.mark.slow
 def test_data_shuffle_reducer_death_recovers(chaos_rt):
     """Kill a streaming-exchange reducer actor mid-ingest: the plan

@@ -131,7 +131,9 @@ def test_spill_restore_roundtrip(monkeypatch):
         assert not client.contains_spilled(resident)
         client.put(spilly, v2)
         assert client.contains_spilled(spilly)
-        assert client.spill_dir_bytes() > v2.nbytes
+        # r14: the spill path compresses, so the PHYSICAL dir byte count
+        # may undercut the logical payload — it just has to be real
+        assert 0 < client.spill_dir_bytes() <= v2.nbytes + 4096
 
         # reads + chunked reads serve straight from the spill file
         raw = client.get_raw(spilly)
