@@ -1,0 +1,388 @@
+"""Traffic-replay load generator for the LLM serving tier (ISSUE 12).
+
+Replays a synthetic multi-tenant trace — a shared-prefix mixture (each
+tenant has a fixed system prompt; its requests append distinct user
+suffixes) with bursty on/off arrivals — against either an in-process
+:class:`~ray_tpu.serve.llm.LLMEngine` (the same-container A/B mode
+``bench.py``'s ``serve_llm`` section uses) or a deployed multi-replica
+application (``python experiments/serve_replay.py --serve``), and
+reports the serving-tier scorecard:
+
+    tokens/s (generated), TTFT p50/p99, TPOT p50/p99,
+    prefix-cache hit rate, shed rate, error count
+
+Scale-parameterized: ``--scale quick`` fits the 2-vCPU CI tier
+(hundreds of requests, tiny model); ``--scale full`` targets the
+ROADMAP's millions-of-requests envelope on real hardware (the trace
+generator is O(1) memory per in-flight request, so the envelope is
+bounded by the cluster, not the harness).
+
+Prints ONE JSON line (the bench.py contract).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+import os
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # runnable as `python experiments/serve_replay.py`
+    sys.path.insert(0, _REPO)
+
+
+# ---------------------------------------------------------------------------
+# trace generation
+# ---------------------------------------------------------------------------
+
+@dataclass
+class TraceConfig:
+    n_requests: int = 200
+    n_tenants: int = 4
+    shared_prefix_tokens: int = 48     # per-tenant system prompt length
+    suffix_tokens_mean: int = 12       # user-suffix length (geometric-ish)
+    max_new_tokens: int = 16
+    vocab: int = 256
+    # bursty arrivals: ON periods at burst_rps, OFF gaps between bursts
+    burst_rps: float = 50.0
+    burst_len_s: float = 0.5
+    gap_s: float = 0.25
+    seed: int = 0
+
+
+@dataclass
+class Request:
+    arrival_s: float
+    tenant: int
+    prompt: List[int]
+    max_new: int
+
+
+def gen_trace(cfg: TraceConfig) -> List[Request]:
+    """Deterministic multi-tenant trace: tenant system prompts are fixed
+    per seed; arrivals are an on/off burst process (the shape that
+    separates load-aware routing from round-robin — bursts pile onto
+    whichever replica round-robin happens to hit mid-burst)."""
+    import numpy as np
+
+    rng = np.random.default_rng(cfg.seed)
+    prefixes = [rng.integers(0, cfg.vocab, cfg.shared_prefix_tokens)
+                .tolist() for _ in range(cfg.n_tenants)]
+    out: List[Request] = []
+    t = 0.0
+    in_burst_left = cfg.burst_len_s
+    for _ in range(cfg.n_requests):
+        # exponential inter-arrival inside a burst; jump the gap when the
+        # burst budget is spent
+        dt = float(rng.exponential(1.0 / cfg.burst_rps))
+        in_burst_left -= dt
+        if in_burst_left <= 0:
+            t += cfg.gap_s
+            in_burst_left = cfg.burst_len_s
+        t += dt
+        tenant = int(rng.integers(cfg.n_tenants))
+        n_suffix = 1 + int(rng.geometric(1.0 / cfg.suffix_tokens_mean))
+        prompt = prefixes[tenant] + rng.integers(
+            0, cfg.vocab, n_suffix).tolist()
+        out.append(Request(t, tenant, prompt,
+                           max_new=cfg.max_new_tokens))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# replay harness
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ReplayStats:
+    started: int = 0
+    completed: int = 0
+    shed: int = 0
+    deadline: int = 0
+    errors: int = 0
+    tokens: int = 0
+    wall_s: float = 0.0
+    ttft: List[float] = field(default_factory=list)
+    tpot: List[float] = field(default_factory=list)
+
+    def _pct(self, xs: List[float], q: float) -> float:
+        from ray_tpu.serve.admission import _percentile
+
+        return _percentile(sorted(xs), q)
+
+    def summary(self) -> Dict[str, Any]:
+        return {
+            "requests": self.started,
+            "completed": self.completed,
+            "shed": self.shed,
+            "deadline_exceeded": self.deadline,
+            "errors": self.errors,
+            "tokens": self.tokens,
+            "wall_s": round(self.wall_s, 3),
+            "tokens_per_s": round(self.tokens / self.wall_s, 2)
+            if self.wall_s else 0.0,
+            "shed_rate": round(self.shed / max(self.started, 1), 4),
+            "ttft_p50_s": round(self._pct(self.ttft, 0.50), 4),
+            "ttft_p99_s": round(self._pct(self.ttft, 0.99), 4),
+            "tpot_p50_s": round(self._pct(self.tpot, 0.50), 5),
+            "tpot_p99_s": round(self._pct(self.tpot, 0.99), 5),
+        }
+
+
+def replay(stream_fn: Callable[[Request], Iterable[int]],
+           trace: List[Request], *, time_scale: float = 1.0,
+           max_clients: int = 32,
+           on_error: Optional[Callable[[Request, BaseException], str]]
+           = None) -> ReplayStats:
+    """Drive the trace against ``stream_fn`` (request -> token iterator),
+    honoring arrival times (``time_scale`` stretches/compresses them).
+    Each in-flight request holds one client thread — the streaming
+    consumption model real callers have. ``on_error`` classifies
+    exceptions: return "shed"/"deadline"/"error" (default heuristics
+    inspect the type name)."""
+    from ray_tpu.serve.admission import (DeadlineExceededError,
+                                         RequestShedError)
+
+    stats = ReplayStats()
+    lock = threading.Lock()
+    sem = threading.Semaphore(max_clients)
+    t0 = time.monotonic()
+
+    def classify(req: Request, e: BaseException) -> str:
+        if on_error is not None:
+            return on_error(req, e)
+        if isinstance(e, RequestShedError):
+            return "shed"
+        if isinstance(e, DeadlineExceededError):
+            return "deadline"
+        # serve wraps engine-side errors (TaskError/RuntimeError): the
+        # class name survives only in str() (remote traceback), and the
+        # MESSAGE prefixes are part of the admission API ("request shed
+        # (<reason>)", "request deadline") — match either so shed/
+        # deadline accounting survives every wrapper
+        s = repr(e) + " " + str(e)
+        if "RequestShedError" in s or "request shed (" in s:
+            return "shed"
+        if "DeadlineExceededError" in s or "request deadline" in s:
+            return "deadline"
+        return "error"
+
+    def client(req: Request) -> None:
+        try:
+            t_submit = time.monotonic()
+            first = None
+            last = t_submit
+            n = 0
+            try:
+                for tok in stream_fn(req):
+                    now = time.monotonic()
+                    if first is None:
+                        first = now - t_submit
+                    else:
+                        with lock:
+                            stats.tpot.append(now - last)
+                    last = now
+                    n += 1
+            except BaseException as e:  # noqa: BLE001 - classified below
+                kind = classify(req, e)
+                with lock:
+                    if kind == "shed":
+                        stats.shed += 1
+                    elif kind == "deadline":
+                        stats.deadline += 1
+                    else:
+                        stats.errors += 1
+                    stats.tokens += n
+                return
+            with lock:
+                stats.completed += 1
+                stats.tokens += n
+                if first is not None:
+                    stats.ttft.append(first)
+        finally:
+            sem.release()
+
+    threads: List[threading.Thread] = []
+    for req in trace:
+        target = t0 + req.arrival_s * time_scale
+        delay = target - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        sem.acquire()
+        stats.started += 1
+        th = threading.Thread(target=client, args=(req,), daemon=True)
+        th.start()
+        threads.append(th)
+    for th in threads:
+        th.join(timeout=300)
+    stats.wall_s = time.monotonic() - t0
+    return stats
+
+
+# ---------------------------------------------------------------------------
+# drivers: in-process engine (bench A/B) and deployed application
+# ---------------------------------------------------------------------------
+
+class EngineRunner:
+    """Minimal deployment-shaped wrapper over one in-process LLMEngine:
+    a stepper thread plus a queue-backed token stream per request — the
+    same-container A/B vehicle (no actor boot noise in the numbers)."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self._stop = False
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop:
+            if not self.engine.step():
+                time.sleep(0.001)
+
+    def stream(self, req: Request,
+               deadline_s: Optional[float] = None) -> Iterable[int]:
+        import queue as _q
+
+        q: "_q.Queue[Any]" = _q.Queue()
+        r = self.engine.submit(req.prompt, req.max_new, q.put_nowait,
+                               deadline_s=deadline_s)
+        try:
+            while True:
+                tok = q.get(timeout=120.0)
+                if tok is None:
+                    return
+                if isinstance(tok, BaseException):
+                    raise tok
+                yield tok
+        finally:
+            self.engine.cancel(r)
+
+    def close(self):
+        self._stop = True
+        self._thread.join(timeout=5)
+
+
+def run_engine_ab(scale: str = "quick", paged: bool = True,
+                  prefix_cache: bool = True, seed: int = 0,
+                  model: str = "llama-debug",
+                  time_scale: float = 0.0) -> Dict[str, Any]:
+    """One replay against one in-process engine; returns the scorecard
+    plus engine KV/prefix state. ``time_scale=0`` = closed-loop (submit
+    as fast as clients free up) — the throughput-capability measurement;
+    > 0 replays real arrival times."""
+    from ray_tpu.serve.llm import LLMEngine
+    from ray_tpu.util.tpu_info import honor_jax_platform_env
+
+    honor_jax_platform_env()
+    cfg = _scale_trace(scale, seed)
+    engine = LLMEngine(model, max_slots=8, max_len=256, seed=seed,
+                       paged=paged, prefix_cache=prefix_cache,
+                       block_size=16, prefill_chunk=8)
+    runner = EngineRunner(engine)
+    try:
+        trace = gen_trace(cfg)
+        # warm the compile out of the measurement
+        list(runner.stream(Request(0.0, 0, trace[0].prompt[:8], 2)))
+        stats = replay(runner.stream, trace, time_scale=time_scale)
+    finally:
+        runner.close()
+    out = stats.summary()
+    kv = engine.kv_state()
+    if "prefix" in kv:
+        p = kv["prefix"]
+        lookups = max(p["hits"] + p["misses"], 1)
+        out["prefix_hit_rate"] = round(p["hits"] / lookups, 4)
+        out["prefix_hit_tokens"] = p["hit_tokens"]
+    out["paged"] = paged
+    return out
+
+
+def _scale_trace(scale: str, seed: int) -> TraceConfig:
+    if scale == "quick":          # 2-vCPU CI tier
+        return TraceConfig(n_requests=48, n_tenants=3,
+                           shared_prefix_tokens=48, max_new_tokens=8,
+                           burst_rps=200.0, seed=seed)
+    if scale == "medium":
+        return TraceConfig(n_requests=2_000, n_tenants=8,
+                           shared_prefix_tokens=96, max_new_tokens=32,
+                           burst_rps=500.0, seed=seed)
+    # full: the millions-of-requests envelope (real hardware only)
+    return TraceConfig(n_requests=1_000_000, n_tenants=64,
+                       shared_prefix_tokens=128, max_new_tokens=64,
+                       burst_rps=2_000.0, seed=seed)
+
+
+def run_serve_replay(scale: str, replicas: int, paged: bool,
+                     seed: int = 0, deadline_s: Optional[float] = None,
+                     slo: Optional[dict] = None) -> Dict[str, Any]:
+    """Deploy a multi-replica LLMDeployment and replay through the real
+    handle/routing path (load-aware picker, admission, streaming)."""
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve import LLMDeployment
+
+    ray_tpu.init(ignore_reinit_error=True)
+    app = serve.deployment(
+        LLMDeployment, num_replicas=replicas,
+        ray_actor_options={"max_concurrency": 16, "num_cpus": 0},
+    ).bind("llama-debug", max_slots=8, max_len=256, seed=seed,
+           paged=paged, block_size=16, prefill_chunk=8, slo=slo)
+    handle = serve.run(app, name="llm_replay")
+    stream_handle = handle.options(stream=True)
+
+    def stream(req: Request):
+        return stream_handle.remote(req.prompt, req.max_new,
+                                    deadline_s=deadline_s)
+
+    trace = gen_trace(_scale_trace(scale, seed))
+    # warm every replica's compile before timing
+    for _ in range(replicas * 2):
+        list(stream_handle.remote(trace[0].prompt[:8], 2))
+    stats = replay(stream, trace, time_scale=0.0)
+    out = stats.summary()
+    # aggregate replica-side KV/prefix state — enumerate the replicas
+    # directly (a ROUTED probe per replica can land on the same one
+    # twice and double-count its hits)
+    handle._refresh(force=True)
+    kv = [ray_tpu.get(r.handle_request.remote("kv_state", (), {}),
+                      timeout=60) for r in handle._replicas]
+    hits = sum(k.get("prefix", {}).get("hits", 0) for k in kv)
+    lookups = sum(k.get("prefix", {}).get("hits", 0)
+                  + k.get("prefix", {}).get("misses", 0) for k in kv)
+    out["prefix_hit_rate"] = round(hits / max(lookups, 1), 4)
+    out["replicas"] = replicas
+    out["paged"] = paged
+    serve.delete("LLMDeployment")
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--scale", default="quick",
+                   choices=("quick", "medium", "full"))
+    p.add_argument("--serve", action="store_true",
+                   help="drive a deployed multi-replica app (default: "
+                        "in-process engine A/B)")
+    p.add_argument("--replicas", type=int, default=2)
+    p.add_argument("--dense", action="store_true",
+                   help="dense baseline instead of paged")
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+    if args.serve:
+        out = run_serve_replay(args.scale, args.replicas,
+                               paged=not args.dense, seed=args.seed)
+    else:
+        out = run_engine_ab(args.scale, paged=not args.dense,
+                            seed=args.seed)
+    print(json.dumps({"metric": "serve_replay", **out}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -261,6 +261,30 @@ _knob("serve_request_retries", int, 3,
       "it was sent to died (each retry reports the death so the "
       "controller replaces the replica); 0 = surface ActorDiedError",
       "serve/handle.py")
+_knob("serve_routing", str, "p2c",
+      "replica picker: p2c (power-of-two-choices over queue depth + "
+      "advertised free KV blocks) | rr (round-robin; the bench A/B "
+      "baseline)", "serve/handle.py")
+_knob("serve_kv_route_weight", float, 4.0,
+      "routing-score weight of KV occupancy: score = queue_depth + "
+      "weight * kv_used_fraction for replicas that advertise KV state; "
+      "0 ignores KV pressure", "serve/handle.py")
+_knob("serve_load_report_interval_s", float, 0.5,
+      "cadence of a replica's load-state push to the controller (KV "
+      "blocks free/total, in-flight requests) when its deployment "
+      "exposes load_state(); <= 0 disables the push loop",
+      "serve/replica.py")
+_knob("llm_stall_timeout_s", float, 120.0,
+      "seconds a caller waits for the NEXT token from the LLM decode "
+      "loop before declaring the stream stalled (per-request deadline_s "
+      "caps it further)", "serve/llm.py")
+_knob("llm_block_size", int, 16,
+      "tokens per paged-KV block (prefix sharing granularity; smaller = "
+      "finer reuse, more table entries)", "serve/llm.py")
+_knob("llm_prefill_chunk", int, 8,
+      "prompt tokens consumed per engine step during chunked prefill "
+      "(1 = token-at-a-time like decode; larger drains long prompts in "
+      "fewer steps without stalling in-flight decodes)", "serve/llm.py")
 
 # -- bench / watch ----------------------------------------------------------
 _knob("pool_prestart", int, 4,

@@ -1,4 +1,4 @@
-"""Layering-seam rule (family ``layering``).
+"""Layering-seam rules (family ``layering``).
 
 The portability seam from CLAUDE.md: everything ML-level builds ONLY on
 the public task/actor/object API — the same property that lets the
@@ -9,9 +9,10 @@ to driver internals and the seam is gone.
 
 from __future__ import annotations
 
+import ast
 from typing import Iterator
 
-from ray_tpu.devtools.graftlint.engine import Project
+from ray_tpu.devtools.graftlint.engine import Project, dotted_parts
 from ray_tpu.devtools.graftlint.model import (
     FAMILY_LAYERING,
     Finding,
@@ -61,3 +62,77 @@ class LayeringSeam(Rule):
                         f"(CLAUDE.md portability seam); use the ray_tpu "
                         f"top-level API or add a public accessor to "
                         f"ray_tpu.util")
+
+
+@register
+class ServeRuntimeSeam(Rule):
+    name = "serve-runtime-seam"
+    family = FAMILY_LAYERING
+    summary = ("the serving tier never touches runtime internals through "
+               "an allowed module's private surface: no _get_runtime/"
+               "global_worker calls and no module._private attribute "
+               "reads from ray_tpu.serve (ISSUE 12 — load-aware routing "
+               "reads state.actor_queue_depths and controller-mediated "
+               "load reports, not the driver's tables)")
+
+    #: private runtime accessors the routing work is tempted by, in any
+    #: spelling (bare call after a from-import, or module-qualified)
+    BANNED_NAMES = ("_get_runtime", "global_worker", "global_runtime")
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for mod in project.modules:
+            if not mod.scope_rel.startswith("ray_tpu/serve/"):
+                continue
+            # ast.walk visits every NESTED Attribute of one chain
+            # (`a.b.c` -> a.b.c, a.b): dedupe by (line, offending name)
+            # so one violation reports once
+            seen = set()
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, (ast.Attribute, ast.Name)):
+                    continue
+                parts = dotted_parts(node)
+                if not parts:
+                    continue
+                hit = next((p for p in parts
+                            if p in self.BANNED_NAMES), None)
+                if hit is not None:
+                    if (node.lineno, hit) in seen:
+                        continue
+                    seen.add((node.lineno, hit))
+                    # bare name must actually BE the runtime accessor
+                    # (an unrelated local `global_worker` variable is
+                    # implausible enough to flag anyway — naming it that
+                    # in serve/ is the confusion this rule exists for)
+                    yield self.finding(
+                        mod, node.lineno,
+                        f"ray_tpu.serve reaches runtime internals via "
+                        f"{'.'.join(parts)} — route load/queue state "
+                        f"through ray_tpu.util.state or the serve "
+                        f"controller's replica load reports")
+                    continue
+                # module-qualified private attribute: state._gcs(),
+                # ray_tpu._private_thing — resolving the HEAD through the
+                # import table proves it's a module, so self._x and
+                # handle-internal attributes stay clean
+                if len(parts) < 2:
+                    continue
+                priv = next((i for i, p in enumerate(parts)
+                             if i > 0 and p.startswith("_")
+                             and not p.startswith("__")), None)
+                if priv is None:
+                    continue
+                key = (node.lineno, ".".join(parts[:priv + 1]))
+                if key in seen:
+                    continue
+                fq = mod.resolve_parts(parts[:priv])
+                if (fq is not None and fq.startswith("ray_tpu")
+                        and not fq.startswith("ray_tpu.serve")):
+                    # intra-tier privates (serve.handle._dag_cache from
+                    # serve.api) are the tier's own business
+                    seen.add(key)
+                    yield self.finding(
+                        mod, node.lineno,
+                        f"ray_tpu.serve reads private attribute "
+                        f"{'.'.join(parts[:priv + 1])} of {fq} — the "
+                        f"serving tier stays on the public API seam; "
+                        f"add a public accessor instead")

@@ -37,6 +37,9 @@ from ray_tpu.models.transformer import (
     decode_step,
     decode_step_multi,
     init_cache_multi,
+    init_cache_paged,
+    decode_step_paged,
+    copy_kv_block,
     generate,
 )
 
@@ -75,5 +78,8 @@ __all__ = [
     "decode_step",
     "decode_step_multi",
     "init_cache_multi",
+    "init_cache_paged",
+    "decode_step_paged",
+    "copy_kv_block",
     "generate",
 ]

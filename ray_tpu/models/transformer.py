@@ -832,6 +832,142 @@ def decode_step_multi(
     return logits, new_cache
 
 
+def init_cache_paged(config: TransformerConfig, num_blocks: int,
+                     block_size: int, dtype=None) -> Params:
+    """Block-paged KV cache for :func:`decode_step_paged` (the serving
+    tier's vLLM-style layout): physical storage is a pool of fixed-size
+    token blocks shared by EVERY request; each request maps its logical
+    positions onto physical blocks through a per-slot block table. No
+    per-slot ``pos`` lives here — positions and block ownership are
+    host-side scheduler state (``ray_tpu.serve.kv_cache``), which is what
+    makes prefix sharing possible: two requests whose tables name the
+    same immutable block read the same HBM."""
+    c = config
+    dt = jnp.dtype(dtype or c.dtype)
+    shape = (c.n_layers, num_blocks, block_size, c.kv_heads, c.hdim)
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+def copy_kv_block(cache: Params, src, dst) -> Params:
+    """Copy one physical block (all layers) — the device half of
+    copy-on-write: when a request must write into a block whose refcount
+    is > 1 (shared prefix tail), the pool duplicates it first so the
+    sharers keep reading the original."""
+    return {"k": cache["k"].at[:, dst].set(cache["k"][:, src]),
+            "v": cache["v"].at[:, dst].set(cache["v"][:, src])}
+
+
+def decode_step_paged(
+    params: Params,
+    cache: Params,
+    tokens: jax.Array,
+    block_tables: jax.Array,
+    pos: jax.Array,
+    nvalid: jax.Array,
+    config: TransformerConfig,
+    active: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, Params]:
+    """Advance B independent requests by up to C tokens each against the
+    block-paged cache — ONE compiled program serves both chunked prefill
+    (rows feeding C prompt tokens) and decode (rows feeding 1 token with
+    C-1 padding), so a long prompt never stalls the in-flight decodes
+    sharing its batch.
+
+    tokens: [B, C] int32; block_tables: [B, M] int32 physical block ids
+    (row-major: logical position p of request b lives in physical block
+    ``block_tables[b, p // bs]`` at offset ``p % bs``; unused entries must
+    hold a valid id — they are masked, never written). pos: [B] tokens
+    already cached; nvalid: [B] how many of this step's C tokens are real.
+    Writes land via an out-of-bounds-dropped scatter, so invalid rows and
+    padding touch nothing (a shared prefix block is immutable because no
+    live request's write positions ever map into it). Returns (logits
+    [B, V] of each row's LAST VALID token, new cache)."""
+    c = config
+    dt = jnp.dtype(c.dtype)
+    b, t = tokens.shape
+    n_blocks, bs = cache["k"].shape[1], cache["k"].shape[2]
+    m = block_tables.shape[1]
+    if active is None:
+        active = jnp.ones((b,), bool)
+    win_arr = jnp.array([w if w > 0 else (1 << 30)
+                         for w in c.layer_windows], jnp.int32)
+
+    positions = pos[:, None] + jnp.arange(t)[None, :]           # [B, C]
+    valid = (jnp.arange(t)[None, :] < nvalid[:, None]) \
+        & active[:, None]                                       # [B, C]
+    # physical destination of each new token; invalid -> OOB (dropped)
+    blk = jnp.take_along_axis(block_tables,
+                              jnp.clip(positions // bs, 0, m - 1), axis=1)
+    dest = jnp.where(valid, blk * bs + positions % bs,
+                     n_blocks * bs).reshape(-1)                 # [B*C]
+    # gather map: logical position j of request b = physical row gidx[b,j]
+    gidx = (block_tables[:, :, None] * bs
+            + jnp.arange(bs)[None, None, :]).reshape(b, m * bs)
+
+    x = params["embed"].astype(dt)[tokens]                      # [B, C, D]
+    if c.positions == "learned":
+        # clamp ONLY the table lookup (padding rows can sit past the
+        # table); rope below uses the true positions — the dense decode
+        # paths do, and clamping would skew angles past max_seq_len
+        x = x + jnp.take(params["pos_embed"].astype(dt),
+                         jnp.clip(positions, 0, c.max_seq_len - 1), axis=0)
+    if c.positions == "rope":
+        cos, sin = rotary_embedding(positions, c.hdim,
+                                    theta=c.rope_theta)     # [B, C, D/2]
+    else:
+        cos = sin = None
+
+    kpos = jnp.arange(m * bs)[None, None, :]                # [1, 1, Mbs]
+
+    def layer(carry, inp):
+        x = carry
+        lp, kc, vc, wl = inp
+        h = _norm(x, lp["attn_norm"], lp.get("attn_norm_b"), c)
+        q, k, v = _qkv_proj(h, lp, dt)
+        if cos is not None:
+            q = apply_rotary(q, cos, sin)
+            k = apply_rotary(k, cos, sin)
+        # write BEFORE gathering: queries at chunk offset c must see the
+        # chunk's own earlier keys (in-chunk causal self-attention)
+        kcf = kc.reshape(n_blocks * bs, *kc.shape[2:])
+        vcf = vc.reshape(n_blocks * bs, *vc.shape[2:])
+        kcf = kcf.at[dest].set(k.reshape(b * t, *k.shape[2:])
+                               .astype(kcf.dtype), mode="drop")
+        vcf = vcf.at[dest].set(v.reshape(b * t, *v.shape[2:])
+                               .astype(vcf.dtype), mode="drop")
+        kctx = kcf[gidx]                            # [B, Mbs, kvh, hd]
+        vctx = vcf[gidx]
+        kx = _repeat_kv(kctx, c.n_heads)
+        vx = _repeat_kv(vctx, c.n_heads)
+        s = jnp.einsum("bchd,bkhd->bhck", q.astype(jnp.float32),
+                       kx.astype(jnp.float32)) * (c.hdim ** -0.5)
+        s = _softcap_scores(s, c.attn_softcap)
+        vis = (kpos <= positions[:, :, None]) \
+            & (kpos > positions[:, :, None] - wl)       # [B, C, Mbs]
+        s = jnp.where(vis[:, None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhck,bkhd->bchd", p,
+                       vx.astype(jnp.float32)).astype(dt)
+        o = jnp.einsum("blhk,hkd->bld", o, lp["wo"].astype(dt))
+        x = x + o
+        return _decode_mlp(x, lp, c, dt), (
+            kcf.reshape(kc.shape), vcf.reshape(vc.shape))
+
+    x, (new_k, new_v) = lax.scan(
+        layer, x, (params["layers"], cache["k"], cache["v"], win_arr))
+    x = _norm(x, params["final_norm"], params.get("final_norm_b"), c)
+    head = (params["embed"].T if c.tie_embeddings
+            else params["lm_head"]).astype(dt)
+    # only each row's LAST VALID position needs logits — project D->V for
+    # B rows, not B*C (the lm-head matmul dominates small-model steps)
+    last = jnp.clip(nvalid - 1, 0, t - 1)
+    x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]
+    logits = jnp.einsum("bd,dv->bv", x_last, head).astype(jnp.float32)
+    if c.logits_softcap:
+        logits = jnp.tanh(logits / c.logits_softcap) * c.logits_softcap
+    return logits, {"k": new_k, "v": new_v}
+
+
 def generate(
     params: Params,
     prompt: jax.Array,

@@ -18,7 +18,11 @@ from ray_tpu.serve.api import (
     start_http_proxy,
     status,
 )
+from ray_tpu.serve.admission import (AdmissionController,
+                                     DeadlineExceededError,
+                                     RequestShedError, SLOConfig)
 from ray_tpu.serve.batching import batch
+from ray_tpu.serve.kv_cache import BlockPool, PrefixCache
 from ray_tpu.serve.llm import LLMDeployment, LLMEngine
 from ray_tpu.serve.deployment import (
     Application,
@@ -49,6 +53,12 @@ __all__ = [
     "batch",
     "LLMDeployment",
     "LLMEngine",
+    "BlockPool",
+    "PrefixCache",
+    "SLOConfig",
+    "AdmissionController",
+    "RequestShedError",
+    "DeadlineExceededError",
     "multiplexed",
     "get_multiplexed_model_id",
     "get_deployment_handle",

@@ -104,7 +104,8 @@ class ServeController:
         actor_cls = ray_tpu.remote(ReplicaActor)
         return actor_cls.options(**opts).remote(
             cls_or_fn, init_args, init_kwargs,
-            spec["config"].get("user_config"))
+            spec["config"].get("user_config"),
+            deployment_name=spec["name"])
 
     def _reconcile(self, name: str) -> None:
         entry = self._deployments.get(name)
@@ -115,7 +116,12 @@ class ServeController:
         while len(replicas) < target:
             replicas.append(self._make_replica(entry["spec"]))
         while len(replicas) > target:
-            self._kill(replicas.pop())
+            victim = replicas.pop()
+            # drop its load report with it: a scaled-down replica's last
+            # (typically high-occupancy) report must not keep inflating
+            # the autoscaler's average for 30 more seconds
+            entry.get("loads", {}).pop(victim._actor_id.binary(), None)
+            self._kill(victim)
 
     def report_replica_death(self, name: str, actor_id: bytes) -> int:
         """Router-reported replica death (the reference's health-check /
@@ -129,6 +135,7 @@ class ServeController:
         before = len(entry["replicas"])
         entry["replicas"] = [r for r in entry["replicas"]
                              if r._actor_id.binary() != actor_id]
+        entry.get("loads", {}).pop(actor_id, None)
         if len(entry["replicas"]) != before:
             self._reconcile(name)
             self._version += 1
@@ -154,6 +161,7 @@ class ServeController:
             "max_ongoing_requests":
                 entry["spec"]["config"].get("max_ongoing_requests", 8),
             "compiled": bool(entry["spec"]["config"].get("compiled")),
+            "has_loads": bool(entry.get("loads")),
         }
 
     def get_version(self) -> int:
@@ -168,6 +176,34 @@ class ServeController:
             for name, e in self._deployments.items()
         }
 
+    # -- replica load reports (KV-aware routing + autoscaling) ------------
+
+    def report_replica_load(self, name: str, actor_id: bytes,
+                            load: Dict[str, Any]) -> None:
+        """Replica-pushed load state ({inflight, kv_free, kv_total} from
+        the deployment's ``load_state()``): the routing signal handles
+        fold into their pick score, and the KV-occupancy input to
+        autoscaling. Stamped on arrival so readers can age it out."""
+        entry = self._deployments.get(name)
+        if entry is None:
+            return
+        rec = dict(load)
+        rec["ts"] = time.time()
+        first = not entry.get("loads")
+        entry.setdefault("loads", {})[actor_id] = rec
+        if first:
+            # first report flips the deployment's has_loads bit in the
+            # routing info — bump the version so handles refetch it and
+            # start consulting the KV view (handles of deployments that
+            # never report skip the controller probe entirely)
+            self._version += 1
+
+    def get_replica_loads(self, name: str) -> Dict[bytes, Dict[str, Any]]:
+        entry = self._deployments.get(name)
+        if entry is None:
+            return {}
+        return dict(entry.get("loads", {}))
+
     # -- autoscaling ------------------------------------------------------
 
     def record_request_metrics(self, name: str, ongoing: float) -> None:
@@ -177,30 +213,82 @@ class ServeController:
         self._metrics[name] = [(t, o) for t, o in self._metrics[name]
                                if t >= cutoff]
 
+    def _desired_replicas(self, name: str) -> Optional[int]:
+        """Autoscaling policy (reference ``autoscaling_policy.py`` plus a
+        KV-pressure input): desired = max over the ongoing/target ratio
+        and the KV-occupancy/target ratio — an LLM deployment can be
+        KV-bound long before its request queue looks deep (one long
+        context pins blocks for its whole stream)."""
+        entry = self._deployments.get(name)
+        if entry is None:
+            return None
+        cfg = entry["spec"]["config"].get("autoscaling_config")
+        if not cfg:
+            return None
+        cur = max(len(entry["replicas"]), 1)
+        desired = None
+        samples = [o for _, o in self._metrics.get(name, [])]
+        if samples:
+            avg_ongoing = sum(samples) / len(samples)
+            desired = avg_ongoing / max(cfg["target_ongoing_requests"],
+                                        1e-9)
+        target_kv = cfg.get("target_kv_utilization")
+        if target_kv:
+            cutoff = time.time() - 30.0
+            # .get defaults throughout: load reports are whatever a user
+            # deployment's load_state() returned — a missing key must
+            # not fail every deployment's autoscale tick
+            fracs = [1.0 - l.get("kv_free", 0) / l["kv_total"]
+                     for l in entry.get("loads", {}).values()
+                     if l.get("ts", 0) >= cutoff and l.get("kv_total")]
+            if fracs:
+                kv_desired = cur * (sum(fracs) / len(fracs)) / target_kv
+                desired = max(desired or 0.0, kv_desired)
+        if desired is None:
+            return None
+        import math
+
+        new = cur
+        if desired > cur:
+            new = min(int(math.ceil(desired)), cfg["max_replicas"])
+        elif desired < cur * cfg["downscale_factor"]:
+            new = max(int(math.ceil(desired)), cfg["min_replicas"])
+        return new
+
     def autoscale_tick(self) -> Dict[str, int]:
-        """Apply the autoscaling policy (reference
-        ``autoscaling_policy.py``: scale to ongoing/target ratio, clamped)."""
+        """Apply the autoscaling policy (ongoing/target ratio plus KV
+        occupancy, clamped)."""
         decisions = {}
         for name, entry in self._deployments.items():
-            cfg = entry["spec"]["config"].get("autoscaling_config")
-            if not cfg:
+            new = self._desired_replicas(name)
+            if new is None or new == len(entry["replicas"]):
                 continue
-            samples = [o for _, o in self._metrics.get(name, [])]
-            if not samples:
-                continue
-            avg_ongoing = sum(samples) / len(samples)
-            cur = max(len(entry["replicas"]), 1)
-            desired = avg_ongoing / max(cfg["target_ongoing_requests"], 1e-9)
-            import math
-
-            new = cur
-            if desired > cur:
-                new = min(int(math.ceil(desired)), cfg["max_replicas"])
-            elif desired < cur * cfg["downscale_factor"]:
-                new = max(int(math.ceil(desired)), cfg["min_replicas"])
-            if new != cur:
-                entry["target"] = new
-                self._reconcile(name)
-                self._version += 1
-                decisions[name] = new
+            entry["target"] = new
+            self._reconcile(name)
+            self._version += 1
+            decisions[name] = new
         return decisions
+
+    def v2_demand(self) -> List[Dict[str, float]]:
+        """Pending replica demand as resource bundles — the bridge into
+        autoscaler v2: feed this as (or into) the AutoscalerV2
+        ``load_source`` so serve scale-up requests become node launches
+        when the cluster itself is out of capacity."""
+        bundles: List[Dict[str, float]] = []
+        for name, entry in self._deployments.items():
+            new = self._desired_replicas(name)
+            if new is None:
+                continue
+            short = new - len(entry["replicas"])
+            if short <= 0:
+                continue
+            opts = entry["spec"]["config"].get("ray_actor_options") or {}
+            # unset num_cpus defaults to 1; an EXPLICIT 0 (the LLM
+            # deployments here) must not advertise phantom CPU demand
+            cpu = opts.get("num_cpus")
+            cpu = 1.0 if cpu is None else float(cpu)
+            bundle = {"CPU": cpu} if cpu > 0 else {}
+            for k, v in (opts.get("resources") or {}).items():
+                bundle[k] = float(v)
+            bundles.extend(dict(bundle) for _ in range(short))
+        return bundles

@@ -19,6 +19,10 @@ class AutoscalingConfig:
     target_ongoing_requests: float = 2.0
     upscale_factor: float = 1.5
     downscale_factor: float = 0.7
+    # KV-occupancy target (LLM deployments): scale up when the average
+    # reported used-block fraction exceeds this; None = ongoing-requests
+    # policy only
+    target_kv_utilization: Optional[float] = None
 
 
 @dataclass

@@ -220,6 +220,13 @@ class CompiledDeploymentResponse:
 
 
 _DEPTH_TTL_S = 0.05
+# replica-pushed KV/load reports (controller-mediated) are refreshed less
+# often than runtime queue depths: they ride a controller round trip, and
+# KV occupancy moves at decode speed, not per-request speed
+_KV_TTL_S = 0.25
+# a load report older than this is ignored (replica died or stopped
+# pushing; depth-only routing beats steering by a ghost)
+_KV_STALE_S = 5.0
 # compiled fast path: routing-table staleness bound. The per-request
 # controller round trip (get_version) is exactly the control-plane cost
 # the compiled plane exists to remove; a stale table self-heals anyway
@@ -263,6 +270,21 @@ class DeploymentHandle:
         self._depth_ts = 0.0
         self._delta: Dict[int, int] = {}
         self._rng = random.Random()
+        # KV-aware routing (ISSUE 12): replicas whose deployment exposes
+        # load_state() push {kv_free, kv_total, inflight} to the
+        # controller; the handle folds KV occupancy into the pick score.
+        # MUTABLE state shared BY REFERENCE with options()/__getattr__
+        # clones (a fresh clone per method-style call would otherwise
+        # reset the TTL — one controller RPC per request — and freeze
+        # the rr cursor): kv_loads, kv_next (monotonic), rr_next
+        self._route_state: Dict[str, Any] = {
+            "kv_loads": {}, "kv_next": 0.0, "rr_next": 0}
+        # set from routing info: whether any replica has ever pushed a
+        # load report. False = never probe the controller for KV state
+        # (plain deployments must not pay even a rare blocking RPC on
+        # their request path); the controller bumps the version on the
+        # FIRST report, so handles refetch and flip this
+        self._has_loads = False
         # compiled execution plane (r13): when the deployment opted in
         # (``compiled=True``), steady-state requests route through one
         # compiled DAG per replica (shm channels, zero per-call task
@@ -299,6 +321,7 @@ class DeploymentHandle:
             self._max_ongoing = info["max_ongoing_requests"]
             self._version = info["version"]
             self._compiled = bool(info.get("compiled"))
+            self._has_loads = bool(info.get("has_loads"))
             self._depths = [0] * len(self._replicas)
             self._depth_ts = 0.0
             self._delta = {i: 0 for i in range(len(self._replicas))}
@@ -364,13 +387,89 @@ class DeploymentHandle:
         return [self._depths[i] + self._delta.get(i, 0)
                 for i in range(len(self._replicas))]
 
-    def _pick_replica(self) -> int:
+    def _kv_view(self) -> Dict[bytes, Dict[str, Any]]:
+        """TTL-cached replica load reports (kv_free/kv_total/inflight)
+        from the controller. Empty for deployments that don't advertise
+        KV state — routing degrades to pure queue depth."""
+        now = time.monotonic()
+        rs = self._route_state
+        if now >= rs["kv_next"]:
+            import ray_tpu
+
+            # claim the window BEFORE the RPC: concurrent callers that
+            # race past a wedged controller must route on the stale view,
+            # not pile up their own blocking probes (one probe per
+            # window, ever)
+            rs["kv_next"] = now + _KV_TTL_S
+            try:
+                ctrl = self._get_controller()
+                rs["kv_loads"] = ray_tpu.get(
+                    ctrl.get_replica_loads.remote(self.deployment_name),
+                    timeout=2) or {}
+            except Exception:
+                pass  # stale view beats no view
+            if not rs["kv_loads"]:
+                # deployment doesn't advertise KV state (no load_state):
+                # exponential backoff to 30s — a plain deployment must
+                # not pay a recurring controller probe on its request
+                # path forever (reset to the base TTL on the first
+                # non-empty view)
+                backoff = min(rs.get("kv_backoff", _KV_TTL_S) * 2, 30.0)
+                rs["kv_backoff"] = backoff
+                rs["kv_next"] = now + backoff
+            else:
+                rs["kv_backoff"] = _KV_TTL_S
+        return rs["kv_loads"]
+
+    def _scores(self) -> List[float]:
+        """Per-replica routing score: runtime queue depth (+ local
+        in-flight deltas) plus weighted KV occupancy — a replica about to
+        run out of KV blocks is as bad a pick as a deep queue, even when
+        its queue is short (admission there would shed or stall)."""
+        from ray_tpu import config as _cfg
+
+        load = [float(x) for x in self._load_view()]
+        if not self._has_loads:
+            return load
+        kv = self._kv_view()
+        if not kv:
+            return load
+        w = float(_cfg.get("serve_kv_route_weight"))
+        if w <= 0:
+            return load
+        now = time.time()
+        for i, r in enumerate(self._replicas):
+            rep = kv.get(r._actor_id.binary())
+            if not rep or now - rep.get("ts", 0) > _KV_STALE_S:
+                continue
+            total = rep.get("kv_total") or 0
+            if total > 0:
+                used_frac = 1.0 - rep.get("kv_free", 0) / total
+                load[i] += w * used_frac
+        return load
+
+    def _pick_replica(self, exclude: Optional[bytes] = None) -> int:
+        """Power-of-two-choices over the combined load score;
+        ``exclude`` bars a replica observed dead THIS request (the retry
+        path must never re-pick its own victim while an alternative
+        exists). RTPU_SERVE_ROUTING=rr forces plain round-robin (the
+        bench A/B baseline)."""
+        from ray_tpu import config as _cfg
+
         n = len(self._replicas)
-        if n == 1:
-            return 0
-        load = self._load_view()
-        i, j = self._rng.sample(range(n), 2)
-        return i if load[i] <= load[j] else j
+        cand = list(range(n))
+        if exclude is not None and n > 1:
+            cand = [i for i in cand
+                    if self._replicas[i]._actor_id.binary() != exclude] \
+                or list(range(n))
+        if len(cand) == 1:
+            return cand[0]
+        if str(_cfg.get("serve_routing")) == "rr":
+            self._route_state["rr_next"] += 1
+            return cand[self._route_state["rr_next"] % len(cand)]
+        score = self._scores()
+        i, j = self._rng.sample(cand, 2)
+        return i if score[i] <= score[j] else j
 
     def options(self, *, method_name: Optional[str] = None,
                 stream: Optional[bool] = None) -> "DeploymentHandle":
@@ -384,13 +483,19 @@ class DeploymentHandle:
         # skip the info fetch — _compiled must travel with it or method
         # clones (handle.my_method) silently leave the compiled plane
         h._compiled = self._compiled
+        h._has_loads = self._has_loads
         h._refresh_ts = self._refresh_ts
+        # the SHARED routing-state object travels by reference:
+        # __getattr__ makes a FRESH clone per method-style call, and a
+        # clone-private copy would reset the KV-view TTL (one blocking
+        # controller RPC per request) and freeze the rr cursor
+        h._route_state = self._route_state
         return h
 
-    def _issue(self, args, kwargs):
+    def _issue(self, args, kwargs, exclude: Optional[bytes] = None):
         """Pick a replica and dispatch one request to it."""
         self._refresh()
-        idx = self._pick_replica()
+        idx = self._pick_replica(exclude=exclude)
         replica = self._replicas[idx]
         self._delta[idx] = self._delta.get(idx, 0) + 1
         call = replica.handle_request
@@ -470,7 +575,11 @@ class DeploymentHandle:
         def _retry():
             # called when the routed-to replica died before replying:
             # report + re-route (bounded — a deployment whose replicas
-            # keep dying must eventually surface the error)
+            # keep dying must eventually surface the error). The re-issue
+            # EXCLUDES the dead pick: _replica_died refreshes routing
+            # state, but when the controller is unreachable the cached
+            # table still lists the corpse — the retry must re-consult
+            # state AND bar its own victim, never re-roll the same pick.
             retries[0] -= 1
             if retries[0] < 0:
                 from ray_tpu.core.exceptions import ActorDiedError
@@ -481,9 +590,10 @@ class DeploymentHandle:
                     "failing after replica-death retries")
             self._delta[state["idx"]] = (
                 self._delta.get(state["idx"], 0) - 1)
+            dead = state["replica"]._actor_id.binary()
             self._replica_died(state["replica"])
             state["idx"], state["replica"], new_ref = self._issue(
-                args, kwargs)
+                args, kwargs, exclude=dead)
             return new_ref
 
         if self._stream:
@@ -554,7 +664,7 @@ class DeploymentHandle:
             except Exception:
                 pass
         self._replica_died(replica)
-        idx, _rep, ref = self._issue(args, kwargs)
+        idx, _rep, ref = self._issue(args, kwargs, exclude=key)
         try:
             return ray_tpu.get(ref, timeout=60)
         finally:

@@ -1,23 +1,32 @@
-"""Continuous-batching LLM serving: slot engine + serve deployment.
+"""Continuous-batching LLM serving: paged slot engine + serve deployment.
 
 Reference role: ``python/ray/serve/batching.py`` (request batching) +
 streaming responses, joined into an LLM decode loop — the reference has
 no LLM engine; this is the TPU-first differentiator (CLAUDE.md round-5
-note). Design follows Orca-style token-level continuous batching:
+note). Design follows Orca-style token-level continuous batching over a
+vLLM-style paged KV cache (PAPERS.md: the Gemma-on-TPU serving
+comparison shows paged KV + batching policy, not raw FLOPs, decide TPU
+serving throughput):
 
-- The engine owns ONE jitted step (:func:`decode_step_multi`) over a
-  fixed slot grid [max_slots]: static shapes, compiled once. Every
-  iteration each active slot advances exactly one token — slots still
-  consuming their PROMPT feed the next prompt token, slots generating
-  feed back their last sample. New requests therefore join the in-flight
-  batch immediately (admission = claiming a free slot), and finished
-  requests free their slot between steps; nobody waits for a "batch" to
-  drain. Prompt prefill thus shares the decode program (one compile); a
-  chunked-prefill fast path is a possible future optimization, at the
-  cost of a second compiled program per chunk shape.
-- Slots need no cache clearing on reuse: the attention band masks
-  ``kpos <= pos``, and pos restarts at 0, so stale K/V from the previous
-  occupant is never visible.
+- The engine owns ONE jitted step (:func:`decode_step_paged`) over a
+  fixed slot grid [max_slots, prefill_chunk]: static shapes, compiled
+  once. Each iteration a decoding slot advances one token while a
+  prefilling slot consumes up to ``prefill_chunk`` prompt tokens — so a
+  long prompt drains in L/chunk steps WITHOUT stalling the decodes
+  sharing its batch (the chunked-prefill TODO from the dense engine).
+- KV lives in a block-paged pool (``serve/kv_cache.py`` +
+  ``models.init_cache_paged``): admission claims BLOCKS, not slots, and
+  a hash-trie prefix cache maps shared system prompts to shared
+  immutable blocks — a prefix hit skips that prefill compute entirely
+  (``pos`` starts past the reused tokens). Copy-on-write covers the one
+  mutable case (a capped match reusing a partial tail block).
+- An :class:`~ray_tpu.serve.admission.AdmissionController` sheds
+  requests whose projected TTFT/decode rate would breach the declared
+  :class:`~ray_tpu.serve.admission.SLOConfig`; per-request
+  ``deadline_s`` is enforced across admission queueing AND streaming.
+- ``paged=False`` keeps the dense per-slot cache
+  (:func:`decode_step_multi`) — the same-container A/B baseline
+  ``bench.py``'s ``serve_llm`` section measures against.
 - The engine is serve-independent (testable standalone); the
   :class:`LLMDeployment` wrapper runs it on a background thread inside a
   ``max_concurrency`` replica and streams tokens to each caller through
@@ -34,6 +43,11 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
+from ray_tpu.serve.admission import (AdmissionController,
+                                     DeadlineExceededError, RequestShedError,
+                                     SLOConfig)
+from ray_tpu.serve.kv_cache import BlockPool, PrefixCache
+
 
 @dataclass(eq=False)   # identity semantics: generated __eq__ would
 class _Request:        # elementwise-compare the prompt arrays and raise
@@ -46,6 +60,13 @@ class _Request:        # elementwise-compare the prompt arrays and raise
     last_token: int = 0
     eos: Optional[int] = None
     cancelled: bool = False
+    # paged-cache state (engine-owned)
+    table: List[int] = field(default_factory=list)   # physical block ids
+    pos: int = 0                       # KV tokens cached (incl. shared)
+    # latency bookkeeping (TTFT/TPOT + deadline enforcement)
+    submit_ts: float = 0.0             # monotonic
+    deadline: Optional[float] = None   # monotonic absolute
+    last_emit_ts: Optional[float] = None
 
 
 class LLMEngine:
@@ -58,10 +79,16 @@ class LLMEngine:
 
     def __init__(self, config, params=None, *, max_slots: int = 8,
                  max_len: int = 256, temperature: float = 0.0,
-                 seed: int = 0):
+                 seed: int = 0, paged: bool = True,
+                 block_size: Optional[int] = None,
+                 num_blocks: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None,
+                 prefix_cache: bool = True,
+                 slo: Optional[SLOConfig] = None):
         import jax
         import jax.numpy as jnp
 
+        from ray_tpu import config as _knobs
         from ray_tpu import models
 
         if isinstance(config, str):
@@ -70,17 +97,67 @@ class LLMEngine:
         self.max_slots = max_slots
         self.max_len = max_len
         self.temperature = temperature
+        self.paged = bool(paged)
         if params is None:
             params = models.init_params(jax.random.PRNGKey(seed), config)
         self.params = params
-        self._cache = models.init_cache_multi(config, max_slots, max_len)
-        self._step_fn = jax.jit(self._raw_step)
+        if self.paged:
+            bs = int(block_size or _knobs.get("llm_block_size"))
+            self._tbl_width = -(-max_len // bs)
+            nb = int(num_blocks or max_slots * self._tbl_width)
+            self.pool = BlockPool(nb, bs)
+            self.prefix = PrefixCache(self.pool) if prefix_cache else None
+            self.prefill_chunk = max(
+                1, int(prefill_chunk or _knobs.get("llm_prefill_chunk")))
+            self._cache = models.init_cache_paged(config, nb, bs)
+            # donate the cache: without donation every step/copy keeps
+            # BOTH pool-sized buffers live (the old one is overwritten
+            # immediately), doubling transient HBM for the KV pool —
+            # fatal at real pool sizes on a 16 GB v5e. CPU ignores
+            # donation (a one-time warning), so tests are unaffected.
+            self._step_fn = jax.jit(self._raw_step_paged,
+                                    donate_argnums=(1,))
+            self._copy_fn = jax.jit(self._raw_copy, donate_argnums=(0,))
+            # warm the COW copy's compile NOW, not in the middle of the
+            # first prefix-sharing request's admission (block 0 onto
+            # itself over an all-zero cache is a no-op; src/dst trace as
+            # scalars so one compile serves all)
+            self._cache = self._copy_fn(self._cache, 0, 0)
+        else:
+            self.pool = None
+            self.prefix = None
+            self.prefill_chunk = 1
+            self._cache = models.init_cache_multi(config, max_slots, max_len)
+            self._step_fn = jax.jit(self._raw_step)
+        self.admission = AdmissionController(slo)
         self._rng = np.random.default_rng(seed)
         self._lock = threading.Lock()
         self._pending: List[_Request] = []
         self._slots: List[Optional[_Request]] = [None] * max_slots
         self.stats = {"steps": 0, "tokens_generated": 0,
-                      "max_concurrent": 0, "requests": 0}
+                      "max_concurrent": 0, "requests": 0,
+                      "prefix_hit_tokens": 0, "deadline_drops": 0}
+        self._metrics = self._init_metrics()
+
+    @staticmethod
+    def _init_metrics():
+        """Serving-tier built-ins (metric_defs-only creation). Instances
+        are cached here so the hot loop never re-resolves the registry."""
+        try:
+            from ray_tpu.util import metric_defs as md
+
+            return {
+                "kv_free": md.get("rtpu_serve_kv_blocks_free"),
+                "kv_used": md.get("rtpu_serve_kv_blocks_used"),
+                "hits": md.get("rtpu_serve_prefix_cache_hits_total"),
+                "misses": md.get("rtpu_serve_prefix_cache_misses_total"),
+                "hit_tokens": md.get("rtpu_serve_prefix_hit_tokens_total"),
+                "sheds": md.get("rtpu_serve_admission_sheds_total"),
+                "ttft": md.get("rtpu_serve_ttft_seconds"),
+                "tpot": md.get("rtpu_serve_tpot_seconds"),
+            }
+        except Exception:  # metrics plane unavailable (bare unit tests)
+            return None
 
     def _raw_step(self, params, cache, tokens, active):
         from ray_tpu.models import decode_step_multi
@@ -88,11 +165,25 @@ class LLMEngine:
         return decode_step_multi(params, cache, tokens, self.config,
                                  active=active)
 
+    def _raw_step_paged(self, params, cache, tokens, tables, pos, nvalid,
+                        active):
+        from ray_tpu.models import decode_step_paged
+
+        return decode_step_paged(params, cache, tokens, tables, pos,
+                                 nvalid, self.config, active=active)
+
+    @staticmethod
+    def _raw_copy(cache, src, dst):
+        from ray_tpu.models import copy_kv_block
+
+        return copy_kv_block(cache, src, dst)
+
     # -- thread-safe intake ------------------------------------------------
 
     def submit(self, prompt, max_new_tokens: int,
                emit: Callable[[Any], None],
-               eos: Optional[int] = None) -> "_Request":
+               eos: Optional[int] = None,
+               deadline_s: Optional[float] = None) -> "_Request":
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if len(prompt) == 0:
             raise ValueError("empty prompt")
@@ -101,10 +192,40 @@ class LLMEngine:
                 f"prompt ({len(prompt)}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds the engine's max_len "
                 f"({self.max_len})")
+        if self.paged:
+            width = self.pool.blocks_for_tokens(
+                len(prompt) + max_new_tokens)
+            if width > self.pool.num_blocks:
+                # bigger than the WHOLE pool: it could never be admitted
+                # — queueing it would pin the strict-FIFO head forever
+                # and busy-spin the decode loop with zero active slots
+                raise ValueError(
+                    f"request needs {width} KV blocks but the pool has "
+                    f"only {self.pool.num_blocks} total; raise "
+                    f"num_blocks or lower max_new_tokens")
         if max_new_tokens < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens}")
-        req = _Request(prompt, max_new_tokens, emit, eos=eos)
+        # SLO gate BEFORE the request joins the queue: a doomed request
+        # gets a fast RequestShedError, not a slow timeout
+        with self._lock:
+            queued = len(self._pending)
+            queued_tokens = sum(len(r.prompt) for r in self._pending)
+            free_slots = sum(r is None for r in self._slots)
+        try:
+            self.admission.check_admit(
+                len(prompt), queued, queued_tokens, self.prefill_chunk,
+                free_slots, self.max_slots - free_slots,
+                deadline_s=deadline_s)
+        except RequestShedError as e:
+            if self._metrics:
+                self._metrics["sheds"].inc(tags={"reason": e.reason})
+            raise
+        now = time.monotonic()
+        req = _Request(prompt, max_new_tokens, emit, eos=eos,
+                       submit_ts=now,
+                       deadline=(now + deadline_s
+                                 if deadline_s is not None else None))
         with self._lock:
             self._pending.append(req)
             self.stats["requests"] += 1
@@ -112,8 +233,9 @@ class LLMEngine:
 
     def cancel(self, req: "_Request") -> None:
         """Abandon a request: pending entries are dropped immediately; an
-        in-slot request frees its slot at the next step without emitting
-        further tokens (client disconnect must not leave zombie slots)."""
+        in-slot request frees its slot (and KV blocks) at the next step
+        without emitting further tokens (client disconnect must not leave
+        zombie slots)."""
         with self._lock:
             req.cancelled = True
             if req in self._pending:
@@ -127,10 +249,81 @@ class LLMEngine:
             self._pending.clear()
             self._slots = [None] * self.max_slots
         for r in victims:
+            # under the lock: block/trie mutation must be invisible to a
+            # concurrent kv_state()/load_state() walking the trie
+            with self._lock:
+                self._release_blocks(r, insert=False)
             try:
                 r.emit(error)
             except Exception:
                 pass
+
+    # -- paged block accounting -------------------------------------------
+
+    def _claim_blocks(self, req: _Request, pending_copies: list) -> bool:
+        """Admission = claiming KV blocks. Prefix-match the prompt, then
+        allocate the remainder of the request's table (prompt + budgeted
+        new tokens, all up front — a request admitted here can never OOM
+        the pool mid-decode). Falls back to trie eviction; False = not
+        enough blocks, the request stays queued.
+
+        Pure host-side bookkeeping (runs under the engine lock): a
+        needed copy-on-write DEVICE copy is queued onto
+        ``pending_copies`` for :meth:`_sweep_and_admit` to run after the
+        lock drops — a tunnel-stalled device op must not freeze
+        ``submit()``/``kv_state()`` behind the lock."""
+        pool, trie = self.pool, self.prefix
+        total = len(req.prompt) + req.max_new_tokens
+        width = pool.blocks_for_tokens(total)
+        lookup_stats = trie.stats() if trie is not None else None
+        blocks, matched, cow = (trie.match(req.prompt.tolist())
+                                if trie is not None else ([], 0, None))
+        fresh_needed = width - len(blocks)
+        fresh = pool.alloc(fresh_needed)
+        if fresh is None and trie is not None:
+            trie.evict(fresh_needed - pool.free_count)
+            fresh = pool.alloc(fresh_needed)
+        def roll_back():
+            pool.release_all(blocks)
+            if cow is not None:
+                pool.release(cow)
+            # roll back the lookup accounting: this SAME request re-runs
+            # the match on every step while it waits at the queue head —
+            # counting each retry would overstate hit rate exactly in
+            # the pool-pressure regime the paged A/B measures
+            if lookup_stats is not None:
+                trie.hits = lookup_stats["hits"]
+                trie.misses = lookup_stats["misses"]
+                trie.hit_tokens = lookup_stats["hit_tokens"]
+
+        if fresh is None:
+            roll_back()
+            return False
+        if cow is not None:
+            # capped match reused part of a shared block: queue the
+            # device copy into the request's first fresh block (the cow
+            # ref stays held until the copy lands)
+            pending_copies.append((req, cow, fresh[0]))
+        req.table = blocks + fresh
+        req.pos = req.consumed = matched
+        self.stats["prefix_hit_tokens"] += matched
+        return True
+
+    def _release_blocks(self, req: _Request, *, insert: bool) -> None:
+        """Return a request's KV blocks. ``insert``: first offer the
+        fully-written full prompt blocks to the prefix trie (the trie
+        retains what it adopts), so the NEXT request with this system
+        prompt hits."""
+        if not self.paged or not req.table:
+            return
+        if insert and self.prefix is not None:
+            n_full = min(len(req.prompt), req.pos) // self.pool.block_size
+            if n_full:
+                self.prefix.insert(
+                    req.prompt[:n_full * self.pool.block_size].tolist(),
+                    req.table[:n_full])
+        self.pool.release_all(req.table)
+        req.table = []
 
     # -- driver-thread loop body ------------------------------------------
 
@@ -139,62 +332,218 @@ class LLMEngine:
 
         self._cache["pos"] = self._cache["pos"].at[i].set(jnp.int32(0))
 
-    def step(self) -> bool:
-        """Admit pending requests, advance every active slot one token,
-        route new tokens to their requests. Returns True if any slot is
-        active or requests are waiting."""
-        import jax
-        import jax.numpy as jnp
-
+    def _sweep_and_admit(self) -> tuple:
+        """Free finished/cancelled/expired slots, then admit pending
+        requests while a slot AND their KV blocks are available (strict
+        FIFO — no head-of-line bypass, so admission order is fair)."""
+        now = time.monotonic()
+        expired: List[_Request] = []
+        pending_copies: List[tuple] = []
         with self._lock:
             for i in range(self.max_slots):
-                if self._slots[i] is not None and self._slots[i].cancelled:
+                r = self._slots[i]
+                if r is not None and r.cancelled:
+                    self._release_blocks(r, insert=False)
                     self._slots[i] = None
+                elif (r is not None and r.deadline is not None
+                        and now > r.deadline):
+                    self._release_blocks(r, insert=False)
+                    self._slots[i] = None
+                    expired.append(r)
+            # deadline enforcement ACROSS admission queueing: a request
+            # that expired while waiting never occupies a slot
+            still = []
+            for r in self._pending:
+                if r.deadline is not None and now > r.deadline:
+                    expired.append(r)
+                else:
+                    still.append(r)
+            self._pending[:] = still
+            for i in range(self.max_slots):
                 if self._slots[i] is None and self._pending:
-                    self._slots[i] = self._pending.pop(0)
-                    self._reset_slot(i)
+                    cand = self._pending[0]
+                    if self.paged:
+                        if not self._claim_blocks(cand, pending_copies):
+                            break  # pool exhausted: stay queued
+                    self._pending.pop(0)
+                    self._slots[i] = cand
+                    if not self.paged:
+                        self._reset_slot(i)
             active_now = sum(r is not None for r in self._slots)
             self.stats["max_concurrent"] = max(
                 self.stats["max_concurrent"], active_now)
             have_pending = bool(self._pending)
+        for r in expired:
+            self.stats["deadline_drops"] += 1
+            try:
+                r.emit(DeadlineExceededError(
+                    f"request deadline elapsed after "
+                    f"{now - r.submit_ts:.3f}s (generated "
+                    f"{r.generated}/{r.max_new_tokens})"))
+            except Exception:
+                pass
+        # COW device copies run AFTER the lock drops (the axon tunnel
+        # can stall a device op for minutes; submit()/kv_state() must
+        # stay responsive) but BEFORE the step consumes the tables
+        for req, src, dst in pending_copies:
+            try:
+                self._cache = self._copy_fn(self._cache, src, dst)
+                with self._lock:
+                    self.pool.release(src)
+            except BaseException as e:
+                # device error: un-claim THIS request and fail it (its
+                # table is already published, so abort_all would miss
+                # the cow ref); then let the loop's abort path handle
+                # the rest of the engine state
+                with self._lock:
+                    self.pool.release(src)
+                    self._release_blocks(req, insert=False)
+                    for i, r in enumerate(self._slots):
+                        if r is req:
+                            self._slots[i] = None
+                try:
+                    req.emit(e)
+                except Exception:
+                    pass
+                raise
+        return active_now, have_pending
+
+    def step(self) -> bool:
+        """Admit pending requests, advance every active slot (one decode
+        token, or up to ``prefill_chunk`` prompt tokens), route new
+        tokens to their requests. Returns True if any slot is active or
+        requests are waiting."""
+        import jax
+        import jax.numpy as jnp
+
+        active_now, have_pending = self._sweep_and_admit()
         if active_now == 0:
+            self._sample_gauges()
             return have_pending
 
-        tokens = np.zeros((self.max_slots, 1), np.int32)
-        active = np.zeros(self.max_slots, bool)
-        for i, req in enumerate(self._slots):
-            if req is None:
-                continue
-            active[i] = True
-            if req.consumed < len(req.prompt):
-                tokens[i, 0] = req.prompt[req.consumed]
-            else:
-                tokens[i, 0] = req.last_token
+        t0 = time.perf_counter()
+        if self.paged:
+            logits_h, nvalid = self._advance_paged(jax, jnp)
+        else:
+            logits_h, nvalid = self._advance_dense(jax, jnp)
+        if self.stats["steps"] > 0:
+            # skip the FIRST step: it includes the jit trace+compile
+            # (seconds), and seeding the EWMA with it would make a
+            # freshly booted SLO-armed replica shed the very burst that
+            # scaled it up
+            self.admission.observe_step(time.perf_counter() - t0)
 
-        logits, self._cache = self._step_fn(
-            self.params, self._cache, jnp.asarray(tokens),
-            jnp.asarray(active))
-        # ONE host transfer for all slots (the tunnel-safe pattern)
-        logits_h = np.asarray(jax.device_get(logits))
-
+        now = time.monotonic()
         for i, req in enumerate(self._slots):
             if req is None:
                 continue
             if req.consumed < len(req.prompt):
-                req.consumed += 1
+                req.consumed += int(nvalid[i])
                 if req.consumed < len(req.prompt):
                     continue  # still prefilling; logits not sampled yet
             tok = self._sample(logits_h[i])
             req.last_token = tok
             req.generated += 1
+            self._observe_emit(req, now)
             req.emit(tok)
             self.stats["tokens_generated"] += 1
             if req.generated >= req.max_new_tokens or (
                     req.eos is not None and tok == req.eos):
+                # lock: the trie insert mutates children dicts that a
+                # concurrent kv_state()/load_state() may be iterating
+                with self._lock:
+                    self._release_blocks(req, insert=True)
                 req.emit(None)
                 self._slots[i] = None
         self.stats["steps"] += 1
+        self._sample_gauges()
         return True
+
+    def _advance_dense(self, jax, jnp):
+        """Dense per-slot cache: every active slot advances exactly one
+        token (the pre-paged engine, kept as the A/B baseline)."""
+        tokens = np.zeros((self.max_slots, 1), np.int32)
+        active = np.zeros(self.max_slots, bool)
+        nvalid = np.zeros(self.max_slots, np.int32)
+        for i, req in enumerate(self._slots):
+            if req is None:
+                continue
+            active[i] = True
+            nvalid[i] = 1
+            if req.consumed < len(req.prompt):
+                tokens[i, 0] = req.prompt[req.consumed]
+            else:
+                tokens[i, 0] = req.last_token
+        logits, self._cache = self._step_fn(
+            self.params, self._cache, jnp.asarray(tokens),
+            jnp.asarray(active))
+        # ONE host transfer for all slots (the tunnel-safe pattern)
+        return np.asarray(jax.device_get(logits)), nvalid
+
+    def _advance_paged(self, jax, jnp):
+        """Paged cache: decoding slots feed 1 token, prefilling slots
+        feed up to ``prefill_chunk`` prompt tokens — one compiled
+        program, no decode stall behind long prompts."""
+        C = self.prefill_chunk
+        tokens = np.zeros((self.max_slots, C), np.int32)
+        nvalid = np.zeros(self.max_slots, np.int32)
+        active = np.zeros(self.max_slots, bool)
+        pos = np.zeros(self.max_slots, np.int32)
+        tables = np.zeros((self.max_slots, self._tbl_width), np.int32)
+        for i, req in enumerate(self._slots):
+            if req is None:
+                continue
+            active[i] = True
+            pos[i] = req.pos
+            tables[i, :len(req.table)] = req.table
+            if req.consumed < len(req.prompt):
+                n = min(C, len(req.prompt) - req.consumed)
+                tokens[i, :n] = req.prompt[req.consumed:req.consumed + n]
+                nvalid[i] = n
+            else:
+                tokens[i, 0] = req.last_token
+                nvalid[i] = 1
+        logits, self._cache = self._step_fn(
+            self.params, self._cache, jnp.asarray(tokens),
+            jnp.asarray(tables), jnp.asarray(pos), jnp.asarray(nvalid),
+            jnp.asarray(active))
+        for i, req in enumerate(self._slots):
+            if req is not None:
+                req.pos += int(nvalid[i])
+        return np.asarray(jax.device_get(logits)), nvalid
+
+    def _observe_emit(self, req: _Request, now: float) -> None:
+        m = self._metrics
+        if req.last_emit_ts is None:
+            ttft = now - req.submit_ts
+            self.admission.observe_ttft(ttft)
+            if m:
+                m["ttft"].observe(ttft)
+        else:
+            tpot = now - req.last_emit_ts
+            self.admission.observe_tpot(tpot)
+            if m:
+                m["tpot"].observe(tpot)
+        req.last_emit_ts = now
+
+    _mirrored = ("hits", "misses", "hit_tokens")
+
+    def _sample_gauges(self) -> None:
+        m = self._metrics
+        if not m:
+            return
+        if self.pool is not None:
+            m["kv_free"].set(self.pool.free_count)
+            m["kv_used"].set(self.pool.used_count)
+        if self.prefix is not None:
+            # counters mirror the trie's totals via deltas
+            cur = self.prefix.stats()
+            prev = getattr(self, "_mirror_prev", None) or {}
+            for k in self._mirrored:
+                d = cur[k] - prev.get(k, 0)
+                if d > 0:
+                    m[k].inc(d)
+            self._mirror_prev = {k: cur[k] for k in self._mirrored}
 
     def _sample(self, logits: np.ndarray) -> int:
         if self.temperature <= 0.0:
@@ -204,6 +553,40 @@ class LLMEngine:
         p = np.exp(z)
         p /= p.sum()
         return int(self._rng.choice(len(p), p=p))
+
+    # -- introspection (routing + tests) ----------------------------------
+
+    def kv_state(self) -> Dict[str, Any]:
+        """Routing/leak-audit snapshot: block accounting + prefix-cache
+        + admission state, all host-side (no device sync)."""
+        # ONE lock covers slots AND the pool/trie walk: every trie
+        # mutation site (claim in _sweep_and_admit, the finish/abort
+        # releases) holds the same lock, so the iteration below can
+        # never see a children dict resize mid-walk
+        with self._lock:
+            out: Dict[str, Any] = {
+                "paged": self.paged,
+                "inflight": sum(r is not None for r in self._slots),
+                "queued": len(self._pending),
+                "max_slots": self.max_slots,
+            }
+            if self.pool is not None:
+                out.update(kv_total=self.pool.num_blocks,
+                           kv_free=self.pool.free_count,
+                           kv_used=self.pool.used_count,
+                           block_size=self.pool.block_size)
+            if self.prefix is not None:
+                out["prefix"] = self.prefix.stats()
+                # claimable = free + evictable-from-trie: the CAPACITY
+                # signal (a warm replica's raw free count trends to ~0
+                # because the trie retains every finished prompt — that
+                # is cache value, not pressure)
+                out["kv_claimable"] = (self.pool.free_count
+                                       + self.prefix.evictable_count())
+            elif self.pool is not None:
+                out["kv_claimable"] = self.pool.free_count
+        out["admission"] = self.admission.snapshot()
+        return out
 
 
 class LLMDeployment:
@@ -221,15 +604,28 @@ class LLMDeployment:
 
     Each ``__call__`` is a SYNC generator (the proven streaming-replica
     path); the engine advances on a dedicated background thread, so all
-    concurrent callers share one jitted decode program and one KV cache.
+    concurrent callers share one jitted decode program and one paged KV
+    pool. ``slo`` (dict or :class:`SLOConfig`) arms admission shedding;
+    per-request ``deadline_s`` bounds queueing AND streaming.
     """
 
     def __init__(self, model="llama-debug", *, max_slots: int = 8,
                  max_len: int = 256, temperature: float = 0.0,
-                 params=None, seed: int = 0):
+                 params=None, seed: int = 0, paged: bool = True,
+                 block_size: Optional[int] = None,
+                 num_blocks: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None,
+                 prefix_cache: bool = True,
+                 slo: Optional[Any] = None):
+        if isinstance(slo, dict):
+            slo = SLOConfig(**slo)
         self.engine = LLMEngine(model, params, max_slots=max_slots,
                                 max_len=max_len, temperature=temperature,
-                                seed=seed)
+                                seed=seed, paged=paged,
+                                block_size=block_size,
+                                num_blocks=num_blocks,
+                                prefill_chunk=prefill_chunk,
+                                prefix_cache=prefix_cache, slo=slo)
         self._error: Optional[BaseException] = None
         self._wake = threading.Event()
         self._stop = False
@@ -256,9 +652,14 @@ class LLMDeployment:
                 self._wake.clear()
 
     def __call__(self, prompt_tokens, max_new_tokens: int = 16,
-                 eos: Optional[int] = None):
+                 eos: Optional[int] = None,
+                 deadline_s: Optional[float] = None):
+        from ray_tpu import config as _knobs
         from ray_tpu.util import tracing
 
+        stall_timeout = float(_knobs.get("llm_stall_timeout_s"))
+        deadline = (time.monotonic() + deadline_s
+                    if deadline_s is not None else None)
         q: "queue.Queue[Any]" = queue.Queue()
         # manual spans (not span()): this is a generator — a thread-local
         # span context held across a yield would leak onto whatever the
@@ -278,14 +679,29 @@ class LLMDeployment:
             # submit INSIDE the try: a dead engine must still finish the
             # admission span (it is the SLO signal for failed admission)
             req = self.engine.submit(prompt_tokens, max_new_tokens,
-                                     q.put_nowait, eos=eos)
+                                     q.put_nowait, eos=eos,
+                                     deadline_s=deadline_s)
             self._wake.set()
             while True:
+                wait = stall_timeout
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise DeadlineExceededError(
+                            f"request deadline ({deadline_s}s) elapsed "
+                            f"after {produced} tokens")
+                    wait = min(wait, remaining)
                 try:
-                    tok = q.get(timeout=120.0)
+                    tok = q.get(timeout=wait)
                 except queue.Empty:
+                    if (deadline is not None
+                            and time.monotonic() >= deadline):
+                        raise DeadlineExceededError(
+                            f"request deadline ({deadline_s}s) elapsed "
+                            f"after {produced} tokens")
                     raise TimeoutError(
-                        "llm decode loop produced no token for 120s"
+                        f"llm decode loop produced no token for "
+                        f"{stall_timeout:.0f}s"
                         + (f" (loop error: {self._error!r})"
                            if self._error else ""))
                 if queue_span is not None:
@@ -293,6 +709,9 @@ class LLMDeployment:
                     queue_span = None
                 if tok is None:
                     return
+                if isinstance(tok, (DeadlineExceededError,
+                                    RequestShedError)):
+                    raise tok  # admission/deadline verdicts pass through
                 if isinstance(tok, BaseException):
                     raise RuntimeError(f"llm decode loop failed: {tok!r}")
                 produced += 1
@@ -311,7 +730,24 @@ class LLMDeployment:
                 stream_span.finish({"tokens": produced})
 
     def stats(self) -> Dict[str, Any]:
-        return dict(self.engine.stats)
+        out = dict(self.engine.stats)
+        out.update(self.engine.kv_state())
+        return out
+
+    def kv_state(self) -> Dict[str, Any]:
+        return self.engine.kv_state()
+
+    def load_state(self) -> Dict[str, Any]:
+        """Load report the replica pushes to the controller (the routing
+        + autoscaling signal). ``kv_free`` here is the CLAIMABLE count
+        (free list + trie-evictable): prefix-cache retention is cache
+        value, not pressure — reporting the raw free count would make a
+        warm idle replica read ~100% utilized, steering traffic to cold
+        replicas and driving autoscale runaway."""
+        s = self.engine.kv_state()
+        return {"inflight": s["inflight"] + s["queued"],
+                "kv_free": s.get("kv_claimable", s.get("kv_free", 0)),
+                "kv_total": s.get("kv_total", 0)}
 
     def check_health(self) -> None:
         if not self._thread.is_alive():

@@ -17,7 +17,8 @@ from typing import Any, Dict, Optional
 class ReplicaActor:
     def __init__(self, cls_or_fn, init_args, init_kwargs,
                  user_config: Optional[Dict[str, Any]] = None,
-                 handle_args: Optional[Dict[str, Any]] = None):
+                 handle_args: Optional[Dict[str, Any]] = None,
+                 deployment_name: Optional[str] = None):
         # handle_args: deployment-name -> handle for composed models
         self._is_function = inspect.isfunction(cls_or_fn) or \
             inspect.isbuiltin(cls_or_fn)
@@ -30,6 +31,48 @@ class ReplicaActor:
             self._callable.reconfigure(user_config)
         self._num_requests = 0
         self._start_time = time.time()
+        self._deployment_name = deployment_name
+        # load-report push loop (ISSUE 12 routing signal): deployments
+        # exposing load_state() advertise {inflight, kv_free, kv_total}
+        # to the controller on a fixed cadence — capture our actor id NOW
+        # (current_actor_id is task-context-local; the push thread runs
+        # outside any task)
+        if (deployment_name is not None
+                and hasattr(self._callable, "load_state")):
+            from ray_tpu import config as _cfg
+
+            interval = float(_cfg.get("serve_load_report_interval_s"))
+            if interval > 0:
+                import threading
+
+                import ray_tpu
+
+                aid = ray_tpu.get_runtime_context().get_actor_id()
+                self._push_thread = threading.Thread(
+                    target=self._push_loop,
+                    args=(interval, bytes.fromhex(aid) if aid else b""),
+                    daemon=True, name="replica-load-report")
+                self._push_thread.start()
+
+    def _push_loop(self, interval: float, actor_id: bytes) -> None:
+        import ray_tpu
+        from ray_tpu.serve.controller import CONTROLLER_NAME
+
+        ctrl = None
+        # daemon thread: dies with the replica process (kill/scale-down);
+        # there is no graceful-stop path to wire it into
+        while True:
+            time.sleep(interval)
+            try:
+                if ctrl is None:
+                    ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
+                load = self._callable.load_state()
+                ctrl.report_replica_load.remote(
+                    self._deployment_name, actor_id, load)
+            except Exception:
+                # controller restarted / unreachable: re-resolve next
+                # beat — a stale routing signal, never a dead replica
+                ctrl = None
 
     def handle_request(self, method_name: str, args, kwargs):
         self._num_requests += 1

@@ -422,6 +422,42 @@ _def("rtpu_tpu_hbm_limit_bytes", "gauge",
 
 
 # ---------------------------------------------------------------------------
+# LLM serving tier (serve/llm.py — recorded in each replica's process,
+# federated to the head /metrics like every worker-side metric)
+# ---------------------------------------------------------------------------
+
+_def("rtpu_serve_kv_blocks_free", "gauge",
+     "paged-KV blocks on this replica's free list (sampled per engine "
+     "step). Drained-replica invariant: free + prefix-cache blocks == "
+     "total — the prefix trie legitimately retains finished prompts, "
+     "so free alone does NOT return to total on a warm idle replica",
+     component="serve")
+_def("rtpu_serve_kv_blocks_used", "gauge",
+     "paged-KV blocks held by live requests and the prefix cache "
+     "(sampled per engine step)", component="serve")
+_def("rtpu_serve_prefix_cache_hits_total", "counter",
+     "prompt lookups that reused at least one cached prefix block",
+     component="serve")
+_def("rtpu_serve_prefix_cache_misses_total", "counter",
+     "prompt lookups that found no cached prefix", component="serve")
+_def("rtpu_serve_prefix_hit_tokens_total", "counter",
+     "prompt tokens served from the prefix cache instead of prefill "
+     "compute (the tokens/s win of prefix reuse)", component="serve")
+_def("rtpu_serve_admission_sheds_total", "counter",
+     "requests shed by the SLO admission controller, by gate "
+     "(ttft/tpot/queue/deadline)", tag_keys=("reason",),
+     component="serve")
+_def("rtpu_serve_ttft_seconds", "histogram",
+     "time from request submission to its first generated token "
+     "(admission queue + prefill — the latency the TTFT SLO declares)",
+     boundaries=_LAT_TASK, component="serve")
+_def("rtpu_serve_tpot_seconds", "histogram",
+     "time between consecutive generated tokens of one stream (decode "
+     "cadence — the latency the TPOT SLO declares)",
+     boundaries=_LAT_FAST, component="serve")
+
+
+# ---------------------------------------------------------------------------
 # instantiation
 # ---------------------------------------------------------------------------
 

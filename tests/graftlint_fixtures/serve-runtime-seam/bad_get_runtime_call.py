@@ -1,0 +1,7 @@
+# graftlint: path=ray_tpu/serve/fake_router.py
+"""Offender: routing code calling the private runtime accessor."""
+from ray_tpu.core.runtime import _get_runtime
+
+
+def depths(ids):
+    return _get_runtime().actor_queue_depths(ids)

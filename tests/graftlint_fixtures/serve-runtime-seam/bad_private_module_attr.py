@@ -1,0 +1,8 @@
+# graftlint: path=ray_tpu/serve/fake_router.py
+"""Offender: reaching a util module's PRIVATE surface — the tempting
+shortcut past the public state API."""
+from ray_tpu.util import state
+
+
+def depths(ids):
+    return state._gcs().actor_queue_depths(ids)

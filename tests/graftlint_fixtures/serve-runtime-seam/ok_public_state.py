@@ -1,0 +1,18 @@
+# graftlint: path=ray_tpu/serve/fake_router.py
+"""Compliant: the public state surface + intra-tier privates."""
+import ray_tpu
+from ray_tpu.serve import handle as _handle_mod
+from ray_tpu.util import state
+
+
+def depths(ids):
+    return state.actor_queue_depths(ids)
+
+
+def loads(name):
+    ctrl = ray_tpu.get_actor("SERVE_CONTROLLER")
+    return ray_tpu.get(ctrl.get_replica_loads.remote(name))
+
+
+def dags():
+    return dict(_handle_mod._dag_cache)
