@@ -1,21 +1,28 @@
-"""Traffic-replay load generator for the LLM serving tier (ISSUE 12).
+"""Traffic-replay load generator for the LLM serving tier (ISSUE 12/13).
 
 Replays a synthetic multi-tenant trace — a shared-prefix mixture (each
 tenant has a fixed system prompt; its requests append distinct user
-suffixes) with bursty on/off arrivals — against either an in-process
-:class:`~ray_tpu.serve.llm.LLMEngine` (the same-container A/B mode
-``bench.py``'s ``serve_llm`` section uses) or a deployed multi-replica
-application (``python experiments/serve_replay.py --serve``), and
-reports the serving-tier scorecard:
+suffixes) with bursty on/off arrivals, optionally salted with periodic
+LONG prompts (the disaggregation stressor: a long prefill arriving
+during steady decode) — against one of:
+
+- an in-process :class:`~ray_tpu.serve.llm.LLMEngine` (the
+  same-container A/B mode ``bench.py``'s ``serve_llm`` section uses);
+- an in-process colocated-vs-disaggregated engine PAIR
+  (``--disagg``; ``bench.py``'s ``serve_disagg`` section);
+- a deployed multi-replica application (``--serve``), optionally
+  through a multi-node cluster (``--nodes N``) and optionally split
+  into prefill/decode pools (``--serve --disagg``).
+
+The trace is GENERATED AS A STREAM (O(1) memory per in-flight request)
+and the stats keep bounded reservoirs, so ``--scale full`` (>= 1M
+requests — the ROADMAP's millions-of-users envelope) runs in bounded
+memory; the envelope is the cluster's, not the harness's. Reports the
+serving-tier scorecard:
 
     tokens/s (generated), TTFT p50/p99, TPOT p50/p99,
-    prefix-cache hit rate, shed rate, error count
-
-Scale-parameterized: ``--scale quick`` fits the 2-vCPU CI tier
-(hundreds of requests, tiny model); ``--scale full`` targets the
-ROADMAP's millions-of-requests envelope on real hardware (the trace
-generator is O(1) memory per in-flight request, so the envelope is
-bounded by the cluster, not the harness).
+    prefix-cache hit rate, shed rate, error count,
+    SLO verdict + per-pool KV-leak audit (serve modes)
 
 Prints ONE JSON line (the bench.py contract).
 """
@@ -29,8 +36,10 @@ import os
 import sys
 import threading
 import time
+import uuid
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+from typing import (Any, Callable, Dict, Iterable, Iterator, List,
+                    Optional, Tuple)
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO not in sys.path:  # runnable as `python experiments/serve_replay.py`
@@ -38,7 +47,7 @@ if _REPO not in sys.path:  # runnable as `python experiments/serve_replay.py`
 
 
 # ---------------------------------------------------------------------------
-# trace generation
+# trace generation (streamed: --scale full must not materialize 1M requests)
 # ---------------------------------------------------------------------------
 
 @dataclass
@@ -54,6 +63,11 @@ class TraceConfig:
     burst_len_s: float = 0.5
     gap_s: float = 0.25
     seed: int = 0
+    # mixed-workload salt (ISSUE 13): every Nth request carries a LONG
+    # prompt — the arrival pattern that makes colocated decode cadence
+    # collapse and disaggregation win. 0 disables.
+    long_every: int = 0
+    long_prompt_tokens: int = 0
 
 
 @dataclass
@@ -64,20 +78,20 @@ class Request:
     max_new: int
 
 
-def gen_trace(cfg: TraceConfig) -> List[Request]:
-    """Deterministic multi-tenant trace: tenant system prompts are fixed
-    per seed; arrivals are an on/off burst process (the shape that
-    separates load-aware routing from round-robin — bursts pile onto
-    whichever replica round-robin happens to hit mid-burst)."""
+def iter_trace(cfg: TraceConfig) -> Iterator[Request]:
+    """Deterministic multi-tenant trace, yielded one request at a time:
+    tenant system prompts are fixed per seed; arrivals are an on/off
+    burst process (the shape that separates load-aware routing from
+    round-robin — bursts pile onto whichever replica round-robin happens
+    to hit mid-burst). O(tenants) state regardless of n_requests."""
     import numpy as np
 
     rng = np.random.default_rng(cfg.seed)
     prefixes = [rng.integers(0, cfg.vocab, cfg.shared_prefix_tokens)
                 .tolist() for _ in range(cfg.n_tenants)]
-    out: List[Request] = []
     t = 0.0
     in_burst_left = cfg.burst_len_s
-    for _ in range(cfg.n_requests):
+    for i in range(cfg.n_requests):
         # exponential inter-arrival inside a burst; jump the gap when the
         # burst budget is spent
         dt = float(rng.exponential(1.0 / cfg.burst_rps))
@@ -87,17 +101,55 @@ def gen_trace(cfg: TraceConfig) -> List[Request]:
             in_burst_left = cfg.burst_len_s
         t += dt
         tenant = int(rng.integers(cfg.n_tenants))
-        n_suffix = 1 + int(rng.geometric(1.0 / cfg.suffix_tokens_mean))
+        if cfg.long_every and (i + 1) % cfg.long_every == 0:
+            n_suffix = cfg.long_prompt_tokens
+        else:
+            n_suffix = 1 + int(rng.geometric(1.0 / cfg.suffix_tokens_mean))
+            if cfg.long_every and cfg.long_prompt_tokens:
+                # keep the mixed workload bimodal: the geometric tail
+                # must not wander into long-prompt territory
+                n_suffix = min(n_suffix, cfg.long_prompt_tokens - 1)
         prompt = prefixes[tenant] + rng.integers(
             0, cfg.vocab, n_suffix).tolist()
-        out.append(Request(t, tenant, prompt,
-                           max_new=cfg.max_new_tokens))
-    return out
+        yield Request(t, tenant, prompt, max_new=cfg.max_new_tokens)
+
+
+def gen_trace(cfg: TraceConfig) -> List[Request]:
+    """Materialized trace (tests / small scales)."""
+    return list(iter_trace(cfg))
 
 
 # ---------------------------------------------------------------------------
-# replay harness
+# replay harness (bounded memory at any request count)
 # ---------------------------------------------------------------------------
+
+class _Reservoir:
+    """Fixed-size uniform sample of a stream — percentile estimates for
+    traces far too long to keep every latency (1M requests x 64 TPOTs
+    would be half a GB as floats)."""
+
+    def __init__(self, cap: int = 65536, seed: int = 0):
+        import random
+
+        self.cap = cap
+        self.n = 0
+        self.xs: List[float] = []
+        self._rng = random.Random(seed)
+
+    def add(self, x: float) -> None:
+        self.n += 1
+        if len(self.xs) < self.cap:
+            self.xs.append(x)
+        else:
+            j = self._rng.randrange(self.n)
+            if j < self.cap:
+                self.xs[j] = x
+
+    def percentile(self, q: float) -> float:
+        from ray_tpu.serve.admission import _percentile
+
+        return _percentile(sorted(self.xs), q)
+
 
 @dataclass
 class ReplayStats:
@@ -108,13 +160,8 @@ class ReplayStats:
     errors: int = 0
     tokens: int = 0
     wall_s: float = 0.0
-    ttft: List[float] = field(default_factory=list)
-    tpot: List[float] = field(default_factory=list)
-
-    def _pct(self, xs: List[float], q: float) -> float:
-        from ray_tpu.serve.admission import _percentile
-
-        return _percentile(sorted(xs), q)
+    ttft: _Reservoir = field(default_factory=_Reservoir)
+    tpot: _Reservoir = field(default_factory=_Reservoir)
 
     def summary(self) -> Dict[str, Any]:
         return {
@@ -128,50 +175,55 @@ class ReplayStats:
             "tokens_per_s": round(self.tokens / self.wall_s, 2)
             if self.wall_s else 0.0,
             "shed_rate": round(self.shed / max(self.started, 1), 4),
-            "ttft_p50_s": round(self._pct(self.ttft, 0.50), 4),
-            "ttft_p99_s": round(self._pct(self.ttft, 0.99), 4),
-            "tpot_p50_s": round(self._pct(self.tpot, 0.50), 5),
-            "tpot_p99_s": round(self._pct(self.tpot, 0.99), 5),
+            "ttft_p50_s": round(self.ttft.percentile(0.50), 4),
+            "ttft_p99_s": round(self.ttft.percentile(0.99), 4),
+            "tpot_p50_s": round(self.tpot.percentile(0.50), 5),
+            "tpot_p99_s": round(self.tpot.percentile(0.99), 5),
         }
 
 
-def replay(stream_fn: Callable[[Request], Iterable[int]],
-           trace: List[Request], *, time_scale: float = 1.0,
-           max_clients: int = 32,
-           on_error: Optional[Callable[[Request, BaseException], str]]
-           = None) -> ReplayStats:
-    """Drive the trace against ``stream_fn`` (request -> token iterator),
-    honoring arrival times (``time_scale`` stretches/compresses them).
-    Each in-flight request holds one client thread — the streaming
-    consumption model real callers have. ``on_error`` classifies
-    exceptions: return "shed"/"deadline"/"error" (default heuristics
-    inspect the type name)."""
+def classify_error(e: BaseException) -> str:
+    """"shed" / "deadline" / "error" off the machine-readable
+    ``error_type`` that admission errors declare and ``TaskError``
+    wrappers now carry across process boundaries (ISSUE 13 satellite —
+    no more str()-prefix matching)."""
     from ray_tpu.serve.admission import (DeadlineExceededError,
                                          RequestShedError)
 
+    seen = set()
+    cur: Optional[BaseException] = e
+    while cur is not None and id(cur) not in seen:
+        seen.add(id(cur))
+        if isinstance(cur, RequestShedError):
+            return "shed"
+        if isinstance(cur, DeadlineExceededError):
+            return "deadline"
+        et = getattr(cur, "error_type", None)
+        if et in ("shed", "deadline"):
+            return et
+        cur = getattr(cur, "cause", None) or cur.__cause__
+    return "error"
+
+
+def replay(stream_fn: Callable[[Request], Iterable[int]],
+           trace: Iterable[Request], *, time_scale: float = 1.0,
+           max_clients: int = 32,
+           on_error: Optional[Callable[[Request, BaseException], str]]
+           = None, max_wall_s: Optional[float] = None,
+           progress_every: int = 0) -> ReplayStats:
+    """Drive the trace against ``stream_fn`` (request -> token iterator),
+    honoring arrival times (``time_scale`` stretches/compresses them;
+    0 = closed loop). Each in-flight request holds one client thread —
+    the streaming consumption model real callers have — and at most
+    ``max_clients`` are alive at once, so memory is bounded by the
+    client window, never the trace length. ``on_error`` overrides the
+    default ``classify_error``. ``max_wall_s`` stops ADMITTING new
+    requests after the budget (already-started streams drain)."""
     stats = ReplayStats()
     lock = threading.Lock()
     sem = threading.Semaphore(max_clients)
     t0 = time.monotonic()
-
-    def classify(req: Request, e: BaseException) -> str:
-        if on_error is not None:
-            return on_error(req, e)
-        if isinstance(e, RequestShedError):
-            return "shed"
-        if isinstance(e, DeadlineExceededError):
-            return "deadline"
-        # serve wraps engine-side errors (TaskError/RuntimeError): the
-        # class name survives only in str() (remote traceback), and the
-        # MESSAGE prefixes are part of the admission API ("request shed
-        # (<reason>)", "request deadline") — match either so shed/
-        # deadline accounting survives every wrapper
-        s = repr(e) + " " + str(e)
-        if "RequestShedError" in s or "request shed (" in s:
-            return "shed"
-        if "DeadlineExceededError" in s or "request deadline" in s:
-            return "deadline"
-        return "error"
+    classify = on_error or (lambda req, e: classify_error(e))
 
     def client(req: Request) -> None:
         try:
@@ -186,7 +238,7 @@ def replay(stream_fn: Callable[[Request], Iterable[int]],
                         first = now - t_submit
                     else:
                         with lock:
-                            stats.tpot.append(now - last)
+                            stats.tpot.add(now - last)
                     last = now
                     n += 1
             except BaseException as e:  # noqa: BLE001 - classified below
@@ -204,29 +256,42 @@ def replay(stream_fn: Callable[[Request], Iterable[int]],
                 stats.completed += 1
                 stats.tokens += n
                 if first is not None:
-                    stats.ttft.append(first)
+                    stats.ttft.add(first)
         finally:
             sem.release()
 
-    threads: List[threading.Thread] = []
+    truncated = False
     for req in trace:
+        if max_wall_s is not None \
+                and time.monotonic() - t0 > max_wall_s:
+            truncated = True
+            break
         target = t0 + req.arrival_s * time_scale
         delay = target - time.monotonic()
         if delay > 0:
             time.sleep(delay)
         sem.acquire()
         stats.started += 1
-        th = threading.Thread(target=client, args=(req,), daemon=True)
-        th.start()
-        threads.append(th)
-    for th in threads:
-        th.join(timeout=300)
+        threading.Thread(target=client, args=(req,), daemon=True).start()
+        if progress_every and stats.started % progress_every == 0:
+            print(f"# replay: {stats.started} started, "
+                  f"{stats.completed} done, "
+                  f"{time.monotonic() - t0:.0f}s", file=sys.stderr)
+    # drain: re-acquire every client permit (each release marks one
+    # client finished) — no per-thread bookkeeping, so a 1M-request
+    # replay never holds 1M Thread objects
+    deadline = time.monotonic() + 600
+    for _ in range(max_clients):
+        if not sem.acquire(timeout=max(0.1, deadline - time.monotonic())):
+            break
     stats.wall_s = time.monotonic() - t0
+    if truncated:
+        stats.truncated = True  # type: ignore[attr-defined]
     return stats
 
 
 # ---------------------------------------------------------------------------
-# drivers: in-process engine (bench A/B) and deployed application
+# drivers: in-process engines (bench A/Bs) and deployed applications
 # ---------------------------------------------------------------------------
 
 class EngineRunner:
@@ -286,10 +351,11 @@ def run_engine_ab(scale: str = "quick", paged: bool = True,
                        block_size=16, prefill_chunk=8)
     runner = EngineRunner(engine)
     try:
-        trace = gen_trace(cfg)
+        first = next(iter_trace(cfg))
         # warm the compile out of the measurement
-        list(runner.stream(Request(0.0, 0, trace[0].prompt[:8], 2)))
-        stats = replay(runner.stream, trace, time_scale=time_scale)
+        list(runner.stream(Request(0.0, 0, first.prompt[:8], 2)))
+        stats = replay(runner.stream, iter_trace(cfg),
+                       time_scale=time_scale)
     finally:
         runner.close()
     out = stats.summary()
@@ -301,6 +367,132 @@ def run_engine_ab(scale: str = "quick", paged: bool = True,
         out["prefix_hit_tokens"] = p["hit_tokens"]
     out["paged"] = paged
     return out
+
+
+def run_disagg_ab(scale: str = "quick", *, disagg: bool,
+                  seed: int = 0,
+                  model: str = "llama-debug") -> Dict[str, Any]:
+    """Colocated-vs-disaggregated same-container A/B (ISSUE 13): TWO
+    engines either way — colocated mode routes whole requests to the
+    less-loaded engine; disagg mode dedicates one to chunked prefill
+    and one to decode, shipping KV blocks over the real DeviceChannel
+    path between them. Same hardware, same trace (mixed: steady short
+    prompts + periodic long prompts), so the delta IS the architecture:
+    long prefills stop sharing a step with in-flight decodes."""
+    from ray_tpu.serve.llm import LLMDeployment
+    from ray_tpu.util.tpu_info import honor_jax_platform_env
+
+    honor_jax_platform_env()
+    cfg = _mixed_cfg(_scale_trace(scale, seed))
+    kw = dict(_MIXED_ENGINE_KW, seed=seed)
+    kw["max_len"] = _mixed_max_len(cfg, kw["block_size"])
+    if disagg:
+        # same TOTAL KV memory as the colocated pair (2x the per-engine
+        # default), split by role: prefill holds only the transient
+        # working set of in-flight prompts, decode keeps the sessions +
+        # prefix cache — a decode pool sized like a colocated engine
+        # would run at permanent pool pressure (every adopt evicts)
+        base_blocks = kw["max_slots"] * (kw["max_len"]
+                                         // kw["block_size"])
+        pools = [LLMDeployment(model, role="prefill",
+                               num_blocks=3 * base_blocks // 4, **kw),
+                 # decode never prefills: one-block prefill_chunk keeps
+                 # the chunk's dead compute out of every decode step
+                 LLMDeployment(model, role="decode",
+                               num_blocks=5 * base_blocks // 4,
+                               **dict(kw,
+                                      prefill_chunk=kw["block_size"]))]
+        node = pools[0].identity()["node"]
+
+        def stream(req: Request) -> Iterable[int]:
+            rid = uuid.uuid4().hex
+            desc = pools[0].prefill_export(
+                req.prompt, {"req": rid, "dst": "decode0",
+                             "dst_node": node})
+            return pools[1].adopt_stream(req.prompt, desc, req.max_new)
+    else:
+        kw = dict(kw, prefill_chunk=_MIXED_COLOC_CHUNK)
+        pools = [LLMDeployment(model, role="colocated", **kw),
+                 LLMDeployment(model, role="colocated", **kw)]
+
+        def stream(req: Request) -> Iterable[int]:
+            states = [p.engine.kv_state() for p in pools]
+            loads = [s["inflight"] + s["queued"] for s in states]
+            return pools[loads.index(min(loads))](
+                req.prompt, req.max_new)
+
+    try:
+        first = next(iter_trace(cfg))
+        # warm every engine's compile paths out of the measurement
+        for p in _mixed_warm_prompts(cfg, first.prompt * 16,
+                                     kw["block_size"]):
+            for _ in range(2):
+                list(stream(Request(0.0, 0, list(p), 2)))
+        stats = replay(stream, iter_trace(cfg), time_scale=0.0,
+                       max_clients=8)
+    finally:
+        for p in pools:
+            p.close()   # in-process: nosess rings have no sweep
+    out = stats.summary()
+    out["mode"] = "disagg" if disagg else "colocated"
+    states = [p.engine.kv_state() for p in pools]
+    out["kv_leaks"] = sum(
+        s["kv_total"] - s["kv_free"] - s["prefix"]["nodes"]
+        for s in states)
+    out["exported"] = sum(p.engine.stats["exported"] for p in pools)
+    out["adopted"] = sum(p.engine.stats["adopted"] for p in pools)
+    return out
+
+
+#: engine shape for the mixed-workload A/Bs. prefill_chunk is the
+#: colocated dilemma knob — one setting must serve prefill throughput
+#: AND decode cadence. The colocated arm runs its measured-best
+#: compromise (chunk 16: on CPU a chunk step costs ~linearly in chunk
+#: width, so narrow chunks barely tax prefill; the swept 16/32/64/128
+#: settings go 229/184/113/61 tok/s); the disagg arms dissolve the
+#: dilemma per pool — prefill replicas take the wide chunk below,
+#: decode replicas shrink it to one block (the compiled step carries
+#: the chunk's compute whether or not anything is prefilling).
+_MIXED_ENGINE_KW = dict(max_slots=8, max_len=512, block_size=16,
+                        prefill_chunk=128)
+_MIXED_COLOC_CHUNK = 16
+
+
+def _mixed_cfg(cfg: TraceConfig) -> TraceConfig:
+    """Salt a trace with the disaggregation workload: steady sessions
+    emitting tokens while every 4th arrival carries a LONG prompt — the
+    pattern where colocated prefill steals decode step-time, and enough
+    prefill work on the wire that a dedicated prefill pool pulls its
+    weight against the all-mixed baseline."""
+    cfg.max_new_tokens = max(cfg.max_new_tokens, 96)
+    cfg.long_every = 4
+    cfg.long_prompt_tokens = 352
+    return cfg
+
+
+def _mixed_warm_prompts(cfg: TraceConfig, base: List[int],
+                        block_size: int) -> List[List[int]]:
+    """Warm prompts covering the gather/scatter jit BUCKETS real
+    mixed-trace prompts hit (pow2 block counts: short mixed prompts
+    land in the 4- and 8-block buckets, long ones at the top) — a
+    mid-run compile would stall every in-flight decode and poison
+    exactly the tail the A/Bs measure. ONE definition for every
+    harness: the bucket set encodes the engine's jit-bucket contract."""
+    return [base[:16], base[:4 * block_size], base[:7 * block_size],
+            base[:cfg.shared_prefix_tokens + cfg.long_prompt_tokens],
+            base[:16]]
+
+
+def _mixed_max_len(cfg: TraceConfig, block_size: int) -> int:
+    """Engine max_len that FITS the mixed trace's worst request
+    (prefix + long prompt + decode budget, block-rounded): the quick
+    scale fits the default 512, but medium/full prefixes (96/128) push
+    the worst case past it — an undersized engine turns every long
+    request into a submit-time ValueError and poisons the A/B."""
+    need = (cfg.shared_prefix_tokens + cfg.long_prompt_tokens
+            + cfg.max_new_tokens)
+    need = ((need + block_size - 1) // block_size) * block_size
+    return max(_MIXED_ENGINE_KW["max_len"], need)
 
 
 def _scale_trace(scale: str, seed: int) -> TraceConfig:
@@ -318,48 +510,194 @@ def _scale_trace(scale: str, seed: int) -> TraceConfig:
                        burst_rps=2_000.0, seed=seed)
 
 
+def _boot_cluster(nodes: int):
+    """Extra node daemons for --nodes (multi-node replay): returns the
+    Cluster handle (caller shuts down) after registering ``nodes`` extra
+    daemons beside the head."""
+    from ray_tpu.cluster import Cluster
+
+    c = Cluster()
+    for _ in range(nodes):
+        c.add_node(num_cpus=2)
+    return c
+
+
 def run_serve_replay(scale: str, replicas: int, paged: bool,
                      seed: int = 0, deadline_s: Optional[float] = None,
-                     slo: Optional[dict] = None) -> Dict[str, Any]:
-    """Deploy a multi-replica LLMDeployment and replay through the real
-    handle/routing path (load-aware picker, admission, streaming)."""
+                     slo: Optional[dict] = None, nodes: int = 0,
+                     disagg: bool = False,
+                     slo_ttft_s: Optional[float] = None,
+                     max_wall_s: Optional[float] = None,
+                     mixed: bool = False,
+                     max_new: Optional[int] = None,
+                     max_clients: int = 32) -> Dict[str, Any]:
+    """Deploy a multi-replica application and replay through the real
+    routing path (load-aware picker, admission, streaming). ``disagg``
+    splits the replicas into a prefill pool and a decode pool and
+    routes through the transfer-aware DisaggHandle; ``nodes`` boots
+    that many extra node daemons first (multi-node envelope); ``mixed``
+    salts the trace with periodic long prompts (the disaggregation A/B
+    workload); ``max_new`` overrides the trace's per-request decode
+    length (the envelope knob that fits a 1M-request run onto a
+    CPU-only box — TTFT, the declared SLO, is decode-length
+    independent). The output carries an SLO verdict (p99 TTFT vs
+    ``slo_ttft_s``) and a zero-leak KV audit across every replica of
+    every pool."""
     import ray_tpu
     from ray_tpu import serve
     from ray_tpu.serve import LLMDeployment
 
-    ray_tpu.init(ignore_reinit_error=True)
-    app = serve.deployment(
-        LLMDeployment, num_replicas=replicas,
-        ray_actor_options={"max_concurrency": 16, "num_cpus": 0},
-    ).bind("llama-debug", max_slots=8, max_len=256, seed=seed,
-           paged=paged, block_size=16, prefill_chunk=8, slo=slo)
-    handle = serve.run(app, name="llm_replay")
-    stream_handle = handle.options(stream=True)
+    cluster = None
+    if nodes > 0:
+        cluster = _boot_cluster(nodes)
+        ray_tpu.init(address=cluster.address,
+                     cluster_authkey=cluster.authkey, num_cpus=2)
+    else:
+        ray_tpu.init(ignore_reinit_error=True)
+    if disagg:
+        paged = True   # KV export/adopt is block-granular by definition
+    if mixed:
+        engine_kw = dict(_MIXED_ENGINE_KW, seed=seed)
+        mixed_cfg = _mixed_cfg(_scale_trace(scale, seed))
+        if max_new is not None:    # the override lands on the trace
+            mixed_cfg.max_new_tokens = max_new  # — size for it too
+        engine_kw["max_len"] = _mixed_max_len(
+            mixed_cfg, engine_kw["block_size"])
+        if not disagg:
+            engine_kw["prefill_chunk"] = _MIXED_COLOC_CHUNK
+    else:
+        engine_kw = dict(max_slots=8, max_len=256, seed=seed,
+                         block_size=16, prefill_chunk=8)
+    try:
+        if disagg:
+            # same TOTAL KV memory as a colocated deployment of the
+            # same replica count, split by role (see run_disagg_ab)
+            base_blocks = engine_kw["max_slots"] * (
+                engine_kw["max_len"] // engine_kw["block_size"])
+            prefill_kw = {"num_blocks": 3 * base_blocks // 4}
+            # the decode pool never prefills, but the compiled step
+            # carries the prefill_chunk-wide prefill slice either
+            # way — shrink it to one block so decode-only steps
+            # stop paying the chunk's dead compute
+            decode_kw = {"num_blocks": 5 * base_blocks // 4,
+                         "prefill_chunk": engine_kw["block_size"]}
+            if scale == "full":
+                # the 1M envelope: per-request work is dominated by
+                # per-STEP and per-MESSAGE overhead, not FLOPs.
+                # prefill pool: 64 tenants x 8 prefix blocks = 512
+                # blocks of trie + the in-flight working set — an
+                # undersized pool thrashes the trie and every prompt
+                # re-prefills its 128-token system prompt (measured:
+                # hit rate 0.42 -> 0.97, and prefill-step time is THE
+                # full-scale bottleneck). decode pool: adoption always
+                # claims fresh blocks, so a decode-side trie is pure
+                # eviction overhead — disable it. stream_batch turns
+                # lagging consumers' N token messages into 1 (TTFT —
+                # the declared SLO — is untouched).
+                engine_kw["prefill_chunk"] = 32
+                engine_kw["stream_batch"] = 8
+                prefill_kw["num_blocks"] = 5 * base_blocks
+                decode_kw.update(num_blocks=3 * base_blocks,
+                                 max_slots=16, prefix_cache=False)
+            handle = serve.deploy_disagg(
+                "llama-debug", name="llm_replay",
+                prefill_replicas=max(1, replicas // 2),
+                decode_replicas=max(1, replicas - replicas // 2),
+                slo=slo,
+                prefill_engine_kwargs=prefill_kw,
+                decode_engine_kwargs=decode_kw,
+                **engine_kw)
 
-    def stream(req: Request):
-        return stream_handle.remote(req.prompt, req.max_new,
-                                    deadline_s=deadline_s)
+            def stream(req: Request):
+                return handle.stream(req.prompt, req.max_new,
+                                     deadline_s=deadline_s)
 
-    trace = gen_trace(_scale_trace(scale, seed))
-    # warm every replica's compile before timing
-    for _ in range(replicas * 2):
-        list(stream_handle.remote(trace[0].prompt[:8], 2))
-    stats = replay(stream, trace, time_scale=0.0)
-    out = stats.summary()
-    # aggregate replica-side KV/prefix state — enumerate the replicas
-    # directly (a ROUTED probe per replica can land on the same one
-    # twice and double-count its hits)
-    handle._refresh(force=True)
-    kv = [ray_tpu.get(r.handle_request.remote("kv_state", (), {}),
-                      timeout=60) for r in handle._replicas]
-    hits = sum(k.get("prefix", {}).get("hits", 0) for k in kv)
-    lookups = sum(k.get("prefix", {}).get("hits", 0)
-                  + k.get("prefix", {}).get("misses", 0) for k in kv)
-    out["prefix_hit_rate"] = round(hits / max(lookups, 1), 4)
-    out["replicas"] = replicas
-    out["paged"] = paged
-    serve.delete("LLMDeployment")
-    return out
+            warm_stream = stream
+        else:
+            app = serve.deployment(
+                LLMDeployment, num_replicas=replicas,
+                ray_actor_options={"max_concurrency": 16, "num_cpus": 0},
+            ).bind("llama-debug", paged=paged, slo=slo, **engine_kw)
+            sh = serve.run(app, name="llm_replay").options(stream=True)
+
+            def stream(req: Request):
+                for tok in sh.remote(req.prompt, req.max_new,
+                                     deadline_s=deadline_s):
+                    # stream_batch replicas deliver token chunks (lists)
+                    if isinstance(tok, list):
+                        yield from tok
+                    else:
+                        yield tok
+
+            warm_stream = stream
+
+        trace_cfg = _scale_trace(scale, seed)
+        if mixed:
+            trace_cfg = _mixed_cfg(trace_cfg)
+        if max_new is not None:
+            trace_cfg.max_new_tokens = max_new
+        first = next(iter_trace(trace_cfg))
+        warm_prompts = [first.prompt[:8], list(first.prompt)]
+        if mixed:
+            warm_prompts += _mixed_warm_prompts(
+                trace_cfg, first.prompt * 16, engine_kw["block_size"])
+        for wp in warm_prompts:
+            for _ in range(replicas * 2):  # warm every replica's compile
+                list(warm_stream(Request(0.0, 0, list(wp), 2)))
+        stats = replay(stream, iter_trace(trace_cfg), time_scale=0.0,
+                       max_wall_s=max_wall_s, max_clients=max_clients,
+                       progress_every=10_000 if scale != "quick" else 0)
+        out = stats.summary()
+        out["replicas"] = replicas
+        out["paged"] = paged
+        out["disagg"] = disagg
+        out["nodes"] = 1 + nodes
+        if engine_kw.get("stream_batch", 1) > 1:
+            out["stream_batch"] = engine_kw["stream_batch"]
+        if getattr(stats, "truncated", False):
+            out["truncated"] = True
+
+        # per-pool KV/prefix state + ZERO-LEAK audit, enumerating the
+        # replicas directly (a ROUTED probe can land on one replica
+        # twice and double-count its hits)
+        if disagg:
+            states = handle.kv_states()
+        else:
+            h = serve.get_deployment_handle("LLMDeployment")
+            h._refresh(force=True)
+            states = {"colocated": [
+                ray_tpu.get(r.handle_request.remote("kv_state", (), {}),
+                            timeout=60) for r in h._replicas]}
+        hits = lookups = leaks = 0
+        for pool in states.values():
+            for s in pool:
+                hits += s.get("prefix", {}).get("hits", 0)
+                lookups += (s.get("prefix", {}).get("hits", 0)
+                            + s.get("prefix", {}).get("misses", 0))
+                # dense engines have no block pool: nothing to audit
+                leaks += (s.get("kv_total", 0) - s.get("kv_free", 0)
+                          - s.get("prefix", {}).get("nodes", 0))
+        out["prefix_hit_rate"] = round(hits / max(lookups, 1), 4)
+        out["kv_leaks"] = leaks
+        if slo_ttft_s is not None:
+            out["slo"] = {
+                "declared_ttft_p99_s": slo_ttft_s,
+                "measured_ttft_p99_s": out["ttft_p99_s"],
+                "ok": out["ttft_p99_s"] <= slo_ttft_s,
+            }
+        if disagg:
+            handle.shutdown()
+        else:
+            serve.delete("LLMDeployment")
+        return out
+    finally:
+        try:
+            serve.shutdown()
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        if cluster is not None:
+            cluster.shutdown()
 
 
 def main(argv=None) -> int:
@@ -372,11 +710,41 @@ def main(argv=None) -> int:
     p.add_argument("--replicas", type=int, default=2)
     p.add_argument("--dense", action="store_true",
                    help="dense baseline instead of paged")
+    p.add_argument("--disagg", action="store_true",
+                   help="disaggregated prefill/decode pools (with "
+                        "--serve: deployed pools; alone: in-process "
+                        "two-engine A/B)")
+    p.add_argument("--colocated", action="store_true",
+                   help="with --disagg (in-process): run the colocated "
+                        "baseline arm instead")
+    p.add_argument("--nodes", type=int, default=0,
+                   help="extra node daemons to boot (multi-node replay)")
+    p.add_argument("--slo-ttft-s", type=float, default=None,
+                   help="declared p99 TTFT SLO; the output carries the "
+                        "verdict")
+    p.add_argument("--max-wall-s", type=float, default=None,
+                   help="stop admitting new requests after this budget")
+    p.add_argument("--mixed", action="store_true",
+                   help="salt the trace with periodic long prompts "
+                        "(the disaggregation A/B workload)")
+    p.add_argument("--max-new", type=int, default=None,
+                   help="override per-request decode length (the "
+                        "envelope knob for CPU-only full-scale runs)")
+    p.add_argument("--max-clients", type=int, default=32,
+                   help="max concurrently in-flight requests")
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args(argv)
     if args.serve:
         out = run_serve_replay(args.scale, args.replicas,
-                               paged=not args.dense, seed=args.seed)
+                               paged=not args.dense, seed=args.seed,
+                               nodes=args.nodes, disagg=args.disagg,
+                               slo_ttft_s=args.slo_ttft_s,
+                               max_wall_s=args.max_wall_s,
+                               mixed=args.mixed, max_new=args.max_new,
+                               max_clients=args.max_clients)
+    elif args.disagg:
+        out = run_disagg_ab(args.scale, disagg=not args.colocated,
+                            seed=args.seed)
     else:
         out = run_engine_ab(args.scale, paged=not args.dense,
                             seed=args.seed)

@@ -25,7 +25,7 @@ import threading
 import time
 from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
-from typing import Any, Dict, List, Optional, Set
+from typing import Any, Dict, List, Optional, Set, Tuple
 
 import cloudpickle
 
@@ -88,19 +88,64 @@ def _transfer_metrics():
     }
 
 
+#: oid -> (stride, payload_bytes) hint for pull_chunks (block-batch
+#: framing, ISSUE 13): a consumer that KNOWS an object is a batch of
+#: fixed-size records (KV blocks) registers the record stride + total
+#: record-payload size before fetching, and the chunked pull aligns
+#: chunk boundaries to record boundaries — every chunk past the
+#: serialized header carries whole records, so a partially-failed pull
+#: can never tear a record across an aborted boundary and receivers can
+#: consume chunk-granular. payload_bytes matters because the stored
+#: layout is ``header | pickle | pad | record body``: the records start
+#: at ``size - payload_bytes``, not at offset 0.
+#: Bounded: entries are popped on first use and capped defensively.
+_pull_align_hints: Dict[bytes, Tuple[int, int]] = {}
+_PULL_ALIGN_MAX = 4096
+
+
+def hint_pull_align(oid_b: bytes, stride: int,
+                    payload_bytes: int = 0) -> None:
+    """Register a frame stride (+ record-payload size) for one object's
+    next chunked pull."""
+    if stride > 1 and len(_pull_align_hints) < _PULL_ALIGN_MAX:
+        _pull_align_hints[bytes(oid_b)] = (int(stride),
+                                           int(payload_bytes))
+
+
 def pull_chunks(call, oid_b: bytes, size: int, writer, *,
                 chunk: int = 4 << 20, parallel: int = 1,
-                timeout: float = 60.0) -> bool:
+                timeout: float = 60.0, align: int = 1,
+                align_base: int = 0) -> bool:
     """Fetch one object's chunks through ``call("pull_chunk", ...)`` into
     an offset-addressed ``writer`` (``IncomingObject`` shape), up to
     ``parallel`` chunks in flight. Standalone so tests can drive it with
     a stub peer; the RpcClient's request-id demux makes concurrent
     ``call``s on one connection safe. Returns False on any short/missing
-    chunk (the caller aborts the receive)."""
-    offsets = list(range(0, size, chunk))
+    chunk (the caller aborts the receive).
 
-    def fetch(off: int) -> bool:
-        ln = min(chunk, size - off)
+    ``align`` > 1 rounds the chunk size DOWN to a multiple of it and
+    anchors every chunk boundary at ``align_base + k * chunk``
+    (block-batch framing: records start at ``align_base`` — after the
+    serialized header — and each chunk then covers whole fixed-size
+    records; the first chunk additionally carries the header, the final
+    chunk takes the tail). An align larger than the chunk size degrades
+    to one record per chunk."""
+    if align > 1 and 0 <= align_base < size:
+        chunk = max((chunk // align) * align, align)
+        spans = []
+        end = min(align_base + chunk, size)
+        spans.append((0, end))
+        while end < size:
+            nxt = min(end + chunk, size)
+            spans.append((end, nxt - end))
+            end = nxt
+        spans = [(off, ln) for off, ln in spans if ln > 0]
+    else:
+        spans = [(off, min(chunk, size - off))
+                 for off in range(0, size, chunk)]
+
+    def fetch(span) -> bool:
+        off, ln = span
         blob = call("pull_chunk", oid_b, off, ln, timeout=timeout)
         if blob is None or len(blob) != ln:
             return False
@@ -109,13 +154,13 @@ def pull_chunks(call, oid_b: bytes, size: int, writer, *,
         return True
 
     try:
-        if parallel <= 1 or len(offsets) <= 1:
-            return all(fetch(off) for off in offsets)
+        if parallel <= 1 or len(spans) <= 1:
+            return all(fetch(s) for s in spans)
         from concurrent.futures import ThreadPoolExecutor
 
-        with ThreadPoolExecutor(max_workers=min(parallel, len(offsets)),
+        with ThreadPoolExecutor(max_workers=min(parallel, len(spans)),
                                 thread_name_prefix="pull-chunk") as pool:
-            return all(pool.map(fetch, offsets))
+            return all(pool.map(fetch, spans))
     except Exception:
         return False
 
@@ -786,12 +831,19 @@ class ClusterAdapter:
         writers never overlap). Peak extra memory per end is one chunk
         per fetch thread. Runs on _pull_io, whose size is the
         concurrent-pull admission cap."""
+        # pop the hint BEFORE the already-local return: a hinted object
+        # that never needs pulling (same-host store fallback) must not
+        # strand its entry until the bounded registry jams shut
+        stride, payload = _pull_align_hints.pop(oid.binary(), (1, 0))
         w = self.rt.store.begin_receive(oid, size)
         if w is None:  # already present locally
             self.rt.gcs.mark_ready(oid, size=size)
             return True
         if not pull_chunks(peer.call, oid.binary(), size, w,
-                           chunk=PULL_CHUNK_BYTES, parallel=PULL_PARALLEL):
+                           chunk=PULL_CHUNK_BYTES, parallel=PULL_PARALLEL,
+                           align=stride,
+                           # records start AFTER the serialized header
+                           align_base=(size - payload) if payload else 0):
             w.abort()
             return False
         try:

@@ -274,6 +274,18 @@ _knob("serve_load_report_interval_s", float, 0.5,
       "blocks free/total, in-flight requests) when its deployment "
       "exposes load_state(); <= 0 disables the push loop",
       "serve/replica.py")
+_knob("serve_prefill_nice", int, 10,
+      "niceness applied to a prefill-role replica's engine step loop: "
+      "prefill is throughput-bound, decode is latency-bound, so on "
+      "shared-core hosts long prefill bursts soak idle cycles instead "
+      "of preempting decode cadence (on a real accelerator the step "
+      "blocks on the device, so this is free); 0 disables",
+      "serve/llm.py")
+_knob("serve_disagg_cross_node_penalty", float, 2.0,
+      "routing-score penalty for picking a decode replica on a "
+      "DIFFERENT host than the chosen prefill replica (a same-host "
+      "DeviceChannel KV transfer beats a cross-node store pull); 0 "
+      "ignores host locality", "serve/disagg.py")
 _knob("llm_stall_timeout_s", float, 120.0,
       "seconds a caller waits for the NEXT token from the LLM decode "
       "loop before declaring the stream stalled (per-request deadline_s "

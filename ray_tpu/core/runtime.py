@@ -855,6 +855,7 @@ class DriverRuntime:
                         or env.get("RTPU_WORKER_FULL_SITE") == "1":
                     return None  # full-site workers need the real exec path
                 env["RTPU_WORKER"] = "1"
+                env["RTPU_NODE_ID"] = self.node_id.hex()
                 if self.labels:
                     from ray_tpu.util.labels import format_labels
 
@@ -907,6 +908,7 @@ class DriverRuntime:
         env = dict(os.environ)
         env.update(self.worker_env)
         env["RTPU_WORKER"] = "1"
+        env["RTPU_NODE_ID"] = self.node_id.hex()
         if self.labels:
             # workers surface their node's labels (runtime context)
             from ray_tpu.util.labels import format_labels
@@ -1626,7 +1628,21 @@ class DriverRuntime:
 
         try:
             if op == "get":
-                ids, timeout = args
+                ids, timeout = args[0], args[1]
+                if len(args) > 2 and args[2]:
+                    # worker-forwarded chunk-alignment hints: the pull
+                    # runs HERE, so the registry must live here too
+                    try:
+                        from ray_tpu.cluster.adapter import \
+                            hint_pull_align
+
+                        for oid_b, hint in args[2].items():
+                            stride, payload = (
+                                hint if isinstance(hint, (tuple, list))
+                                else (hint, 0))
+                            hint_pull_align(oid_b, stride, payload)
+                    except Exception:
+                        pass
                 self._async_get(ids, timeout, reply)
             elif op == "wait":
                 ids, num_returns, timeout = args

@@ -19,10 +19,24 @@ class RuntimeContext:
     def get_node_id(self) -> str:
         if self._rt.is_driver:
             return self._rt.node_id.hex()
-        return "node"
+        # workers inherit their spawning node runtime's id via env (set
+        # at spawn) — the disaggregated-serving transfer plane keys
+        # channel-vs-store on node identity (ISSUE 13)
+        import os
+
+        return os.environ.get("RTPU_NODE_ID", "node")
 
     def get_job_id(self) -> str:
         return "job"
+
+    def get_session_id(self) -> str:
+        """The runtime session id (shared by the driver and its workers;
+        a remote node's workers carry their daemon's session). Public
+        surface: shm artifacts named ``rtpu-chan-<session>-*`` are swept
+        by that session's runtime shutdown, so anything creating
+        channels outside dag/ (e.g. the serve KV-transfer plane) must
+        embed it."""
+        return self._rt.session
 
     def get_worker_id(self) -> str:
         if self._rt.is_driver:

@@ -58,6 +58,11 @@ class WorkerRuntime:
         self._running_threads: Dict[bytes, int] = {}
         self._running_futs: Dict[bytes, Any] = {}
         self._running_lock = threading.Lock()
+        # chunked-pull alignment hints (oid -> (stride, payload_bytes)):
+        # the pull runs in the HOSTING runtime (driver/daemon), so get()
+        # forwards these on the wire — a worker-local registry would
+        # never be seen by the process that actually fetches (ISSUE 13)
+        self._pull_aligns: Dict[bytes, tuple] = {}
         self._req_counter = itertools.count()
         self._send_lock = threading.Lock()
         # Control-message coalescing (r13, ROADMAP item 1): fire-and-forget
@@ -506,11 +511,27 @@ class WorkerRuntime:
         self.cast("put", obj_id.binary(), inline, size)
         return ObjectRef(obj_id)
 
+    def hint_pull_align(self, oid_b: bytes, stride: int,
+                        payload_bytes: int = 0) -> None:
+        """Register a chunk-alignment (stride, payload-size) hint for
+        ``oid_b``'s next get (consumed by the hosting runtime's chunked
+        cross-node pull — records start after the serialized header)."""
+        if stride > 1 and len(self._pull_aligns) < 4096:
+            self._pull_aligns[bytes(oid_b)] = (int(stride),
+                                               int(payload_bytes))
+
     def get(self, refs: List[ObjectRef], timeout: Optional[float] = None):
         ids = [r.id.binary() for r in refs]
+        # pop-with-default: two task threads getting the same hinted
+        # ref must not race a bare pop into a KeyError
+        aligns = {i: h for i in ids
+                  if (h := self._pull_aligns.pop(i, None)) is not None}
         self.cast("blocked")
         try:
-            results = self.request("get", ids, timeout)
+            if aligns:
+                results = self.request("get", ids, timeout, aligns)
+            else:
+                results = self.request("get", ids, timeout)
         finally:
             self.cast("unblocked")
         if results is None:

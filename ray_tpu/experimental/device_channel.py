@@ -40,8 +40,27 @@ from ray_tpu.experimental.channel import Channel
 
 _KIND_PICKLE = 0
 _KIND_TENSOR = 1
+_KIND_META_TENSOR = 2
 _PREFIX = struct.Struct("<BIH")  # (kind, header_size, body_pad)
 _ALIGN = 64  # body alignment: unaligned buffers force jax to copy on import
+
+
+class TensorWithMeta:
+    """Channel payload pairing a small picklable ``meta`` dict with ONE
+    host tensor whose bytes ride the ring slot RAW (64B-aligned body,
+    like the bare-tensor kind) — the KV-block shipping shape (ISSUE 13):
+    meta carries request identity/geometry, the tensor carries the block
+    batch, and neither side ever pickles the tensor body. The reader
+    gets the array as a COPY (ring backpressure protects aliased reads
+    only while the value is being consumed in-stage; KV adoption defers
+    the device scatter to the decode engine's loop thread, which may run
+    after this reader advances past ``nslots`` more values)."""
+
+    __slots__ = ("meta", "tensor")
+
+    def __init__(self, meta: dict, tensor):
+        self.meta = meta
+        self.tensor = tensor
 
 
 class DeviceTensorType:
@@ -66,6 +85,17 @@ class DeviceChannel(Channel):
     """Channel whose payloads are jax arrays moved as raw device bytes."""
 
     def _encode(self, value: Any):
+        if isinstance(value, TensorWithMeta):
+            import numpy as np
+
+            host = np.asarray(value.tensor)
+            # the dtype OBJECT, not dtype.str: extension dtypes
+            # (ml_dtypes bfloat16 — the KV payload dtype) stringify to
+            # an opaque void ("|V2") that cannot round-trip
+            header = pickle.dumps((value.meta, host.dtype, host.shape))
+            body = (host if host.flags["C_CONTIGUOUS"] else host.tobytes())
+            return self._encode_parts(_KIND_META_TENSOR, header, body,
+                                      host.nbytes)
         if not _is_jax_array(value):
             body = pickle.dumps(value)
             return self._encode_parts(_KIND_PICKLE, b"", body, len(body))
@@ -117,6 +147,14 @@ class DeviceChannel(Channel):
         body_size = size - _PREFIX.size - hsize - pad
         if kind == _KIND_PICKLE:
             return pickle.loads(bytes(self._mm[o:o + body_size]))
+        if kind == _KIND_META_TENSOR:
+            meta, dtype_obj, shape = pickle.loads(header)
+            dt = np.dtype(dtype_obj)
+            view = np.frombuffer(self._mm, dt, body_size // dt.itemsize,
+                                 o).reshape(shape)
+            # copy out of the mapped slot: the consumer (KV adoption)
+            # uses the array after this reader's cursor moves on
+            return TensorWithMeta(meta, np.array(view))
         dtype_str, shape = pickle.loads(header)
         dtype = np.dtype(dtype_str)
         host = np.frombuffer(self._mm, dtype, body_size // dtype.itemsize,

@@ -40,6 +40,8 @@ from ray_tpu.models.transformer import (
     init_cache_paged,
     decode_step_paged,
     copy_kv_block,
+    gather_kv_blocks,
+    scatter_kv_blocks,
     generate,
 )
 
@@ -81,5 +83,7 @@ __all__ = [
     "init_cache_paged",
     "decode_step_paged",
     "copy_kv_block",
+    "gather_kv_blocks",
+    "scatter_kv_blocks",
     "generate",
 ]

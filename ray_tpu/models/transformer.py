@@ -857,6 +857,33 @@ def copy_kv_block(cache: Params, src, dst) -> Params:
             "v": cache["v"].at[:, dst].set(cache["v"][:, src])}
 
 
+def gather_kv_blocks(cache: Params, block_ids) -> Params:
+    """Gather a request's physical blocks out of the paged pool — the
+    device half of KV-block EXPORT for disaggregated prefill/decode:
+    the prefill engine pulls exactly the blocks named by one request's
+    table ([L, n, bs, kvh, hd] per tensor) without ever materializing
+    the whole pool on the host. The result is contiguous, so the
+    transfer plane ships it as one raw tensor body."""
+    ids = jnp.asarray(block_ids, jnp.int32)
+    return {"k": cache["k"][:, ids], "v": cache["v"][:, ids]}
+
+
+def scatter_kv_blocks(cache: Params, block_ids, kv: Params) -> Params:
+    """Scatter a shipped block batch into this pool's physical blocks —
+    the device half of KV-block ADOPTION on a decode engine: the blocks
+    claimed for the arriving request (and ONLY those rows) are
+    overwritten with the prefill engine's exported KV. ``kv`` layout
+    matches :func:`gather_kv_blocks` ([L, n, bs, kvh, hd]). Out-of-range
+    ids are DROPPED (mode="drop") — the engine pads batches to bucketed
+    shapes with the out-of-range id so one compile serves a bucket of
+    block counts instead of retracing per count."""
+    ids = jnp.asarray(block_ids, jnp.int32)
+    return {"k": cache["k"].at[:, ids].set(kv["k"].astype(cache["k"].dtype),
+                                           mode="drop"),
+            "v": cache["v"].at[:, ids].set(kv["v"].astype(cache["v"].dtype),
+                                           mode="drop")}
+
+
 def decode_step_paged(
     params: Params,
     cache: Params,

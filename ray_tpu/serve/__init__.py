@@ -23,7 +23,9 @@ from ray_tpu.serve.admission import (AdmissionController,
                                      RequestShedError, SLOConfig)
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.kv_cache import BlockPool, PrefixCache
-from ray_tpu.serve.llm import LLMDeployment, LLMEngine
+from ray_tpu.serve.llm import KVExport, LLMDeployment, LLMEngine
+from ray_tpu.serve.disagg import DisaggHandle, deploy_disagg
+from ray_tpu.serve.kv_transfer import KVTransferError
 from ray_tpu.serve.deployment import (
     Application,
     AutoscalingConfig,
@@ -53,6 +55,10 @@ __all__ = [
     "batch",
     "LLMDeployment",
     "LLMEngine",
+    "KVExport",
+    "DisaggHandle",
+    "deploy_disagg",
+    "KVTransferError",
     "BlockPool",
     "PrefixCache",
     "SLOConfig",

@@ -26,16 +26,25 @@ from typing import Dict, Optional
 
 class RequestShedError(RuntimeError):
     """Raised at submission when projected latency breaches the SLO (the
-    serving tier's 503). Carries ``reason`` for shed-rate accounting."""
+    serving tier's 503). Carries ``reason`` for shed-rate accounting and
+    a machine-readable ``error_type`` that survives ``TaskError``
+    wrapping across process boundaries (ISSUE 13 satellite)."""
+
+    error_type = "shed"
 
     def __init__(self, msg: str, reason: str = "slo"):
         super().__init__(msg)
         self.reason = reason
 
+    def __reduce__(self):  # keep .reason across process boundaries
+        return (RequestShedError, (self.args[0], self.reason))
+
 
 class DeadlineExceededError(TimeoutError):
     """A request's own ``deadline_s`` elapsed — in the admission queue,
     waiting for its first token, or mid-stream."""
+
+    error_type = "deadline"
 
 
 @dataclass

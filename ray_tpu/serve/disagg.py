@@ -1,0 +1,362 @@
+"""Disaggregated prefill/decode serving (ISSUE 13 tentpole).
+
+Splits :class:`~ray_tpu.serve.llm.LLMDeployment` serving into TWO
+replica pools — a **prefill pool** that runs chunked prefill into paged
+KV blocks and a **decode pool** that adopts the shipped blocks and
+emits tokens — so a long prompt arriving never steals step time from
+in-flight decodes: TTFT becomes prefill time plus one block-batch
+transfer, and TPOT stops degrading under mixed traffic. The shipping
+plane is :mod:`ray_tpu.serve.kv_transfer` (DeviceChannel rings on a
+shared host, chunk-parallel store pulls across nodes) — the TPU analog
+of the reference's NCCL channels inside compiled DAGs (PAPER.md L4).
+
+The router (:class:`DisaggHandle`) is transfer-aware:
+
+- **prompts go to prefill capacity**: power-of-two-choices over the
+  prefill pool's queue depths (the handle's runtime load view);
+- **sessions go to decode capacity**: power-of-two-choices over the
+  decode pool's controller-mediated load reports (KV-claimable blocks +
+  in-flight streams), with a configurable penalty for decode replicas
+  on a DIFFERENT host than the chosen prefill replica (a channel hop
+  beats a store hop);
+- **admission budgets across both pools**: a request whose KV table
+  could not fit the best decode replica's claimable blocks is shed at
+  the router (``RequestShedError`` reason ``decode_kv``) before any
+  prefill compute is spent; prefill-side SLO admission still applies in
+  the engine.
+
+Replica death at any stage re-routes: a dead prefill replica re-prefills
+on a peer (nothing was delivered, so the decode pool adopts nothing
+partial); a dead decode replica re-prefills too (the shipped payload
+died with it — block refcounts are per-engine, so nothing leaks).
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Dict, Iterator, List, Optional
+
+from ray_tpu.serve.admission import RequestShedError
+from ray_tpu.serve.handle import DeploymentHandle
+
+#: ignore a load report older than this (dead/stalled replica)
+_LOAD_STALE_S = 5.0
+
+
+def deploy_disagg(model: str = "llama-debug", *, name: str = "llm",
+                  prefill_replicas: int = 1, decode_replicas: int = 1,
+                  max_concurrency: int = 16,
+                  slo: Optional[Any] = None,
+                  decode_slo: Optional[Any] = None,
+                  prefill_actor_options: Optional[Dict[str, Any]] = None,
+                  decode_actor_options: Optional[Dict[str, Any]] = None,
+                  prefill_engine_kwargs: Optional[Dict[str, Any]] = None,
+                  decode_engine_kwargs: Optional[Dict[str, Any]] = None,
+                  **engine_kwargs) -> "DisaggHandle":
+    """Deploy the two pools and return the routing handle. Engine
+    kwargs (max_slots/max_len/block_size/prefill_chunk/...) apply to
+    both pools, with the per-role engine kwargs layered on top (the
+    pools genuinely want different tuning — prefill holds only the
+    transient working set of in-flight prompts, decode keeps sessions +
+    the prefix cache, so e.g. ``num_blocks`` splits asymmetrically);
+    ``slo`` arms the prefill engines' admission gate, ``decode_slo``
+    the decode engines' (defaults to ``slo``); the per-role actor
+    options override the defaults (placement: pin a pool to a node
+    with a scheduling strategy)."""
+    from ray_tpu import serve
+    from ray_tpu.serve.llm import LLMDeployment
+
+    base = {"max_concurrency": max_concurrency, "num_cpus": 0}
+    apps = {}
+    for role, n, role_slo, extra, role_kw in (
+            ("prefill", prefill_replicas, slo, prefill_actor_options,
+             prefill_engine_kwargs),
+            ("decode", decode_replicas,
+             decode_slo if decode_slo is not None else slo,
+             decode_actor_options, decode_engine_kwargs)):
+        dep = serve.deployment(
+            LLMDeployment, name=f"{name}-{role}", num_replicas=n,
+            ray_actor_options=dict(base, **(extra or {})))
+        apps[role] = serve.run(
+            dep.bind(model, role=role, slo=role_slo,
+                     **dict(engine_kwargs, **(role_kw or {}))),
+            name=f"{name}-{role}")
+    return DisaggHandle(apps["prefill"], apps["decode"])
+
+
+class DisaggHandle:
+    """Client-side router over one prefill pool and one decode pool."""
+
+    def __init__(self, prefill: DeploymentHandle,
+                 decode: DeploymentHandle):
+        self.prefill = prefill
+        self.decode = decode
+        import random
+
+        self._rng = random.Random()
+
+    # -- load views --------------------------------------------------------
+
+    @staticmethod
+    def _pool_loads(handle: DeploymentHandle) -> Dict[bytes, dict]:
+        """The handle's own TTL'd controller load view (shared with its
+        routing path — claim-the-window-before-RPC plus empty-view
+        backoff, so a wedged controller costs one probe per TTL window
+        across ALL concurrent streams, never a probe pileup)."""
+        return handle._kv_view()
+
+    @staticmethod
+    def _fresh(loads: Dict[bytes, dict]) -> Dict[bytes, dict]:
+        cutoff = time.time() - _LOAD_STALE_S
+        return {k: v for k, v in loads.items()
+                if v.get("ts", 0) >= cutoff}
+
+    # -- picking -----------------------------------------------------------
+
+    @staticmethod
+    def _refresh_safe(handle: DeploymentHandle) -> None:
+        """Refresh the replica table, but route on the existing (stale)
+        table rather than fail the request when the controller RPC
+        hiccups mid-flight."""
+        try:
+            handle._refresh()
+        except Exception:
+            if not handle._replicas:
+                raise
+
+    def _pick_prefill(self, exclude: Optional[bytes] = None):
+        """Prompts go to prefill capacity: the handle's own p2c over
+        runtime queue depths (+ the dead-pick exclusion)."""
+        self._refresh_safe(self.prefill)
+        if not self.prefill._replicas:
+            raise RuntimeError("prefill pool has no replicas")
+        idx = self.prefill._pick_replica(exclude=exclude)
+        return self.prefill._replicas[idx]
+
+    def _pick_decode(self, prefer_node: Optional[str],
+                     exclude: Optional[bytes] = None):
+        """Sessions go to decode capacity: p2c over (inflight + weighted
+        KV occupancy) from the load reports, plus a cross-node penalty
+        so same-host transfers (channel path) win ties."""
+        from ray_tpu import config as _cfg
+
+        self._refresh_safe(self.decode)
+        reps = self.decode._replicas
+        if not reps:
+            raise RuntimeError("decode pool has no replicas")
+        cand = list(range(len(reps)))
+        if exclude is not None and len(cand) > 1:
+            cand = [i for i in cand
+                    if reps[i]._actor_id.binary() != exclude] or cand
+        if len(cand) == 1:
+            return reps[cand[0]]
+        loads = self._fresh(self._pool_loads(self.decode))
+        w_kv = float(_cfg.get("serve_kv_route_weight"))
+        w_x = float(_cfg.get("serve_disagg_cross_node_penalty"))
+
+        def score(i: int) -> float:
+            rep = loads.get(reps[i]._actor_id.binary())
+            if not rep:
+                return 0.0  # unknown: neutral (cold replica)
+            s = float(rep.get("inflight", 0))
+            total = rep.get("kv_total") or 0
+            if total:
+                s += w_kv * (1.0 - rep.get("kv_free", 0) / total)
+            if prefer_node and rep.get("node") \
+                    and rep["node"] != prefer_node:
+                s += w_x
+            return s
+
+        i, j = self._rng.sample(cand, 2)
+        return reps[i] if score(i) <= score(j) else reps[j]
+
+    def _budget_check(self, n_prompt: int, max_new: int) -> None:
+        """Cross-pool admission: shed NOW if no decode replica could
+        claim this request's KV table (prefilling it would burn compute
+        on a stream that can never start)."""
+        loads = self._fresh(self._pool_loads(self.decode))
+        sized = [l for l in loads.values()
+                 if l.get("kv_total") and l.get("block_size")]
+        if not sized:
+            return  # no reports yet: the engines' own gates decide
+        best = max(l["kv_free"] * l["block_size"] for l in sized)
+        if n_prompt + max_new > best:
+            raise RequestShedError(
+                f"request shed (decode_kv): needs {n_prompt + max_new} "
+                f"KV tokens but the best decode replica has {best} "
+                "claimable", reason="decode_kv")
+
+    # -- the request path --------------------------------------------------
+
+    def stream(self, prompt_tokens, max_new_tokens: int = 16,
+               eos: Optional[int] = None,
+               deadline_s: Optional[float] = None) -> Iterator[int]:
+        """One disaggregated request: prefill -> KV ship -> decode
+        stream. Yields tokens (the prefill-sampled first token
+        included). Replica deaths re-route within the configured retry
+        budget; SLO sheds and deadline verdicts surface as-is."""
+        from ray_tpu import config as _cfg
+        from ray_tpu.util import tracing
+
+        self._budget_check(len(prompt_tokens), max_new_tokens)
+        req_span = tracing.manual_span(
+            "serve.disagg::request",
+            {"prompt_tokens": len(prompt_tokens),
+             "max_new_tokens": max_new_tokens})
+        tokens_out = 0
+        try:
+            retries = int(_cfg.get("serve_request_retries"))
+            bad_prefill: Optional[bytes] = None
+            bad_decode: Optional[bytes] = None
+            attempt = 0
+            while True:
+                attempt += 1
+                try:
+                    for tok in self._attempt(
+                            prompt_tokens, max_new_tokens, eos,
+                            deadline_s, req_span, bad_prefill,
+                            bad_decode):
+                        tokens_out += 1
+                        yield tok
+                    return
+                except _RetryableDeath as rd:
+                    if rd.tokens_yielded or attempt > retries:
+                        # a half-consumed stream cannot be spliced onto a
+                        # fresh prefill, and the retry budget is bounded
+                        raise rd.cause
+                    bad_prefill, bad_decode = rd.bad_prefill, rd.bad_decode
+        except BaseException as e:
+            if req_span is not None:
+                req_span.finish(error=repr(e))
+                req_span = None
+            raise
+        finally:
+            if req_span is not None:
+                req_span.finish({"tokens": tokens_out})
+
+    def _attempt(self, prompt_tokens, max_new_tokens, eos, deadline_s,
+                 parent_span, bad_prefill, bad_decode):
+        import ray_tpu
+        from ray_tpu.core.exceptions import ActorDiedError
+        from ray_tpu.util import tracing
+
+        parent = parent_span.traceparent if parent_span else None
+        prefill_rep = self._pick_prefill(exclude=bad_prefill)
+        p_loads = self._fresh(self._pool_loads(self.prefill))
+        p_node = (p_loads.get(prefill_rep._actor_id.binary()) or {}) \
+            .get("node")
+        decode_rep = self._pick_decode(p_node, exclude=bad_decode)
+        req_id = uuid.uuid4().hex
+        transfer = {"req": req_id,
+                    "dst": decode_rep._actor_id.binary().hex(),
+                    "dst_node": None}
+        d_loads = self._fresh(self._pool_loads(self.decode))
+        d_rec = d_loads.get(decode_rep._actor_id.binary())
+        if d_rec:
+            transfer["dst_node"] = d_rec.get("node")
+
+        pre_span = tracing.manual_span(
+            "serve.disagg::prefill", {"req": req_id}, parent=parent)
+        try:
+            desc = ray_tpu.get(
+                prefill_rep.handle_request.remote(
+                    "prefill_export",
+                    (prompt_tokens, transfer, deadline_s), {}),
+                timeout=float(_timeout(deadline_s)))
+        except ActorDiedError as e:
+            if pre_span is not None:
+                pre_span.finish(error="prefill replica died")
+            self._report_death(self.prefill, prefill_rep)
+            raise _RetryableDeath(e, prefill_rep._actor_id.binary(),
+                                  bad_decode, 0)
+        except BaseException as e:
+            if pre_span is not None:
+                pre_span.finish(error=repr(e))
+            raise
+        if pre_span is not None:
+            pre_span.finish({"kind": desc.get("kind", "?")})
+
+        dec_span = tracing.manual_span(
+            "serve.disagg::decode", {"req": req_id}, parent=parent)
+        n = 0
+        try:
+            it = iter(decode_rep.handle_request.options(
+                num_returns="streaming").remote(
+                "adopt_stream",
+                (prompt_tokens, desc, max_new_tokens, eos, deadline_s),
+                {}))
+            while True:
+                try:
+                    ref = next(it)
+                    tok = ray_tpu.get(ref)
+                except StopIteration:
+                    break
+                except ActorDiedError as e:
+                    self._report_death(self.decode, decode_rep)
+                    raise _RetryableDeath(
+                        e, bad_prefill, decode_rep._actor_id.binary(), n)
+                # stream_batch > 1 replicas deliver token CHUNKS (lists)
+                # — flatten so callers always consume per-token
+                for t in (tok if isinstance(tok, list) else (tok,)):
+                    n += 1
+                    yield t
+        except _RetryableDeath:
+            if dec_span is not None:
+                dec_span.finish(error="decode replica died")
+            raise
+        except BaseException as e:
+            if dec_span is not None:
+                dec_span.finish(error=repr(e))
+            raise
+        if dec_span is not None:
+            dec_span.finish({"tokens": n})
+
+    @staticmethod
+    def _report_death(handle: DeploymentHandle, replica) -> None:
+        try:
+            handle._replica_died(replica)
+        except Exception:
+            pass
+
+    # -- introspection / lifecycle -----------------------------------------
+
+    def kv_states(self) -> Dict[str, List[Dict[str, Any]]]:
+        """Per-replica engine KV state for both pools (leak audits)."""
+        import ray_tpu
+
+        out: Dict[str, List[Dict[str, Any]]] = {}
+        for role, h in (("prefill", self.prefill),
+                        ("decode", self.decode)):
+            h._refresh(force=True)
+            out[role] = [
+                ray_tpu.get(r.handle_request.remote("kv_state", (), {}),
+                            timeout=60)
+                for r in h._replicas]
+        return out
+
+    def shutdown(self) -> None:
+        from ray_tpu import serve
+
+        for h in (self.prefill, self.decode):
+            try:
+                serve.delete(h.deployment_name)
+            except Exception:
+                pass
+
+
+class _RetryableDeath(Exception):
+    """Internal: a replica died during an attempt; carries which pick to
+    exclude on the retry and whether tokens already reached the caller."""
+
+    def __init__(self, cause, bad_prefill, bad_decode, tokens_yielded):
+        super().__init__(str(cause))
+        self.cause = cause
+        self.bad_prefill = bad_prefill
+        self.bad_decode = bad_decode
+        self.tokens_yielded = tokens_yielded
+
+
+def _timeout(deadline_s: Optional[float]) -> float:
+    base = 120.0
+    return base if deadline_s is None else min(base, deadline_s + 5.0)

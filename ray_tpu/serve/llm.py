@@ -35,6 +35,7 @@ serving throughput):
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 import time
@@ -47,6 +48,14 @@ from ray_tpu.serve.admission import (AdmissionController,
                                      DeadlineExceededError, RequestShedError,
                                      SLOConfig)
 from ray_tpu.serve.kv_cache import BlockPool, PrefixCache
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(n - 1, 0).bit_length()
+
+
+#: sentinel distinct from None (None IS a stream terminal)
+_NO_ITEM = object()
 
 
 @dataclass(eq=False)   # identity semantics: generated __eq__ would
@@ -67,6 +76,28 @@ class _Request:        # elementwise-compare the prompt arrays and raise
     submit_ts: float = 0.0             # monotonic
     deadline: Optional[float] = None   # monotonic absolute
     last_emit_ts: Optional[float] = None
+    # disaggregated prefill/decode (ISSUE 13)
+    prefill_only: bool = False         # stop after the first token and
+    #                                    emit a KVExport instead of it
+    adopt_kv: Optional[Dict[str, np.ndarray]] = None  # shipped prompt KV
+    #                                    to scatter into claimed blocks
+
+
+@dataclass(eq=False)
+class KVExport:
+    """What a prefill-only request emits instead of its first token: the
+    sampled token plus the prompt's KV blocks gathered off the paged
+    pool ([L, n_blocks, bs, kvh, hd] per tensor, host-side) — exactly
+    the payload a decode engine's :meth:`LLMEngine.adopt` consumes."""
+
+    token: int
+    prompt_len: int
+    block_size: int
+    kv: Dict[str, np.ndarray]
+
+    @property
+    def nbytes(self) -> int:
+        return sum(int(a.nbytes) for a in self.kv.values())
 
 
 class LLMEngine:
@@ -84,7 +115,8 @@ class LLMEngine:
                  num_blocks: Optional[int] = None,
                  prefill_chunk: Optional[int] = None,
                  prefix_cache: bool = True,
-                 slo: Optional[SLOConfig] = None):
+                 slo: Optional[SLOConfig] = None,
+                 role: str = "colocated"):
         import jax
         import jax.numpy as jnp
 
@@ -98,6 +130,9 @@ class LLMEngine:
         self.max_len = max_len
         self.temperature = temperature
         self.paged = bool(paged)
+        if role not in ("colocated", "prefill", "decode"):
+            raise ValueError(f"unknown engine role {role!r}")
+        self.role = role
         if params is None:
             params = models.init_params(jax.random.PRNGKey(seed), config)
         self.params = params
@@ -118,6 +153,13 @@ class LLMEngine:
             self._step_fn = jax.jit(self._raw_step_paged,
                                     donate_argnums=(1,))
             self._copy_fn = jax.jit(self._raw_copy, donate_argnums=(0,))
+            # disaggregation (ISSUE 13): gather exports a request's
+            # blocks (no donation — the pool stays live), scatter adopts
+            # a shipped batch (donated — the old pool is dead on write).
+            # Distinct block counts retrace; table widths bound the set.
+            self._gather_fn = jax.jit(self._raw_gather)
+            self._scatter_fn = jax.jit(self._raw_scatter,
+                                       donate_argnums=(0,))
             # warm the COW copy's compile NOW, not in the middle of the
             # first prefix-sharing request's admission (block 0 onto
             # itself over an all-zero cache is a no-op; src/dst trace as
@@ -136,7 +178,8 @@ class LLMEngine:
         self._slots: List[Optional[_Request]] = [None] * max_slots
         self.stats = {"steps": 0, "tokens_generated": 0,
                       "max_concurrent": 0, "requests": 0,
-                      "prefix_hit_tokens": 0, "deadline_drops": 0}
+                      "prefix_hit_tokens": 0, "deadline_drops": 0,
+                      "exported": 0, "adopted": 0}
         self._metrics = self._init_metrics()
 
     @staticmethod
@@ -155,6 +198,10 @@ class LLMEngine:
                 "sheds": md.get("rtpu_serve_admission_sheds_total"),
                 "ttft": md.get("rtpu_serve_ttft_seconds"),
                 "tpot": md.get("rtpu_serve_tpot_seconds"),
+                "pool_inflight": md.get("rtpu_serve_pool_inflight"),
+                "pool_queued": md.get("rtpu_serve_pool_queued"),
+                "pool_kv_used_frac":
+                    md.get("rtpu_serve_pool_kv_used_fraction"),
             }
         except Exception:  # metrics plane unavailable (bare unit tests)
             return None
@@ -178,13 +225,33 @@ class LLMEngine:
 
         return copy_kv_block(cache, src, dst)
 
+    @staticmethod
+    def _raw_gather(cache, ids):
+        from ray_tpu.models import gather_kv_blocks
+
+        return gather_kv_blocks(cache, ids)
+
+    @staticmethod
+    def _raw_scatter(cache, ids, kv):
+        from ray_tpu.models import scatter_kv_blocks
+
+        return scatter_kv_blocks(cache, ids, kv)
+
     # -- thread-safe intake ------------------------------------------------
 
     def submit(self, prompt, max_new_tokens: int,
                emit: Callable[[Any], None],
                eos: Optional[int] = None,
-               deadline_s: Optional[float] = None) -> "_Request":
+               deadline_s: Optional[float] = None,
+               prefill_only: bool = False) -> "_Request":
         prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prefill_only:
+            if not self.paged:
+                raise ValueError("prefill_only requires a paged engine "
+                                 "(KV export is block-granular)")
+            # the export happens at the FIRST sample: exactly one token
+            # is produced here; the decode pool owns the rest
+            max_new_tokens = 1
         if len(prompt) == 0:
             raise ValueError("empty prompt")
         if len(prompt) + max_new_tokens > self.max_len:
@@ -193,8 +260,11 @@ class LLMEngine:
                 f"({max_new_tokens}) exceeds the engine's max_len "
                 f"({self.max_len})")
         if self.paged:
+            # a prefill-only request claims PROMPT blocks only: its one
+            # sampled token's KV is never written (KV lands when a token
+            # is FED, and feeding moves to the decode pool)
             width = self.pool.blocks_for_tokens(
-                len(prompt) + max_new_tokens)
+                len(prompt) + (0 if prefill_only else max_new_tokens))
             if width > self.pool.num_blocks:
                 # bigger than the WHOLE pool: it could never be admitted
                 # — queueing it would pin the strict-FIFO head forever
@@ -225,10 +295,98 @@ class LLMEngine:
         req = _Request(prompt, max_new_tokens, emit, eos=eos,
                        submit_ts=now,
                        deadline=(now + deadline_s
-                                 if deadline_s is not None else None))
+                                 if deadline_s is not None else None),
+                       prefill_only=prefill_only)
         with self._lock:
             self._pending.append(req)
             self.stats["requests"] += 1
+        return req
+
+    def adopt(self, prompt, kv: Dict[str, np.ndarray], first_token: int,
+              max_new_tokens: int, emit: Callable[[Any], None],
+              eos: Optional[int] = None,
+              deadline_s: Optional[float] = None) -> "_Request":
+        """Admit a request whose prompt KV was prefilled on ANOTHER
+        engine (the decode half of disaggregated serving): claim a full
+        table, scatter the shipped block batch into it, and start
+        decoding from ``first_token`` — no prompt tokens ever run
+        through this engine's model. ``kv`` is the
+        :class:`KVExport` payload ([L, n_blocks, bs, kvh, hd] per
+        tensor); the first token is re-emitted here so the caller sees
+        one uninterrupted stream."""
+        if not self.paged:
+            raise ValueError("adopt requires a paged engine")
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if len(prompt) == 0:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if len(prompt) + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds the engine's max_len "
+                f"({self.max_len})")
+        need = self.pool.blocks_for_tokens(len(prompt))
+        got = int(kv["k"].shape[1])
+        if got != need:
+            raise ValueError(
+                f"KV payload carries {got} blocks but the prompt needs "
+                f"{need} (block_size {self.pool.block_size})")
+        if int(kv["k"].shape[2]) != self.pool.block_size:
+            raise ValueError(
+                f"KV payload block_size {int(kv['k'].shape[2])} != this "
+                f"engine's {self.pool.block_size}")
+        # FULL geometry check, both tensors, against this engine's cache
+        # ([L, n, bs, kvh, hd]): per-role engine kwargs make mismatched
+        # pool configs constructible, and a bad payload must fail THIS
+        # request at adopt — not blow up the jitted scatter later on the
+        # engine loop, where abort_all would kill every in-flight stream
+        ck = self._cache["k"]
+        want = (int(ck.shape[0]), got, int(ck.shape[2]),
+                int(ck.shape[3]), int(ck.shape[4]))
+        for name in ("k", "v"):
+            if tuple(int(d) for d in kv[name].shape) != want:
+                raise ValueError(
+                    f"KV payload {name} shape "
+                    f"{tuple(kv[name].shape)} does not match this "
+                    f"engine's cache geometry {want} "
+                    "(mismatched pool model configs?)")
+        width = self.pool.blocks_for_tokens(len(prompt) + max_new_tokens)
+        if width > self.pool.num_blocks:
+            raise ValueError(
+                f"request needs {width} KV blocks but the pool has "
+                f"only {self.pool.num_blocks} total")
+        # decode-side admission: no prefill cost (the blocks arrive
+        # precomputed), so only the queue/TPOT gates carry signal
+        with self._lock:
+            queued = len(self._pending)
+            queued_tokens = sum(len(r.prompt) for r in self._pending)
+            free_slots = sum(r is None for r in self._slots)
+        try:
+            self.admission.check_admit(
+                1, queued, queued_tokens, self.prefill_chunk, free_slots,
+                self.max_slots - free_slots, deadline_s=deadline_s)
+        except RequestShedError as e:
+            if self._metrics:
+                self._metrics["sheds"].inc(tags={"reason": e.reason})
+            raise
+        now = time.monotonic()
+        req = _Request(prompt, max_new_tokens, emit, eos=eos,
+                       submit_ts=now,
+                       deadline=(now + deadline_s
+                                 if deadline_s is not None else None))
+        # the copy is load-bearing, not defensive: store-path payloads
+        # arrive as zero-copy views into the object store, and the
+        # scatter runs later on the engine loop — by then the caller's
+        # descriptor (and its ref pin) may be gone
+        req.adopt_kv = {"k": np.ascontiguousarray(kv["k"]),
+                        "v": np.ascontiguousarray(kv["v"])}
+        req.last_token = int(first_token)
+        with self._lock:
+            self._pending.append(req)
+            self.stats["requests"] += 1
+            self.stats["adopted"] += 1
         return req
 
     def cancel(self, req: "_Request") -> None:
@@ -273,8 +431,26 @@ class LLMEngine:
         lock drops — a tunnel-stalled device op must not freeze
         ``submit()``/``kv_state()`` behind the lock."""
         pool, trie = self.pool, self.prefix
-        total = len(req.prompt) + req.max_new_tokens
+        total = len(req.prompt) + (0 if req.prefill_only
+                                   else req.max_new_tokens)
         width = pool.blocks_for_tokens(total)
+        if req.adopt_kv is not None:
+            # adoption: the payload IS the prompt KV — a trie match would
+            # alias blocks the scatter must not overwrite, so claim all
+            # fresh (the finished request still seeds the trie on release)
+            fresh = pool.alloc(width)
+            if fresh is None and trie is not None:
+                trie.evict(width - pool.free_count)
+                fresh = pool.alloc(width)
+            if fresh is None:
+                return False
+            req.table = fresh
+            req.pos = req.consumed = len(req.prompt)
+            n_kv = int(req.adopt_kv["k"].shape[1])
+            pending_copies.append(("adopt", req, fresh[:n_kv],
+                                   req.adopt_kv))
+            req.adopt_kv = None
+            return True
         lookup_stats = trie.stats() if trie is not None else None
         blocks, matched, cow = (trie.match(req.prompt.tolist())
                                 if trie is not None else ([], 0, None))
@@ -303,7 +479,7 @@ class LLMEngine:
             # capped match reused part of a shared block: queue the
             # device copy into the request's first fresh block (the cow
             # ref stays held until the copy lands)
-            pending_copies.append((req, cow, fresh[0]))
+            pending_copies.append(("cow", req, cow, fresh[0]))
         req.table = blocks + fresh
         req.pos = req.consumed = matched
         self.stats["prefix_hit_tokens"] += matched
@@ -382,19 +558,25 @@ class LLMEngine:
                     f"{r.generated}/{r.max_new_tokens})"))
             except Exception:
                 pass
-        # COW device copies run AFTER the lock drops (the axon tunnel
-        # can stall a device op for minutes; submit()/kv_state() must
-        # stay responsive) but BEFORE the step consumes the tables
-        for req, src, dst in pending_copies:
+        # COW copies and adoption scatters run AFTER the lock drops (the
+        # axon tunnel can stall a device op for minutes; submit()/
+        # kv_state() must stay responsive) but BEFORE the step consumes
+        # the tables
+        adopts = []
+        for kind, req, *rest in pending_copies:
+            if kind == "adopt":
+                adopts.append((req, rest[0], rest[1]))
+                continue
+            (src, dst) = rest
             try:
                 self._cache = self._copy_fn(self._cache, src, dst)
                 with self._lock:
                     self.pool.release(src)
             except BaseException as e:
-                # device error: un-claim THIS request and fail it (its
-                # table is already published, so abort_all would miss
-                # the cow ref); then let the loop's abort path handle
-                # the rest of the engine state
+                # device error: un-claim THIS request and fail it
+                # (its table is already published, so abort_all
+                # would miss the cow ref); then let the loop's abort
+                # path handle the rest of the engine state
                 with self._lock:
                     self.pool.release(src)
                     self._release_blocks(req, insert=False)
@@ -406,7 +588,67 @@ class LLMEngine:
                 except Exception:
                     pass
                 raise
+        if adopts:
+            self._apply_adoptions(adopts)
         return active_now, have_pending
+
+    def _apply_adoptions(self, adopts: List[tuple]) -> None:
+        """Scatter every pending adoption's shipped blocks in ONE device
+        op (a burst of arrivals must cost the in-flight decodes one
+        kernel, not K), then emit each request's prefill-side first
+        token. Ids/payload pad to a power-of-two bucket (pad ids are
+        out-of-range -> dropped by the scatter) so the jit retraces per
+        bucket, not per batch geometry."""
+        import jax.numpy as jnp
+
+        ids: List[int] = []
+        ks: List[np.ndarray] = []
+        vs: List[np.ndarray] = []
+        for _req, table_prefix, kv in adopts:
+            ids.extend(table_prefix)
+            ks.append(kv["k"])
+            vs.append(kv["v"])
+        k = ks[0] if len(ks) == 1 else np.concatenate(ks, axis=1)
+        v = vs[0] if len(vs) == 1 else np.concatenate(vs, axis=1)
+        pad = _next_pow2(len(ids)) - len(ids)
+        if pad:
+            ids = ids + [self.pool.num_blocks] * pad
+            zk = np.zeros(k.shape[:1] + (pad,) + k.shape[2:], k.dtype)
+            zv = np.zeros(v.shape[:1] + (pad,) + v.shape[2:], v.dtype)
+            k = np.concatenate([k, zk], axis=1)
+            v = np.concatenate([v, zv], axis=1)
+        try:
+            self._cache = self._scatter_fn(
+                self._cache, jnp.asarray(np.asarray(ids, np.int32)),
+                {"k": jnp.asarray(k), "v": jnp.asarray(v)})
+        except BaseException as e:
+            with self._lock:
+                for req, _tp, _kv in adopts:
+                    self._release_blocks(req, insert=False)
+                    for i, r in enumerate(self._slots):
+                        if r is req:
+                            self._slots[i] = None
+            for req, _tp, _kv in adopts:
+                try:
+                    req.emit(e)
+                except Exception:
+                    pass
+            raise
+        now = time.monotonic()
+        for req, _tp, _kv in adopts:
+            req.generated = 1
+            self._observe_emit(req, now)
+            req.emit(req.last_token)
+            self.stats["tokens_generated"] += 1
+            if req.generated >= req.max_new_tokens or (
+                    req.eos is not None and req.last_token == req.eos):
+                # degenerate single-token request: done at adoption
+                with self._lock:
+                    self._release_blocks(req, insert=True)
+                    for i, r in enumerate(self._slots):
+                        if r is req:
+                            self._slots[i] = None
+                req.emit(None)
 
     def step(self) -> bool:
         """Admit pending requests, advance every active slot (one decode
@@ -445,6 +687,34 @@ class LLMEngine:
             req.last_token = tok
             req.generated += 1
             self._observe_emit(req, now)
+            if req.prefill_only:
+                # export INSTEAD of streaming: gather the prompt's blocks
+                # off the pool (one device op, one host transfer) and
+                # hand them to the sink with the sampled token; the
+                # blocks then release normally — full prompt blocks into
+                # the trie, so repeated system prompts prefill once even
+                # on a dedicated prefill pool. The id list is padded to a
+                # power-of-two bucket (repeating the last id — reads are
+                # harmless) so the gather retraces per BUCKET, not per
+                # block count: a mid-stream jit compile would stall every
+                # in-flight decode for hundreds of ms.
+                nb = self.pool.blocks_for_tokens(len(req.prompt))
+                bucket = min(_next_pow2(nb), self._tbl_width)
+                ids = req.table[:nb] + [req.table[nb - 1]] * (bucket - nb)
+                kv_dev = self._gather_fn(
+                    self._cache, jnp.asarray(np.asarray(ids, np.int32)))
+                kv_host = jax.device_get(kv_dev)
+                self.stats["exported"] += 1
+                req.emit(KVExport(
+                    token=tok, prompt_len=len(req.prompt),
+                    block_size=self.pool.block_size,
+                    kv={"k": np.asarray(kv_host["k"])[:, :nb],
+                        "v": np.asarray(kv_host["v"])[:, :nb]}))
+                with self._lock:
+                    self._release_blocks(req, insert=True)
+                req.emit(None)
+                self._slots[i] = None
+                continue
             req.emit(tok)
             self.stats["tokens_generated"] += 1
             if req.generated >= req.max_new_tokens or (
@@ -532,9 +802,17 @@ class LLMEngine:
         m = self._metrics
         if not m:
             return
+        role = {"role": self.role}
+        with self._lock:
+            m["pool_inflight"].set(
+                sum(r is not None for r in self._slots), tags=role)
+            m["pool_queued"].set(len(self._pending), tags=role)
         if self.pool is not None:
             m["kv_free"].set(self.pool.free_count)
             m["kv_used"].set(self.pool.used_count)
+            m["pool_kv_used_frac"].set(
+                self.pool.used_count / max(self.pool.num_blocks, 1),
+                tags=role)
         if self.prefix is not None:
             # counters mirror the trie's totals via deltas
             cur = self.prefix.stats()
@@ -566,6 +844,7 @@ class LLMEngine:
         with self._lock:
             out: Dict[str, Any] = {
                 "paged": self.paged,
+                "role": self.role,
                 "inflight": sum(r is not None for r in self._slots),
                 "queued": len(self._pending),
                 "max_slots": self.max_slots,
@@ -616,24 +895,56 @@ class LLMDeployment:
                  num_blocks: Optional[int] = None,
                  prefill_chunk: Optional[int] = None,
                  prefix_cache: bool = True,
-                 slo: Optional[Any] = None):
+                 slo: Optional[Any] = None,
+                 role: str = "colocated",
+                 stream_batch: int = 1):
         if isinstance(slo, dict):
             slo = SLOConfig(**slo)
+        # stream_batch > 1 turns on micro-batched token delivery: each
+        # streamed message carries a LIST of up to stream_batch tokens —
+        # whatever the engine produced since the consumer last kept up.
+        # The first token still ships the moment it exists (TTFT is
+        # untouched); only messages the consumer was already lagging
+        # behind coalesce. This is the 1M-request envelope knob: at high
+        # request rates the per-token object/message cost dominates the
+        # serving stack, and a lagging consumer turns N messages into 1.
+        self._stream_batch = max(1, int(stream_batch))
         self.engine = LLMEngine(model, params, max_slots=max_slots,
                                 max_len=max_len, temperature=temperature,
                                 seed=seed, paged=paged,
                                 block_size=block_size,
                                 num_blocks=num_blocks,
                                 prefill_chunk=prefill_chunk,
-                                prefix_cache=prefix_cache, slo=slo)
+                                prefix_cache=prefix_cache, slo=slo,
+                                role=role)
         self._error: Optional[BaseException] = None
         self._wake = threading.Event()
         self._stop = False
+        # disaggregation plumbing (ISSUE 13), all lazy: the transfer
+        # plane only exists on replicas that actually ship/adopt blocks
+        self._kv_sender = None
+        self._kv_receiver = None
+        self._xfer_lock = threading.Lock()
+        self._ident: Optional[Dict[str, str]] = None
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="llm-decode-loop")
         self._thread.start()
 
     def _loop(self) -> None:
+        if self.engine.role == "prefill":
+            # dedicated-decode-capacity analog for shared-core hosts:
+            # the prefill pool's step loop yields the core to decode
+            # cadence (see the serve_prefill_nice knob); on a real
+            # accelerator the step blocks on the device, so this is free
+            try:
+                from ray_tpu import config as _knobs
+
+                nice = int(_knobs.get("serve_prefill_nice"))
+                if nice > 0:
+                    os.setpriority(os.PRIO_PROCESS,
+                                   threading.get_native_id(), nice)
+            except Exception:
+                pass
         while not self._stop:
             try:
                 busy = self.engine.step()
@@ -654,13 +965,28 @@ class LLMDeployment:
     def __call__(self, prompt_tokens, max_new_tokens: int = 16,
                  eos: Optional[int] = None,
                  deadline_s: Optional[float] = None):
+        q: "queue.Queue[Any]" = queue.Queue()
+
+        def submit():
+            return self.engine.submit(prompt_tokens, max_new_tokens,
+                                      q.put_nowait, eos=eos,
+                                      deadline_s=deadline_s)
+
+        return self._token_stream(q, submit, len(prompt_tokens),
+                                  max_new_tokens, deadline_s)
+
+    def _token_stream(self, q: "queue.Queue[Any]", submit,
+                      n_prompt: int, max_new_tokens: int,
+                      deadline_s: Optional[float]):
+        """The streaming body shared by the colocated request path and
+        the decode pool's adopt path: run ``submit`` (engine intake),
+        then drain the request's token queue to the caller."""
         from ray_tpu import config as _knobs
         from ray_tpu.util import tracing
 
         stall_timeout = float(_knobs.get("llm_stall_timeout_s"))
         deadline = (time.monotonic() + deadline_s
                     if deadline_s is not None else None)
-        q: "queue.Queue[Any]" = queue.Queue()
         # manual spans (not span()): this is a generator — a thread-local
         # span context held across a yield would leak onto whatever the
         # worker thread runs next (graftlint tracing-context-capture).
@@ -668,8 +994,9 @@ class LLMDeployment:
         # prefill); stream = the whole token stream — the per-request
         # latency decomposition SLO admission control needs (ISSUE 7).
         stream_span = tracing.manual_span(
-            "serve.llm::stream", {"prompt_tokens": len(prompt_tokens),
-                                  "max_new_tokens": max_new_tokens})
+            "serve.llm::stream", {"prompt_tokens": n_prompt,
+                                  "max_new_tokens": max_new_tokens,
+                                  "role": self.engine.role})
         queue_span = tracing.manual_span(
             "serve.llm::queue", {},
             parent=stream_span.traceparent if stream_span else None)
@@ -678,9 +1005,7 @@ class LLMDeployment:
         try:
             # submit INSIDE the try: a dead engine must still finish the
             # admission span (it is the SLO signal for failed admission)
-            req = self.engine.submit(prompt_tokens, max_new_tokens,
-                                     q.put_nowait, eos=eos,
-                                     deadline_s=deadline_s)
+            req = submit()
             self._wake.set()
             while True:
                 wait = stall_timeout
@@ -714,8 +1039,36 @@ class LLMDeployment:
                     raise tok  # admission/deadline verdicts pass through
                 if isinstance(tok, BaseException):
                     raise RuntimeError(f"llm decode loop failed: {tok!r}")
-                produced += 1
-                yield tok
+                if self._stream_batch == 1:
+                    produced += 1
+                    yield tok
+                    continue
+                # micro-batched delivery: sweep whatever else the engine
+                # already produced (bounded by stream_batch) into this
+                # message; a terminal item found mid-sweep is handled
+                # AFTER the tokens before it reach the consumer
+                chunk = [tok]
+                terminal = _NO_ITEM
+                while len(chunk) < self._stream_batch:
+                    try:
+                        nxt = q.get_nowait()
+                    except queue.Empty:
+                        break
+                    if nxt is None or isinstance(nxt, BaseException):
+                        terminal = nxt
+                        break
+                    chunk.append(nxt)
+                produced += len(chunk)
+                yield chunk
+                if terminal is _NO_ITEM:
+                    continue
+                if terminal is None:
+                    return
+                if isinstance(terminal, (DeadlineExceededError,
+                                         RequestShedError)):
+                    raise terminal
+                raise RuntimeError(
+                    f"llm decode loop failed: {terminal!r}")
         finally:
             # client stopped consuming (disconnect / GC'd generator):
             # free the slot instead of generating into an orphan queue
@@ -728,6 +1081,121 @@ class LLMDeployment:
                 queue_span.finish(error="no token produced")
             if stream_span is not None:
                 stream_span.finish({"tokens": produced})
+
+    # -- disaggregated prefill/decode (ISSUE 13) ---------------------------
+
+    def identity(self) -> Dict[str, str]:
+        """This replica's transfer identity: actor id (channel naming)
+        + node id (channel-vs-store path choice). Cached — the runtime
+        context is task-local, so capture happens on first request."""
+        if self._ident is None or self._ident["actor"] is None:
+            # actor id is TASK-context-local: calls arriving outside a
+            # task (the load-report push thread) see None — keep retrying
+            # until a real request captures it. Channel names derive from
+            # it, so it must be the unique actor id, never a placeholder.
+            try:
+                import ray_tpu
+
+                ctx = ray_tpu.get_runtime_context()
+                self._ident = {"actor": ctx.get_actor_id(),
+                               "node": ctx.get_node_id(),
+                               "role": self.engine.role}
+            except Exception:
+                # no runtime at all (in-process engine A/B harness):
+                # a stable per-process host identity still lets the
+                # same-host channel path work
+                import os
+
+                self._ident = {"actor": None,
+                               "node": os.environ.get("RTPU_NODE_ID",
+                                                      "local"),
+                               "role": self.engine.role}
+        return self._ident
+
+    def _max_payload_bytes(self) -> int:
+        eng = self.engine
+        c = eng._cache["k"]
+        per_block = int(c.dtype.itemsize) * int(np.prod(c.shape[2:])) \
+            * int(c.shape[0]) * 2
+        return per_block * eng._tbl_width
+
+    def prefill_export(self, prompt_tokens, transfer: Dict[str, Any],
+                       deadline_s: Optional[float] = None
+                       ) -> Dict[str, Any]:
+        """Prefill-pool entry point: run chunked prefill, then ship the
+        prompt's KV blocks toward the decode replica named by
+        ``transfer`` ({req, dst, dst_node}) and return the transfer
+        descriptor (+ first token in its meta). The payload moves over a
+        DeviceChannel ring when both replicas share ``dst_node``'s host,
+        else through the object store's chunk-parallel pull path."""
+        from ray_tpu import config as _knobs
+        from ray_tpu.serve.kv_transfer import KVSender
+
+        stall_timeout = float(_knobs.get("llm_stall_timeout_s"))
+        q: "queue.Queue[Any]" = queue.Queue()
+        req = self.engine.submit(prompt_tokens, 1, q.put_nowait,
+                                 deadline_s=deadline_s, prefill_only=True)
+        self._wake.set()
+        export = None
+        try:
+            wait = stall_timeout if deadline_s is None \
+                else min(stall_timeout, deadline_s)
+            while True:
+                tok = q.get(timeout=wait)
+                if isinstance(tok, KVExport):
+                    export = tok
+                    continue
+                if tok is None:
+                    break
+                if isinstance(tok, BaseException):
+                    raise tok
+        except queue.Empty:
+            raise TimeoutError(
+                f"prefill produced no export for {wait:.0f}s"
+                + (f" (loop error: {self._error!r})"
+                   if self._error else ""))
+        finally:
+            self.engine.cancel(req)
+        if export is None:
+            raise RuntimeError("prefill finished without a KV export")
+        with self._xfer_lock:
+            if self._kv_sender is None:
+                import uuid
+
+                # actor id when deployed; a process-unique fallback for
+                # the in-process harness (bench/replay A/B) — channel
+                # names must never collide across senders on one host
+                src = self.identity()["actor"] or uuid.uuid4().hex[:12]
+                self._kv_sender = KVSender(
+                    src, max_payload_bytes=self._max_payload_bytes())
+        same_host = bool(transfer.get("dst_node")) and \
+            transfer["dst_node"] == self.identity()["node"]
+        return self._kv_sender.ship(
+            export, req_id=transfer["req"], dst_id=transfer["dst"],
+            same_host=same_host)
+
+    def adopt_stream(self, prompt_tokens, desc: Dict[str, Any],
+                     max_new_tokens: int = 16, eos: Optional[int] = None,
+                     deadline_s: Optional[float] = None):
+        """Decode-pool entry point: fetch the shipped KV-block batch
+        named by ``desc``, adopt it into this engine's pool, and stream
+        the tokens (the first one — sampled by prefill — included)."""
+        from ray_tpu.serve.kv_transfer import KVReceiver
+
+        with self._xfer_lock:
+            if self._kv_receiver is None:
+                self._kv_receiver = KVReceiver()
+        q: "queue.Queue[Any]" = queue.Queue()
+
+        def submit():
+            timeout = 30.0 if deadline_s is None else min(30.0, deadline_s)
+            meta, kv = self._kv_receiver.fetch(desc, timeout=timeout)
+            return self.engine.adopt(prompt_tokens, kv, meta["token"],
+                                     max_new_tokens, q.put_nowait,
+                                     eos=eos, deadline_s=deadline_s)
+
+        return self._token_stream(q, submit, len(prompt_tokens),
+                                  max_new_tokens, deadline_s)
 
     def stats(self) -> Dict[str, Any]:
         out = dict(self.engine.stats)
@@ -747,7 +1215,16 @@ class LLMDeployment:
         s = self.engine.kv_state()
         return {"inflight": s["inflight"] + s["queued"],
                 "kv_free": s.get("kv_claimable", s.get("kv_free", 0)),
-                "kv_total": s.get("kv_total", 0)}
+                "kv_total": s.get("kv_total", 0),
+                # disaggregation routing signals (ISSUE 13): pool role,
+                # host identity for channel-vs-store transfer choice,
+                # and queue depth for prefill-capacity picking
+                "role": s.get("role", "colocated"),
+                "node": self.identity()["node"],
+                "actor": self.identity()["actor"],
+                "queued": s["queued"],
+                "max_slots": s["max_slots"],
+                "block_size": s.get("block_size", 0)}
 
     def check_health(self) -> None:
         if not self._thread.is_alive():
@@ -755,5 +1232,22 @@ class LLMDeployment:
         if self._error is not None:
             raise RuntimeError(f"llm decode loop error: {self._error!r}")
 
-    def __del__(self):  # pragma: no cover - GC-time best effort
+    def close(self) -> None:
+        """Stop the step loop and unlink/close the KV-transfer planes.
+        In-process harnesses (bench A/Bs) MUST call this: outside a
+        runtime the rings carry the unswept ``nosess`` session prefix,
+        so GC-time ``__del__`` is the only other thing standing between
+        a ring and a leaked /dev/shm segment."""
         self._stop = True
+        with self._xfer_lock:
+            planes, self._kv_sender, self._kv_receiver = (
+                (self._kv_sender, self._kv_receiver), None, None)
+        for plane in planes:
+            if plane is not None:
+                try:
+                    plane.close()
+                except Exception:
+                    pass
+
+    def __del__(self):  # pragma: no cover - GC-time best effort
+        self.close()

@@ -22,6 +22,7 @@ Sites (grep ``failpoints.hit(`` for the live list)::
     daemon.lease_grant     peer-forwarded task acceptance      (daemon)
     adapter.pg.before_commit   between PG prepare and commit   (creator)
     data.exchange.ack      reducer-ack retirement              (driver)
+    serve.kv_transfer      prefill->decode KV-block ship       (replica)
 
 Spec grammar (one or more comma/semicolon-separated entries)::
 

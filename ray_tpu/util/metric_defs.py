@@ -456,6 +456,30 @@ _def("rtpu_serve_tpot_seconds", "histogram",
      "cadence — the latency the TPOT SLO declares)",
      boundaries=_LAT_FAST, component="serve")
 
+# disaggregated prefill/decode (ISSUE 13): per-pool occupancy + the
+# KV-block transfer plane between the pools
+_def("rtpu_serve_pool_inflight", "gauge",
+     "requests occupying engine slots, by pool role "
+     "(prefill/decode/colocated; sampled per engine step)",
+     tag_keys=("role",), component="serve")
+_def("rtpu_serve_pool_queued", "gauge",
+     "admitted requests waiting for an engine slot, by pool role "
+     "(sampled per engine step)", tag_keys=("role",), component="serve")
+_def("rtpu_serve_pool_kv_used_fraction", "gauge",
+     "fraction of this replica's paged-KV blocks in use, by pool role "
+     "(sampled per engine step)", tag_keys=("role",), component="serve")
+_def("rtpu_serve_kv_transfer_bytes_total", "counter",
+     "KV-block payload bytes shipped prefill -> decode, by path "
+     "(channel = same-host DeviceChannel ring slot; store = cross-node "
+     "object-store chunked pull)", tag_keys=("path",), component="serve")
+_def("rtpu_serve_kv_transfers_total", "counter",
+     "KV-block batches shipped prefill -> decode, by path",
+     tag_keys=("path",), component="serve")
+_def("rtpu_serve_kv_transfer_seconds", "histogram",
+     "wall time of one KV-block batch transfer (prefill-side ship for "
+     "send, decode-side fetch for recv), by path",
+     tag_keys=("path",), boundaries=_LAT_FAST, component="serve")
+
 
 # ---------------------------------------------------------------------------
 # instantiation

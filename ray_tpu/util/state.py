@@ -33,6 +33,40 @@ def actor_queue_depths(actor_ids: List[bytes]) -> List[int]:
     return _gcs().actor_queue_depths(actor_ids)
 
 
+def hint_object_pull_align(ref, stride: int,
+                           payload_bytes: int = 0) -> None:
+    """Block-batch framing hint for a cross-node fetch (ISSUE 13): a
+    consumer that knows ``ref`` holds a batch of fixed-size records
+    (KV blocks) registers the record stride — and the total record
+    payload size, since records start AFTER the serialized header —
+    BEFORE touching the value; the cluster adapter's chunked pull then
+    aligns chunk boundaries to whole records. Public surface for the ML
+    layers (layering seam) — a no-op off-cluster or when the object is
+    already local.
+
+    In a WORKER process the hint is stashed on the worker runtime and
+    forwarded on the next ``get`` wire call — the pull itself runs in
+    the hosting driver/daemon process, so a registry in this process
+    would never be consulted."""
+    oid_b = ref.binary() if hasattr(ref, "binary") else bytes(ref)
+    try:
+        from ray_tpu.core.runtime import _get_runtime
+
+        rt = _get_runtime()
+        if rt is not None and hasattr(rt, "hint_pull_align"):
+            rt.hint_pull_align(oid_b, int(stride),
+                               int(payload_bytes))  # worker: wire path
+            return
+    except Exception:
+        pass
+    try:
+        from ray_tpu.cluster.adapter import hint_pull_align
+
+        hint_pull_align(oid_b, int(stride), int(payload_bytes))
+    except Exception:
+        pass
+
+
 def list_actors(filters: Optional[List] = None) -> List[Dict[str, Any]]:
     rt = _gcs()
     out = []

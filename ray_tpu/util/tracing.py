@@ -44,6 +44,9 @@ rule keeps this catalog and the call sites bidirectionally in sync —
     serve.proxy::stream     HTTP proxy streaming response (manual span)
     serve.llm::queue        LLM admission wait to first token (manual)
     serve.llm::stream       LLM token-stream lifetime (manual span)
+    serve.disagg::request   end-to-end disaggregated request (manual)
+    serve.disagg::prefill   prefill-pool call + KV-block ship (manual)
+    serve.disagg::decode    decode-pool adopt + token stream (manual)
     data.exchange::map      streaming-exchange partition task body
     data.exchange::reduce   streaming-exchange reducer block ingest
     train::step             one optimizer step (manual span)
