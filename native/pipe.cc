@@ -695,7 +695,9 @@ int64_t rtpu_lz4_compress(const uint8_t* src, uint64_t n, uint8_t* dst,
       }
       dst[op++] = static_cast<uint8_t>(rest);
     }
-    memcpy(dst + op, src + lit_start, lit_len);
+    // guard: memcpy's args are declared nonnull, and a zero-byte input
+    // arrives as src == nullptr (empty buffer) — UB even with len 0
+    if (lit_len) memcpy(dst + op, src + lit_start, lit_len);
     op += lit_len;
     if (offset) {
       dst[op++] = static_cast<uint8_t>(offset & 0xff);

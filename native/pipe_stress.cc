@@ -1,0 +1,346 @@
+// Sanitizer stress harness for the native pipe engine (ISSUE 15: the
+// store has had a TSAN gate since r5 — this is the same gate for the
+// r14 control-pipe transport, built/run under ASan+UBSan AND TSan via
+// `make -C native sanitize`).
+//
+// Phases (each asserts wire-level correctness, not just "no crash", so
+// the sanitizers watch the real framing/refpin/overflow code paths):
+//   1. kThreads senders hammer one engine pair with pseudo-random-sized
+//      pickle-shaped messages (occasional 300 KiB ones to force the
+//      partial-write path and multi-recv reassembly) while a single
+//      drain thread verifies payload bytes and per-sender ordering.
+//   2. sequential RTP1 refpin frames: net borrow table + 0<->1
+//      transition records + drain_pins serialization.
+//   3. overflow: a record larger than the drain cap must report -needed
+//      and survive intact in the overflow queue.
+//   4. shutdown from another thread wakes a blocked drain (EOF).
+//   5. data-plane: rtpu_copy_mt shard seams + LZ4 roundtrip on random
+//      and structured buffers (bounds bugs here are ASan's home turf).
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <sys/socket.h>
+#include <unistd.h>
+
+// Prototypes MUST match pipe.cc exactly (mismatched function types are
+// UB that can miscompile under LTO/CFI — defeating a sanitizer gate).
+struct NativePipe;
+extern "C" {
+NativePipe *rtpu_pipe_new(int fd, uint64_t coalesce_us);
+int rtpu_pipe_send(NativePipe *p, const uint8_t *buf, uint64_t len);
+int64_t rtpu_pipe_drain(NativePipe *p, uint8_t *out, uint64_t cap,
+                        uint64_t timeout_ms);
+int64_t rtpu_pipe_drain_pins(NativePipe *p, uint8_t *out, uint64_t cap);
+void rtpu_pipe_stats(NativePipe *p, uint64_t *out8);
+void rtpu_pipe_shutdown(NativePipe *p);
+void rtpu_pipe_close(NativePipe *p);
+void rtpu_copy_mt(uint8_t *dst, const uint8_t *src, uint64_t n,
+                  int threads);
+uint64_t rtpu_lz4_bound(uint64_t n);
+int64_t rtpu_lz4_compress(const uint8_t *src, uint64_t n, uint8_t *dst,
+                          uint64_t cap);
+int64_t rtpu_lz4_decompress(const uint8_t *src, uint64_t n, uint8_t *dst,
+                            uint64_t dcap);
+
+// The CopyPool and its detached workers are intentionally leaked (see
+// pipe.cc: joining them at exit deadlocks in __run_exit_handlers), so
+// leak checking would only report designed leaks.
+const char *__asan_default_options() { return "detect_leaks=0"; }
+}
+
+static const int kThreads = 4;
+static const int kIters = 500;
+static const int kBigEvery = 97;  // every Nth message is 300 KiB
+static const uint64_t kBigSize = 300 * 1024;
+
+#define CHECK(cond, what)                                      \
+  do {                                                         \
+    if (!(cond)) {                                             \
+      std::fprintf(stderr, "FAIL %s:%d %s\n", __FILE__,        \
+                   __LINE__, what);                            \
+      std::exit(1);                                            \
+    }                                                          \
+  } while (0)
+
+// Message layout: 0x80 (pickle-protocol marker keeps us off the RTB1/
+// RTP1 magics) + u32le thread + u32le seq + pattern byte fill.
+static uint64_t msg_size(int t, int i) {
+  if (i % kBigEvery == kBigEvery - 1) return kBigSize;
+  uint32_t x = static_cast<uint32_t>(t * 2654435761u + i * 40503u + 9);
+  return 9 + (x % 4096);
+}
+
+static void fill_msg(std::string &m, int t, int i) {
+  uint64_t n = msg_size(t, i);
+  m.resize(n);
+  m[0] = static_cast<char>(0x80);
+  uint32_t tv = static_cast<uint32_t>(t), iv = static_cast<uint32_t>(i);
+  std::memcpy(&m[1], &tv, 4);
+  std::memcpy(&m[5], &iv, 4);
+  uint8_t pat = static_cast<uint8_t>(t * 41 + i);
+  for (uint64_t k = 9; k < n; ++k) m[k] = static_cast<char>(pat + k);
+}
+
+static void check_msg(const uint8_t *d, uint64_t n, int *t_out,
+                      int *i_out) {
+  CHECK(n >= 9, "record too short");
+  CHECK(d[0] == 0x80, "payload lost its pickle marker");
+  uint32_t tv, iv;
+  std::memcpy(&tv, d + 1, 4);
+  std::memcpy(&iv, d + 5, 4);
+  CHECK(tv < static_cast<uint32_t>(kThreads), "bad thread field");
+  CHECK(n == msg_size(static_cast<int>(tv), static_cast<int>(iv)),
+        "record length mismatch");
+  uint8_t pat = static_cast<uint8_t>(tv * 41 + iv);
+  for (uint64_t k = 9; k < n; ++k)
+    CHECK(d[k] == static_cast<uint8_t>(pat + k), "payload corrupted");
+  *t_out = static_cast<int>(tv);
+  *i_out = static_cast<int>(iv);
+}
+
+// Walk packed drain records [u8 type][u32le len][payload]*, invoking
+// fn(type, payload, len).
+template <typename F>
+static void for_each_record(const uint8_t *buf, int64_t n, F fn) {
+  int64_t off = 0;
+  while (off < n) {
+    uint8_t type = buf[off];
+    uint32_t len;
+    std::memcpy(&len, buf + off + 1, 4);
+    CHECK(off + 5 + static_cast<int64_t>(len) <= n,
+          "record overruns drain buffer");
+    fn(type, buf + off + 5, static_cast<uint64_t>(len));
+    off += 5 + len;
+  }
+  CHECK(off == n, "trailing garbage in drain buffer");
+}
+
+static void phase_concurrent_senders(NativePipe *tx, NativePipe *rx) {
+  std::vector<std::thread> senders;
+  for (int t = 0; t < kThreads; ++t) {
+    senders.emplace_back([&, t] {
+      std::string m;
+      for (int i = 0; i < kIters; ++i) {
+        fill_msg(m, t, i);
+        CHECK(rtpu_pipe_send(
+                  tx, reinterpret_cast<const uint8_t *>(m.data()),
+                  m.size()) == 0,
+              "send failed mid-stress");
+      }
+    });
+  }
+
+  std::vector<uint8_t> buf(64 * 1024);
+  int next_seq[kThreads] = {0, 0, 0, 0};
+  uint64_t total = 0, want = static_cast<uint64_t>(kThreads) * kIters;
+  while (total < want) {
+    int64_t n = rtpu_pipe_drain(rx, buf.data(), buf.size(), 200);
+    CHECK(n != -1, "unexpected EOF");
+    if (n < -1) {  // a big record needs a bigger buffer
+      buf.resize(static_cast<uint64_t>(-n));
+      continue;
+    }
+    for_each_record(buf.data(), n,
+                    [&](uint8_t type, const uint8_t *d, uint64_t len) {
+                      CHECK(type == 0, "unexpected refpin record");
+                      int t, i;
+                      check_msg(d, len, &t, &i);
+                      // sends from one thread are sequential calls, and
+                      // the engine preserves accepted-message order
+                      CHECK(i == next_seq[t], "per-sender order broken");
+                      next_seq[t]++;
+                      total++;
+                    });
+  }
+  for (auto &s : senders) s.join();
+
+  uint64_t st_tx[8], st_rx[8];
+  rtpu_pipe_stats(tx, st_tx);
+  rtpu_pipe_stats(rx, st_rx);
+  CHECK(st_tx[1] == want, "sender message count drifted");
+  CHECK(st_rx[4] == want, "receiver message count drifted");
+  CHECK(st_tx[0] <= st_tx[1], "more frames than messages");
+  std::printf("  phase1 ok: msgs=%llu frames=%llu bytes=%llu\n",
+              (unsigned long long)st_tx[1], (unsigned long long)st_tx[0],
+              (unsigned long long)st_tx[2]);
+}
+
+static void phase_refpins(NativePipe *tx, NativePipe *rx) {
+  // Sent sequentially with the socket idle so every frame ships alone
+  // (refpin frames are only recognized at top level, never inside a
+  // coalesced RTB1 batch — same invariant the Python wrapper relies on).
+  uint8_t ida[16], idb[16];
+  std::memset(ida, 'a', 16);
+  std::memset(idb, 'b', 16);
+  const int8_t plan[][2] = {  // {id-is-b, delta}
+      {0, +1}, {0, +1}, {1, +1}, {0, -1}, {1, -1}, {0, -1}, {1, +1}};
+  for (auto &step : plan) {
+    std::string f("RTP1");
+    f.append(reinterpret_cast<char *>(step[0] ? idb : ida), 16);
+    f.push_back(static_cast<char>(step[1]));
+    CHECK(rtpu_pipe_send(tx, reinterpret_cast<const uint8_t *>(f.data()),
+                         f.size()) == 0,
+          "refpin send failed");
+  }
+  // expected net transitions: a:+1, b:+1, b:-1, a:-1, b:+1
+  const int8_t want_trans[][2] = {{0, +1}, {1, +1}, {1, -1}, {0, -1},
+                                  {1, +1}};
+  size_t seen = 0;
+  std::vector<uint8_t> buf(4096);
+  for (int tick = 0; seen < 5; ++tick) {
+    CHECK(tick < 40, "refpin transitions never arrived");
+    int64_t n = rtpu_pipe_drain(rx, buf.data(), buf.size(), 500);
+    CHECK(n >= 0, "unexpected EOF waiting for refpins");
+    if (n == 0) continue;  // timeout tick
+    for_each_record(
+        buf.data(), n, [&](uint8_t type, const uint8_t *d, uint64_t len) {
+          CHECK(type == 1, "expected only refpin records here");
+          CHECK(len % 17 == 0, "refpin record not 17-byte packed");
+          for (uint64_t off = 0; off < len; off += 17) {
+            CHECK(seen < 5, "too many transitions");
+            const uint8_t *want_id = want_trans[seen][0] ? idb : ida;
+            CHECK(std::memcmp(d + off, want_id, 16) == 0,
+                  "transition id mismatch");
+            CHECK(static_cast<int8_t>(d[off + 16]) ==
+                      want_trans[seen][1],
+                  "transition sign mismatch");
+            seen++;
+          }
+        });
+  }
+  // net table: a=0 (erased), b=1
+  uint8_t pins[64];
+  int64_t n = rtpu_pipe_drain_pins(rx, pins, sizeof(pins));
+  CHECK(n == 24, "borrow table should hold exactly one id");
+  CHECK(std::memcmp(pins, idb, 16) == 0, "wrong surviving id");
+  int64_t count;
+  std::memcpy(&count, pins + 16, 8);
+  CHECK(count == 1, "wrong surviving count");
+  CHECK(rtpu_pipe_drain_pins(rx, pins, sizeof(pins)) == 0,
+        "drain_pins must clear the table");
+  std::printf("  phase2 ok: refpin transitions + drain_pins verified\n");
+}
+
+static void phase_overflow(NativePipe *tx, NativePipe *rx) {
+  std::string m;
+  fill_msg(m, 1, kBigEvery - 1);  // a 300 KiB message
+  CHECK(m.size() == kBigSize, "big fixture sized wrong");
+  CHECK(rtpu_pipe_send(tx, reinterpret_cast<const uint8_t *>(m.data()),
+                       m.size()) == 0,
+        "big send failed");
+  uint8_t tiny[512];
+  int64_t n;
+  do {  // the record may not have fully arrived on the first tick
+    n = rtpu_pipe_drain(rx, tiny, sizeof(tiny), 500);
+  } while (n == 0);
+  CHECK(n == -static_cast<int64_t>(5 + kBigSize),
+        "undersized drain must report -(record size)");
+  std::vector<uint8_t> big(5 + kBigSize);
+  n = rtpu_pipe_drain(rx, big.data(), big.size(), 500);
+  CHECK(n == static_cast<int64_t>(5 + kBigSize),
+        "retry with exact cap must return the record");
+  int t, i;
+  CHECK(big[0] == 0, "overflow record type drifted");
+  check_msg(big.data() + 5, kBigSize, &t, &i);
+  std::printf("  phase3 ok: overflow -needed path verified\n");
+}
+
+static void phase_shutdown_wakes_drain() {
+  int sv[2];
+  CHECK(socketpair(AF_UNIX, SOCK_STREAM, 0, sv) == 0, "socketpair");
+  NativePipe *rx = rtpu_pipe_new(sv[0], 0);
+  std::atomic<int64_t> result{123456};
+  std::thread drainer([&] {
+    uint8_t buf[256];
+    result.store(rtpu_pipe_drain(rx, buf, sizeof(buf), 10000));
+  });
+  // give the drain a moment to block in recv, then shut down under it
+  std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  rtpu_pipe_shutdown(rx);
+  drainer.join();
+  CHECK(result.load() == -1, "shutdown must surface as drain EOF");
+  rtpu_pipe_close(rx);
+  ::close(sv[0]);
+  ::close(sv[1]);
+  std::printf("  phase4 ok: shutdown wakes blocked drain as EOF\n");
+}
+
+static void phase_data_plane() {
+  // copy_mt: shard seams must be exact for sizes around the 1 MiB
+  // single-thread cutoff and non-multiples of the 64 B shard alignment
+  const uint64_t sizes[] = {1, 4096, (1u << 20) - 1, (1u << 20) + 1,
+                            (4u << 20) + 12345};
+  for (uint64_t n : sizes) {
+    std::vector<uint8_t> src(n), dst(n, 0);
+    for (uint64_t i = 0; i < n; ++i)
+      src[i] = static_cast<uint8_t>(i * 131 + 7);
+    rtpu_copy_mt(dst.data(), src.data(), n, 4);
+    CHECK(std::memcmp(dst.data(), src.data(), n) == 0,
+          "copy_mt corrupted bytes");
+  }
+  // lz4 roundtrip: structured (compressible) and pseudo-random data,
+  // including the <13-byte literal-only path
+  uint32_t rng = 0x2545f491u;
+  for (uint64_t n : {0ull, 5ull, 12ull, 13ull, 4096ull, 262144ull}) {
+    for (int mode = 0; mode < 2; ++mode) {
+      std::vector<uint8_t> raw(n);
+      for (uint64_t i = 0; i < n; ++i) {
+        if (mode == 0) {
+          raw[i] = static_cast<uint8_t>((i / 64) & 0xff);  // runs
+        } else {
+          rng ^= rng << 13;
+          rng ^= rng >> 17;
+          rng ^= rng << 5;
+          raw[i] = static_cast<uint8_t>(rng);
+        }
+      }
+      std::vector<uint8_t> comp(rtpu_lz4_bound(n) + 1);
+      int64_t c = rtpu_lz4_compress(raw.data(), n, comp.data(),
+                                    comp.size());
+      CHECK(c >= 0, "compress within bound must succeed");
+      std::vector<uint8_t> back(n ? n : 1);
+      int64_t d = rtpu_lz4_decompress(comp.data(),
+                                      static_cast<uint64_t>(c),
+                                      back.data(), n);
+      CHECK(d == static_cast<int64_t>(n), "roundtrip length mismatch");
+      CHECK(n == 0 || std::memcmp(back.data(), raw.data(), n) == 0,
+            "roundtrip bytes mismatch");
+    }
+  }
+  // malformed input must fail cleanly, not read out of bounds
+  const uint8_t evil[] = {0x1f, 0x41, 0x41, 0x41, 0xff, 0xff};
+  uint8_t out[64];
+  CHECK(rtpu_lz4_decompress(evil, sizeof(evil), out, sizeof(out)) == -1,
+        "malformed block must return -1");
+  std::printf("  phase5 ok: copy_mt + lz4 roundtrips verified\n");
+}
+
+int main() {
+  int sv[2];
+  CHECK(socketpair(AF_UNIX, SOCK_STREAM, 0, sv) == 0, "socketpair");
+  NativePipe *tx = rtpu_pipe_new(sv[0], 0);
+  NativePipe *rx = rtpu_pipe_new(sv[1], 0);
+
+  phase_concurrent_senders(tx, rx);
+  phase_refpins(tx, rx);
+  phase_overflow(tx, rx);
+
+  rtpu_pipe_close(tx);
+  rtpu_pipe_close(rx);
+  ::close(sv[0]);
+  ::close(sv[1]);
+
+  phase_shutdown_wakes_drain();
+  phase_data_plane();
+
+  std::printf("pipe-stress ok: %d senders x %d msgs\n", kThreads, kIters);
+  return 0;
+}

@@ -21,6 +21,17 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
 _SO_PATH = os.path.join(_NATIVE_DIR, "build", "librtpu_store.so")
 
+
+def _so_path() -> str:
+    """The .so to load. ``RTPU_NATIVE_SO`` overrides the default build
+    product — the sanitizer pytest lane points it at
+    ``native/build/librtpu_store_asan.so`` (with libasan LD_PRELOADed)
+    so the whole Python-facing surface runs instrumented without
+    touching the normal artifact. Resolved once per process: the first
+    load is cached in ``_lib``."""
+    return os.environ.get("RTPU_NATIVE_SO") or _SO_PATH
+
+
 _lib_lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _lib_failed = False
@@ -47,11 +58,15 @@ def load_store_lib() -> Optional[ctypes.CDLL]:
             return _lib
         if _lib_failed:
             return None
-        if not os.path.exists(_SO_PATH) and not _build():
-            _lib_failed = True
-            return None
+        so = _so_path()
+        if not os.path.exists(so):
+            # never auto-build over an explicit RTPU_NATIVE_SO target —
+            # a missing override is a configuration error, not a cache miss
+            if so != _SO_PATH or not _build():
+                _lib_failed = True
+                return None
         try:
-            lib = ctypes.CDLL(_SO_PATH)
+            lib = ctypes.CDLL(so)
         except OSError:
             _lib_failed = True
             return None
@@ -61,14 +76,14 @@ def load_store_lib() -> Optional[ctypes.CDLL]:
             # if the symbols are STILL missing, consumers fall back
             # per-feature via hasattr and native_status() reports stale.
             del lib
-            if _build():
+            if so == _SO_PATH and _build():
                 try:
-                    lib = ctypes.CDLL(_SO_PATH)
+                    lib = ctypes.CDLL(so)
                 except OSError:
                     _lib_failed = True
                     return None
             else:
-                lib = ctypes.CDLL(_SO_PATH)
+                lib = ctypes.CDLL(so)
             _lib_stale = not hasattr(lib, "rtpu_pipe_new")
         lib.rtpu_store_open.restype = ctypes.c_void_p
         lib.rtpu_store_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
@@ -144,6 +159,8 @@ def native_status() -> dict:
         "pipe": lib is not None and hasattr(lib, "rtpu_pipe_new"),
         "lz4": lib is not None and hasattr(lib, "rtpu_lz4_compress"),
         "stale": _lib_stale,
+        "so_path": _so_path(),
+        "override": "RTPU_NATIVE_SO" in os.environ,
     }
 
 
@@ -174,7 +191,7 @@ def _load_pipe_pylib() -> Optional[ctypes.PyDLL]:
         if _pylib is not None:
             return _pylib
         try:
-            plib = ctypes.PyDLL(_SO_PATH)
+            plib = ctypes.PyDLL(_so_path())
         except OSError:
             return None
         plib.rtpu_pipe_send.restype = ctypes.c_int

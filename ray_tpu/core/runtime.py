@@ -1566,8 +1566,6 @@ class DriverRuntime:
         elif op == "stream_consumed":
             self.stream_consumed(args[0], args[1],
                                  args[2] if len(args) > 2 else None)
-        elif op == "refpin":
-            self.worker_ref_delta(ws, args[0], args[1])
         elif op == "refpins":
             # batched borrow transitions (r13 coalescing): list order IS
             # transition order, applied sequentially

@@ -376,12 +376,10 @@ class WorkerRuntime:
                     self._dispatch_recv(_pickle.loads(buf[off:off + ln]))
                     off += ln
                 continue
-            msg = _pickle.loads(buf)
-            if msg[0] == "batch":
-                for sub in msg[1]:
-                    self._dispatch_recv(sub)
-            else:
-                self._dispatch_recv(msg)
+            # no "batch" unwrap here: driver->worker coalescing is the
+            # native RTB1 frame above — only the worker->driver direction
+            # ships ("batch", [...]) tuples (pipe-protocol-sync)
+            self._dispatch_recv(_pickle.loads(buf))
 
     def _dispatch_recv(self, msg):
         kind = msg[0]

@@ -10,6 +10,16 @@ Rule families (see the generated catalog in README "Static analysis"):
 - ``invariants`` AST ports of the old test_invariants.py regex greps
 - ``failpoints`` chaos-plane site catalog consistency
 - ``meta``       suppression hygiene
+- ``protocol``   whole-program wire-protocol sync: every pipe cast/req,
+                 GCS/peer rpc_* method, and pubsub topic matches the
+                 checked-in catalog in ``core/protocol.py`` AND has both
+                 a live sender and a dispatch arm
+- ``lifecycle``  session-scoped resource lifecycles: shm/DeviceChannel
+                 names carry the session id (sweep-reachable), BlockPool
+                 claims roll back on every error path, manual spans are
+                 finished or handed off
+- ``lockgraph``  global lock-order graph: held->acquired edges merged
+                 across ALL modules, cycles reported with witness paths
 
 Public entry points::
 
@@ -46,9 +56,14 @@ from ray_tpu.devtools.graftlint.model import (  # noqa: F401
 
 def lint(paths: List[Path], rules: Iterable[str] = (),
          families: Iterable[str] = (),
-         root: Optional[Path] = None) -> List[Finding]:
+         root: Optional[Path] = None,
+         cache: bool = True) -> List[Finding]:
     """Analyze ``paths`` and return sorted findings (parse errors
-    included as findings). The one-call API tests build on."""
-    project, errors = build_project([Path(p) for p in paths], root=root)
+    included as findings). The one-call API tests build on.
+
+    ``cache=False`` bypasses the ``.graftlint_cache/`` model cache
+    (which is only consulted when ``root`` is given anyway)."""
+    project, errors = build_project([Path(p) for p in paths], root=root,
+                                    cache=cache)
     findings = run_rules(project, select_rules(rules, families))
     return sorted(errors + findings, key=lambda f: f.sort_key())

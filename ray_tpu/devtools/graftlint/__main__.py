@@ -61,6 +61,10 @@ def main(argv=None) -> int:
                    help="verify README's rule table matches the registry")
     p.add_argument("--update", metavar="README",
                    help="rewrite README's rule table in place")
+    p.add_argument("--no-cache", action="store_true",
+                   help="bypass the .graftlint_cache/ per-module model "
+                        "cache (escape hatch; results must be identical "
+                        "— tested by test_graftlint.py cache parity)")
     p.add_argument("--baseline", metavar="PATH",
                    help="baseline file of known findings to ignore "
                         "(default: <root>/.graftlint-baseline.json if "
@@ -89,7 +93,8 @@ def main(argv=None) -> int:
             return 2
     try:
         findings = graftlint.lint(paths, rules=args.rule,
-                                  families=args.family, root=root)
+                                  families=args.family, root=root,
+                                  cache=not args.no_cache)
     except KeyError as e:
         print(f"error: {e.args[0]}", file=sys.stderr)
         return 2

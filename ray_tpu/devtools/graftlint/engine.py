@@ -28,7 +28,11 @@ environment, including under the axon sitecustomize.
 from __future__ import annotations
 
 import ast
+import hashlib
+import os
+import pickle
 import re
+import sys
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Dict, FrozenSet, List, Optional, Set, Tuple
@@ -180,6 +184,10 @@ class ModuleIndex:
         self.classes: Dict[str, ClassInfo] = {}
         self.functions: Dict[str, FunctionInfo] = {}  # by qualname
         self.module_locks: Set[str] = set()
+        # (outer, inner, line, via) pairs acquired in module-level
+        # functions — the class-free twin of ClassInfo.lock_pairs, so the
+        # global lock-order graph sees edges outside any class
+        self.lock_pairs: List[Tuple[str, str, int, str]] = []
         self.calls: List[CallSite] = []
         self.module_import_nodes: List[Tuple[int, str]] = []  # (line, fq)
         self.all_import_nodes: List[Tuple[int, str]] = []     # incl. nested
@@ -487,12 +495,12 @@ class _BodyWalker(ast.NodeVisitor):
             if key:
                 acquired.append(key)
         for key in acquired:
-            if self.ci is not None:
-                # pair against EVERY held lock, not just the innermost —
-                # a->b->c vs c->a inverts on (a,c)
-                for held in self.locks:
-                    self.ci.lock_pairs.append(
-                        (held, key, node.lineno, ""))
+            # pair against EVERY held lock, not just the innermost —
+            # a->b->c vs c->a inverts on (a,c)
+            sink = (self.ci.lock_pairs if self.ci is not None
+                    else self.mod.lock_pairs)
+            for held in self.locks:
+                sink.append((held, key, node.lineno, ""))
             self.locks.append(key)
             if self.nested_depth == 0:
                 # a closure's acquisition happens when the CALLBACK runs,
@@ -657,6 +665,75 @@ def load_module(path: Path, root: Optional[Path] = None) -> ModuleIndex:
     return ModuleIndex(path, display, scope, source)
 
 
+# ---------------------------------------------------------------------------
+# model cache (ISSUE 15): warm `make lint` re-analyzes only changed files
+# ---------------------------------------------------------------------------
+
+CACHE_DIR_NAME = ".graftlint_cache"
+_CACHE_VERSION = 1
+_engine_digest_memo: Optional[str] = None
+
+
+def _engine_digest() -> str:
+    """Invalidation key: a cached model is only valid for the engine
+    source (and interpreter) that built it — ast node shapes and the
+    analysis itself both change across versions."""
+    global _engine_digest_memo
+    if _engine_digest_memo is None:
+        h = hashlib.sha256()
+        h.update(Path(__file__).read_bytes())
+        h.update(sys.version.encode())
+        _engine_digest_memo = h.hexdigest()
+    return _engine_digest_memo
+
+
+def _set_display(mod: ModuleIndex, path: Path, root: Optional[Path]) -> None:
+    # display is the only root-dependent field — recompute it after a
+    # cache hit so findings render identically with any cwd/root
+    if root is not None:
+        try:
+            mod.display = path.resolve().relative_to(
+                root.resolve()).as_posix()
+            return
+        except ValueError:
+            pass
+    mod.display = str(path)
+
+
+def _load_module_cached(f: Path, root: Optional[Path],
+                        cache_dir: Path) -> ModuleIndex:
+    """load_module through a (path, mtime_ns, size)-keyed pickle cache.
+    Every failure mode (corrupt pickle, racing writer, read-only dir)
+    falls back to a fresh parse — the cache can never change results,
+    only skip work (parity-tested in test_graftlint.py)."""
+    key = hashlib.sha256(
+        str(f.resolve()).encode()).hexdigest()[:32]
+    cpath = cache_dir / f"{key}.pkl"
+    try:
+        st = f.stat()
+        with open(cpath, "rb") as fh:
+            tag, mtime, size, digest, mod = pickle.load(fh)
+        if (tag == _CACHE_VERSION and mtime == st.st_mtime_ns
+                and size == st.st_size and digest == _engine_digest()
+                and isinstance(mod, ModuleIndex)):
+            _set_display(mod, f, root)
+            return mod
+    except Exception:
+        pass
+    mod = load_module(f, root)
+    try:
+        cache_dir.mkdir(exist_ok=True)
+        tmp = cache_dir / f".{key}.{os.getpid()}.tmp"
+        with open(tmp, "wb") as fh:
+            pickle.dump((_CACHE_VERSION, f.stat().st_mtime_ns,
+                         f.stat().st_size, _engine_digest(), mod),
+                        fh, pickle.HIGHEST_PROTOCOL)
+        tmp.replace(cpath)
+    except Exception:
+        pass
+    return mod
+
+
 def collect_files(paths: List[Path]) -> List[Path]:
     files: List[Path] = []
     seen = set()  # dedupe: a file named alongside its containing dir
@@ -672,14 +749,25 @@ def collect_files(paths: List[Path]) -> List[Path]:
     return files
 
 
-def build_project(paths: List[Path], root: Optional[Path] = None):
-    """Returns (Project, [Finding]) — the findings are parse errors."""
+def build_project(paths: List[Path], root: Optional[Path] = None,
+                  cache: bool = True):
+    """Returns (Project, [Finding]) — the findings are parse errors.
+
+    With ``cache=True`` and a ``root``, per-module models are pickled
+    under ``<root>/.graftlint_cache/`` keyed (path, mtime_ns, size) +
+    engine digest; rootless calls (single-fixture lints in tests) never
+    touch the cache."""
     from ray_tpu.devtools.graftlint.model import Finding
 
+    cache_dir = (root / CACHE_DIR_NAME) if (cache and root is not None) \
+        else None
     modules, errors = [], []
     for f in collect_files(paths):
         try:
-            modules.append(load_module(f, root))
+            if cache_dir is not None:
+                modules.append(_load_module_cached(f, root, cache_dir))
+            else:
+                modules.append(load_module(f, root))
         except SyntaxError as e:
             errors.append(Finding(str(f), e.lineno or 0, "parse-error",
                                   f"syntax error: {e.msg}"))

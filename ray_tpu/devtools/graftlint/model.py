@@ -20,9 +20,15 @@ FAMILY_LAYERING = "layering"
 FAMILY_INVARIANTS = "invariants"
 FAMILY_FAILPOINTS = "failpoints"
 FAMILY_META = "meta"
+#: whole-program families (ISSUE 15): cross-file analyses over the
+#: already-built per-module models
+FAMILY_PROTOCOL = "protocol"
+FAMILY_LIFECYCLE = "lifecycle"
+FAMILY_LOCKGRAPH = "lockgraph"
 
 FAMILIES = (FAMILY_LOCKS, FAMILY_JAX, FAMILY_LAYERING, FAMILY_INVARIANTS,
-            FAMILY_FAILPOINTS, FAMILY_META)
+            FAMILY_FAILPOINTS, FAMILY_META, FAMILY_PROTOCOL,
+            FAMILY_LIFECYCLE, FAMILY_LOCKGRAPH)
 
 
 @dataclass(frozen=True)
@@ -86,9 +92,12 @@ def _load_rule_modules() -> None:
         rules_invariants,
         rules_jax,
         rules_layering,
+        rules_lifecycle,
+        rules_lockgraph,
         rules_locks,
         rules_meta,
         rules_profiling,
+        rules_protocol,
         rules_tracing,
     )
 

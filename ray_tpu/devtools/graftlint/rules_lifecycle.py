@@ -1,0 +1,372 @@
+"""Resource-lifecycle rules (family ``lifecycle``, ISSUE 15).
+
+Session-scoped resources must be reclaimable by something other than
+the code path that created them — this box's 2-vCPU contention kills
+replicas mid-request routinely, and a leaked /dev/shm ring or an
+un-rolled-back block claim survives the process that leaked it. Three
+acquire/release disciplines, checked intra-function with lexical
+path-sensitivity (guard-aware, closure-bodies included):
+
+- every shm ring created (``Channel``/``DeviceChannel`` with
+  ``create=True``) is session-named, so the runtime shutdown sweep
+  (``rtpu-chan-<session>-*`` in core/runtime.py) reclaims it;
+- every ``BlockPool.alloc`` claim is released on each failure exit
+  (the admission invariant: a request that is NOT admitted holds zero
+  blocks);
+- every ``tracing.manual_span`` started in a function is finished
+  there or handed off — an unfinished manual span silently records
+  nothing, which is worse than crashing (the SLO decomposition just
+  loses a term).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from ray_tpu.devtools.graftlint.engine import (
+    ModuleIndex,
+    Project,
+    dotted_parts,
+)
+from ray_tpu.devtools.graftlint.model import (
+    FAMILY_LIFECYCLE,
+    Finding,
+    Rule,
+    register,
+)
+
+
+def _kw(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _is_true(node: Optional[ast.AST]) -> bool:
+    return isinstance(node, ast.Constant) and node.value is True
+
+
+# ---------------------------------------------------------------------------
+# rule 1: shm rings must be session-named
+# ---------------------------------------------------------------------------
+
+_CHANNEL_CLASSES = {"Channel", "DeviceChannel"}
+
+
+def _mentions_session(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and "session" in n.id.lower():
+            return True
+        if isinstance(n, ast.Attribute) and "session" in n.attr.lower():
+            return True
+    return False
+
+
+def _local_assigns(func: ast.AST) -> Dict[str, List[ast.AST]]:
+    out: Dict[str, List[ast.AST]] = {}
+    for n in ast.walk(func):
+        if isinstance(n, ast.Assign) and n.value is not None:
+            for t in n.targets:
+                if isinstance(t, ast.Name):
+                    out.setdefault(t.id, []).append(n.value)
+    return out
+
+
+def _session_tainted(name_arg: ast.AST, func: ast.AST,
+                     mod: ModuleIndex) -> bool:
+    """True when the channel-name expression derives from the session id,
+    by transitive local dataflow within ``func`` plus one hop into
+    same-module helper functions it calls (kv_transfer's
+    ``channel_name()`` shape)."""
+    assigns = _local_assigns(func)
+    tainted: Set[str] = set()
+    # seed: local names whose RHS mentions session directly
+    changed = True
+    while changed:
+        changed = False
+        for name, exprs in assigns.items():
+            if name in tainted:
+                continue
+            for e in exprs:
+                if _mentions_session(e) or any(
+                        isinstance(n, ast.Name) and n.id in tainted
+                        for n in ast.walk(e)):
+                    tainted.add(name)
+                    changed = True
+                    break
+
+    def expr_ok(expr: ast.AST) -> bool:
+        if _mentions_session(expr):
+            return True
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Name) and n.id in tainted:
+                return True
+            if isinstance(n, ast.Call) and isinstance(n.func, ast.Name):
+                helper = mod.functions.get(n.func.id)
+                if helper is not None and _mentions_session(helper.node):
+                    return True
+        return False
+
+    return expr_ok(name_arg)
+
+
+def _callee_names(call: ast.Call, assigns: Dict[str, List[ast.AST]]
+                  ) -> Set[str]:
+    """Terminal class names a call could construct, resolving one level
+    of local aliasing (``cls = DeviceChannel if ... else Channel``)."""
+    parts = dotted_parts(call.func)
+    if not parts:
+        return set()
+    tail = parts[-1]
+    if tail in _CHANNEL_CLASSES:
+        return {tail}
+    out: Set[str] = set()
+    if len(parts) == 1:
+        for e in assigns.get(tail, ()):
+            for n in ast.walk(e):
+                if isinstance(n, ast.Name) and n.id in _CHANNEL_CLASSES:
+                    out.add(n.id)
+    return out
+
+
+@register
+class ShmSessionLifecycle(Rule):
+    name = "shm-session-lifecycle"
+    family = FAMILY_LIFECYCLE
+    summary = ("every shm ring created (Channel/DeviceChannel "
+               "create=True) must derive its name from the runtime "
+               "session id so the shutdown sweep (rtpu-chan-<session>-*) "
+               "reclaims it when the creator dies uncleanly")
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for mod in project.modules:
+            if mod.scope_rel.startswith("ray_tpu/experimental/"):
+                continue  # the channel implementation itself
+            # cheap gate: a module that neither imports nor defines a
+            # channel class cannot create one (the aliased-callee shape
+            # still needs the class name in scope)
+            if not (_CHANNEL_CLASSES & set(mod.imports)
+                    or _CHANNEL_CLASSES & set(mod.classes)):
+                continue
+            # walk only functions that contain a create=True call
+            # (mod.calls is already indexed; ast.walk per function is not)
+            funcs = {cs.func for cs in mod.calls
+                     if _is_true(_kw(cs.node, "create"))}
+            for fi in mod.functions.values():
+                if fi.qualname not in funcs:
+                    continue
+                assigns = _local_assigns(fi.node)
+                for node in ast.walk(fi.node):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    if not _callee_names(node, assigns):
+                        continue
+                    if not _is_true(_kw(node, "create")):
+                        continue  # attach side: somebody else's segment
+                    name_arg = (node.args[0] if node.args
+                                else _kw(node, "name"))
+                    if name_arg is None:
+                        continue
+                    if not _session_tainted(name_arg, fi.node, mod):
+                        yield self.finding(
+                            mod, node.lineno,
+                            "shm channel created with a name not derived "
+                            "from the runtime session id — the shutdown "
+                            "sweep (rtpu-chan-<session>-*) can never "
+                            "reclaim it if this process dies; build the "
+                            "name from get_runtime_context()."
+                            "get_session_id()")
+
+
+# ---------------------------------------------------------------------------
+# rule 2: BlockPool claims roll back on failure exits
+# ---------------------------------------------------------------------------
+
+_CLAIM_TAILS = {"alloc"}
+_RELEASE_TAILS = {"release", "release_all"}
+
+
+def _pool_call_tail(node: ast.Call) -> Optional[str]:
+    parts = dotted_parts(node.func)
+    if not parts or len(parts) < 2:
+        return None
+    tail = parts[-1]
+    if tail in _CLAIM_TAILS | _RELEASE_TAILS and (
+            "pool" in parts[-2].lower()):
+        return tail
+    return None
+
+
+def _falsy_exit(node: ast.AST) -> bool:
+    if isinstance(node, ast.Raise):
+        return True
+    if isinstance(node, ast.Return):
+        v = node.value
+        return v is None or (isinstance(v, ast.Constant)
+                             and v.value in (False, None))
+    return False
+
+
+def _none_guard_names(func: ast.AST, exit_node: ast.AST) -> Set[str]:
+    """Names X for which ``exit_node`` sits inside an ``if X is None:`` /
+    ``if not X:`` body — the claim-failed branch, where that claim holds
+    nothing."""
+    out: Set[str] = set()
+    for n in ast.walk(func):
+        if not isinstance(n, ast.If):
+            continue
+        in_body = any(exit_node is d or any(exit_node is dd
+                                            for dd in ast.walk(d))
+                      for d in n.body)
+        if not in_body:
+            continue
+        t = n.test
+        if (isinstance(t, ast.Compare) and len(t.ops) == 1
+                and isinstance(t.ops[0], ast.Is)
+                and isinstance(t.comparators[0], ast.Constant)
+                and t.comparators[0].value is None
+                and isinstance(t.left, ast.Name)):
+            out.add(t.left.id)
+        elif (isinstance(t, ast.UnaryOp) and isinstance(t.op, ast.Not)
+              and isinstance(t.operand, ast.Name)):
+            out.add(t.operand.id)
+    return out
+
+
+@register
+class PoolClaimRollback(Rule):
+    name = "pool-claim-rollback"
+    family = FAMILY_LIFECYCLE
+    summary = ("a function that claims KV blocks (pool.alloc) must "
+               "release them on every failure exit (raise / return "
+               "False/None) after the claim — an un-admitted request "
+               "holding blocks leaks pool capacity until process death")
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for mod in project.modules:
+            # mod.calls is pre-indexed: group claim/release sites by
+            # enclosing function, walk only the functions that claim
+            by_func: Dict[str, Tuple[List[int], List[int]]] = {}
+            for cs in mod.calls:
+                tail = _pool_call_tail(cs.node)
+                if tail is None:
+                    continue
+                sink = by_func.setdefault(cs.func, ([], []))
+                (sink[0] if tail in _CLAIM_TAILS else sink[1]).append(
+                    cs.line)
+            for fi in mod.functions.values():
+                claim_lines, releases = by_func.get(fi.qualname, ((), ()))
+                if not claim_lines:
+                    continue
+                claims: List[Tuple[int, Optional[str]]] = [
+                    (l, None) for l in claim_lines]
+                for node in ast.walk(fi.node):
+                    if (isinstance(node, ast.Assign)
+                            and isinstance(node.value, ast.Call)
+                            and _pool_call_tail(node.value) in _CLAIM_TAILS
+                            and len(node.targets) == 1
+                            and isinstance(node.targets[0], ast.Name)):
+                        claims.append((node.value.lineno,
+                                       node.targets[0].id))
+                first_claim = min(l for l, _ in claims)
+                claim_names = {n for _, n in claims if n}
+                for node in ast.walk(fi.node):
+                    if not _falsy_exit(node):
+                        continue
+                    line = node.lineno
+                    if line <= first_claim:
+                        continue
+                    if any(first_claim < r <= line for r in releases):
+                        continue  # rolled back before bailing
+                    guards = _none_guard_names(fi.node, node)
+                    if guards & claim_names:
+                        continue  # the claim-failed branch holds nothing
+                    yield self.finding(
+                        mod, line,
+                        f"failure exit after pool.alloc() at line "
+                        f"{first_claim} without releasing the claimed "
+                        f"blocks — release/release_all on every error "
+                        f"path (see llm._claim_blocks's roll_back())")
+
+
+# ---------------------------------------------------------------------------
+# rule 3: manual spans are finished or handed off
+# ---------------------------------------------------------------------------
+
+@register
+class ManualSpanFinish(Rule):
+    name = "manual-span-finish"
+    family = FAMILY_LIFECYCLE
+    summary = ("a tracing.manual_span() started in a function must be "
+               ".finish()ed there or escape (stored/passed/returned) — "
+               "an abandoned manual span records nothing and silently "
+               "drops a term from the request latency decomposition")
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for mod in project.modules:
+            if mod.scope_rel == "ray_tpu/util/tracing.py":
+                continue  # the implementation
+            # walk only functions that start a manual span (pre-indexed)
+            span_funcs = {cs.func for cs in mod.calls
+                          if cs.parts and cs.parts[-1] == "manual_span"}
+            if not span_funcs:
+                continue
+            for fi in mod.functions.values():
+                if fi.qualname not in span_funcs:
+                    continue
+                spans: Dict[str, int] = {}
+                for node in ast.walk(fi.node):
+                    if (isinstance(node, ast.Assign)
+                            and isinstance(node.value, ast.Call)
+                            and len(node.targets) == 1
+                            and isinstance(node.targets[0], ast.Name)):
+                        parts = dotted_parts(node.value.func)
+                        if parts and parts[-1] == "manual_span":
+                            spans.setdefault(node.targets[0].id,
+                                             node.lineno)
+                if not spans:
+                    continue
+                finished: Set[str] = set()
+                escaped: Set[str] = set()
+                for node in ast.walk(fi.node):
+                    if isinstance(node, ast.Call):
+                        parts = dotted_parts(node.func)
+                        if (parts and len(parts) == 2
+                                and parts[1] == "finish"
+                                and parts[0] in spans):
+                            finished.add(parts[0])
+                        # bare span passed into another call = handoff
+                        for a in list(node.args) + [
+                                kw.value for kw in node.keywords]:
+                            if isinstance(a, ast.Name) and a.id in spans:
+                                escaped.add(a.id)
+                    elif isinstance(node, (ast.Return, ast.Yield,
+                                           ast.YieldFrom)):
+                        v = getattr(node, "value", None)
+                        if v is not None:
+                            for n in ast.walk(v):
+                                if (isinstance(n, ast.Name)
+                                        and n.id in spans):
+                                    escaped.add(n.id)
+                    elif isinstance(node, ast.Assign):
+                        # stored onto an object / container / other name
+                        # (re-assignment of the span var itself is not an
+                        # escape)
+                        if any(not isinstance(t, ast.Name)
+                               for t in node.targets):
+                            for n in ast.walk(node.value):
+                                if (isinstance(n, ast.Name)
+                                        and n.id in spans):
+                                    escaped.add(n.id)
+                for name, line in sorted(spans.items()):
+                    if name in finished or name in escaped:
+                        continue
+                    yield self.finding(
+                        mod, line,
+                        f"manual span '{name}' is started but never "
+                        f".finish()ed in {fi.qualname}() and never "
+                        f"escapes — the span will not be recorded; "
+                        f"finish it in a finally: (error= on the "
+                        f"failure path) or hand it off")

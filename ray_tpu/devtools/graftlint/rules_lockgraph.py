@@ -1,0 +1,181 @@
+"""Global lock-order graph (family ``lockgraph``, ISSUE 15).
+
+The per-class ``lock-order-inversion`` rule (rules_locks) sees two
+locks of ONE class acquired in both orders. This rule merges every
+module's held->acquired pairs into one directed graph over qualified
+lock names — ``pkg.module.Class.attr`` for instance locks, the
+import-resolved fully-qualified name for module-level locks (so
+``from x import _lock`` references land on the same node as the
+definition) — and reports every cycle, with a witness (file:line) for
+each edge.
+
+That catches what the per-class view structurally cannot: a 3+-cycle
+inside one class (A->B, B->C, C->A never inverts any single pair), and
+cross-class/cross-module cycles through shared module-level locks.
+2-cycles whose edges both come from the same class are left to the
+per-class rule (same finding, better message).
+
+Edges come from direct lexical nesting only (``with a: ... with b:``),
+the same evidence the engine already collects — call-through edges stay
+per-class where the self-call graph is reliable.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from ray_tpu.devtools.graftlint.engine import ModuleIndex, Project
+from ray_tpu.devtools.graftlint.model import (
+    FAMILY_LOCKGRAPH,
+    Finding,
+    Rule,
+    register,
+)
+
+#: edge value: (display path, line, owning-class name or "")
+_Witness = Tuple[str, int, str]
+
+
+def _qualify(key: str, mod: ModuleIndex, class_name: str) -> str:
+    if key.startswith("self."):
+        return f"{mod.module_name}.{class_name}{key[4:]}"
+    if "." not in key:
+        # module-level lock: resolve through imports so the defining
+        # module and its importers share one node
+        fq = mod.imports.get(key)
+        return fq if fq else f"{mod.module_name}.{key}"
+    # x.y.lock style: scope to the module (no reliable cross-module
+    # identity for attribute paths)
+    return f"{mod.module_name}:{key}"
+
+
+def _edges(project: Project) -> Dict[str, Dict[str, _Witness]]:
+    adj: Dict[str, Dict[str, _Witness]] = {}
+    for mod in project.modules:
+        sources = [("", mod.lock_pairs)]
+        sources += [(ci.name, ci.lock_pairs)
+                    for ci in mod.classes.values()]
+        for cname, pairs in sources:
+            for outer, inner, line, _via in pairs:
+                if outer == inner:
+                    continue  # re-entrant acquire, not an ordering edge
+                a = _qualify(outer, mod, cname)
+                b = _qualify(inner, mod, cname)
+                if a == b:
+                    continue
+                adj.setdefault(a, {}).setdefault(
+                    b, (mod.display, line, cname))
+    return adj
+
+
+def _sccs(adj: Dict[str, Dict[str, _Witness]]) -> List[List[str]]:
+    """Iterative Tarjan; returns SCCs with >= 2 nodes."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Dict[str, bool] = {}
+    stack: List[str] = []
+    out: List[List[str]] = []
+    counter = [0]
+
+    for root in sorted(adj):
+        if root in index:
+            continue
+        work = [(root, iter(sorted(adj.get(root, ()))))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack[root] = True
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack[w] = True
+                    work.append((w, iter(sorted(adj.get(w, ())))))
+                    advanced = True
+                    break
+                if on_stack.get(w):
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+            if low[v] == index[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack[w] = False
+                    comp.append(w)
+                    if w == v:
+                        break
+                if len(comp) > 1:
+                    out.append(sorted(comp))
+    return out
+
+
+def _shortest_cycle(adj: Dict[str, Dict[str, _Witness]],
+                    comp: List[str]) -> Optional[List[str]]:
+    """Shortest cycle through comp[0], edges restricted to the SCC."""
+    nodes = set(comp)
+    start = comp[0]
+    prev: Dict[str, Optional[str]] = {start: None}
+    frontier = [start]
+    while frontier:
+        nxt: List[str] = []
+        for v in frontier:
+            for w in sorted(adj.get(v, ())):
+                if w not in nodes:
+                    continue
+                if w == start:
+                    path = [v]
+                    while prev[path[-1]] is not None:
+                        path.append(prev[path[-1]])
+                    return path[::-1] + [start]
+                if w not in prev:
+                    prev[w] = v
+                    nxt.append(w)
+        frontier = nxt
+    return None
+
+
+@register
+class GlobalLockOrder(Rule):
+    name = "global-lock-order"
+    family = FAMILY_LOCKGRAPH
+    summary = ("the whole-program held->acquired lock graph must be "
+               "acyclic — any cycle (including 3+-cycles and cross-"
+               "module cycles invisible to the per-class inversion "
+               "rule) is a deadlock candidate; reported with a witness "
+               "acquisition site per edge")
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        adj = _edges(project)
+        for comp in _sccs(adj):
+            cycle = _shortest_cycle(adj, comp)
+            if cycle is None:  # pragma: no cover - SCC>1 implies a cycle
+                continue
+            edges = [(cycle[i], cycle[i + 1],
+                      adj[cycle[i]][cycle[i + 1]])
+                     for i in range(len(cycle) - 1)]
+            classes = {(w[0], w[2]) for _, _, w in edges}
+            if len(edges) == 2 and len(classes) == 1 and edges[0][2][2]:
+                # plain two-lock inversion inside one class: the
+                # per-class rule owns that finding
+                continue
+            desc = "; ".join(
+                f"{a.rsplit('.', 1)[-1]} -> {b.rsplit('.', 1)[-1]} "
+                f"({w[0]}:{w[1]})" for a, b, w in edges)
+            first = edges[0][2]
+            mod = next(m for m in project.modules
+                       if m.display == first[0])
+            yield self.finding(
+                mod, first[1],
+                f"lock-order cycle across "
+                f"{len({n for a, b, _ in edges for n in (a, b)})} locks: "
+                f"{desc} — inconsistent global order deadlocks under "
+                f"contention; pick one order")

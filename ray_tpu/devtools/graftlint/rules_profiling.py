@@ -93,6 +93,13 @@ class ProfilerSamplerDiscipline(Rule):
 
     def check(self, project: Project) -> Iterator[Finding]:
         for mod in project.modules:
+            # pre-indexed gate: sampler scopes exist only in modules
+            # with a *Sampler* class or a _sample_loop/_sample_once
+            # function — skip the two full-module walks everywhere else
+            if not (any("Sampler" in c for c in mod.classes)
+                    or any(fi.name in _SAMPLER_FUNCS
+                           for fi in mod.functions.values())):
+                continue
             timed = _timed_lock_attrs(mod.tree)
             seen_lines: Set[int] = set()
             for scope in _sampler_scopes(mod.tree):

@@ -201,6 +201,11 @@ class TracingContextCapture(Rule):
         for mod in project.modules:
             if mod.scope_rel == TRACING_MOD:
                 continue
+            # gate: both findings need the tracing module or a span
+            # callable in scope — skip the full-module walk elsewhere
+            if not ("tracing" in mod.imports or "span" in mod.imports
+                    or "span" in mod.functions):
+                continue
             for node in ast.walk(mod.tree):
                 if isinstance(node, (ast.With, ast.AsyncWith)):
                     if not any(

@@ -366,6 +366,42 @@ def _cmd_stack(args) -> int:
     return 0
 
 
+def _cmd_list(args) -> int:
+    """``rtpu list actors|pgs`` — dump the cluster GCS actor /
+    placement-group directories (reference ``ray list actors`` role;
+    these are the CLI senders for the ``actor_list`` / ``pg_list``
+    RPCs the graftlint protocol family tracks)."""
+    from ray_tpu.cluster.rpc import RpcClient
+
+    def _hex(v, n=32):
+        return v.hex()[:n] if isinstance(v, bytes) else str(v or "-")[:n]
+
+    cli = RpcClient(args.address, args.authkey.encode())
+    try:
+        if args.what == "actors":
+            recs = cli.call("actor_list", timeout=30) or {}
+            print(f"{'ACTOR_ID':34} {'STATE':10} {'NODE':18} NAME/CLASS")
+            for aid, rec in sorted(recs.items(), key=lambda kv: _hex(kv[0])):
+                label = (rec.get("name") or rec.get("class_name")
+                         or rec.get("cls") or "-")
+                print(f"{_hex(aid):34} {str(rec.get('state', '-')):10} "
+                      f"{_hex(rec.get('node_id'), 16):18} {label}")
+            print(f"-- {len(recs)} actor(s)")
+        else:
+            recs = cli.call("pg_list", timeout=30) or {}
+            print(f"{'PG_ID':34} {'STRATEGY':12} {'BUNDLES':>7} ASSIGNED")
+            for pid, rec in sorted(recs.items(), key=lambda kv: _hex(kv[0])):
+                assignments = rec.get("assignments") or []
+                assigned = sum(1 for a in assignments if a)
+                print(f"{_hex(pid):34} {str(rec.get('strategy', '-')):12} "
+                      f"{len(rec.get('bundles') or []):>7} "
+                      f"{assigned}/{len(assignments)}")
+            print(f"-- {len(recs)} placement group(s)")
+    finally:
+        cli.close()
+    return 0
+
+
 def _cmd_clean(args) -> int:
     import glob
 
@@ -416,6 +452,13 @@ def main(argv=None) -> int:
                      help="fetch from a running head's dashboard "
                           "(http://host:8265) instead of in-process")
     mem.add_argument("--limit", type=int, default=10000)
+
+    ls = sub.add_parser("list", help="list cluster actors / placement "
+                                     "groups from the GCS directories")
+    ls.add_argument("what", choices=["actors", "pgs"])
+    ls.add_argument("--address", required=True,
+                    help="GCS address host:port")
+    ls.add_argument("--authkey", default="", help="cluster authkey")
 
     st = sub.add_parser("stack", help="dump python stacks of live "
                                       "ray_tpu processes (py-spy role)")
@@ -492,6 +535,8 @@ def main(argv=None) -> int:
         return _cmd_timeline(args)
     if args.cmd == "memory":
         return _cmd_memory(args)
+    if args.cmd == "list":
+        return _cmd_list(args)
     if args.cmd == "stack":
         return _cmd_stack(args)
     if args.cmd == "profile":

@@ -1,0 +1,23 @@
+# graftlint: path=ray_tpu/core/foo.py
+"""Positive fixture: a lock-order cycle between a module-level function
+and a class method — invisible to the per-class inversion rule (the two
+acquisition sites live in different scopes), caught only by the merged
+global graph."""
+
+import threading
+
+_pump_lock = threading.Lock()
+_state_lock = threading.Lock()
+
+
+def pump():
+    with _pump_lock:
+        with _state_lock:
+            pass
+
+
+class Flusher:
+    def flush(self):
+        with _state_lock:
+            with _pump_lock:
+                pass

@@ -1,0 +1,21 @@
+# graftlint: path=ray_tpu/core/foo.py
+"""Negative fixture: every scope acquires in the same global order —
+the graph is acyclic, no finding."""
+
+import threading
+
+_pump_lock = threading.Lock()
+_state_lock = threading.Lock()
+
+
+def pump():
+    with _pump_lock:
+        with _state_lock:
+            pass
+
+
+class Flusher:
+    def flush(self):
+        with _pump_lock:
+            with _state_lock:
+                pass

@@ -1,0 +1,22 @@
+# graftlint: path=ray_tpu/core/foo.py
+"""Negative fixture FOR THIS RULE: a plain two-lock inversion inside one
+class is the per-class lock-order-inversion rule's finding (better
+message, same deadlock) — the global rule must not duplicate it."""
+
+import threading
+
+
+class Engine:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.io_lock = threading.Lock()
+
+    def submit(self):
+        with self.lock:
+            with self.io_lock:
+                pass
+
+    def drain(self):
+        with self.io_lock:
+            with self.lock:
+                pass

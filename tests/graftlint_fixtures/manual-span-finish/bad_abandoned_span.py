@@ -1,0 +1,11 @@
+# graftlint: path=ray_tpu/serve/foo.py
+"""Positive fixture: a manual span started and then abandoned must fire
+— nothing ever records it, so the request latency decomposition
+silently loses a term (worse than crashing)."""
+
+from ray_tpu.util import tracing
+
+
+def handle(req):
+    ms = tracing.manual_span("serve.foo::request", {"route": req.route})
+    return req.execute()

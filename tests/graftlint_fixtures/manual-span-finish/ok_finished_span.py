@@ -1,0 +1,26 @@
+# graftlint: path=ray_tpu/serve/foo.py
+"""Negative fixture: finished and handed-off manual spans are clean —
+finish-in-finally (with the None guard for disabled tracing), storage
+onto an object the caller finishes, and pass-through to a consumer."""
+
+from ray_tpu.util import tracing
+
+
+def handle(req):
+    ms = tracing.manual_span("serve.foo::request", {"route": req.route})
+    try:
+        return req.execute()
+    finally:
+        if ms is not None:
+            ms.finish()
+
+
+def start_stream(req):
+    span = tracing.manual_span("serve.foo::stream")
+    req.span = span  # the request teardown path finishes it
+    return req
+
+
+def enqueue(req, sink):
+    pending = tracing.manual_span("serve.foo::queue")
+    sink.admit(req, pending)

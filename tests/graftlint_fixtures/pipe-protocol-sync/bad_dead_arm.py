@@ -1,0 +1,17 @@
+# graftlint: path=ray_tpu/core/runtime.py
+"""Positive fixture: a dispatch arm for an op that is not in PIPE_CASTS
+must fire — the regression shape of the r14 leftover ``refpin`` arm
+removed by ISSUE 15 (single-transition casts were replaced by the
+batched ``refpins`` op)."""
+
+
+class Runtime:
+    def worker_ref_delta(self, ws, oid, d):
+        raise NotImplementedError
+
+    def _handle_cast(self, ws, op, args):
+        if op == "refpin":
+            self.worker_ref_delta(ws, args[0], args[1])
+        elif op == "refpins":
+            for oid_b, d in args[0]:
+                self.worker_ref_delta(ws, oid_b, d)

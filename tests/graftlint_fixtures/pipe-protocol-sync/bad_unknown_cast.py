@@ -1,0 +1,11 @@
+# graftlint: path=ray_tpu/core/worker.py
+"""Positive fixture: a worker cast op absent from PIPE_CASTS in
+core/protocol.py must fire (typo'd/uncataloged pipe vocabulary)."""
+
+
+class WorkerRuntime:
+    def cast(self, op, *args):
+        raise NotImplementedError
+
+    def report(self, stats):
+        self.cast("frobnicate", stats)

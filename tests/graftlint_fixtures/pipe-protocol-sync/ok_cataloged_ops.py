@@ -1,0 +1,24 @@
+# graftlint: path=ray_tpu/core/worker.py
+"""Negative fixture: cataloged pipe ops (PIPE_CASTS / PIPE_REQS /
+PIPE_WORKER_MSGS) are clean, including the tuple-send and IfExp-coalesce
+shapes the extractor must see through."""
+
+
+class WorkerRuntime:
+    def cast(self, op, *args):
+        raise NotImplementedError
+
+    def request(self, op, *args):
+        raise NotImplementedError
+
+    def put(self, value):
+        self.cast("put", value)
+
+    def get(self, oid):
+        return self.request("get", oid)
+
+    def _flush(self, batch):
+        self.conn.send(batch[0] if len(batch) == 1 else ("batch", batch))
+
+    def _hello(self, wid):
+        self.conn.send(("hello", wid))

@@ -1,0 +1,14 @@
+# graftlint: path=ray_tpu/serve/foo.py
+"""Positive fixture: a failure exit after ``pool.alloc()`` without
+releasing the claim must fire — an un-admitted request holding blocks
+leaks pool capacity until process death."""
+
+
+def admit(pool, req):
+    fresh = pool.alloc(4)
+    if fresh is None:
+        return False
+    if req.deadline_passed:
+        return False  # leaks the 4 claimed blocks
+    req.table = fresh
+    return True

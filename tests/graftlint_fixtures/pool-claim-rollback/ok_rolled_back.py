@@ -1,0 +1,32 @@
+# graftlint: path=ray_tpu/serve/foo.py
+"""Negative fixture: every failure exit either follows a release (the
+roll_back-closure shape of llm._claim_blocks counts — the release is
+lexically inside the claim..exit interval) or sits on the claim-failed
+branch (``if fresh is None:``), where nothing is held."""
+
+
+def admit(pool, req):
+    fresh = pool.alloc(4)
+    if fresh is None:
+        return False
+    if req.deadline_passed:
+        pool.release_all(fresh)
+        return False
+    req.table = fresh
+    return True
+
+
+def admit_with_rollback(pool, trie, req):
+    blocks, matched, cow = trie.match(req.prompt)
+    fresh = pool.alloc(4 - len(blocks))
+
+    def roll_back():
+        pool.release_all(blocks)
+        if cow is not None:
+            pool.release(cow)
+
+    if fresh is None:
+        roll_back()
+        return False
+    req.table = blocks + fresh
+    return True

@@ -1,0 +1,12 @@
+# graftlint: path=ray_tpu/cluster/gcs_server.py
+"""Positive fixture: publishing a channel absent from PUBSUB_CHANNELS
+must fire — nobody can be subscribed to a topic the catalog does not
+know, so the payload vanishes."""
+
+
+class GcsServer:
+    def _publish(self, channel, payload):
+        raise NotImplementedError
+
+    def on_weather(self, payload):
+        self._publish("weather", payload)

@@ -1,0 +1,20 @@
+# graftlint: path=ray_tpu/cluster/foo.py
+"""Negative fixture: cataloged channels are clean — via _publish, the
+publish/subscribe RPCs, and a module-constant channel name (the
+util/tracing.py shape the extractor must resolve)."""
+
+CHANNEL = "tracing"
+
+
+class Plane:
+    def _publish(self, channel, payload):
+        raise NotImplementedError
+
+    def announce(self, payload):
+        self._publish("nodes", payload)
+
+    def push(self, gcs, payload):
+        gcs.call("publish", CHANNEL, payload)
+
+    def attach(self, gcs):
+        gcs.call("subscribe", "objects")

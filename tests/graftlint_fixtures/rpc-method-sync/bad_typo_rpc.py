@@ -1,0 +1,8 @@
+# graftlint: path=ray_tpu/cluster/foo.py
+"""Positive fixture: an RPC literal that names no cataloged GCS or peer
+method (here a typo of ``actor_list``) must fire — it would fail at
+runtime with method-not-found."""
+
+
+def dump_actors(gcs):
+    return gcs.call("actor_lst")

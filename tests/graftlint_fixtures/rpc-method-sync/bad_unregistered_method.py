@@ -1,0 +1,9 @@
+# graftlint: path=ray_tpu/cluster/gcs_server.py
+"""Positive fixture: a ``rpc_*`` method not in the GCS_RPC catalog must
+fire — the catalog is the review surface for wire-protocol changes, so
+a new method lands as a protocol.py diff hunk alongside the code."""
+
+
+class GcsServer:
+    def rpc_frobnicate(self, ctx):
+        return None

@@ -1,0 +1,21 @@
+# graftlint: path=ray_tpu/cluster/foo.py
+"""Negative fixture: cataloged RPC literals are clean — including the
+indirect-sender shapes (method literal at arg index 1) and the dynamic
+``"kv_" + op`` dispatch (cataloged via GCS_RPC_DYNAMIC_PREFIXES, so the
+extractor must not flag the non-literal first argument)."""
+
+
+def dump_actors(gcs):
+    return gcs.call("actor_list")
+
+
+def reserve(self, nid, spec):
+    return self._pg_call(nid, "pg_prepare", spec)
+
+
+def kv_op(gcs, op, *args):
+    return gcs.call("kv_" + op, *args)
+
+
+def forward(self, peer, spec):
+    return self._call_with_attempt(peer, "submit_spec", spec)

@@ -1,0 +1,11 @@
+# graftlint: path=ray_tpu/serve/foo.py
+"""Positive fixture: creating a shm ring whose name does not derive
+from the runtime session id must fire — the shutdown sweep globs
+``rtpu-chan-<session>-*``, so this segment leaks forever if the
+creating process dies uncleanly."""
+
+from ray_tpu.experimental.channel import Channel
+
+
+def make_ring():
+    return Channel("scratch-ring", capacity=1024, create=True)

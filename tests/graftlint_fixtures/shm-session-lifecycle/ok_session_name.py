@@ -1,0 +1,32 @@
+# graftlint: path=ray_tpu/serve/foo.py
+"""Negative fixture: session-derived channel names are clean — through
+transitive local dataflow (uid -> name), the aliased-class shape
+(``cls = DeviceChannel if ... else Channel``), a same-module helper
+function, and the attach side (create=False needs no sweep scope)."""
+
+import uuid
+
+from ray_tpu.experimental.channel import Channel
+from ray_tpu.experimental.device_channel import DeviceChannel
+
+
+def ring_name(src: str) -> str:
+    from ray_tpu import get_runtime_context
+
+    session = get_runtime_context().get_session_id()
+    return f"{session}-kvx-{src}"
+
+
+def make_rings(session_id: str, device: bool):
+    uid = f"{session_id}-{uuid.uuid4().hex[:8]}"
+    name = f"{uid}-0"
+    cls = DeviceChannel if device else Channel
+    return cls(name, capacity=1024, create=True)
+
+
+def make_helper_ring(src: str):
+    return DeviceChannel(ring_name(src), capacity=1024, create=True)
+
+
+def attach_ring(name: str):
+    return Channel(name, create=False)

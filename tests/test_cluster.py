@@ -494,7 +494,13 @@ def test_pg_node_death_releases_and_reschedules(cluster):
 def _wait_nodes(n, timeout=15):
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
-        if len([x for x in ray_tpu.nodes() if x["Alive"]]) >= n:
+        try:
+            alive = [x for x in ray_tpu.nodes() if x["Alive"]]
+        except ConnectionError:
+            # transient GCS connection drop under suite load; the client
+            # reconnects and the next poll succeeds
+            alive = []
+        if len(alive) >= n:
             return
         time.sleep(0.2)
     raise AssertionError(f"cluster did not reach {n} nodes")
@@ -823,7 +829,11 @@ def test_locality_aware_scheduling(cluster):
     rt = _get_runtime()
     deadline = time.monotonic() + 90
     while time.monotonic() < deadline:
-        st = rt.cluster.gcs.call("obj_state", ref.id.binary(), timeout=10)
+        try:
+            st = rt.cluster.gcs.call("obj_state", ref.id.binary(),
+                                     timeout=10)
+        except ConnectionError:
+            st = None  # transient drop under suite load; poll again
         if st is not None and st["status"] == "READY":
             break
         time.sleep(0.2)
@@ -1162,7 +1172,11 @@ def test_broadcast_replicates_via_relay_tree(cluster):
     rt = _get_runtime()
     deadline = time.monotonic() + 60
     while time.monotonic() < deadline:
-        st = rt.cluster.gcs.call("obj_state", ref.id.binary(), timeout=10)
+        try:
+            st = rt.cluster.gcs.call("obj_state", ref.id.binary(),
+                                     timeout=10)
+        except ConnectionError:
+            st = None  # transient drop under suite load; poll again
         if st and len(st.get("locations") or ()) >= 4:  # head + 3 daemons
             break
         time.sleep(0.3)

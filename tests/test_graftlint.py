@@ -224,3 +224,84 @@ def test_catalog_lists_every_rule():
     table = catalog.markdown_table()
     for rule in graftlint.all_rules():
         assert f"`{rule.name}`" in table
+
+
+# ---------------------------------------------------------------------------
+# model cache (ISSUE 15): cold/warm/no-cache parity, stat-keyed invalidation
+# ---------------------------------------------------------------------------
+
+def _span_leak_src():
+    return (
+        "from ray_tpu.util import tracing\n\n\n"
+        "def handler():\n"
+        "    sp = tracing.manual_span('serve::probe')\n"
+        "    return 1\n")
+
+
+def test_cache_parity_and_invalidation(tmp_path):
+    """With ``root`` given, findings must be byte-identical across a
+    cold run (populates .graftlint_cache/), a warm run (served from it),
+    and a ``cache=False`` run — and editing a file must invalidate its
+    entry (the key is (path, mtime_ns, size))."""
+    import shutil
+
+    core = tmp_path / "ray_tpu" / "core"
+    core.mkdir(parents=True)
+    for name in ("worker.py", "protocol.py"):
+        shutil.copy(ROOT / "ray_tpu" / "core" / name, core / name)
+    serve = tmp_path / "ray_tpu" / "serve"
+    serve.mkdir()
+    leaky = serve / "probe.py"
+    leaky.write_text(_span_leak_src())
+
+    paths = [tmp_path / "ray_tpu"]
+    cold = [f.render() for f in graftlint.lint(paths, root=tmp_path)]
+    cache_dir = tmp_path / ".graftlint_cache"
+    assert cache_dir.is_dir() and list(cache_dir.glob("*.pkl")), (
+        "cold lint with root= did not populate the model cache")
+    warm = [f.render() for f in graftlint.lint(paths, root=tmp_path)]
+    raw = [f.render() for f in
+           graftlint.lint(paths, root=tmp_path, cache=False)]
+    assert cold == warm == raw, (cold, warm, raw)
+    assert any("manual-span-finish" in line for line in cold), (
+        "parity test lost its known finding — the fixture file no "
+        "longer trips manual-span-finish", cold)
+
+    # editing the file must bust its cache entry, not serve stale model
+    leaky.write_text(_span_leak_src().replace(
+        "    return 1", "    sp.finish()\n    return 1"))
+    fixed = [f.render() for f in graftlint.lint(paths, root=tmp_path)]
+    assert not any("manual-span-finish" in line for line in fixed), fixed
+
+
+def test_cache_never_engages_without_root(tmp_path):
+    p = tmp_path / "mod.py"
+    p.write_text("x = 1\n")
+    graftlint.lint([p])
+    assert not (tmp_path / ".graftlint_cache").exists()
+
+
+# ---------------------------------------------------------------------------
+# protocol catalog drift (ISSUE 15): removing a cataloged op must fail
+# ---------------------------------------------------------------------------
+
+def test_protocol_catalog_drift_is_flagged(tmp_path):
+    """Dropping 'put' from PIPE_CASTS in core/protocol.py while
+    worker.py still casts it must produce pipe-protocol-sync findings on
+    both sides of the wire (sender drift + now-uncataloged arm)."""
+    import shutil
+
+    core = tmp_path / "ray_tpu" / "core"
+    core.mkdir(parents=True)
+    for name in ("worker.py", "runtime.py", "protocol.py"):
+        shutil.copy(ROOT / "ray_tpu" / "core" / name, core / name)
+    cat = core / "protocol.py"
+    src = cat.read_text()
+    assert '"put",' in src
+    cat.write_text(src.replace('"put",', "", 1))
+
+    findings = graftlint.lint([tmp_path / "ray_tpu"],
+                              rules=["pipe-protocol-sync"])
+    msgs = [f.render() for f in findings
+            if f.rule == "pipe-protocol-sync"]
+    assert any("'put'" in m for m in msgs), msgs

@@ -24,6 +24,17 @@ What the families guard (CLAUDE.md "Architecture invariants"):
                   and documented.
 - ``meta``        every inline suppression names a real rule and
                   carries a reason (no silent baselines).
+- ``protocol``    the wire vocabularies (worker pipe casts/reqs/frame
+                  kinds, GCS + peer RPC methods, pubsub topics) agree
+                  three ways: senders, dispatch arms, and the
+                  checked-in core/protocol.py catalog (ISSUE 15).
+- ``lifecycle``   session-scoped resources are reclaimable: shm rings
+                  session-named for the shutdown sweep, BlockPool
+                  claims rolled back on failure exits, manual spans
+                  finished or handed off.
+- ``lockgraph``   the merged whole-program held->acquired lock graph
+                  is acyclic (3+-cycles and cross-module cycles the
+                  per-class inversion rule cannot see).
 """
 
 from pathlib import Path
@@ -116,3 +127,38 @@ def test_suppression_hygiene(tree_findings):
     _assert_clean(
         tree_findings, "meta",
         "write '# graftlint: disable=<rule> -- <why this is safe>'")
+
+
+def test_wire_protocol_sync(tree_findings):
+    """Whole-program protocol drift (ISSUE 15): every pipe cast/req/
+    frame kind, GCS/peer RPC literal, and pubsub topic has a sender, a
+    dispatch arm, and a core/protocol.py catalog entry. A send without
+    a handler is a silently dropped message; a handler without a sender
+    is dead protocol (the r14 native migration left two)."""
+    _assert_clean(
+        tree_findings, "protocol",
+        "update ray_tpu/core/protocol.py in the same change as the "
+        "sender/handler — the catalog is the wire-protocol review "
+        "surface")
+
+
+def test_resource_lifecycle(tree_findings):
+    """Acquire/release symmetry for session-scoped resources: shm
+    rings created with session-derived names (the rtpu-chan-<session>-*
+    sweep must be able to reclaim them), pool.alloc claims released on
+    every failure exit, manual spans finished or handed off."""
+    _assert_clean(
+        tree_findings, "lifecycle",
+        "pair every acquire with a release on each exit path; see the "
+        "rule messages for the compliant in-tree pattern")
+
+
+def test_global_lock_order(tree_findings):
+    """The merged held->acquired lock graph over all modules is
+    acyclic — catches 3+-cycles inside one class and cross-module
+    cycles through shared module-level locks, which the per-class
+    inversion rule structurally cannot see."""
+    _assert_clean(
+        tree_findings, "lockgraph",
+        "pick one global acquisition order (each edge in the reported "
+        "cycle carries its witness file:line)")

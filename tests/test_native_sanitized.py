@@ -1,0 +1,191 @@
+"""Sanitizer lane for the native plane (ISSUE 15).
+
+``make -C native sanitize`` builds ASan+UBSan and TSan variants of
+librtpu_store.so and runs the two C stress harnesses. This lane closes
+the remaining gap: the *Python-facing* surface — ctypes marshaling,
+buffer lifetimes, the id padding contract, drain-buffer reuse — runs
+against the instrumented .so, so an out-of-bounds read the plain build
+silently tolerates aborts the child here.
+
+Mechanics: the ASan runtime must be in the process before the .so loads,
+so the exercise runs in a child interpreter with LD_PRELOADed libasan
+and ``RTPU_NATIVE_SO`` pointed at the instrumented artifact (the loader
+override added for exactly this lane). Leak checking stays off: the
+CopyPool and its detached workers are intentionally leaked (pipe.cc).
+
+Slow-marked: the default `make test` lane skips it; `pytest -m slow
+tests/test_native_sanitized.py` (or plain pytest on the file) runs it.
+"""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+ROOT = Path(__file__).resolve().parents[1]
+NATIVE = ROOT / "native"
+ASAN_SO = NATIVE / "build" / "librtpu_store_asan.so"
+
+pytestmark = pytest.mark.slow
+
+# What the child runs: every Python wrapper over the native API, against
+# real shm + a real socketpair. Assertions are correctness checks; the
+# point is that ASan/UBSan watch every native byte they touch.
+_CHILD = r"""
+import os, socket, sys
+
+from ray_tpu import _native
+
+st = _native.native_status()
+assert st["override"] and st["so_path"].endswith("librtpu_store_asan.so"), st
+assert st["loaded"] and st["pipe"] and st["lz4"] and not st["stale"], st
+
+# -- arena: create/seal/get/release/delete + eviction + frag stats ----------
+_native.NativeArena.destroy("san-lane")
+arena = _native.NativeArena("san-lane", capacity=8 << 20)
+try:
+    for i in range(16):
+        oid = b"obj-%03d" % i
+        mv = arena.create(oid, 32 * 1024)
+        assert mv is not None
+        mv[:] = bytes([i]) * len(mv)
+        arena.seal(oid)
+        got = arena.get(oid)
+        assert got is not None and bytes(got[:8]) == bytes([i]) * 8
+        del got
+        arena.release(oid)
+        arena.release(oid)  # drop the create ref too: evictable
+    stats = arena.stats()
+    assert stats["num_objects"] == 16, stats
+    arena.delete(b"obj-000")
+    assert not arena.contains(b"obj-000")
+    assert arena.contains(b"obj-001")
+    arena.evict(1 << 20)
+    arena.frag_stats()
+finally:
+    arena.close()
+    _native.NativeArena.destroy("san-lane")
+
+# -- pipe engine: send/drain/refpins/drain_pins/stats/close -----------------
+a, b = socket.socketpair()
+tx = _native.NativePipe(a.fileno())
+rx = _native.NativePipe(b.fileno())
+try:
+    msgs = [b"\x80" + bytes([i]) * (100 + 37 * i) for i in range(64)]
+    for m in msgs:
+        assert tx.send(m)
+    got = []
+    while len(got) < len(msgs):
+        recs = rx.drain(timeout=2.0)
+        assert recs is not None, "unexpected EOF"
+        for typ, payload in recs:
+            assert typ == _native.REC_MSG
+            got.append(payload)
+    assert got == msgs
+
+    # oversized record: exercises the grow-and-retry drain path
+    big = b"\x80" + os.urandom(3 << 20)
+    assert tx.send(big)
+    recs = []
+    while not recs:
+        recs = rx.drain(timeout=2.0)
+    assert recs == [(0, big)]
+
+    # refpin frame -> native borrow table -> transitions + drain_pins
+    oid = b"p" * 16
+    assert tx.send(b"RTP1" + oid + b"\x01")
+    recs = []
+    while not recs:
+        recs = rx.drain(timeout=2.0)
+    assert recs == [(_native.REC_REFPINS, oid + b"\x01")], recs
+    assert rx.drain_pins() == [(oid, 1)]
+    assert rx.drain_pins() == []
+
+    st_tx, st_rx = tx.stats(), rx.stats()
+    assert st_tx["sent_msgs"] == len(msgs) + 2, st_tx
+    assert st_rx["recv_msgs"] == len(msgs) + 1, st_rx
+    assert st_rx["refpin_deltas"] == 1, st_rx
+finally:
+    tx.close()
+    rx.close()
+    a.close()
+    b.close()
+
+# -- data plane: parallel_copy + lz4 wrappers -------------------------------
+src = bytearray(os.urandom(2 << 20))
+dst = bytearray(len(src))
+assert _native.parallel_copy(dst, src, threads=2) == len(src)
+assert dst == src
+
+for raw in (b"", b"abc", bytes(range(256)) * 64, os.urandom(1 << 16)):
+    comp = _native.lz4_compress(raw)
+    assert comp is not None
+    assert _native.lz4_decompress(comp, len(raw)) == raw
+    out = bytearray(len(raw) or 1)
+    if raw:
+        assert _native.lz4_decompress_into(comp, out) == len(raw)
+        assert bytes(out) == raw
+try:
+    _native.lz4_decompress(b"\x1fAAA\xff\xff", 64)
+except ValueError:
+    pass
+else:
+    raise AssertionError("malformed lz4 block must raise")
+
+print("SANITIZED-LANE-OK")
+"""
+
+
+def _libasan_path():
+    try:
+        out = subprocess.run(
+            ["gcc", "-print-file-name=libasan.so"],
+            capture_output=True, text=True, timeout=30, check=True,
+        ).stdout.strip()
+    except Exception:
+        return None
+    return out if out and os.path.sep in out else None
+
+
+def test_python_surface_under_asan():
+    libasan = _libasan_path()
+    if libasan is None:
+        pytest.skip("libasan not resolvable via gcc")
+    if not ASAN_SO.exists():
+        build = subprocess.run(
+            ["make", "-C", str(NATIVE), "-s",
+             f"build/{ASAN_SO.name}"],
+            capture_output=True, text=True, timeout=300)
+        assert build.returncode == 0, build.stderr
+    env = dict(os.environ)
+    env.update({
+        "LD_PRELOAD": libasan,
+        "RTPU_NATIVE_SO": str(ASAN_SO),
+        # halt_on_error is the default; leaks are designed (CopyPool)
+        "ASAN_OPTIONS": "detect_leaks=0",
+        # skip the background arena prefault: the lane times child exit,
+        # and the prefault thread adds nothing the harness doesn't cover
+        "RTPU_WORKER": "1",
+        "JAX_PLATFORMS": "cpu",
+    })
+    proc = subprocess.run(
+        [sys.executable, "-c", _CHILD], env=env, cwd=str(ROOT),
+        capture_output=True, text=True, timeout=300)
+    tail = (proc.stdout + "\n" + proc.stderr)[-4000:]
+    assert proc.returncode == 0, f"sanitized child failed:\n{tail}"
+    assert "SANITIZED-LANE-OK" in proc.stdout, tail
+    assert "ERROR: AddressSanitizer" not in proc.stderr, tail
+    assert "runtime error:" not in proc.stderr, tail
+
+
+def test_sanitize_artifacts_fresh_enough():
+    """`make -C native sanitize` must keep building both .so variants —
+    a missing TSan artifact after the ASan lane ran means the target
+    rotted. Cheap existence check only (the full gate is the Makefile)."""
+    if not ASAN_SO.exists():
+        pytest.skip("sanitize artifacts not built in this checkout")
+    assert (NATIVE / "build" / "librtpu_store_tsan.so").exists(), (
+        "ASan .so present but TSan .so missing — `make -C native "
+        "sanitize` builds BOTH; the target or its deps regressed")
