@@ -30,6 +30,7 @@ Prints ONE JSON line (the bench.py contract).
 from __future__ import annotations
 
 import argparse
+import hashlib as _hash
 import json
 
 import os
@@ -68,6 +69,12 @@ class TraceConfig:
     # collapse and disaggregation win. 0 disables.
     long_every: int = 0
     long_prompt_tokens: int = 0
+    # multi-model salt (ISSUE 16): each request addresses one of
+    # n_models models, drawn Zipf(zipf_alpha) — the skew that makes
+    # multiplexing win (the hot model spreads over every replica while
+    # dedicated deployments strand their cold engines). 0 disables.
+    n_models: int = 0
+    zipf_alpha: float = 1.5
 
 
 @dataclass
@@ -76,6 +83,7 @@ class Request:
     tenant: int
     prompt: List[int]
     max_new: int
+    model_id: Optional[str] = None
 
 
 def iter_trace(cfg: TraceConfig) -> Iterator[Request]:
@@ -89,6 +97,11 @@ def iter_trace(cfg: TraceConfig) -> Iterator[Request]:
     rng = np.random.default_rng(cfg.seed)
     prefixes = [rng.integers(0, cfg.vocab, cfg.shared_prefix_tokens)
                 .tolist() for _ in range(cfg.n_tenants)]
+    model_p = None
+    if cfg.n_models > 0:
+        w = np.array([1.0 / (r + 1) ** cfg.zipf_alpha
+                      for r in range(cfg.n_models)])
+        model_p = w / w.sum()
     t = 0.0
     in_burst_left = cfg.burst_len_s
     for i in range(cfg.n_requests):
@@ -111,7 +124,10 @@ def iter_trace(cfg: TraceConfig) -> Iterator[Request]:
                 n_suffix = min(n_suffix, cfg.long_prompt_tokens - 1)
         prompt = prefixes[tenant] + rng.integers(
             0, cfg.vocab, n_suffix).tolist()
-        yield Request(t, tenant, prompt, max_new=cfg.max_new_tokens)
+        mid = (f"m{int(rng.choice(cfg.n_models, p=model_p))}"
+               if model_p is not None else None)
+        yield Request(t, tenant, prompt, max_new=cfg.max_new_tokens,
+                      model_id=mid)
 
 
 def gen_trace(cfg: TraceConfig) -> List[Request]:
@@ -444,6 +460,372 @@ def run_disagg_ab(scale: str = "quick", *, disagg: bool,
     return out
 
 
+def run_multiplex_ab(scale: str = "quick", *, dedicated: bool,
+                     n_models: int = 8, replicas: int = 2,
+                     speculative: bool = False,
+                     budget_models: int = 2, seed: int = 0,
+                     model: str = "llama-debug") -> Dict[str, Any]:
+    """Multi-model consolidation A/B (ISSUE 16): the SAME Zipf trace
+    over ``n_models`` models, the SAME fleet-wide weight budget of
+    ``replicas * budget_models`` resident model-slots, two ways of
+    spending it. The DEDICATED arm does what static allocation does:
+    deploys the Zipf-hottest models that fit the budget, one engine
+    each, and hard-sheds every request for a model it chose not to
+    host. The MULTIPLEX arm serves ALL ``n_models`` through
+    ``replicas`` multiplexed deployments whose registries page weights
+    in and out of the same per-replica budget on demand (LRU under
+    in-flight pinning) — the swap counters in the output are the proof
+    that the tail models were PAGED, not resident. Replay is
+    open-loop at ~75% of fleet capacity, so a shed request is lost
+    tokens at unchanged wall time, exactly what it is in production.
+
+    Routing in the multiplex arm is sticky-home (models greedy-packed
+    onto replicas by Zipf weight — steady traffic partitions the fleet
+    into full batches exactly like dedicated deployments would) with
+    budget-shed retries walking the other replicas and then waiting
+    for an in-flight pin to drain; eager least-inflight splitting
+    would fragment the hot model's batches on every request.
+
+    ``budget_models=0`` removes the budget from BOTH arms (dedicated
+    hosts all ``n_models``; the registry pages lazily but never
+    evicts) — the capacity-unconstrained control."""
+    from ray_tpu.serve.admission import RequestShedError
+    from ray_tpu.serve.llm import LLMDeployment
+    from ray_tpu.serve.multiplex import MultiplexedLLMDeployment
+    from ray_tpu.util.tpu_info import honor_jax_platform_env
+
+    honor_jax_platform_env()
+    cfg = _scale_trace(scale, seed)
+    cfg.n_models = n_models
+    cfg.zipf_alpha = 1.0
+    cfg.max_new_tokens = max(cfg.max_new_tokens, 32)
+    # steady open-loop arrivals at ~75% of measured fleet capacity
+    # (~700 tok/s on the 2-vCPU CI box): wall time is set by the
+    # ARRIVAL span, so the dedicated arm cannot convert its sheds into
+    # a shorter run — lost requests are lost tokens
+    cfg.n_requests = max(cfg.n_requests, 96)
+    cfg.burst_rps = 16.0
+    cfg.burst_len_s = 1e9        # steady Poisson, no off-gaps
+    model_ids = [f"m{i}" for i in range(n_models)]
+    fleet_slots = (replicas * budget_models if budget_models > 0
+                   else n_models)
+    kw = dict(max_slots=8, max_len=256, block_size=16, prefill_chunk=8)
+    lock = threading.Lock()
+    if dedicated:
+        # static allocation: one single-model deployment per hosted
+        # model, Zipf-hottest first, as many as the weight budget
+        # seats; per-model seeds match the multiplex arm's registry
+        # (identical weights per arm)
+        deps = {mid: LLMDeployment(model, seed=seed + i, **kw)
+                for i, mid in enumerate(model_ids[:fleet_slots])}
+        pools: List[Any] = list(deps.values())
+
+        def stream(req: Request) -> Iterable[int]:
+            dep = deps.get(req.model_id)
+            if dep is None:
+                raise RequestShedError(
+                    f"no deployment hosts {req.model_id!r} (fleet "
+                    f"weight budget seats {fleet_slots} models)",
+                    reason="model_budget")
+            return dep(req.prompt, req.max_new)
+
+        warm = [(dep, {}) for dep in deps.values()]
+    else:
+        spec = {mid: {"config": model, "seed": seed + i}
+                for i, mid in enumerate(model_ids)}
+        budget = None
+        if budget_models > 0:
+            import jax
+
+            from ray_tpu import models as M
+
+            c = M.get_config(model)
+            one = M.params_bytes(M.init_params(jax.random.PRNGKey(0), c))
+            budget = budget_models * one + 1
+        pools = [MultiplexedLLMDeployment(
+                     spec, budget_bytes=budget, speculative=speculative,
+                     spec_accept_floor=0.0 if speculative else None,
+                     seed=seed, **kw)
+                 for _ in range(replicas)]
+        w = [1.0 / (r + 1) ** cfg.zipf_alpha for r in range(n_models)]
+        packed = [0.0] * replicas
+        home: Dict[str, int] = {}
+        for i, mid in enumerate(model_ids):
+            j = packed.index(min(packed))
+            home[mid] = j
+            packed[j] += w[i]
+        counts = [0] * replicas
+
+        def _try(pick: int, req: Request):
+            return pools[pick](req.prompt, req.max_new,
+                               model_id=req.model_id)
+
+        def stream(req: Request) -> Iterable[int]:
+            # home first; on a model_budget shed walk the other
+            # replicas; when every registry is pinned full, wait for a
+            # stream to drain a pin and retry — the request queues for
+            # a model-slot instead of dying
+            deadline = time.monotonic() + 30.0
+            while True:
+                order = [home[req.model_id]] + [
+                    j for j in range(replicas)
+                    if j != home[req.model_id]]
+                shed: Optional[BaseException] = None
+                for pick in order:
+                    try:
+                        inner = _try(pick, req)
+                        break
+                    except RequestShedError as e:
+                        if getattr(e, "reason", "") != "model_budget":
+                            raise
+                        shed = e
+                else:
+                    if time.monotonic() > deadline:
+                        raise shed
+                    time.sleep(0.025)
+                    continue
+                break
+            with lock:
+                counts[pick] += 1
+
+            def gen() -> Iterator[int]:
+                try:
+                    yield from inner
+                finally:
+                    with lock:
+                        counts[pick] -= 1
+
+            return gen()
+
+        warm = [(rep, {"model_id": mid})
+                for rep in pools for mid in model_ids]
+    try:
+        first = next(iter_trace(cfg))
+        # warm every (replica, model) engine's compile out of the
+        # measurement — in the multiplex arm this IS the lazy
+        # materialization (the registry counts the page-ins), and
+        # under the budget it already runs the LRU churn the swap
+        # counters report; a mid-run compile would stall every
+        # in-flight decode on that replica
+        for target, target_kw in warm:
+            list(target(first.prompt[:8], 2, **target_kw))
+            list(target(list(first.prompt), 2, **target_kw))
+        stats = replay(stream, iter_trace(cfg), time_scale=1.0,
+                       max_clients=32)
+        # collect BEFORE close(): close tears down the lazy engines
+        # and frees the registry entries the counters live on
+        rep_stats = ([] if dedicated
+                     else [rep.stats() for rep in pools])
+    finally:
+        for p in pools:
+            p.close()
+    out = stats.summary()
+    out["mode"] = "dedicated" if dedicated else "multiplex"
+    out["n_models"] = n_models
+    out["fleet_model_slots"] = fleet_slots
+    if dedicated:
+        out["engines"] = len(pools)
+        out["hosted_models"] = len(pools)
+    else:
+        snaps = [s["models"] for s in rep_stats]
+        out["replicas"] = replicas
+        out["engines"] = sum(len(s) - 1 for s in rep_stats)
+        out["swaps_in"] = sum(r["swaps_in"] for s in snaps
+                              for r in s.values())
+        out["swaps_out"] = sum(r["swaps_out"] for s in snaps
+                               for r in s.values())
+        if budget_models > 0:
+            out["budget_models"] = budget_models
+        if speculative:
+            agg = {"spec_proposed": 0, "spec_accepted": 0,
+                   "spec_fallbacks": 0}
+            for s in rep_stats:
+                for mid, es in s.items():
+                    if mid == "models":
+                        continue
+                    for k in agg:
+                        agg[k] += es.get(k, 0)
+            out.update(agg)
+            out["speculative"] = True
+    return out
+
+
+def run_spec_ab(scale: str = "quick", *, spec: bool, seed: int = 0,
+                model: str = "gpt2-debug",
+                spec_k: int = 4) -> Dict[str, Any]:
+    """Speculative-vs-plain same-engine A/B (ISSUE 16): one in-process
+    engine, greedy decoding, same trace — the only difference is the
+    drafter proposing ``spec_k`` tokens per step for one batched
+    verify. Greedy spec is token-exact by construction (the parity
+    tests assert it), so the delta here is pure tokens/s. The ngram
+    drafter feeds on self-repetition, so acceptance (reported) is
+    model- and trace-dependent; ``spec_accept_floor=0`` keeps the
+    fallback out of the measurement."""
+    from ray_tpu.serve.llm import LLMEngine
+    from ray_tpu.serve.multiplex import SpeculativeLLMEngine
+    from ray_tpu.util.tpu_info import honor_jax_platform_env
+
+    honor_jax_platform_env()
+    cfg = _scale_trace(scale, seed)
+    # speculative decoding is a DECODE-phase lever: the drafter feeds
+    # on the sequence's own repetition, which a handful of decode steps
+    # never develops. Long-decode sessions are the workload it exists
+    # for — size the trace accordingly (TTFT is untouched either way).
+    cfg.max_new_tokens = max(cfg.max_new_tokens, 64)
+    kw = dict(max_slots=8, max_len=256, seed=seed, paged=True,
+              block_size=16, prefill_chunk=8)
+    if spec:
+        engine = SpeculativeLLMEngine(model, spec_k=spec_k,
+                                      spec_accept_floor=0.0, **kw)
+    else:
+        engine = LLMEngine(model, **kw)
+    runner = EngineRunner(engine)
+    try:
+        first = next(iter_trace(cfg))
+        list(runner.stream(Request(0.0, 0, first.prompt[:8], 2)))
+        list(runner.stream(Request(0.0, 0, list(first.prompt), 2)))
+        stats = replay(runner.stream, iter_trace(cfg), time_scale=0.0,
+                       max_clients=8)
+    finally:
+        runner.close()
+    out = stats.summary()
+    out["mode"] = "speculative" if spec else "plain"
+    out["model"] = model
+    if spec:
+        s = engine.stats
+        out["spec_k"] = spec_k
+        out["spec_proposed"] = s.get("spec_proposed", 0)
+        out["spec_accepted"] = s.get("spec_accepted", 0)
+        out["spec_fallbacks"] = s.get("spec_fallbacks", 0)
+        out["spec_accept_rate"] = round(
+            s.get("spec_accepted", 0) / max(s.get("spec_proposed", 0),
+                                            1), 4)
+    return out
+
+
+def run_affinity_ab(scale: str = "quick", *, replicas: int = 3,
+                    seed: int = 0,
+                    model: str = "llama-debug") -> Dict[str, Any]:
+    """Cluster-wide prefix-affinity A/B (ISSUE 16): the same
+    shared-prefix trace replayed three ways — ONE replica (the hit-rate
+    ceiling: every tenant's prefix lives in the only trie), ``replicas``
+    replicas routed by published prefix digests (the handle's affinity
+    logic, mirrored in-process off each replica's ``load_state``), and
+    ``replicas`` replicas routed at random (the scatter baseline that
+    re-prefills every system prompt once per replica it lands on). The
+    acceptance bar: affinity's hit rate within 0.05 of the
+    single-replica ceiling."""
+    import random as _random
+
+    from ray_tpu.serve.kv_cache import prefix_key_digest
+    from ray_tpu.serve.llm import LLMDeployment
+    from ray_tpu.util.tpu_info import honor_jax_platform_env
+
+    honor_jax_platform_env()
+    kw = dict(max_slots=4, max_len=256, block_size=16, prefill_chunk=8,
+              seed=seed)
+    rng = _random.Random(seed)
+
+    def one_replay(mode: str) -> Dict[str, Any]:
+        n = 1 if mode == "single" else replicas
+        pools = [LLMDeployment(model, **kw) for _ in range(n)]
+        lock = threading.Lock()
+        counts = [0] * n
+        digests: Dict[int, Dict[str, int]] = {}
+        ts = [0.0]
+
+        def _pick(req: Request) -> int:
+            if n == 1:
+                return 0
+            if mode == "scatter":
+                return rng.randrange(n)
+            with lock:
+                now = time.monotonic()
+                if now - ts[0] > 0.05:
+                    ts[0] = now
+                    for j, p in enumerate(pools):
+                        digests[j] = dict(
+                            p.load_state().get("prefix_digest", []))
+                key = prefix_key_digest(
+                    list(req.prompt)[:kw["block_size"]])
+                best, best_w = None, -1
+                for j in range(n):
+                    w = digests.get(j, {}).get(key)
+                    if w is not None and int(w) > best_w:
+                        best, best_w = j, int(w)
+                if best is None:
+                    # cold prefix — no replica has published it yet.
+                    # Rendezvous-hash the key so every request of the
+                    # tenant lands on the SAME replica before its
+                    # digest exists; least-counts here scatters the
+                    # opening burst across the fleet, planting the
+                    # prefix in every trie it touches and paying the
+                    # re-prefill once per replica.
+                    best = max(range(n),
+                               key=lambda j: _hash.sha1(
+                                   f"{key}:{j}".encode()).digest())
+                counts[best] += 1
+                return best
+
+        def stream(req: Request) -> Iterable[int]:
+            pick = _pick(req)
+            inner = pools[pick](req.prompt, req.max_new)
+
+            def gen() -> Iterator[int]:
+                try:
+                    yield from inner
+                finally:
+                    if mode == "affinity":
+                        with lock:
+                            counts[pick] -= 1
+
+            return gen()
+
+        cfg = _scale_trace(scale, seed)
+        try:
+            first = next(iter_trace(cfg))
+            for p in pools:
+                list(p(first.prompt[:8], 2))
+                list(p(list(first.prompt), 2))
+            # baseline the trie counters after warm-up: the warm pass
+            # runs PER REPLICA, so without the subtraction the
+            # multi-replica arms are charged n-1 extra sets of warm
+            # misses the single-replica ceiling never pays
+            base = []
+            for p in pools:
+                pf = p.engine.kv_state().get("prefix", {})
+                base.append((pf.get("hits", 0), pf.get("misses", 0)))
+            stats = replay(stream, iter_trace(cfg), time_scale=0.0,
+                           max_clients=4)
+            hits = lookups = 0
+            for p, (bh, bm) in zip(pools, base):
+                pf = p.engine.kv_state().get("prefix", {})
+                h = pf.get("hits", 0) - bh
+                m = pf.get("misses", 0) - bm
+                hits += h
+                lookups += h + m
+        finally:
+            for p in pools:
+                p.close()
+        out = stats.summary()
+        out["hit_rate"] = round(hits / max(lookups, 1), 4)
+        return out
+
+    arms = {m: one_replay(m) for m in ("single", "affinity", "scatter")}
+    return {
+        "mode": "affinity_ab",
+        "replicas": replicas,
+        "single_hit_rate": arms["single"]["hit_rate"],
+        "affinity_hit_rate": arms["affinity"]["hit_rate"],
+        "scatter_hit_rate": arms["scatter"]["hit_rate"],
+        "affinity_within": round(arms["single"]["hit_rate"]
+                                 - arms["affinity"]["hit_rate"], 4),
+        "affinity_ok": (arms["single"]["hit_rate"]
+                        - arms["affinity"]["hit_rate"]) <= 0.05,
+        "arms": arms,
+    }
+
+
 #: engine shape for the mixed-workload A/Bs. prefill_chunk is the
 #: colocated dilemma knob — one setting must serve prefill throughput
 #: AND decode cadence. The colocated arm runs its measured-best
@@ -717,6 +1099,28 @@ def main(argv=None) -> int:
     p.add_argument("--colocated", action="store_true",
                    help="with --disagg (in-process): run the colocated "
                         "baseline arm instead")
+    p.add_argument("--multi-model", action="store_true",
+                   help="multi-model Zipf trace through multiplexed "
+                        "replicas (in-process A/B; ISSUE 16)")
+    p.add_argument("--dedicated", action="store_true",
+                   help="with --multi-model: run the N dedicated "
+                        "single-model deployments baseline arm instead")
+    p.add_argument("--n-models", type=int, default=8,
+                   help="distinct models in the multi-model trace")
+    p.add_argument("--budget-models", type=int, default=2,
+                   help="with --multi-model: resident model-slots per "
+                        "replica — the fleet weight budget BOTH arms "
+                        "spend (0 = unbounded)")
+    p.add_argument("--spec", action="store_true",
+                   help="speculative-decoding engine A/B (in-process; "
+                        "ISSUE 16); with --multi-model: speculative "
+                        "multiplexed replicas")
+    p.add_argument("--plain", action="store_true",
+                   help="with --spec: run the plain-decoding baseline "
+                        "arm instead")
+    p.add_argument("--affinity", action="store_true",
+                   help="prefix-affinity routing A/B over --replicas "
+                        "replicas (in-process; ISSUE 16)")
     p.add_argument("--nodes", type=int, default=0,
                    help="extra node daemons to boot (multi-node replay)")
     p.add_argument("--slo-ttft-s", type=float, default=None,
@@ -742,6 +1146,19 @@ def main(argv=None) -> int:
                                max_wall_s=args.max_wall_s,
                                mixed=args.mixed, max_new=args.max_new,
                                max_clients=args.max_clients)
+    elif args.multi_model:
+        out = run_multiplex_ab(args.scale, dedicated=args.dedicated,
+                               n_models=args.n_models,
+                               replicas=args.replicas,
+                               speculative=args.spec,
+                               budget_models=args.budget_models,
+                               seed=args.seed)
+    elif args.spec:
+        out = run_spec_ab(args.scale, spec=not args.plain,
+                          seed=args.seed)
+    elif args.affinity:
+        out = run_affinity_ab(args.scale, replicas=args.replicas,
+                              seed=args.seed)
     elif args.disagg:
         out = run_disagg_ab(args.scale, disagg=not args.colocated,
                             seed=args.seed)

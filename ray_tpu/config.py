@@ -281,6 +281,37 @@ _knob("serve_prefill_nice", int, 10,
       "of preempting decode cadence (on a real accelerator the step "
       "blocks on the device, so this is free); 0 disables",
       "serve/llm.py")
+_knob("serve_model_budget_bytes", int, 0,
+      "per-replica resident-weight budget for model multiplexing: the "
+      "ModelRegistry LRU-evicts unpinned models past this many bytes of "
+      "materialized params (in-flight requests pin their model); 0 = "
+      "unbounded", "serve/multiplex.py")
+_knob("serve_model_route_weight", float, 4.0,
+      "routing-score penalty a DeploymentHandle adds to replicas that "
+      "do NOT advertise the request's model_id as resident (a swap-in "
+      "costs a weight page-in; 0 ignores residency)", "serve/handle.py")
+_knob("serve_prefix_affinity", _bool, True,
+      "route requests whose first prompt block matches a replica's "
+      "published prefix digest to THAT replica (cluster-wide prefix "
+      "affinity); off = plain p2c", "serve/handle.py")
+_knob("serve_prefix_affinity_margin", float, 6.0,
+      "max routing-score gap by which the prefix-affine replica may "
+      "LOSE to the p2c winner and still be picked (beyond it the "
+      "replica is overloaded and affinity yields to load)",
+      "serve/handle.py")
+_knob("serve_prefix_digest_top", int, 8,
+      "top-N hottest prefix-trie roots (by reused tokens) a replica "
+      "publishes in its load report for affinity routing",
+      "serve/llm.py")
+_knob("spec_k", int, 4,
+      "draft tokens proposed per speculative-decoding round (the "
+      "target verifies k+1 positions in one batched step)",
+      "serve/multiplex.py")
+_knob("spec_accept_floor", float, 0.2,
+      "per-request acceptance-EWMA floor: a request whose draft "
+      "acceptance collapses below this after the warmup rounds falls "
+      "back to plain decode permanently (speculation only pays when "
+      "drafts are accepted)", "serve/multiplex.py")
 _knob("serve_disagg_cross_node_penalty", float, 2.0,
       "routing-score penalty for picking a decode replica on a "
       "DIFFERENT host than the chosen prefill replica (a same-host "

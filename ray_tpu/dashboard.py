@@ -95,6 +95,8 @@ class _Handler(BaseHTTPRequestHandler):
             "/api/jobs": _jobs_list,
             # serve REST (reference dashboard/modules/serve role)
             "/api/serve/applications": serve_rest.serve_rest_get,
+            # multi-model residency (per-replica models + prefix digests)
+            "/api/models": serve_rest.serve_models_get,
             # Chrome-trace task spans (reference timeline view role)
             "/api/timeline": _timeline_events,
         }

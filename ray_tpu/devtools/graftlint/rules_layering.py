@@ -73,16 +73,39 @@ class ServeRuntimeSeam(Rule):
                "global_worker calls and no module._private attribute "
                "reads from ray_tpu.serve (ISSUE 12 — load-aware routing "
                "reads state.actor_queue_depths and controller-mediated "
-               "load reports, not the driver's tables)")
+               "load reports, not the driver's tables); and only "
+               "serve/kv_transfer.py may import ray_tpu.experimental "
+               "channels (ISSUE 13's ONE sanctioned exception — public "
+               "exception types are fine anywhere)")
 
     #: private runtime accessors the routing work is tempted by, in any
     #: spelling (bare call after a from-import, or module-qualified)
     BANNED_NAMES = ("_get_runtime", "global_worker", "global_runtime")
 
+    #: the one serve module sanctioned to ride the experimental
+    #: DeviceChannel rings (CLAUDE.md architecture invariants, r16)
+    CHANNEL_EXEMPT = "serve/kv_transfer.py"
+
     def check(self, project: Project) -> Iterator[Finding]:
         for mod in project.modules:
             if not mod.scope_rel.startswith("ray_tpu/serve/"):
                 continue
+            if not mod.scope_rel.endswith(self.CHANNEL_EXEMPT):
+                for line, fq in mod.all_import_nodes:
+                    if not fq.startswith("ray_tpu.experimental"):
+                        continue
+                    # exception TYPES are contract surface (handles catch
+                    # ChannelFullError on the compiled path) — transports,
+                    # rings, and channel classes are not
+                    if fq.rpartition(".")[2].endswith("Error"):
+                        continue
+                    yield self.finding(
+                        mod, line,
+                        f"ray_tpu.serve imports {fq} — only "
+                        f"serve/kv_transfer.py rides the experimental "
+                        f"channel plane (the sanctioned KV-shipping "
+                        f"seam); everything else in the serving tier "
+                        f"stays on the public task/actor/object API")
             # ast.walk visits every NESTED Attribute of one chain
             # (`a.b.c` -> a.b.c, a.b): dedupe by (line, offending name)
             # so one violation reports once

@@ -39,10 +39,18 @@ from ray_tpu.models.transformer import (
     init_cache_multi,
     init_cache_paged,
     decode_step_paged,
+    verify_step_paged,
     copy_kv_block,
     gather_kv_blocks,
     scatter_kv_blocks,
     generate,
+)
+
+from ray_tpu.models.delta import (
+    apply_delta,
+    delta_bytes,
+    make_delta,
+    params_bytes,
 )
 
 from ray_tpu.models.import_hf import (
@@ -82,8 +90,13 @@ __all__ = [
     "init_cache_multi",
     "init_cache_paged",
     "decode_step_paged",
+    "verify_step_paged",
     "copy_kv_block",
     "gather_kv_blocks",
     "scatter_kv_blocks",
     "generate",
+    "apply_delta",
+    "delta_bytes",
+    "make_delta",
+    "params_bytes",
 ]

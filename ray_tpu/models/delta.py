@@ -1,0 +1,93 @@
+"""LoRA-style parameter deltas for model multiplexing.
+
+A fine-tune variant in a multiplexed fleet is almost never a full new
+weight set — it is a low-rank delta over a shared base (the reference
+Serve's model-multiplexing pattern assumes exactly this). A delta here is
+a plain pytree of per-layer low-rank factors over named projection
+leaves; :func:`apply_delta` materializes only the touched leaves and
+SHARES every other leaf with the base, so a resident variant costs the
+registry its delta bytes plus the few materialized projections, not a
+full model copy.
+
+Pure functions over pytrees like the rest of models/ — no framework
+state, cloudpickle/object-store friendly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.config import TransformerConfig
+
+Params = Dict[str, Any]
+
+# default leaves a delta perturbs — attention q/v projections, the classic
+# LoRA target set
+DEFAULT_TARGETS: Tuple[str, ...] = ("wq", "wv")
+
+
+def make_delta(rng: jax.Array, config: TransformerConfig, *,
+               rank: int = 2, scale: float = 1.0,
+               targets: Tuple[str, ...] = DEFAULT_TARGETS) -> Params:
+    """Random low-rank delta: per target leaf ``W [L, d, ...]`` the
+    factors are ``a [L, d, r]`` and ``b [L, r, prod(rest)]``; the applied
+    update is ``scale * (a @ b)`` reshaped to ``W``'s shape. ``scale=0``
+    gives an exact-identity variant (useful as a parity fixture)."""
+    c = config
+    pdt = jnp.dtype(c.param_dtype)
+    L, d = c.n_layers, c.d_model
+    shapes = {
+        "wq": (d, c.n_heads * c.hdim),
+        "wk": (d, c.kv_heads * c.hdim),
+        "wv": (d, c.kv_heads * c.hdim),
+        "wo": (c.n_heads * c.hdim, d),
+    }
+    out: Dict[str, Any] = {}
+    keys = iter(jax.random.split(rng, 2 * max(len(targets), 1)))
+    for name in targets:
+        if name not in shapes:
+            raise ValueError(
+                f"unknown delta target {name!r}; have {sorted(shapes)}")
+        din, dout = shapes[name]
+        a = (jax.random.normal(next(keys), (L, din, rank), jnp.float32)
+             * din ** -0.5).astype(pdt)
+        b = (jax.random.normal(next(keys), (L, rank, dout), jnp.float32)
+             * rank ** -0.5).astype(pdt)
+        out[name] = {"a": a, "b": b}
+    return {"scale": float(scale), "targets": out}
+
+
+def apply_delta(params: Params, delta: Params) -> Params:
+    """Materialize ``base + delta``: touched layer leaves are rebuilt,
+    every other leaf is the SAME array object as the base (zero copy) —
+    evicting a variant from a registry never needs to re-fetch the base."""
+    scale = float(delta.get("scale", 1.0))
+    layers = dict(params["layers"])
+    for name, fac in delta["targets"].items():
+        w = layers[name]
+        flat = w.reshape(w.shape[0], w.shape[1], -1)
+        upd = jnp.einsum("ldr,lre->lde", fac["a"].astype(flat.dtype),
+                         fac["b"].astype(flat.dtype))
+        layers[name] = (flat + scale * upd).reshape(w.shape).astype(w.dtype)
+    out = dict(params)
+    out["layers"] = layers
+    return out
+
+
+def delta_bytes(delta: Params) -> int:
+    """Size of the delta's own factors (what a registry charges a variant
+    beyond its base)."""
+    total = 0
+    for fac in delta["targets"].values():
+        for leaf in (fac["a"], fac["b"]):
+            total += leaf.size * leaf.dtype.itemsize
+    return total
+
+
+def params_bytes(params: Params) -> int:
+    """Total bytes of a param pytree (registry budget accounting)."""
+    leaves = jax.tree_util.tree_leaves(params)
+    return sum(int(x.size) * x.dtype.itemsize for x in leaves)

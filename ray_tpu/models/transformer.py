@@ -909,6 +909,46 @@ def decode_step_paged(
     padding touch nothing (a shared prefix block is immutable because no
     live request's write positions ever map into it). Returns (logits
     [B, V] of each row's LAST VALID token, new cache)."""
+    return _step_paged_impl(params, cache, tokens, block_tables, pos,
+                            nvalid, config, active, all_logits=False)
+
+
+def verify_step_paged(
+    params: Params,
+    cache: Params,
+    tokens: jax.Array,
+    block_tables: jax.Array,
+    pos: jax.Array,
+    nvalid: jax.Array,
+    config: TransformerConfig,
+    active: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, Params]:
+    """The speculative-decoding verify twin of :func:`decode_step_paged`:
+    identical cache semantics and masking, but logits come back for EVERY
+    fed position ([B, C, V]) instead of only each row's last valid one.
+    Feeding ``[last, d1..dk]`` verifies a k-token draft in one call —
+    logits[:, i] is the target's distribution after consuming input i, so
+    the greedy accept check is a per-position argmax compare. Invalid
+    positions still write nothing; their logits are garbage and must be
+    masked host-side via ``nvalid``. The extra lm-head cost (B*C rows vs
+    B) is the price of batched verification and is exactly what the
+    draft's accepted tokens amortize."""
+    return _step_paged_impl(params, cache, tokens, block_tables, pos,
+                            nvalid, config, active, all_logits=True)
+
+
+def _step_paged_impl(
+    params: Params,
+    cache: Params,
+    tokens: jax.Array,
+    block_tables: jax.Array,
+    pos: jax.Array,
+    nvalid: jax.Array,
+    config: TransformerConfig,
+    active: Optional[jax.Array] = None,
+    *,
+    all_logits: bool = False,
+) -> Tuple[jax.Array, Params]:
     c = config
     dt = jnp.dtype(c.dtype)
     b, t = tokens.shape
@@ -985,11 +1025,17 @@ def decode_step_paged(
     x = _norm(x, params["final_norm"], params.get("final_norm_b"), c)
     head = (params["embed"].T if c.tie_embeddings
             else params["lm_head"]).astype(dt)
-    # only each row's LAST VALID position needs logits — project D->V for
-    # B rows, not B*C (the lm-head matmul dominates small-model steps)
-    last = jnp.clip(nvalid - 1, 0, t - 1)
-    x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]
-    logits = jnp.einsum("bd,dv->bv", x_last, head).astype(jnp.float32)
+    if all_logits:
+        # verify path: the accept check needs a distribution at every fed
+        # position, so project all B*C rows
+        logits = jnp.einsum("bcd,dv->bcv", x, head).astype(jnp.float32)
+    else:
+        # only each row's LAST VALID position needs logits — project D->V
+        # for B rows, not B*C (the lm-head matmul dominates small-model
+        # steps)
+        last = jnp.clip(nvalid - 1, 0, t - 1)
+        x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]
+        logits = jnp.einsum("bd,dv->bv", x_last, head).astype(jnp.float32)
     if c.logits_softcap:
         logits = jnp.tanh(logits / c.logits_softcap) * c.logits_softcap
     return logits, {"k": new_k, "v": new_v}

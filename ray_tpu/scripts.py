@@ -366,12 +366,52 @@ def _cmd_stack(args) -> int:
     return 0
 
 
+def _cmd_list_models(args) -> int:
+    """``rtpu list models --url http://head:8265`` — per-replica model
+    residency (tier, swap counters, inflight) + prefix-digest summaries,
+    from the serve controller's load reports via ``/api/models``."""
+    doc = _fetch_api(args.url, "/api/models") or {}
+    deployments = doc.get("deployments") or {}
+    if doc.get("error"):
+        print(f"error: {doc['error']}")
+    n_models = 0
+    for dep, rec in sorted(deployments.items()):
+        print(f"deployment {dep}:")
+        for rid, rep in sorted((rec.get("replicas") or {}).items()):
+            print(f"  replica {rid[:16]} inflight={rep.get('inflight', 0)}")
+            for mid, m in sorted((rep.get("models") or {}).items()):
+                n_models += 1
+                extra = ""
+                if "swaps_in" in m:
+                    extra = (f" swaps={m.get('swaps_in', 0)}/"
+                             f"{m.get('swaps_out', 0)}")
+                print(f"    {mid:<24} {str(m.get('state', '-')):<8} "
+                      f"inflight={m.get('inflight', 0)}{extra}")
+            digest = rep.get("prefix_digest") or []
+            if digest:
+                tops = ", ".join(f"{d[0][:12]}:{d[1]}" for d in digest[:4])
+                print(f"    prefix-digest: {tops}")
+    print(f"-- {n_models} model(s) across {len(deployments)} "
+          "multiplexed deployment(s)")
+    return 0
+
+
 def _cmd_list(args) -> int:
-    """``rtpu list actors|pgs`` — dump the cluster GCS actor /
+    """``rtpu list actors|pgs|models`` — dump the cluster GCS actor /
     placement-group directories (reference ``ray list actors`` role;
     these are the CLI senders for the ``actor_list`` / ``pg_list``
-    RPCs the graftlint protocol family tracks)."""
+    RPCs the graftlint protocol family tracks), or the serve plane's
+    model-residency report (``models``, dashboard-backed)."""
     from ray_tpu.cluster.rpc import RpcClient
+
+    if args.what == "models":
+        if not args.url:
+            print("rtpu list models needs --url http://<head>:8265")
+            return 2
+        return _cmd_list_models(args)
+    if not args.address:
+        print(f"rtpu list {args.what} needs --address <gcs host:port>")
+        return 2
 
     def _hex(v, n=32):
         return v.hex()[:n] if isinstance(v, bytes) else str(v or "-")[:n]
@@ -454,11 +494,13 @@ def main(argv=None) -> int:
     mem.add_argument("--limit", type=int, default=10000)
 
     ls = sub.add_parser("list", help="list cluster actors / placement "
-                                     "groups from the GCS directories")
-    ls.add_argument("what", choices=["actors", "pgs"])
-    ls.add_argument("--address", required=True,
-                    help="GCS address host:port")
+                                     "groups / served models")
+    ls.add_argument("what", choices=["actors", "pgs", "models"])
+    ls.add_argument("--address", default=None,
+                    help="GCS address host:port (actors/pgs)")
     ls.add_argument("--authkey", default="", help="cluster authkey")
+    ls.add_argument("--url", default=None,
+                    help="dashboard URL http://host:8265 (models)")
 
     st = sub.add_parser("stack", help="dump python stacks of live "
                                       "ray_tpu processes (py-spy role)")

@@ -24,6 +24,10 @@ from ray_tpu.serve.admission import (AdmissionController,
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.kv_cache import BlockPool, PrefixCache
 from ray_tpu.serve.llm import KVExport, LLMDeployment, LLMEngine
+from ray_tpu.serve.multiplex import (ModelRegistry,
+                                     MultiplexedLLMDeployment,
+                                     SpeculativeLLMDeployment,
+                                     SpeculativeLLMEngine)
 from ray_tpu.serve.disagg import DisaggHandle, deploy_disagg
 from ray_tpu.serve.kv_transfer import KVTransferError
 from ray_tpu.serve.deployment import (
@@ -56,6 +60,10 @@ __all__ = [
     "LLMDeployment",
     "LLMEngine",
     "KVExport",
+    "ModelRegistry",
+    "MultiplexedLLMDeployment",
+    "SpeculativeLLMDeployment",
+    "SpeculativeLLMEngine",
     "DisaggHandle",
     "deploy_disagg",
     "KVTransferError",

@@ -110,6 +110,16 @@ def status() -> Dict[str, Any]:
     return ray_tpu.get(controller.list_deployments.remote())
 
 
+def model_report() -> Dict[str, Any]:
+    """Cluster-wide multi-model residency view (``rtpu list models`` /
+    ``GET /api/models``). Read-only: never creates a controller."""
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:
+        return {}
+    return ray_tpu.get(controller.model_report.remote())
+
+
 def shutdown() -> None:
     _deployed_apps.clear()  # stale handles must not outlive the controller
     # compiled execution plane: tear down every cached per-replica DAG

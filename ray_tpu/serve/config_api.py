@@ -88,6 +88,17 @@ def serve_rest_get() -> Dict[str, Any]:
         return {"applications": {}, "error": str(e)}
 
 
+def serve_models_get() -> Dict[str, Any]:
+    """GET /api/models payload: per-deployment replica model residency
+    (tier, swap counters, inflight) plus prefix-digest summaries."""
+    from ray_tpu.serve import api as serve_api
+
+    try:
+        return {"deployments": serve_api.model_report()}
+    except Exception as e:
+        return {"deployments": {}, "error": str(e)}
+
+
 def serve_rest_put(cfg: Dict[str, Any]) -> Dict[str, Any]:
     """PUT /api/serve/applications: declarative (re)deploy."""
     return {"deployed": deploy_config(cfg)}

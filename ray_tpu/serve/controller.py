@@ -204,6 +204,29 @@ class ServeController:
             return {}
         return dict(entry.get("loads", {}))
 
+    def model_report(self) -> Dict[str, Any]:
+        """Cluster-wide multi-model view (``rtpu list models`` /
+        ``/api/models``): per deployment, each replica's resident
+        models (with residency tier + swap counters from the registry)
+        and its published prefix-digest summary — assembled from the
+        SAME load reports routing runs on, so what this returns is
+        exactly what handles see."""
+        out: Dict[str, Any] = {}
+        for name, entry in self._deployments.items():
+            reps = {}
+            for actor_id, rec in (entry.get("loads") or {}).items():
+                if "models" not in rec:
+                    continue
+                reps[actor_id.hex()] = {
+                    "models": rec.get("models", {}),
+                    "prefix_digest": rec.get("prefix_digest", []),
+                    "inflight": rec.get("inflight", 0),
+                    "ts": rec.get("ts", 0.0),
+                }
+            if reps:
+                out[name] = {"replicas": reps}
+        return out
+
     # -- autoscaling ------------------------------------------------------
 
     def record_request_metrics(self, name: str, ongoing: float) -> None:

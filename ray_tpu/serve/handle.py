@@ -294,6 +294,14 @@ class DeploymentHandle:
         self._compiled = False
         self._refresh_ts = 0.0  # last successful _refresh (monotonic)
         self._dags = _dag_cache
+        # multi-model routing (ISSUE 16): model_id steers toward replicas
+        # advertising the model RESIDENT (a swap-in costs a weight
+        # page-in) and rides the request as a kwarg; prefix_hint (the
+        # request's prompt tokens, or a precomputed digest) steers
+        # sessions sharing a system prompt to the replica whose prefix
+        # trie already holds it
+        self._model_id: Optional[str] = None
+        self._prefix_hint: Optional[Any] = None
 
     # -- controller sync --------------------------------------------------
 
@@ -435,7 +443,9 @@ class DeploymentHandle:
         if not kv:
             return load
         w = float(_cfg.get("serve_kv_route_weight"))
-        if w <= 0:
+        mw = (float(_cfg.get("serve_model_route_weight"))
+              if self._model_id is not None else 0.0)
+        if w <= 0 and mw <= 0:
             return load
         now = time.time()
         for i, r in enumerate(self._replicas):
@@ -443,10 +453,89 @@ class DeploymentHandle:
             if not rep or now - rep.get("ts", 0) > _KV_STALE_S:
                 continue
             total = rep.get("kv_total") or 0
-            if total > 0:
+            if w > 0 and total > 0:
                 used_frac = 1.0 - rep.get("kv_free", 0) / total
                 load[i] += w * used_frac
+            if mw > 0:
+                models = rep.get("models")
+                if models is not None:
+                    # model residency folds into the p2c score: a
+                    # replica that must page the weights in competes at
+                    # a penalty, but can still win when the resident
+                    # replicas are saturated
+                    m = models.get(self._model_id)
+                    if not m or m.get("state") != "hbm":
+                        load[i] += mw
         return load
+
+    def _affinity_key(self) -> Optional[str]:
+        """Content digest of the request's first prompt block (the key
+        replicas publish in their prefix digests), or None when no hint
+        was given / the block geometry is unknown."""
+        hint = self._prefix_hint
+        if hint is None:
+            return None
+        if isinstance(hint, str):
+            return hint  # precomputed digest
+        bs = 0
+        for rep in self._route_state["kv_loads"].values():
+            bs = int(rep.get("block_size") or 0)
+            if bs:
+                break
+        toks = list(hint)
+        if not bs or len(toks) < bs:
+            return None
+        from ray_tpu.serve.kv_cache import prefix_key_digest
+
+        return prefix_key_digest(toks[:bs])
+
+    def _affinity_pick(self, cand: List[int],
+                       score: List[float]) -> Optional[int]:
+        """Cluster-wide prefix affinity: direct-pick the replica whose
+        published prefix digest carries this request's first-block key —
+        unless that replica is overloaded (its score trails the best
+        candidate by more than the margin), in which case load wins and
+        the pick falls through to p2c. A cold prefix falls through too;
+        whoever serves it becomes the affinity home via its trie."""
+        from ray_tpu import config as _cfg
+
+        if not self._has_loads or self._prefix_hint is None:
+            return None
+        if not _cfg.get("serve_prefix_affinity"):
+            return None
+        kv = self._kv_view()
+        if not kv:
+            return None
+        key = self._affinity_key()
+        if key is None:
+            return None
+        now = time.time()
+        best, best_w = None, -1
+        for i in cand:
+            rep = kv.get(self._replicas[i]._actor_id.binary())
+            if not rep or now - rep.get("ts", 0) > _KV_STALE_S:
+                continue
+            for k, wgt in rep.get("prefix_digest", []):
+                if k == key and wgt > best_w:
+                    best, best_w = i, int(wgt)
+        if best is None:
+            # cold prefix: no replica has published it yet. Rendezvous-
+            # hash the key over the candidates so every handle in the
+            # cluster sends this tenant's opening burst to the SAME
+            # replica — falling through to p2c scatters the prefix
+            # across the fleet, planting one trie copy (and paying one
+            # re-prefill) per replica it touches before any digest can
+            # converge. Stable replica ids make independent handles
+            # agree without coordination; the margin check below still
+            # lets load override the hash.
+            import hashlib
+            best = max(cand, key=lambda i: hashlib.sha1(
+                str(key).encode()
+                + self._replicas[i]._actor_id.binary()).digest())
+        margin = float(_cfg.get("serve_prefix_affinity_margin"))
+        if score[best] > min(score[c] for c in cand) + margin:
+            return None  # overloaded: affinity yields to load
+        return best
 
     def _pick_replica(self, exclude: Optional[bytes] = None) -> int:
         """Power-of-two-choices over the combined load score;
@@ -468,11 +557,16 @@ class DeploymentHandle:
             self._route_state["rr_next"] += 1
             return cand[self._route_state["rr_next"] % len(cand)]
         score = self._scores()
+        aff = self._affinity_pick(cand, score)
+        if aff is not None:
+            return aff
         i, j = self._rng.sample(cand, 2)
         return i if score[i] <= score[j] else j
 
     def options(self, *, method_name: Optional[str] = None,
-                stream: Optional[bool] = None) -> "DeploymentHandle":
+                stream: Optional[bool] = None,
+                model_id: Optional[str] = None,
+                prefix_hint: Optional[Any] = None) -> "DeploymentHandle":
         h = DeploymentHandle(self.deployment_name, self._controller,
                              method_name or self._method,
                              self._stream if stream is None else stream)
@@ -490,11 +584,17 @@ class DeploymentHandle:
         # clone-private copy would reset the KV-view TTL (one blocking
         # controller RPC per request) and freeze the rr cursor
         h._route_state = self._route_state
+        h._model_id = model_id if model_id is not None else self._model_id
+        h._prefix_hint = (prefix_hint if prefix_hint is not None
+                          else self._prefix_hint)
         return h
 
     def _issue(self, args, kwargs, exclude: Optional[bytes] = None):
         """Pick a replica and dispatch one request to it."""
         self._refresh()
+        if self._model_id is not None:
+            # the routing hint doubles as the request's model address
+            kwargs.setdefault("model_id", self._model_id)
         idx = self._pick_replica(exclude=exclude)
         replica = self._replicas[idx]
         self._delta[idx] = self._delta.get(idx, 0) + 1

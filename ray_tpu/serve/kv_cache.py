@@ -28,8 +28,19 @@ without a mesh, and the engine stays the single owner of device arrays.
 
 from __future__ import annotations
 
+import hashlib
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
+
+
+def prefix_key_digest(tokens: Sequence[int]) -> str:
+    """Stable cross-process digest of one block's token tuple — the key
+    replicas publish in their prefix digest and handles recompute from a
+    request's first prompt block to route for affinity. Content-hashed
+    (not id-based) so two replicas that independently cached the same
+    system prompt advertise the SAME key."""
+    raw = ",".join(str(int(t)) for t in tokens).encode()
+    return hashlib.blake2b(raw, digest_size=8).hexdigest()
 
 
 class KVCacheError(RuntimeError):
@@ -113,7 +124,8 @@ class BlockPool:
 
 
 class _TrieNode:
-    __slots__ = ("key", "block_id", "children", "parent", "last_used")
+    __slots__ = ("key", "block_id", "children", "parent", "last_used",
+                 "hit_weight")
 
     def __init__(self, key: Optional[Tuple[int, ...]],
                  block_id: Optional[int], parent: Optional["_TrieNode"]):
@@ -122,6 +134,10 @@ class _TrieNode:
         self.parent = parent
         self.children: Dict[Tuple[int, ...], "_TrieNode"] = {}
         self.last_used = 0.0
+        # tokens reused through this ROOT child (only root children
+        # accumulate weight — the digest ranks system prompts, and a
+        # system prompt is identified by its first block)
+        self.hit_weight = 0
 
 
 class PrefixCache:
@@ -186,6 +202,7 @@ class PrefixCache:
         if not chain:
             self.misses += 1
             return [], 0, None
+        first_child = chain[0]
         matched = len(chain) * self.block_size
         cow_src: Optional[int] = None
         if matched >= len(tokens):
@@ -201,6 +218,7 @@ class PrefixCache:
             self.pool.retain(b)
         self.hits += 1
         self.hit_tokens += matched
+        first_child.hit_weight += matched
         return blocks, matched, cow_src
 
     # -- registration ------------------------------------------------------
@@ -303,6 +321,22 @@ class PrefixCache:
             if ok:
                 count += 1
         return count
+
+    def digest(self, top: int = 8) -> List[Tuple[str, int]]:
+        """Top trie roots by hit-weight as ``(key_digest, weight)`` pairs
+        — the cluster-wide prefix-affinity signal. One entry per resident
+        ROOT child (≈ one per distinct system prompt). Roots that never
+        produced a hit publish weight 0: a HELD root is routable — the
+        tenant's first repeat request would hit it, so omitting cold
+        entries scatters every session's opening requests across the
+        fleet before affinity can converge. Hot roots sort first so the
+        ``top`` cap sheds cold ones under pressure. Small and stable by
+        construction: ``top`` entries of ~24 bytes ride every load
+        report."""
+        roots = sorted(self._root.children.values(),
+                       key=lambda n: -n.hit_weight)
+        return [(prefix_key_digest(n.key), n.hit_weight)
+                for n in roots[:max(top, 0)]]
 
     def stats(self) -> Dict[str, int]:
         return {"nodes": self._nodes, "hits": self.hits,

@@ -136,6 +136,13 @@ class LLMEngine:
         if params is None:
             params = models.init_params(jax.random.PRNGKey(seed), config)
         self.params = params
+        # model multiplexing (serve/multiplex.py): a registry-managed
+        # engine's params can be PAGED OUT between steps (dropped to the
+        # arena store under budget pressure) and re-acquired lazily —
+        # the provider is called at the top of step() when params are
+        # absent. jit-safe: the step donates only the cache, so swapping
+        # the params pytree never invalidates the compiled program.
+        self.params_provider: Optional[Callable[[], Any]] = None
         if self.paged:
             bs = int(block_size or _knobs.get("llm_block_size"))
             self._tbl_width = -(-max_len // bs)
@@ -388,6 +395,28 @@ class LLMEngine:
             self.stats["requests"] += 1
             self.stats["adopted"] += 1
         return req
+
+    # -- weight paging (model multiplexing) --------------------------------
+
+    def set_params(self, params) -> None:
+        """Install (swap in) a params pytree. Called from the step/loop
+        thread between steps; safe because the jitted step donates the
+        cache, never the params."""
+        self.params = params
+
+    def drop_params(self) -> None:
+        """Page this engine's weights out. Only legal while the engine
+        has no in-flight work (the registry's pin accounting guarantees
+        it); the next step with work re-acquires via
+        ``params_provider``."""
+        self.params = None
+
+    def _ensure_params(self) -> None:
+        if self.params is None:
+            if self.params_provider is None:
+                raise RuntimeError(
+                    "engine params paged out and no params_provider set")
+            self.params = self.params_provider()
 
     def cancel(self, req: "_Request") -> None:
         """Abandon a request: pending entries are dropped immediately; an
@@ -662,6 +691,7 @@ class LLMEngine:
         if active_now == 0:
             self._sample_gauges()
             return have_pending
+        self._ensure_params()
 
         t0 = time.perf_counter()
         if self.paged:
@@ -688,32 +718,7 @@ class LLMEngine:
             req.generated += 1
             self._observe_emit(req, now)
             if req.prefill_only:
-                # export INSTEAD of streaming: gather the prompt's blocks
-                # off the pool (one device op, one host transfer) and
-                # hand them to the sink with the sampled token; the
-                # blocks then release normally — full prompt blocks into
-                # the trie, so repeated system prompts prefill once even
-                # on a dedicated prefill pool. The id list is padded to a
-                # power-of-two bucket (repeating the last id — reads are
-                # harmless) so the gather retraces per BUCKET, not per
-                # block count: a mid-stream jit compile would stall every
-                # in-flight decode for hundreds of ms.
-                nb = self.pool.blocks_for_tokens(len(req.prompt))
-                bucket = min(_next_pow2(nb), self._tbl_width)
-                ids = req.table[:nb] + [req.table[nb - 1]] * (bucket - nb)
-                kv_dev = self._gather_fn(
-                    self._cache, jnp.asarray(np.asarray(ids, np.int32)))
-                kv_host = jax.device_get(kv_dev)
-                self.stats["exported"] += 1
-                req.emit(KVExport(
-                    token=tok, prompt_len=len(req.prompt),
-                    block_size=self.pool.block_size,
-                    kv={"k": np.asarray(kv_host["k"])[:, :nb],
-                        "v": np.asarray(kv_host["v"])[:, :nb]}))
-                with self._lock:
-                    self._release_blocks(req, insert=True)
-                req.emit(None)
-                self._slots[i] = None
+                self._emit_prefill_export(i, req, tok, jax, jnp)
                 continue
             req.emit(tok)
             self.stats["tokens_generated"] += 1
@@ -728,6 +733,34 @@ class LLMEngine:
         self.stats["steps"] += 1
         self._sample_gauges()
         return True
+
+    def _emit_prefill_export(self, i: int, req: _Request, tok: int,
+                             jax, jnp) -> None:
+        """Export INSTEAD of streaming: gather the prompt's blocks off
+        the pool (one device op, one host transfer) and hand them to the
+        sink with the sampled token; the blocks then release normally —
+        full prompt blocks into the trie, so repeated system prompts
+        prefill once even on a dedicated prefill pool. The id list is
+        padded to a power-of-two bucket (repeating the last id — reads
+        are harmless) so the gather retraces per BUCKET, not per block
+        count: a mid-stream jit compile would stall every in-flight
+        decode for hundreds of ms."""
+        nb = self.pool.blocks_for_tokens(len(req.prompt))
+        bucket = min(_next_pow2(nb), self._tbl_width)
+        ids = req.table[:nb] + [req.table[nb - 1]] * (bucket - nb)
+        kv_dev = self._gather_fn(
+            self._cache, jnp.asarray(np.asarray(ids, np.int32)))
+        kv_host = jax.device_get(kv_dev)
+        self.stats["exported"] += 1
+        req.emit(KVExport(
+            token=tok, prompt_len=len(req.prompt),
+            block_size=self.pool.block_size,
+            kv={"k": np.asarray(kv_host["k"])[:, :nb],
+                "v": np.asarray(kv_host["v"])[:, :nb]}))
+        with self._lock:
+            self._release_blocks(req, insert=True)
+        req.emit(None)
+        self._slots[i] = None
 
     def _advance_dense(self, jax, jnp):
         """Dense per-slot cache: every active slot advances exactly one
@@ -856,6 +889,17 @@ class LLMEngine:
                            block_size=self.pool.block_size)
             if self.prefix is not None:
                 out["prefix"] = self.prefix.stats()
+                # cluster-wide prefix affinity (serve/multiplex.py): the
+                # top trie roots by hit-weight, published through load
+                # reports so handles can route sessions sharing a system
+                # prompt to the replica that already holds it
+                try:
+                    from ray_tpu import config as _knobs
+
+                    top = int(_knobs.get("serve_prefix_digest_top"))
+                except Exception:
+                    top = 8
+                out["prefix_digest"] = self.prefix.digest(top)
                 # claimable = free + evictable-from-trie: the CAPACITY
                 # signal (a warm replica's raw free count trends to ~0
                 # because the trie retains every finished prompt — that
@@ -909,14 +953,15 @@ class LLMDeployment:
         # request rates the per-token object/message cost dominates the
         # serving stack, and a lagging consumer turns N messages into 1.
         self._stream_batch = max(1, int(stream_batch))
-        self.engine = LLMEngine(model, params, max_slots=max_slots,
-                                max_len=max_len, temperature=temperature,
-                                seed=seed, paged=paged,
-                                block_size=block_size,
-                                num_blocks=num_blocks,
-                                prefill_chunk=prefill_chunk,
-                                prefix_cache=prefix_cache, slo=slo,
-                                role=role)
+        # advertised in load reports so handles can route by model
+        # residency (serve/multiplex.py multiplexes several of these)
+        self._model_id = model if isinstance(model, str) else "custom"
+        self.engine = self._engine_factory(
+            model, params, max_slots=max_slots, max_len=max_len,
+            temperature=temperature, seed=seed, paged=paged,
+            block_size=block_size, num_blocks=num_blocks,
+            prefill_chunk=prefill_chunk, prefix_cache=prefix_cache,
+            slo=slo, role=role)
         self._error: Optional[BaseException] = None
         self._wake = threading.Event()
         self._stop = False
@@ -929,6 +974,12 @@ class LLMDeployment:
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="llm-decode-loop")
         self._thread.start()
+
+    def _engine_factory(self, *args, **kw) -> LLMEngine:
+        """Engine construction seam: subclasses swap the engine class
+        (``serve/multiplex.py``'s speculative deployment) without
+        re-plumbing the loop-thread/streaming machinery."""
+        return LLMEngine(*args, **kw)
 
     def _loop(self) -> None:
         if self.engine.role == "prefill":
@@ -1214,6 +1265,13 @@ class LLMDeployment:
         replicas and driving autoscale runaway."""
         s = self.engine.kv_state()
         return {"inflight": s["inflight"] + s["queued"],
+                # model-residency + prefix-affinity routing signals
+                # (ISSUE 16): which models this replica can serve without
+                # a swap-in, and the hottest cached system prompts
+                "models": {self._model_id: {
+                    "state": "hbm",
+                    "inflight": s["inflight"] + s["queued"]}},
+                "prefix_digest": s.get("prefix_digest", []),
                 "kv_free": s.get("kv_claimable", s.get("kv_free", 0)),
                 "kv_total": s.get("kv_total", 0),
                 # disaggregation routing signals (ISSUE 13): pool role,

@@ -480,6 +480,38 @@ _def("rtpu_serve_kv_transfer_seconds", "histogram",
      "send, decode-side fetch for recv), by path",
      tag_keys=("path",), boundaries=_LAT_FAST, component="serve")
 
+# multi-model serving plane (ISSUE 16): arena-paged model multiplexing
+# + speculative decoding
+_def("rtpu_serve_model_swaps_total", "counter",
+     "model weight-set page events on this replica's ModelRegistry, by "
+     "direction (in = materialized from the arena store; out = LRU-"
+     "evicted under the resident-byte budget) — the lazy-paging proof "
+     "the multiplexing A/B asserts on", tag_keys=("direction",),
+     component="serve")
+_def("rtpu_serve_model_resident", "gauge",
+     "registered models on this replica by residency tier (hbm = "
+     "materialized params; host = cold weights in the arena store; "
+     "spilled = aged to the store's on-disk tier; sampled per registry "
+     "snapshot)", tag_keys=("state",), component="serve")
+_def("rtpu_serve_model_resident_bytes", "gauge",
+     "bytes of materialized model params counted against this "
+     "replica's serve_model_budget_bytes (delta variants charge only "
+     "their unique leaves)", component="serve")
+_def("rtpu_spec_rounds_total", "counter",
+     "speculative-decoding verify rounds that carried at least one "
+     "draft token (one batched verify_step_paged call per round)",
+     component="serve")
+_def("rtpu_spec_proposed_tokens_total", "counter",
+     "draft tokens proposed to the target verifier", component="serve")
+_def("rtpu_spec_accepted_tokens_total", "counter",
+     "draft tokens accepted (equal to the target's own greedy chain); "
+     "each round also emits one free target token, so tokens/round = "
+     "accepted/rounds + 1", component="serve")
+_def("rtpu_spec_fallbacks_total", "counter",
+     "requests whose draft-acceptance EWMA collapsed below "
+     "spec_accept_floor and fell back to plain decode permanently",
+     component="serve")
+
 
 # ---------------------------------------------------------------------------
 # instantiation

@@ -596,6 +596,28 @@ def store_report() -> Dict[str, Any]:
     return _gcs().store.report()
 
 
+def object_store_tier(ref) -> str:
+    """Storage tier of one object: ``"shm"`` (arena/segment resident),
+    ``"spilled"`` (cold on-disk tier), ``"unknown"`` (no runtime, or the
+    object is inline/absent). The PUBLIC residency probe the serving
+    tier's model registry reports through ``/api/models`` — libraries
+    must not reach into the store client for this (layering seam)."""
+    try:
+        from ray_tpu.core.runtime import _get_runtime
+
+        rt = _get_runtime()
+        if rt is None:
+            return "unknown"
+        oid = ref.id if hasattr(ref, "id") else ref
+        if rt.store.contains_spilled(oid):
+            return "spilled"
+        if rt.store.contains(oid):
+            return "shm"
+    except Exception:
+        pass
+    return "unknown"
+
+
 def _apply_filters(records: List[Dict[str, Any]],
                    filters: Optional[List]) -> List[Dict[str, Any]]:
     """filters: [(key, op, value)] with op in {'=', '!='} (reference

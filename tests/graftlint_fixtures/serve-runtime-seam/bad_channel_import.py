@@ -1,0 +1,9 @@
+# graftlint: path=ray_tpu/serve/fake_streamer.py
+"""Offender: a serve module (not kv_transfer) riding the experimental
+channel plane directly."""
+from ray_tpu.experimental.device_channel import DeviceChannel
+
+
+def ship(blob):
+    ch = DeviceChannel("serve-side-channel", capacity=4)
+    ch.put(blob)

@@ -1,0 +1,13 @@
+# graftlint: path=ray_tpu/serve/fake_streamer.py
+"""Compliant: catching a public channel exception TYPE is contract
+surface (the compiled handle path does exactly this) — only transports
+and channel classes are fenced to kv_transfer.py."""
+from ray_tpu.experimental.channel import ChannelFullError
+
+
+def push(ch, blob):
+    try:
+        ch.put(blob)
+    except ChannelFullError:
+        return False
+    return True
