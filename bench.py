@@ -1333,6 +1333,31 @@ def _core_microbench() -> dict:
         except Exception as e:
             out["profiling_overhead"] = {"error": str(e)}
 
+        # events on/off A/B on the SAME warm process tree (ISSUE 18
+        # bench guard). The plane defaults ON, so unlike tracing/
+        # profiling the interesting direction is inverted: measure
+        # disarmed first, then re-arm (the shipped default) and measure
+        # again — the on/off ratio bounds what worker_spawn/worker_death
+        # recording costs on the task hot path. MUST end re-armed:
+        # leaving events off would silently disarm the default-on plane
+        # for the rest of the microbench.
+        try:
+            from ray_tpu.util import events as _events
+
+            _events.disable_events()
+            try:
+                e_off = best_of(3, tasks_trial)
+            finally:
+                _events.enable_events()
+            e_on = best_of(3, tasks_trial)
+            out["events_overhead"] = {
+                "tasks_per_s_off": e_off,
+                "tasks_per_s_on": e_on,
+                "on_off_ratio": round(e_on / e_off, 3) if e_off else None,
+            }
+        except Exception as e:
+            out["events_overhead"] = {"error": str(e)}
+
         @ray_tpu.remote
         class A:
             def f(self):

@@ -217,6 +217,10 @@ class ClusterAdapter:
         self._task_ev_cursor = 0  # next local task event to ship to GCS
         self._trace_ev_cursor = 0  # next TraceStore span to ship to GCS
         self._profile_ev_cursor = 0  # next ProfileStore batch to ship
+        self._event_ev_cursor = 0  # next EventStore lifecycle event to ship
+        # set by the first successful _register(): a later register that
+        # the GCS answers "unknown node" is then a restart observation
+        self._had_registered = False
         # (size, locations) cache for dependency-locality scoring: fan-outs
         # of one big ref to N tasks pay one directory lookup, not N.
         # _obj_info_down_until: circuit breaker — while the GCS is not
@@ -328,7 +332,8 @@ class ClusterAdapter:
                     pass
                 if known is False:
                     # a restarted GCS lost the (non-durable) node table:
-                    # re-register + re-subscribe (GCS FT path)
+                    # re-register + re-subscribe (GCS FT path; _register
+                    # itself records the gcs_restart lifecycle event)
                     self._register()
                 # ship NEW task events (reference TaskEventBuffer flush,
                 # task_event_buffer.h:206 role): batched + bounded, so
@@ -369,6 +374,19 @@ class ClusterAdapter:
                         from ray_tpu.util import profiling as _profiling
 
                         _profiling.note_push()
+                # event plane rides the same beats: this node's lifecycle
+                # ring (driver/daemon process) + its workers' pushed
+                # batches, shipped as acked EventStore deltas
+                self.rt.collect_lifecycle_events()
+                eb, estart = self.rt.event_store.since(
+                    self._event_ev_cursor)
+                if eb:
+                    if self.gcs.call("lifecycle_events", self.node_id, eb,
+                                     estart, timeout=5):
+                        self._event_ev_cursor = estart + len(eb)
+                        from ray_tpu.util import events as _events
+
+                        _events.note_push()
             except Exception:
                 pass
 
@@ -392,6 +410,20 @@ class ClusterAdapter:
         except Exception:
             return None
 
+    def _note_gcs_restart(self) -> None:
+        """THE gcs_restart emit site: a re-registration found the GCS
+        had no entry for this node — it came back without its
+        (non-durable) node table, so record the outage as a lifecycle
+        event. (A heartbeat blackout does NOT land here: dead entries
+        stay in the table with alive=False, so node_register still
+        reports the node as known.)"""
+        try:
+            from ray_tpu.util import events as _events
+
+            _events.emit("gcs_restart", node_id=self.node_id.hex()[:8])
+        except Exception:
+            pass
+
     def _register(self):
         self.gcs.call("subscribe", "nodes", timeout=10)
         self.gcs.call("subscribe", "objects", timeout=10)
@@ -399,9 +431,17 @@ class ClusterAdapter:
         self.gcs.call("subscribe", "failpoints", timeout=10)
         self.gcs.call("subscribe", "tracing", timeout=10)
         self.gcs.call("subscribe", "profiling", timeout=10)
-        self.gcs.call("node_register", self.node_id, self.server.addr,
-                      self.rt.resources("total"), self.is_scheduler,
-                      dict(getattr(self.rt, "labels", {})), timeout=10)
+        self.gcs.call("subscribe", "events", timeout=10)
+        known = self.gcs.call(
+            "node_register", self.node_id, self.server.addr,
+            self.rt.resources("total"), self.is_scheduler,
+            dict(getattr(self.rt, "labels", {})), timeout=10)
+        if known is False and self._had_registered:
+            # the GCS forgot a node it once accepted: state loss —
+            # whether we got here via the reconnect callback (GCS
+            # process restart) or a heartbeat NACK
+            self._note_gcs_restart()
+        self._had_registered = True
         self._node_view_ts = 0.0
         # a (re)registered GCS starts with an empty task-event store:
         # reship our full local history
@@ -426,6 +466,12 @@ class ClusterAdapter:
         profiling.sync_from_kv(
             lambda k, ns: self.gcs.call("kv_get", k, ns, timeout=10))
         self._profile_ev_cursor = 0
+        # event plane, late-joiner path: same contract as tracing
+        from ray_tpu.util import events
+
+        events.sync_from_kv(
+            lambda k, ns: self.gcs.call("kv_get", k, ns, timeout=10))
+        self._event_ev_cursor = 0
         # GCS restart recovery (chaos: kill -9 mid-submit): the object
         # directory is NOT durable and obj_ready is a cast, so anything
         # that turned terminal during the outage is unknown to the rebuilt
@@ -678,6 +724,8 @@ class ClusterAdapter:
             self._io.submit(self._on_tracing, payload)
         elif channel == "profiling":
             self._io.submit(self._on_profiling, payload)
+        elif channel == "events":
+            self._io.submit(self._on_events, payload)
 
     def _on_profiling(self, payload: dict) -> None:
         """Cluster-wide profiler arm/disarm AND live stack-dump requests
@@ -695,6 +743,29 @@ class ClusterAdapter:
                 return
             profiling.apply_remote(payload)
             profiling.broadcast_local(self.rt, payload)
+        except Exception:
+            pass
+
+    def _on_events(self, payload: dict) -> None:
+        """Cluster-wide event-plane arm/disarm AND log-fetch requests
+        (the `rtpu logs` federation, cluster-wide): a ``logfetch`` op
+        resolves the target against this node's workers/session logs and
+        replies to the GCS rendezvous (only when it has rows — the
+        collector counts replies, not nodes); an arming payload applies
+        here and relays to this runtime's workers over their pipes."""
+        from ray_tpu.util import events
+
+        try:
+            if payload.get("op") == "logfetch":
+                rows = self.rt.fetch_local_logs(
+                    payload.get("target") or {},
+                    tail_bytes=payload.get("tail_bytes"))
+                if rows:
+                    self.gcs.call("log_reply", payload.get("req"),
+                                  self.node_id, rows, timeout=10)
+                return
+            events.apply_remote(payload)
+            events.broadcast_local(self.rt, payload)
         except Exception:
             pass
 

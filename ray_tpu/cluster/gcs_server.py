@@ -127,6 +127,15 @@ class GcsService:
         self._profile_ev_seq: Dict[bytes, int] = {}
         self._stack_req_seq = 0
         self._stack_replies: Dict[int, Dict[str, Any]] = {}
+        # event plane: lifecycle events shipped on node heartbeats (same
+        # cursor+dedup contract); the GCS appends its OWN node-lifecycle
+        # events (register / death) here directly. Log-fetch rendezvous
+        # for `rtpu logs` mirrors the stack-dump rendezvous above.
+        self.lifecycle_events = deque(
+            maxlen=int(config.get("gcs_max_lifecycle_events")))
+        self._lifecycle_ev_seq: Dict[bytes, int] = {}
+        self._log_req_seq = 0
+        self._log_replies: Dict[int, Dict[str, Any]] = {}
         # metrics federation: latest [(origin_labels, records)] payload per
         # node, replaced wholesale on each carrying heartbeat (idempotent;
         # reference metrics-agent -> head pipeline role). Head /metrics
@@ -219,6 +228,12 @@ class GcsService:
                           resources: Dict[str, float], is_head: bool,
                           labels: Optional[Dict[str, str]] = None):
         with self.lock:
+            # returned to the caller: False = this GCS had no entry for
+            # the node (fresh process after a restart — dead entries are
+            # kept with alive=False, so a blackout re-register stays
+            # True). A re-registering daemon uses it to detect GCS state
+            # loss (the gcs_restart lifecycle event).
+            known = node_id in self.nodes
             self.nodes[node_id] = _NodeEntry(node_id, addr, resources,
                                              is_head, labels)
         ctx.meta["node_id"] = node_id
@@ -226,7 +241,15 @@ class GcsService:
         self._publish("nodes", {"event": "up", "node_id": node_id,
                                 "addr": addr, "resources": dict(resources),
                                 "labels": dict(labels or {})})
-        return True
+        try:
+            from ray_tpu.util import events as _events
+
+            self._append_lifecycle(_events.record(
+                "node_register", node_id=node_id.hex()[:8], addr=addr,
+                is_head=bool(is_head), component="gcs"))
+        except Exception:
+            pass
+        return known
 
     def rpc_node_heartbeat(self, ctx, node_id: bytes,
                            avail: Dict[str, float], queue_depth: int,
@@ -339,6 +362,22 @@ class GcsService:
                                 "cause": cause, "lost_objects": lost,
                                 "dead_actors": dead_actors,
                                 "lost_pgs": lost_pgs})
+        try:
+            from ray_tpu.util import events as _events
+
+            # the node-death postmortem is the BLAST RADIUS — there is
+            # no process left to read a stderr tail from, so the useful
+            # forensics are what the cluster lost with the node
+            self._append_lifecycle(_events.record(
+                "node_death", node_id=node_id.hex()[:8], cause=cause,
+                component="gcs",
+                postmortem={"cause": cause,
+                            "lost_objects": len(lost),
+                            "dead_actors": len(dead_actors),
+                            "lost_pg_bundles": sum(
+                                len(v) for v in lost_pgs.values())}))
+        except Exception:
+            pass
 
     def _health_loop(self):
         while not self._stop.wait(DEFAULT_HEARTBEAT_S):
@@ -366,6 +405,7 @@ class GcsService:
                          "task_events": len(self.task_events),
                          "trace_events": len(self.trace_events),
                          "profile_events": len(self.profile_events),
+                         "lifecycle_events": len(self.lifecycle_events),
                          "free_candidates": len(self._free_candidates),
                          "tombstones": len(self._freed_tombstones)}
                 alive = sum(1 for e in self.nodes.values() if e.alive)
@@ -617,6 +657,42 @@ class GcsService:
 
     # -- live cluster-wide stack dumps (`ray_tpu stack` py-spy role) ----
 
+    def rpc_lifecycle_events(self, ctx, node_id: bytes, events,
+                             start_seq=None):
+        """Batched lifecycle events from a node's EventStore (event-plane
+        twin of rpc_trace_events — same acked-cursor/dedup contract
+        against the per-node high-water mark)."""
+        with self.lock:
+            if start_seq is not None:
+                seen = self._lifecycle_ev_seq.get(node_id, 0)
+                skip = max(0, seen - start_seq)
+                if skip >= len(events):
+                    return True
+                events = events[skip:]
+                start_seq += skip
+                self._lifecycle_ev_seq[node_id] = start_seq + len(events)
+            self.lifecycle_events.extend(events)
+        return True
+
+    def rpc_lifecycle_events_get(self, ctx, limit: int = 10000):
+        limit = int(limit)
+        if limit <= 0:
+            return []
+        with self.lock:
+            evs = list(self.lifecycle_events)
+        return evs[-limit:]
+
+    def _append_lifecycle(self, rec) -> None:
+        """Append a GCS-origin event record: node register/death are
+        observed HERE (no daemon survives to report its own death), so
+        the record skips the ring/heartbeat hop and lands in the head
+        store directly with component=gcs provenance. ``rec`` is an
+        ``events.record(...)`` result (None when the plane is killed)."""
+        if rec is None:
+            return
+        with self.lock:
+            self.lifecycle_events.append(rec)
+
     def rpc_stack_request(self, ctx):
         """Start a cluster-wide stack dump: publish the request on the
         ``profiling`` channel (every node's adapter collects its process
@@ -645,6 +721,41 @@ class GcsService:
         answered or their own deadline passes)."""
         with self.lock:
             return dict(self._stack_replies.get(req_id) or {})
+
+    # -- cluster-wide log federation (`rtpu logs` rendezvous) -----------
+
+    def rpc_log_request(self, ctx, target: dict,
+                        tail_bytes: Optional[int] = None):
+        """Start a cluster-wide log fetch: publish the resolution target
+        on the ``events`` channel (every node's adapter resolves it
+        against its own workers/session logs and calls log_reply only
+        when it has rows) and return the request id the caller later
+        passes to log_collect."""
+        with self.lock:
+            self._log_req_seq += 1
+            req_id = self._log_req_seq
+            self._log_replies[req_id] = {}
+            # bound: keep only the most recent requests
+            while len(self._log_replies) > 8:
+                self._log_replies.pop(min(self._log_replies))
+        self._publish("events", {"op": "logfetch", "req": req_id,
+                                 "target": dict(target or {}),
+                                 "tail_bytes": tail_bytes})
+        return req_id
+
+    def rpc_log_reply(self, ctx, req_id: int, node_id: bytes, rows):
+        with self.lock:
+            bucket = self._log_replies.get(req_id)
+            if bucket is not None:
+                bucket[node_id.hex()[:8]] = rows
+        return True
+
+    def rpc_log_collect(self, ctx, req_id: int):
+        """{node_id: [log rows]} gathered so far for a log_request id
+        (callers poll until a reply lands or their deadline passes —
+        unlike stackdumps, only nodes that RESOLVED the target reply)."""
+        with self.lock:
+            return dict(self._log_replies.get(req_id) or {})
 
     def rpc_metrics_get(self, ctx, exclude_node: Optional[bytes] = None):
         """Flattened [(origin_labels, records)] across nodes for the head

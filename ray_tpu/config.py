@@ -248,6 +248,29 @@ _knob("profile_store_max", int, 2048,
 _knob("gcs_max_profile_events", int, 4096,
       "cluster-wide profile-batch buffer size in the GCS (profile twin "
       "of gcs_max_trace_events)", "cluster/gcs_server.py")
+_knob("event_ring", int, 2048,
+      "per-process lifecycle-event ring capacity (event plane recording "
+      "side); overflow before collection drops the oldest event and "
+      "counts rtpu_lifecycle_events_dropped_total", "util/events.py")
+_knob("event_push_interval_s", float, 1.0,
+      "min seconds between a worker's batched lifecycle-event pushes "
+      "over the control pipe (the event twin of trace_push_interval_s)",
+      "core/worker.py")
+_knob("event_store_max", int, 16384,
+      "lifecycle events retained by a runtime's EventStore (head query "
+      "surface; daemons buffer here between heartbeats)",
+      "util/event_store.py")
+_knob("gcs_max_lifecycle_events", int, 16384,
+      "cluster-wide lifecycle-event buffer size in the GCS (event twin "
+      "of gcs_max_trace_events)", "cluster/gcs_server.py")
+_knob("alerts_interval_s", float, 5.0,
+      "watchdog evaluation period for the declarative alert rules at "
+      "the head (RTPU_ALERTS=0 kills the watchdog outright)",
+      "util/alerts.py")
+_knob("log_tail_bytes", int, 16384,
+      "max bytes of one log file shipped per cluster-wide log fetch "
+      "(`rtpu logs` / /api/logs); postmortem stderr tails use a smaller "
+      "fixed bound", "util/events.py")
 _knob("obj_meta_max", int, 100_000,
       "object creation-metadata entries (owner/age/call-site) kept by "
       "the driver for `ray_tpu memory` forensics", "core/runtime.py")

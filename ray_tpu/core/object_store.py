@@ -258,6 +258,7 @@ class StoreClient:
                     data, buffers, spill_codec.BLOCK_RAW))
             m["spilled_bytes"].inc(size)  # logical, as always
             m["spilled_objects"].inc()
+            self._note_spill_event(obj_id, size, "put")
             self._note_put(m, "spill", size, t0)
             return None, size
         path = _seg_path(self.session, obj_id)
@@ -272,6 +273,19 @@ class StoreClient:
         self._file_bytes += size
         self._note_put(m, "file", size, t0)
         return None, size
+
+    @staticmethod
+    def _note_spill_event(obj_id: ObjectID, size: int, how: str) -> None:
+        """THE object_spill emit site (one call site for the event-name
+        catalog): both the put-path overflow spill and the chunked-pull
+        writer's spill report through here."""
+        try:
+            from ray_tpu.util import events
+
+            events.emit("object_spill", object_id=obj_id.hex()[:16],
+                        size=size, how=how)
+        except Exception:
+            pass
 
     @staticmethod
     def _note_put(m, path: str, size: int, t0: float) -> None:
@@ -684,6 +698,13 @@ class StoreClient:
         m = _store_metrics()
         m["restored_bytes"].inc(size)
         m["restored_objects"].inc()
+        try:
+            from ray_tpu.util import events
+
+            events.emit("object_restore", object_id=obj_id.hex()[:16],
+                        size=size, into="arena" if restored else "file")
+        except Exception:
+            pass
         return True
 
     @staticmethod
@@ -784,6 +805,8 @@ class IncomingObject:
                 m = _store_metrics()
                 m["spilled_bytes"].inc(self._size)
                 m["spilled_objects"].inc()
+                ObjectStore._note_spill_event(self._oid, self._size,
+                                              "chunked_pull")
             else:
                 self._store._file_bytes += self._size
 

@@ -39,8 +39,8 @@ PIPE_WORKER_MSGS = frozenset({
 #: top-level message kinds the driver ships to a worker
 #: (``Worker._dispatch_recv`` arms)
 PIPE_DRIVER_MSGS = frozenset({
-    "exec", "cancel", "reply", "fp", "trace", "prof", "stackdump",
-    "shutdown",
+    "exec", "cancel", "reply", "fp", "trace", "prof", "events",
+    "stackdump", "shutdown",
 })
 
 #: fire-and-forget worker->driver casts: ``("cast", op, args)``
@@ -48,7 +48,7 @@ PIPE_DRIVER_MSGS = frozenset({
 PIPE_CASTS = frozenset({
     "put", "submit", "actor_call", "fn_put", "blocked", "unblocked",
     "kill_actor", "cancel", "stream_consumed", "refpins", "metrics",
-    "spans", "prof", "stacks", "free",
+    "spans", "prof", "stacks", "free", "events",
 })
 
 #: request/reply worker->driver ops: ``("req", req_id, op, args)``
@@ -73,6 +73,8 @@ GCS_RPC = frozenset({
     "task_events", "task_events_get", "trace_events", "trace_events_get",
     "profile_events", "profile_events_get", "stack_request",
     "stack_reply", "stack_collect", "metrics_get",
+    "lifecycle_events", "lifecycle_events_get", "log_request",
+    "log_reply", "log_collect",
     # kv + function store
     "kv_put", "kv_get", "kv_del", "kv_keys", "fn_put", "fn_get",
     # actors
@@ -109,4 +111,5 @@ PEER_RPC = frozenset({
 
 PUBSUB_CHANNELS = frozenset({
     "nodes", "objects", "pgs", "failpoints", "tracing", "profiling",
+    "events",
 })

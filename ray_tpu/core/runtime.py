@@ -268,6 +268,41 @@ def _pid_alive(pid: int) -> bool:
         return True
 
 
+def _worker_crashed_error(ws, spec, pm) -> WorkerCrashedError:
+    """A ``WorkerCrashedError`` carrying the death postmortem: the exit
+    cause class rides ``error_type`` (the r16 machine-readable contract,
+    e.g. ``worker_died:signal:SIGKILL``), the structured forensics ride
+    ``postmortem``, and the message folds in the readable excerpt so a
+    bare ``ray_tpu.get`` shows WHY the worker died."""
+    from ray_tpu.util import events as _events
+
+    cause = (pm or {}).get("cause", "unknown")
+    msg = (f"worker {ws.worker_id.hex()} died running task "
+           f"{spec.get('name') if spec else '?'} ({cause})")
+    detail = _events.format_postmortem(pm)
+    if detail:
+        msg += "\n--- worker postmortem ---\n" + detail
+    err = WorkerCrashedError(msg)
+    err.error_type = f"worker_died:{cause}"
+    err.postmortem = pm
+    return err
+
+
+def _actor_died_error(actor_hex: str, pm) -> ActorDiedError:
+    """``ActorDiedError`` twin of :func:`_worker_crashed_error`."""
+    from ray_tpu.util import events as _events
+
+    cause = (pm or {}).get("cause", "unknown")
+    msg = f"actor {actor_hex} died ({cause})"
+    detail = _events.format_postmortem(pm)
+    if detail:
+        msg += "\n--- worker postmortem ---\n" + detail
+    err = ActorDiedError(msg)
+    err.error_type = f"actor_died:{cause}"
+    err.postmortem = pm
+    return err
+
+
 class _Zygote:
     """Driver-side handle for the fork-server process (core/zygote.py)."""
 
@@ -482,6 +517,24 @@ class DriverRuntime:
 
         self.profile_store = _profiling.ProfileStore()
         self._profile_push = None
+        # event plane (receiver side): workers' lifecycle-event batches
+        # and this process's own ring land here; daemons ship deltas on
+        # the heartbeat, the head serves state.list_events()
+        from ray_tpu.util.event_store import EventStore
+
+        self.event_store = EventStore()
+        self._event_push = None
+        # alerting watchdog (head-side): declarative rules over the
+        # metric view, RTPU_ALERTS=0 kills it. Started here (the driver
+        # IS the head in local mode and the head node's driver in
+        # cluster mode); daemons don't evaluate — their metrics reach
+        # the head on heartbeats.
+        try:
+            from ray_tpu.util import alerts as _alerts
+
+            _alerts.start_watchdog()
+        except Exception:
+            pass
         # env-armed boot (RTPU_PROFILING=1 before init): resolving here
         # starts this process's sampler; one dict get when disarmed
         _profiling.profiling_enabled()
@@ -902,6 +955,7 @@ class DriverRuntime:
                     self.workers[wid] = ws
                 threading.Thread(target=self._reap, args=(ws,),
                                  daemon=True).start()
+                self._note_spawn_event(ws)
                 return ws
 
         wid = WorkerID.from_random()
@@ -975,7 +1029,20 @@ class DriverRuntime:
         with self.lock:
             self.workers[wid] = ws
         threading.Thread(target=self._reap, args=(ws,), daemon=True).start()
+        self._note_spawn_event(ws)
         return ws
+
+    def _note_spawn_event(self, ws: _WorkerState) -> None:
+        """One worker_spawn lifecycle event per spawn (both paths)."""
+        try:
+            from ray_tpu.util import events as _events
+
+            _events.emit("worker_spawn",
+                         worker_id=ws.worker_id.hex()[:8],
+                         kind=ws.kind, spawn_mode=ws.spawn_mode,
+                         pid=getattr(ws.proc, "pid", None))
+        except Exception:
+            pass
 
     def _reap(self, ws: _WorkerState):
         ws.proc.wait()
@@ -1154,6 +1221,30 @@ class DriverRuntime:
             self._m_deaths._inc_key(())
         except Exception:
             pass
+        # Death forensics at the reaping site (event plane): exit
+        # code/signal from the Popen/zygote exit report, stderr tail +
+        # error lines + last USR1 stack from the worker's log file —
+        # built ONCE here and shared by the worker_death lifecycle event
+        # and the WorkerCrashedError/ActorDiedError users see.
+        pm = None
+        try:
+            from ray_tpu.util import events as _events
+
+            # the pipe-EOF reader usually gets here BEFORE the reaper /
+            # zygote exit report lands; the process is already dead, so
+            # a short wait turns "unknown" into the real exit signal
+            status = ws.proc.poll()
+            if status is None:
+                try:
+                    status = ws.proc.wait(timeout=2.0)
+                except Exception:
+                    status = ws.proc.poll()
+            pm = _events.build_postmortem(
+                exit_status=status,
+                log_path=ws.log_path,
+                pid=getattr(ws.proc, "pid", None))
+        except Exception:
+            pm = None
         self._drop_worker_pins(ws)
         with self.lock:
             if not ws.released:
@@ -1162,10 +1253,29 @@ class DriverRuntime:
             inflight = list(ws.inflight_specs.values())
             ws.inflight_specs.clear()
             ws.current = None
+        try:
+            from ray_tpu.util import events as _events
+
+            _events.emit(
+                "worker_death",
+                worker_id=ws.worker_id.hex()[:8],
+                kind=ws.kind,
+                spawn_mode=ws.spawn_mode,
+                pid=getattr(ws.proc, "pid", None),
+                actor_id=(ActorID(ws.actor_id).hex()
+                          if ws.actor_id else None),
+                task=((spec.get("name") or spec.get("method"))
+                      if spec else None),
+                task_id=(spec["task_id"].hex()[:16]
+                         if spec and spec.get("task_id") else None),
+                cause=(pm or {}).get("cause", "unknown"),
+                postmortem=pm)
+        except Exception:
+            pass
         if spec is not None and spec["type"] == ts.ACTOR_CREATE:
-            self._actor_process_died(ws, [])
+            self._actor_process_died(ws, [], pm)
         elif ws.actor_id is not None:
-            self._actor_process_died(ws, inflight)
+            self._actor_process_died(ws, inflight, pm)
         elif spec is not None:
             if spec.get("retries_left", 0) > 0:
                 spec["retries_left"] -= 1
@@ -1175,8 +1285,8 @@ class DriverRuntime:
                     err = cloudpickle.dumps(
                         TaskCancelledError("task was cancelled (force)"))
                 else:
-                    err = cloudpickle.dumps(WorkerCrashedError(
-                        f"worker {ws.worker_id.hex()} died running task"))
+                    err = cloudpickle.dumps(
+                        _worker_crashed_error(ws, spec, pm))
                 for rid in spec["return_ids"]:
                     self.gcs.mark_error(ObjectID(rid), err)
         with self.lock:
@@ -1194,7 +1304,8 @@ class DriverRuntime:
         self._pump()
 
     def _actor_process_died(self, ws: _WorkerState,
-                            inflight_specs: List[dict]):
+                            inflight_specs: List[dict],
+                            pm: Optional[dict] = None):
         aid = ws.actor_id or next(
             (s.get("actor_id") for s in inflight_specs if s.get("actor_id")),
             None)
@@ -1203,7 +1314,7 @@ class DriverRuntime:
         info = self.gcs.get_actor(ActorID(aid))
         if info is None:
             return
-        err = cloudpickle.dumps(ActorDiedError(f"actor {ActorID(aid).hex()} died"))
+        err = cloudpickle.dumps(_actor_died_error(ActorID(aid).hex(), pm))
         for s in inflight_specs:
             for rid in s["return_ids"]:
                 self.gcs.mark_error(ObjectID(rid), err)
@@ -1215,6 +1326,25 @@ class DriverRuntime:
                 restart = True
             else:
                 restart = False
+        try:
+            from ray_tpu.util import events as _events
+
+            if restart:
+                _events.emit("actor_restart",
+                             actor_id=ActorID(aid).hex(),
+                             restarts=info.restarts,
+                             max_restarts=info.max_restarts,
+                             worker_id=ws.worker_id.hex()[:8],
+                             cause=(pm or {}).get("cause", "unknown"))
+            else:
+                _events.emit("actor_death",
+                             actor_id=ActorID(aid).hex(),
+                             restarts=info.restarts,
+                             worker_id=ws.worker_id.hex()[:8],
+                             cause=(pm or {}).get("cause", "unknown"),
+                             postmortem=pm)
+        except Exception:
+            pass
         if restart:
             new_ws = self._spawn_worker("actor")
             new_ws.actor_id = aid
@@ -1280,6 +1410,13 @@ class DriverRuntime:
             if ppush is not None:
                 try:
                     ws.send(("prof", ppush))
+                except (OSError, BrokenPipeError):
+                    pass
+            # event plane: same replay for enable/disable_events()
+            epush = getattr(self, "_event_push", None)
+            if epush is not None:
+                try:
+                    ws.send(("events", epush))
                 except (OSError, BrokenPipeError):
                     pass
             with self.lock:
@@ -1598,6 +1735,17 @@ class DriverRuntime:
             # pure deque appends into the bounded ProfileStore
             try:
                 self.profile_store.ingest(
+                    args[0],
+                    {"worker_id": ws.worker_id.hex()[:8],
+                     "node_id": self.node_id.hex()[:8],
+                     "component": "worker"})
+            except Exception:
+                pass
+        elif op == "events":
+            # event plane: batched lifecycle-event push from the worker —
+            # pure deque appends into the bounded EventStore
+            try:
+                self.event_store.ingest(
                     args[0],
                     {"worker_id": ws.worker_id.hex()[:8],
                      "node_id": self.node_id.hex()[:8],
@@ -2840,6 +2988,84 @@ class DriverRuntime:
         self.trace_store.ingest(
             batch, {"node_id": self.node_id.hex()[:8], "component": comp})
 
+    def collect_lifecycle_events(self) -> None:
+        """Drain this PROCESS's event ring into the runtime's EventStore
+        with origin labels — called at query time (state.list_events)
+        and before each heartbeat ships event deltas, so driver/daemon
+        events join their workers' pushed batches."""
+        from ray_tpu.util import events
+
+        batch = events.drain_ring()
+        if not batch:
+            return
+        comp = "driver"
+        if self.cluster is not None and not self.cluster.is_scheduler:
+            comp = "raylet"
+        self.event_store.ingest(
+            batch, {"node_id": self.node_id.hex()[:8], "component": comp})
+
+    def fetch_local_logs(self, target: dict,
+                         tail_bytes: Optional[int] = None) -> List[dict]:
+        """Resolve a log-fetch target against THIS node's session logs
+        (the daemon half of the log-federation rendezvous; also the
+        single-node fast path). ``target``: ``{"worker_id": <hex>}`` for
+        one worker's log, or ``{"node": True}`` for every log file of
+        this node's session (daemon + workers, bounded). Live workers
+        whose log file was deleted under them are read through
+        ``/proc/<pid>/fd`` (the known failure mode on this box). Returns
+        [] when the target resolves to nothing here — the head keeps
+        only non-empty replies."""
+        from ray_tpu import config
+        from ray_tpu.util import events as _events
+
+        if tail_bytes is None:
+            tail_bytes = int(config.get("log_tail_bytes"))
+        want_node = (target.get("node_id") or "").lower()
+        if want_node and not self.node_id.hex().startswith(want_node[:8]):
+            return []  # a node-scoped fetch for some other node
+        logs_dir = os.path.join(self.session_dir, "logs")
+        want_wid = (target.get("worker_id") or "").lower()
+        rows: List[tuple] = []
+        if want_wid:
+            w8 = want_wid[:8]
+            with self.lock:
+                ws = next((w for w in self.workers.values()
+                           if w.worker_id.hex().startswith(w8)), None)
+            path = (ws.log_path if ws is not None and ws.log_path
+                    else os.path.join(logs_dir, f"worker-{w8}.log"))
+            pid = getattr(ws.proc, "pid", None) if ws is not None else None
+            if ws is not None or os.path.exists(path):
+                rows.append((f"worker:{w8}", path, pid))
+        elif target.get("node"):
+            try:
+                for name in sorted(os.listdir(logs_dir))[:32]:
+                    if name.endswith(".log"):
+                        rows.append((name, os.path.join(logs_dir, name),
+                                     None))
+            except OSError:
+                pass
+        out: List[dict] = []
+        for label, path, pid in rows:
+            tail = _events._read_log_tail(path, pid, int(tail_bytes))
+            out.append({
+                "label": label,
+                "path": path,
+                "node_id": self.node_id.hex()[:8],
+                "bytes": len(tail),
+                "tail": tail,
+                "error_lines": _events.extract_error_lines(tail),
+            })
+        if out:
+            try:
+                from ray_tpu.util import metric_defs as _md
+
+                _md.get("rtpu_log_fetches_total")._inc_key((), len(out))
+                _md.get("rtpu_log_fetch_bytes_total")._inc_key(
+                    (), sum(r["bytes"] for r in out))
+            except Exception:
+                pass
+        return out
+
     def collect_profile_batches(self) -> None:
         """Drain this PROCESS's sampler window into the runtime's
         ProfileStore with origin labels — called at query time
@@ -2908,6 +3134,12 @@ class DriverRuntime:
             federation.clear()  # drop this runtime's worker-origin samples
             if self._metrics_collector is not None:
                 unregister_collector(self._metrics_collector)
+        except Exception:
+            pass
+        try:
+            from ray_tpu.util import alerts as _alerts
+
+            _alerts.stop_watchdog()
         except Exception:
             pass
         _object_ref.clear_ref_hook()

@@ -150,6 +150,11 @@ class WorkerRuntime:
         # rides the pipe as batched casts on the same cadence pattern
         self._profile_last_push = 0.0
         self._profile_interval: Optional[float] = None
+        # event plane (sender side): lifecycle events ride the pipe as
+        # batched casts on the same cadence pattern (events are rare —
+        # the interval only bounds the batching delay)
+        self._event_last_push = 0.0
+        self._event_interval: Optional[float] = None
         try:
             from ray_tpu import config as _cfg
 
@@ -430,6 +435,18 @@ class WorkerRuntime:
                     # disarm: ship the table's tail NOW (the push
                     # loop stops looking once profiling is off)
                     self._push_profile_now()
+        elif kind == "events":
+            # event plane: driver-pushed mid-session arm/disarm —
+            # workers spawned before an enable/disable_events() flip
+            # learn here
+            from ray_tpu.util import events
+
+            if msg[1] is not None:
+                events.apply_remote(msg[1])
+                if not msg[1].get("enabled"):
+                    # disarm: ship the ring's tail NOW (the push
+                    # loop stops looking once events are off)
+                    self._push_events_now()
         elif kind == "stackdump":
             # live stack request (`ray_tpu stack` py-spy role): walk
             # sys._current_frames on THIS receiver thread (pure
@@ -1264,16 +1281,52 @@ class WorkerRuntime:
         except Exception:
             pass
 
+    def _maybe_push_events(self) -> None:
+        """Drain this process's lifecycle-event ring to the driver as a
+        batched cast, rate-limited (the event twin of
+        _maybe_push_spans). One dict get when the plane is killed."""
+        from ray_tpu.util import events
+
+        if not events.events_enabled():
+            return
+        now = time.monotonic()
+        if self._event_interval is None:
+            try:
+                from ray_tpu import config as _cfg
+
+                self._event_interval = float(
+                    _cfg.get("event_push_interval_s"))
+            except Exception:
+                self._event_interval = 1.0
+        if now - self._event_last_push < self._event_interval:
+            return
+        self._event_last_push = now
+        self._push_events_now()
+
+    def _push_events_now(self) -> None:
+        """Drain the ring and ship it as one cast — THE event-push hop,
+        shared by the rate-limited loop and the disarm tail flush."""
+        from ray_tpu.util import events
+
+        try:
+            batch = events.drain_ring()
+            if batch:
+                self.cast("events", batch)
+                events.note_push()
+        except Exception:
+            pass
+
     def push_telemetry(self) -> None:
-        """Rate-limited metric/span/profile pushes, callable from ANY
-        thread: the main loop's idle ticks, and compiled-DAG exec loops —
-        whose occupying ``__rtpu_call__`` starves a concurrency-1 actor's
-        main loop, so without this hook a DAG actor's spans/metrics would
-        strand in its rings until teardown."""
+        """Rate-limited metric/span/profile/event pushes, callable from
+        ANY thread: the main loop's idle ticks, and compiled-DAG exec
+        loops — whose occupying ``__rtpu_call__`` starves a
+        concurrency-1 actor's main loop, so without this hook a DAG
+        actor's spans/metrics would strand in its rings until teardown."""
         with self._push_lock:
             self._maybe_push_metrics()
             self._maybe_push_spans()
             self._maybe_push_profile()
+            self._maybe_push_events()
 
     def main_loop(self):
         self._start_receiver()

@@ -91,6 +91,19 @@ class _Handler(BaseHTTPRequestHandler):
             "/api/task_events": st.list_task_events,
             # lock-contention profiler (this process's hot locks)
             "/api/contention": st.summarize_contention,
+            # event plane: lifecycle events w/ death postmortems
+            "/api/events": lambda: st.list_events(
+                limit=int(_p("limit", 1000)),
+                filters=([("name", "=", _p("name"))]
+                         if _p("name") else None)),
+            # log federation: ?worker_id= / ?task_id= / ?actor_id= /
+            # ?node_id= resolves to bounded log tails cluster-wide
+            "/api/logs": lambda: st.fetch_logs(
+                {k: _p(k) for k in ("worker_id", "task_id", "actor_id",
+                                    "node_id") if _p(k)},
+                timeout=float(_p("timeout", 5.0))),
+            # alerting watchdog: currently-raised alerts
+            "/api/alerts": st.list_alerts,
             # job submission REST (list; per-job routes handled below)
             "/api/jobs": _jobs_list,
             # serve REST (reference dashboard/modules/serve role)

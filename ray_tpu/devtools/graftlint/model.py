@@ -88,6 +88,7 @@ def register(cls):
 def _load_rule_modules() -> None:
     # import for registration side effect; cheap (stdlib-only modules)
     from ray_tpu.devtools.graftlint import (  # noqa: F401
+        rules_events,
         rules_failpoints,
         rules_invariants,
         rules_jax,

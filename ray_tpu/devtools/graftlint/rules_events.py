@@ -1,0 +1,145 @@
+"""Event-plane discipline rules (family ``invariants``).
+
+The event plane (ISSUE 18) is only as debuggable as its event names:
+``state.list_events()`` filters, ``rtpu events --name``, and the alert
+rules all key off the flat ``lower_snake`` catalog in
+``util/events.py``'s docstring. Every name is emitted from exactly ONE
+call site (the reaping/registration site that owns the fact), so a
+head-visible event is attributable to a single code path — the same
+literal+unique+doc-sync contract as failpoint sites and tracing spans.
+Both ``events.emit()`` (ring + ship) and ``events.record()`` (build
+only — the GCS appends directly to its own store) are emission sites.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from collections import defaultdict
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from ray_tpu.devtools.graftlint.engine import Project
+from ray_tpu.devtools.graftlint.model import (
+    FAMILY_INVARIANTS,
+    Finding,
+    Rule,
+    register,
+)
+
+EVENTS_MOD = "ray_tpu/util/events.py"
+_EMIT_FNS = ("emit", "record")
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+_CATALOG_LINE = re.compile(r"^\s{4}([a-z][a-z0-9_]*)\s{2,}\S")
+
+
+def documented_event_names(events_source: str) -> Set[str]:
+    """Exact names from the ``Event names`` block of util/events.py's
+    docstring. Event names are flat ``lower_snake`` identifiers — there
+    is deliberately no dynamic-prefix escape hatch (unlike spans): the
+    catalog is closed so death/alert consumers can switch on it."""
+    tree = ast.parse(events_source)
+    doc = ast.get_docstring(tree) or ""
+    names: Set[str] = set()
+    in_block = False
+    seen_entry = False
+    for line in doc.splitlines():
+        if line.startswith("Event names"):
+            in_block = True
+            continue
+        if in_block:
+            m = _CATALOG_LINE.match(line)
+            if m:
+                seen_entry = True
+                names.add(m.group(1))
+            elif seen_entry and line.strip() and not line.startswith(" "):
+                break  # next top-level section (after the entries)
+    return names
+
+
+def _is_event_call(cs) -> Optional[str]:
+    """The event-API function name when ``cs`` emits events, else None."""
+    if cs.fq and cs.fq.startswith("ray_tpu.util.events."):
+        fn = cs.fq.rsplit(".", 1)[1]
+        return fn if fn in _EMIT_FNS else None
+    if (cs.parts and len(cs.parts) >= 2
+            and cs.parts[-2] in ("events", "_events")
+            and cs.parts[-1] in _EMIT_FNS):
+        return cs.parts[-1]
+    return None
+
+
+def _event_name_arg(node: ast.Call):
+    """('literal', name) for a str constant first arg, (None, None)
+    otherwise — event names have no f-string prefix form."""
+    if not node.args:
+        return None, None
+    arg = node.args[0]
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return "literal", arg.value
+    return None, None
+
+
+@register
+class EventNameCatalog(Rule):
+    name = "event-name-catalog"
+    family = FAMILY_INVARIANTS
+    summary = ("lifecycle event names passed to events.emit()/record() "
+               "are literal lower_snake strings, unique per call site, "
+               "and present in util/events.py's Event-names catalog")
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        ev_mod = project.module(EVENTS_MOD)
+        documented = (documented_event_names(ev_mod.source)
+                      if ev_mod is not None else None)
+        literals: Dict[str, List[Tuple]] = defaultdict(list)
+        for mod in project.modules:
+            if mod.scope_rel == EVENTS_MOD:
+                continue
+            for cs in mod.calls:
+                fn = _is_event_call(cs)
+                if fn is None:
+                    continue
+                kind, value = _event_name_arg(cs.node)
+                if kind is None:
+                    yield self.finding(
+                        mod, cs.line,
+                        f"events.{fn}() with a non-literal name — event "
+                        "names must be string literals so the catalog, "
+                        "list_events filters, and death-cause consumers "
+                        "stay greppable (no dynamic funnels)")
+                    continue
+                if not _NAME_RE.match(value):
+                    yield self.finding(
+                        mod, cs.line,
+                        f"event name {value!r} does not follow the flat "
+                        "'lower_snake' convention (lowercase letters, "
+                        "digits, underscores)")
+                    continue
+                literals[value].append((mod, cs.line))
+        for name, uses in sorted(literals.items()):
+            if len(uses) > 1:
+                locs = ", ".join(f"{m.display}:{ln}" for m, ln in uses)
+                for m, ln in uses:
+                    yield self.finding(
+                        m, ln,
+                        f"event name '{name}' is emitted from "
+                        f"{len(uses)} call sites ({locs}) — each event "
+                        "name is owned by exactly one emitting site so "
+                        "a head-visible event is attributable to one "
+                        "code path; funnel through a single helper or "
+                        "add a distinct name")
+            if documented is not None and name not in documented:
+                m, ln = uses[0]
+                yield self.finding(
+                    m, ln,
+                    f"event name '{name}' is not in util/events.py's "
+                    "Event-names catalog — add it there (the docstring "
+                    "is what operators and `rtpu events` readers grep)")
+        if documented is not None and ev_mod is not None \
+                and project.whole_package:
+            for entry in sorted(documented - set(literals)):
+                yield self.finding(
+                    ev_mod, 1,
+                    f"documented event name '{entry}' has no emitting "
+                    "call site left in the tree — remove it from the "
+                    "Event-names catalog or restore the emission")

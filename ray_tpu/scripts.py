@@ -22,6 +22,79 @@ def _cmd_status(args) -> int:
         size = os.stat(os.path.join("/dev/shm", a)).st_size
         print(f"  {a}  ({size >> 20} MiB mapped)")
     print(f"other rtpu shm segments: {len(shm) - len(arenas)}")
+    if getattr(args, "url", None):
+        # raised watchdog alerts from a running head (/api/alerts)
+        try:
+            alerts = _fetch_api(args.url, "/api/alerts") or []
+        except Exception as e:
+            print(f"alerts: unavailable ({e})")
+            return 0
+        if not alerts:
+            print("alerts: none raised")
+        for a in alerts:
+            print(f"ALERT [{a.get('severity', '?'):7}] {a.get('alert')}: "
+                  f"value={a.get('value'):.4g} "
+                  f"threshold={a.get('threshold')} — "
+                  f"{a.get('description', '')}")
+    return 0
+
+
+def _cmd_events(args) -> int:
+    """``rtpu events --url http://head:8265`` — the lifecycle-event log
+    (worker/actor/node deaths with postmortems, spills, serve reroutes,
+    alerts), newest last. ``--name worker_death`` filters; death rows
+    print their postmortem cause + first error line."""
+    path = f"/api/events?limit={args.limit}"
+    if args.name:
+        path += f"&name={args.name}"
+    evs = _fetch_api(args.url, path) or []
+    import datetime
+
+    for ev in evs:
+        ts = datetime.datetime.fromtimestamp(
+            ev.get("ts", 0)).strftime("%H:%M:%S")
+        sev = ev.get("severity", "info")
+        extras = {k: v for k, v in ev.items()
+                  if k not in ("name", "ts", "severity", "postmortem")}
+        kv = " ".join(f"{k}={v}" for k, v in sorted(extras.items()))
+        print(f"{ts} [{sev:7}] {ev.get('name', '?'):22} {kv}")
+        pm = ev.get("postmortem")
+        if pm:
+            print(f"    postmortem: cause={pm.get('cause', '?')}")
+            for ln in (pm.get("error_lines") or [])[-3:]:
+                print(f"      {ln}")
+    print(f"-- {len(evs)} event(s)")
+    return 0
+
+
+def _cmd_logs(args) -> int:
+    """``rtpu logs --task <id> --url http://head:8265`` — cluster-wide
+    log federation: resolve a task/actor/worker/node id to its log
+    file(s) wherever they live and print bounded tails (error lines
+    first). Dead workers resolve through their death events; live
+    processes whose log file was deleted are read via /proc fds."""
+    target = {k: getattr(args, k) for k in ("task_id", "actor_id",
+                                            "worker_id", "node_id")
+              if getattr(args, k, None)}
+    if not target:
+        print("rtpu logs needs one of --task/--actor/--worker/--node")
+        return 2
+    from urllib.parse import urlencode
+
+    rows = _fetch_api(args.url, "/api/logs?" + urlencode(target)) or []
+    for r in rows:
+        print(f"==== node {r.get('node_id', '?')} · {r.get('label')} "
+              f"({r.get('bytes', 0)} bytes) ====")
+        if args.errors_only:
+            for ln in r.get("error_lines") or []:
+                print(f"  {ln}")
+        else:
+            print(r.get("tail", ""), end="")
+            if not (r.get("tail") or "").endswith("\n"):
+                print()
+    if not rows:
+        print(f"no logs resolved for {target}")
+        return 1
     return 0
 
 
@@ -460,7 +533,30 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="ray_tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
 
-    sub.add_parser("status", help="show local shm sessions/arenas")
+    stat = sub.add_parser("status", help="show local shm sessions/arenas "
+                                         "(+ raised alerts with --url)")
+    stat.add_argument("--url", default=None,
+                      help="also show the watchdog's raised alerts from "
+                           "a running head (http://host:8265)")
+
+    ev = sub.add_parser("events", help="lifecycle-event log (deaths w/ "
+                                       "postmortems, spills, alerts)")
+    ev.add_argument("--url", default="http://127.0.0.1:8265",
+                    help="running head's dashboard (http://host:8265)")
+    ev.add_argument("--limit", type=int, default=200)
+    ev.add_argument("--name", default=None,
+                    help="only this event name (e.g. worker_death)")
+
+    lg = sub.add_parser("logs", help="cluster-wide log fetch by task/"
+                                     "actor/worker/node id")
+    lg.add_argument("--url", default="http://127.0.0.1:8265",
+                    help="running head's dashboard (http://host:8265)")
+    lg.add_argument("--task", dest="task_id", default=None)
+    lg.add_argument("--actor", dest="actor_id", default=None)
+    lg.add_argument("--worker", dest="worker_id", default=None)
+    lg.add_argument("--node", dest="node_id", default=None)
+    lg.add_argument("--errors-only", action="store_true",
+                    help="print only the extracted error lines")
     sub.add_parser("config", help="print every runtime knob (name, env "
                                   "var, default, current value)")
     sub.add_parser("clean", help="remove leftover rtpu shm segments")
@@ -581,6 +677,10 @@ def main(argv=None) -> int:
         return _cmd_list(args)
     if args.cmd == "stack":
         return _cmd_stack(args)
+    if args.cmd == "events":
+        return _cmd_events(args)
+    if args.cmd == "logs":
+        return _cmd_logs(args)
     if args.cmd == "profile":
         return _cmd_profile(args)
     if args.cmd == "up":

@@ -139,6 +139,19 @@ class ServeController:
         if len(entry["replicas"]) != before:
             self._reconcile(name)
             self._version += 1
+            # lifecycle events (controller runs inside an actor, so
+            # these ride the worker's pipe push like any other event)
+            try:
+                from ray_tpu.util import events
+
+                events.emit("serve_replica_death", deployment=name,
+                            actor_id=actor_id.hex(),
+                            replicas_left=len(entry["replicas"]))
+                events.emit("serve_reroute", deployment=name,
+                            version=self._version,
+                            target=entry.get("target", 1))
+            except Exception:
+                pass
         return self._version
 
     def _kill(self, replica) -> None:

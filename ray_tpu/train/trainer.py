@@ -133,6 +133,14 @@ class DataParallelTrainer(BaseTrainer):
                     trial_dir, self.scaling_config.num_workers)
                 if latest:
                     start_ckpt = latest
+                    try:
+                        from ray_tpu.util import events
+
+                        events.emit("checkpoint_resume", trial=name,
+                                    checkpoint=latest, attempt=attempt + 1,
+                                    error=type(e).__name__)
+                    except Exception:
+                        pass
         raise TrainingFailedError(
             f"training failed after {attempts} attempt(s)") from last_error
 

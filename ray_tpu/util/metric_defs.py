@@ -358,6 +358,43 @@ _def("rtpu_profile_push_batches_total", "counter",
      "pushes + node heartbeat rides)", component="profiling")
 
 # ---------------------------------------------------------------------------
+# event plane (util/events.py -> util/event_store.py)
+# ---------------------------------------------------------------------------
+
+_def("rtpu_lifecycle_events_total", "counter",
+     "lifecycle events recorded into this process's event ring "
+     "(worker/actor/node deaths, spills, serve re-routes, alerts; "
+     "0 when RTPU_EVENTS=0)", component="events")
+_def("rtpu_lifecycle_events_dropped_total", "counter",
+     "events evicted from the bounded event ring before collection "
+     "(raise RTPU_EVENT_RING or shorten the push interval)",
+     component="events")
+_def("rtpu_event_push_batches_total", "counter",
+     "lifecycle-event batches shipped toward the head (worker "
+     "control-pipe pushes + node heartbeat rides)", component="events")
+
+# ---------------------------------------------------------------------------
+# alerting watchdog (util/alerts.py)
+# ---------------------------------------------------------------------------
+
+_def("rtpu_alerts_active", "gauge",
+     "alert rules currently raised by the head watchdog, by severity "
+     "(0 everywhere = healthy; RTPU_ALERTS=0 disables evaluation)",
+     tag_keys=("severity",), component="alerts")
+
+# ---------------------------------------------------------------------------
+# log federation (util/events.py log fetch rendezvous)
+# ---------------------------------------------------------------------------
+
+_def("rtpu_log_fetches_total", "counter",
+     "cluster-wide log fetches served by this process (`rtpu logs` / "
+     "/api/logs rendezvous replies, including /proc fd fallbacks)",
+     component="logs")
+_def("rtpu_log_fetch_bytes_total", "counter",
+     "log bytes shipped in fetch replies (bounded per fetch by "
+     "RTPU_LOG_TAIL_BYTES)", component="logs")
+
+# ---------------------------------------------------------------------------
 # lock contention profiler (util/contention.py)
 # ---------------------------------------------------------------------------
 

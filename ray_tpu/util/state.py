@@ -190,6 +190,117 @@ def list_spans(limit: int = 10000,
     return _apply_filters(rt.trace_store.snapshot(int(limit)), filters)
 
 
+def list_events(limit: int = 1000,
+                filters: Optional[List] = None) -> List[Dict[str, Any]]:
+    """Collected lifecycle events — cluster-wide in cluster mode (every
+    node ships its EventStore deltas on the heartbeat; the GCS appends
+    its own node register/death events), head-local otherwise. Each
+    event carries a ``name`` from the catalog in
+    :mod:`ray_tpu.util.events`, a timestamp, severity, structured
+    fields, and origin labels; DEATH events carry a ``postmortem``
+    (exit cause, stderr tail, error lines). On by default;
+    ``RTPU_EVENTS=0`` empties the plane."""
+    rt = _gcs()
+    try:
+        rt.collect_lifecycle_events()
+    except Exception:
+        pass
+    if rt.cluster is not None:
+        try:
+            evs = rt.cluster.gcs.call("lifecycle_events_get", int(limit),
+                                      timeout=10)
+            if evs:
+                return _apply_filters(evs, filters)
+        except Exception:
+            pass
+    return _apply_filters(rt.event_store.snapshot(int(limit)), filters)
+
+
+def _resolve_log_target(rt, target: Dict[str, Any]) -> Dict[str, Any]:
+    """Map a task/actor id onto the worker that ran it so the log fetch
+    can rendezvous on worker_id: death events carry both ids (the usual
+    reason someone fetches a log is that the worker died), and the
+    head's flight-recorder ring covers tasks that finished alive."""
+    want_task = (target.get("task_id") or "").lower()
+    want_actor = (target.get("actor_id") or "").lower()
+    if not want_task and not want_actor:
+        return target
+    for ev in reversed(list_events(limit=10000)):
+        if want_task and (ev.get("task_id") or "").startswith(
+                want_task[:16]) and ev.get("worker_id"):
+            return {"worker_id": ev["worker_id"]}
+        if want_actor and (ev.get("actor_id") or "").startswith(
+                want_actor) and ev.get("worker_id"):
+            return {"worker_id": ev["worker_id"]}
+    if want_task:
+        for ev in reversed(list(getattr(rt, "task_ring", ()) or ())):
+            tid = ev.get("task_id")
+            if tid is not None and tid.hex().startswith(want_task[:16]):
+                return {"worker_id": ev["worker_id"].hex()[:8]}
+    return target
+
+
+def fetch_logs(target: Dict[str, Any], timeout: float = 5.0,
+               tail_bytes: Optional[int] = None) -> List[Dict[str, Any]]:
+    """Cluster-wide log fetch (the ``rtpu logs`` backend): resolve
+    ``target`` — ``{"task_id"}``, ``{"actor_id"}``, ``{"worker_id"}``,
+    ``{"node_id"}`` (all hex prefixes; node fetches also need
+    ``"node": True``) or ``{"node": True}`` — to log files wherever
+    they live, and return bounded tails with extracted error lines.
+    Task/actor ids resolve through death events (cross-node) or the
+    flight recorder; in cluster mode the fetch rides a GCS ``events``-
+    channel rendezvous and only nodes that resolved the target reply.
+    Falls back to ``/proc/<pid>/fd`` reads for live processes whose log
+    file was deleted under them."""
+    import time as _time
+
+    rt = _gcs()
+    target = _resolve_log_target(rt, dict(target or {}))
+    if target.get("node_id") and "worker_id" not in target:
+        target.setdefault("node", True)
+    rows = rt.fetch_local_logs(target, tail_bytes=tail_bytes)
+    if rt.cluster is None or rows:
+        return rows
+    try:
+        req = rt.cluster.gcs.call("log_request", target, tail_bytes,
+                                  timeout=10)
+    except Exception:
+        return rows
+    deadline = _time.monotonic() + timeout
+    replies: Dict[str, Any] = {}
+    while _time.monotonic() < deadline:
+        try:
+            replies = rt.cluster.gcs.call("log_collect", req,
+                                          timeout=10) or {}
+        except Exception:
+            replies = {}
+        if replies:
+            # one more collect round: give slower nodes of a broadcast
+            # fetch a beat to land before returning
+            _time.sleep(0.3)
+            try:
+                replies = rt.cluster.gcs.call("log_collect", req,
+                                              timeout=10) or replies
+            except Exception:
+                pass
+            break
+        _time.sleep(0.2)
+    out: List[Dict[str, Any]] = []
+    for _node, node_rows in sorted(replies.items()):
+        out.extend(node_rows)
+    return out
+
+
+def list_alerts() -> List[Dict[str, Any]]:
+    """Currently-raised watchdog alerts at this head (see
+    :mod:`ray_tpu.util.alerts`): rule name, severity, observed value vs
+    threshold, and since-when. Empty when all rules are healthy or the
+    plane is killed (``RTPU_ALERTS=0``)."""
+    from ray_tpu.util import alerts
+
+    return alerts.active_alerts()
+
+
 def _collect_profile_batches(rt) -> List[Dict[str, Any]]:
     """Every collected profile batch visible from this head: the local
     ProfileStore (this process's sampler + its workers' pushes) plus —

@@ -1,0 +1,13 @@
+"""Positive fixture: dynamic event names and convention violations."""
+
+from ray_tpu.util import events
+
+
+def report(kind: str) -> None:
+    # BAD: non-literal name — a dynamic funnel hides which code path
+    # emitted the event
+    events.emit("worker_" + kind, pid=1)
+    # BAD: f-string name is still non-literal for events (no prefix form)
+    events.record(f"death_{kind}")
+    # BAD: literal but violates the flat lower_snake convention
+    events.emit("Worker::Death", pid=2)

@@ -1,0 +1,17 @@
+"""Negative fixture: literal lower_snake names, one call site each.
+
+(The catalog-membership and stale-entry checks only run when
+util/events.py is part of the linted project / whole-package scope, so
+a standalone fixture exercises the literal + convention + uniqueness
+contracts.)
+"""
+
+from ray_tpu.util import events as _events
+
+
+def on_spawn(pid: int) -> None:
+    _events.emit("demo_worker_spawn", pid=pid)
+
+
+def on_death(pid: int, cause: str):
+    return _events.record("demo_worker_death", pid=pid, cause=cause)

@@ -40,6 +40,17 @@ def _token(tmp_path, name):
     return str(tmp_path / f"fp-{name}.tok")
 
 
+def _events_named(name, **field_filters):
+    """Head-visible lifecycle events matching ``name`` + field values
+    (ISSUE 18: every chaos death case leaves exactly one death event
+    with a correct cause class and a postmortem)."""
+    from ray_tpu.util import state
+
+    return [e for e in state.list_events(limit=100000)
+            if e["name"] == name
+            and all(e.get(k) == v for k, v in field_filters.items())]
+
+
 # ---------------------------------------------------------------------------
 # quick subset (tier-1): worker kill, seal failure, serve replica death,
 # compiled-DAG actor death
@@ -63,6 +74,15 @@ def test_worker_kill_mid_exec_task_graph(chaos_rt):
     refs = [square.remote(i) for i in range(8)]
     assert ray_tpu.get(total.remote(*refs), timeout=120) == sum(
         i * i for i in range(8))
+
+    # the kill left exactly ONE worker_death event (once= election),
+    # with the right cause class and a postmortem from the reaping site
+    deaths = poll_until(
+        lambda: _events_named("worker_death", task="square"),
+        timeout=30, desc="worker_death event for the killed square")
+    assert len(deaths) == 1, deaths
+    assert deaths[0]["cause"] == "signal:SIGKILL"
+    assert deaths[0]["postmortem"]["cause"] == "signal:SIGKILL"
 
 
 def test_store_seal_failure_retries_task(chaos_rt):
@@ -104,6 +124,16 @@ def test_serve_replica_death_rerouted_and_replaced(chaos_rt):
             lambda: ray_tpu.get(ctrl.list_deployments.remote()),
             timeout=30, desc="controller view")
         assert deps["Echo"]["num_replicas"] == 2  # dead one was replaced
+
+        # the controller (an actor: events ride its worker pipe) emitted
+        # the replica death + the re-route fanout as lifecycle events
+        dead = poll_until(
+            lambda: _events_named("serve_replica_death", deployment="Echo"),
+            timeout=30, desc="serve_replica_death event")
+        assert len(dead) == 1, dead
+        assert poll_until(
+            lambda: _events_named("serve_reroute", deployment="Echo"),
+            timeout=30, desc="serve_reroute event")
     finally:
         serve.shutdown()
 
@@ -146,6 +176,16 @@ def test_compiled_dag_actor_death_mid_loop(chaos_rt):
             "not detection"
         with pytest.raises(DAGExecutionError):
             compiled.execute(3)   # broken pipeline refuses new work
+
+        # ray_tpu.kill() exhausts restarts: exactly one terminal
+        # actor_death event for `a`, cause = the kill signal
+        deaths = poll_until(
+            lambda: _events_named("actor_death",
+                                  actor_id=a._actor_id.hex()),
+            timeout=30, desc="actor_death event for the killed stage")
+        assert len(deaths) == 1, deaths
+        assert deaths[0]["cause"].startswith("signal:")
+        assert deaths[0]["postmortem"]["cause"] == deaths[0]["cause"]
     finally:
         compiled.teardown()
     assert not any(_os.path.exists(p) for p in paths), \
@@ -239,6 +279,16 @@ def test_actor_herd_survives_worker_kill(chaos_rt):
 
     assert poll_until(herd_answers, timeout=120, desc="herd answers")
 
+    # the restart left an actor_restart lifecycle event (warning, not a
+    # terminal actor_death — the member came back)
+    restarts = poll_until(
+        lambda: _events_named("actor_restart", cause="signal:SIGKILL"),
+        timeout=30, desc="actor_restart event for the killed member")
+    assert restarts[0]["severity"] == "warning"
+    herd_ids = {m._actor_id.hex() for m in herd}
+    assert not [e for e in _events_named("actor_death")
+                if e.get("actor_id") in herd_ids]
+
 
 @pytest.mark.slow
 def test_delayed_and_dropped_control_pipe_messages(chaos_rt):
@@ -312,6 +362,20 @@ def test_data_shuffle_reducer_death_recovers(chaos_rt):
     got = {int(r["k"]): int(r["sum(v)"]) for r in out}
     assert got == expect
 
+    # both engine kills left death events, each dead reducer worker
+    # exactly ONCE (no dupes, no losses), classified with forensics.
+    # Count is >= 2, not == 2: aborting a half-done stage can tear down
+    # sibling reducers that were still mid-add_block.
+    deaths = poll_until(
+        lambda: d if len(d := _events_named(
+            "worker_death", task="add_block")) >= 2 else None,
+        timeout=60, desc="reducer death events")
+    assert len({ev["worker_id"] for ev in deaths}) == len(deaths), deaths
+    assert [ev for ev in deaths if ev["cause"] == "signal:SIGKILL"]
+    for ev in deaths:
+        assert ev["cause"].startswith("signal:"), ev
+        assert ev["postmortem"]["cause"] == ev["cause"]
+
 
 @pytest.mark.slow
 def test_trainer_worker_kill_resumes_from_checkpoint(chaos_rt):
@@ -353,6 +417,12 @@ def test_trainer_worker_kill_resumes_from_checkpoint(chaos_rt):
     result = trainer.fit()
     assert result.metrics["step"] == 5
     assert result.metrics["resumed_from"] > 0  # did NOT restart from 0
+
+    # the resume left a checkpoint_resume lifecycle event (emitted by
+    # the driver-side retry loop, so no pipe hop to wait for)
+    resumes = _events_named("checkpoint_resume")
+    assert resumes and resumes[0]["attempt"] >= 1
+    assert resumes[0]["checkpoint"]
 
 
 # ---------------------------------------------------------------------------
@@ -404,6 +474,17 @@ def test_daemon_kill_mid_lease_grant_replaces_work(chaos_cluster):
             for i in range(8)]
     assert ray_tpu.get(refs, timeout=180) == [i * 10 for i in range(8)]
 
+    # the dead daemon is exactly ONE node_death at the head (acked
+    # heartbeat cursor dedups re-delivery), classified and with the
+    # GCS's blast-radius postmortem
+    deaths = poll_until(lambda: _events_named("node_death"),
+                        timeout=60, desc="node_death event")
+    time.sleep(2)  # dedup settle: a re-shipped batch must not dupe it
+    deaths = _events_named("node_death")
+    assert len(deaths) == 1
+    assert deaths[0]["cause"] in ("connection lost", "heartbeat timeout")
+    assert deaths[0]["postmortem"]["cause"] == deaths[0]["cause"]
+
 
 @pytest.mark.slow
 def test_gcs_kill_mid_submit_snapshot_recovery(chaos_cluster):
@@ -441,6 +522,12 @@ def test_gcs_kill_mid_submit_snapshot_recovery(chaos_cluster):
     assert results == {i: i + 1000 for i in range(30)}
     assert poll_until(lambda: rt.kv_op("get", "chaos-key") == b"durable",
                       timeout=60, desc="KV after restart")
+
+    # the restart itself is a lifecycle event (recorded by the new GCS
+    # on snapshot reload, so it survives the process that died)
+    restarts = poll_until(lambda: _events_named("gcs_restart"),
+                          timeout=60, desc="gcs_restart event")
+    assert restarts[0]["severity"] == "warning"
 
 
 @pytest.mark.slow

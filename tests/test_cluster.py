@@ -12,6 +12,8 @@ import pytest
 import ray_tpu
 from ray_tpu.cluster import Cluster
 
+from conftest import poll_until
+
 
 @pytest.fixture
 def cluster():
@@ -30,32 +32,19 @@ def test_cluster_boots_and_lists_nodes(cluster):
     cluster.add_node(num_cpus=2)
     cluster.add_node(num_cpus=2)
     _init(cluster)
-    deadline = time.monotonic() + 20
-    nodes = []
-    while time.monotonic() < deadline:
-        try:
-            nodes = [n for n in ray_tpu.nodes() if n["Alive"]]
-        except ConnectionError:
-            # transient GCS connection drop under suite load; the client
-            # reconnects and the next poll succeeds
-            nodes = []
-        if len(nodes) >= 3:
-            break
-        time.sleep(0.2)
+    def _alive():
+        nodes = [n for n in ray_tpu.nodes() if n["Alive"]]
+        return nodes if len(nodes) >= 3 else None
+
+    nodes = poll_until(_alive, timeout=20, desc="head + 2 daemons alive")
     assert len(nodes) >= 3  # head + 2 daemons
 
     # host utilization samples ride heartbeats into the node table
     # (reporter-module role) — wait one heartbeat period for the first
-    deadline = time.monotonic() + 20
-    while time.monotonic() < deadline:
-        try:
-            with_stats = [n for n in ray_tpu.nodes()
-                          if (n.get("stats") or {}).get("mem_total")]
-        except ConnectionError:
-            with_stats = []
-        if with_stats:
-            break
-        time.sleep(0.5)
+    with_stats = poll_until(
+        lambda: [n for n in ray_tpu.nodes()
+                 if (n.get("stats") or {}).get("mem_total")],
+        timeout=20, interval=0.5, desc="host stats on a node")
     assert with_stats, "no node ever reported host stats"
 
 
@@ -273,29 +262,28 @@ def test_gcs_restart_fault_tolerance(tmp_path):
         c.restart_gcs()
 
         # KV survived the restart
-        deadline = time.monotonic() + 30
-        val = None
-        while time.monotonic() < deadline:
-            try:
-                val = rt.kv_op("get", "durable-key")
-                if val == b"survives":
-                    break
-            except Exception:
-                pass
-            time.sleep(0.5)
+        val = poll_until(lambda: rt.kv_op("get", "durable-key"),
+                         timeout=30, interval=0.5,
+                         desc="durable KV after GCS restart")
         assert val == b"survives"
 
         # nodes re-registered: remote work schedules again
-        deadline = time.monotonic() + 60
-        ok = False
-        while time.monotonic() < deadline:
-            try:
-                if ray_tpu.get(ping.remote(), timeout=20) == "pong":
-                    ok = True
-                    break
-            except Exception:
-                time.sleep(0.5)
+        ok = poll_until(
+            lambda: ray_tpu.get(ping.remote(), timeout=20) == "pong",
+            timeout=60, interval=0.5,
+            desc="remote task schedules after GCS restart")
         assert ok, "remote task did not schedule after GCS restart"
+
+        # the daemon's re-registration left a gcs_restart lifecycle
+        # event (warning severity) in the head store — the event plane's
+        # record that cluster state was rebuilt from the snapshot
+        from ray_tpu.util import state
+
+        restarts = poll_until(
+            lambda: [e for e in state.list_events(limit=10000)
+                     if e["name"] == "gcs_restart"],
+            timeout=90, interval=0.5, desc="gcs_restart event collected")
+        assert restarts[0]["severity"] == "warning"
     finally:
         ray_tpu.shutdown()
         c.shutdown()
@@ -492,18 +480,10 @@ def test_pg_node_death_releases_and_reschedules(cluster):
 
 
 def _wait_nodes(n, timeout=15):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        try:
-            alive = [x for x in ray_tpu.nodes() if x["Alive"]]
-        except ConnectionError:
-            # transient GCS connection drop under suite load; the client
-            # reconnects and the next poll succeeds
-            alive = []
-        if len(alive) >= n:
-            return
-        time.sleep(0.2)
-    raise AssertionError(f"cluster did not reach {n} nodes")
+    # poll_until retries transient GCS connection drops under suite load
+    poll_until(
+        lambda: len([x for x in ray_tpu.nodes() if x["Alive"]]) >= n,
+        timeout=timeout, desc=f"cluster reaches {n} nodes")
 
 
 def test_jax_trainer_gang_schedules_across_daemons(cluster, tmp_path):
@@ -739,11 +719,10 @@ def test_cross_node_fetch_of_spilled_object(monkeypatch):
         # publication is async — wait until A actually dropped them
         # (restore's headroom gate reads A's real shm usage).
         ray_tpu.free(refs[:2])
-        deadline = time.monotonic() + 30
-        while time.monotonic() < deadline:
-            if not ray_tpu.get(probe.remote(refs[0].hex()), timeout=60)[1]:
-                break
-            time.sleep(0.5)
+        poll_until(
+            lambda: not ray_tpu.get(probe.remote(refs[0].hex()),
+                                    timeout=60)[1],
+            timeout=30, interval=0.5, desc="freed residents dropped on A")
 
         # node B (the driver) pulls the object that exists ONLY in A's
         # spill file — 6 MB > pull_chunk_bytes, so this is a chunked read
@@ -754,14 +733,14 @@ def test_cross_node_fetch_of_spilled_object(monkeypatch):
 
         # the serve path restored it: gone from the spill dir, still
         # readable on A (freed-headroom publication is async — poll)
-        deadline = time.monotonic() + 30
-        still_spilled, present = True, True
-        while time.monotonic() < deadline:
-            still_spilled, present = ray_tpu.get(
-                probe.remote(refs[2].hex()), timeout=60)
-            if not still_spilled:
-                break
-            time.sleep(0.5)
+        def _restored():
+            sp, present = ray_tpu.get(probe.remote(refs[2].hex()),
+                                      timeout=60)
+            return (sp, present) if not sp else None
+
+        still_spilled, present = poll_until(
+            _restored, timeout=30, interval=0.5,
+            desc="spilled object restored on A")
         assert present
         assert not still_spilled, "spilled object was never restored"
     finally:
@@ -832,7 +811,7 @@ def test_locality_aware_scheduling(cluster):
         try:
             st = rt.cluster.gcs.call("obj_state", ref.id.binary(),
                                      timeout=10)
-        except ConnectionError:
+        except (ConnectionError, TimeoutError, OSError):
             st = None  # transient drop under suite load; poll again
         if st is not None and st["status"] == "READY":
             break
@@ -1170,16 +1149,14 @@ def test_broadcast_replicates_via_relay_tree(cluster):
     from ray_tpu.core.runtime import _get_runtime
 
     rt = _get_runtime()
-    deadline = time.monotonic() + 60
-    while time.monotonic() < deadline:
-        try:
-            st = rt.cluster.gcs.call("obj_state", ref.id.binary(),
-                                     timeout=10)
-        except ConnectionError:
-            st = None  # transient drop under suite load; poll again
-        if st and len(st.get("locations") or ()) >= 4:  # head + 3 daemons
-            break
-        time.sleep(0.3)
+
+    def _replicated():
+        st = rt.cluster.gcs.call("obj_state", ref.id.binary(), timeout=10)
+        # head + 3 daemons hold it once the relay tree finished
+        return st if st and len(st.get("locations") or ()) >= 4 else None
+
+    st = poll_until(_replicated, timeout=60, interval=0.3,
+                    desc="broadcast replicated to all nodes")
     assert st and len(st["locations"]) >= 4, st
     # broadcast again: everyone already holds it -> no targets
     assert rexp.broadcast_object(ref) == 0
@@ -1328,16 +1305,9 @@ def test_gcs_sqlite_external_store_fault_tolerance(tmp_path):
 
         c.restart_gcs()  # kill -9 + fresh process reading the sqlite db
 
-        deadline = time.monotonic() + 30
-        val = None
-        while time.monotonic() < deadline:
-            try:
-                val = rt.kv_op("get", "durable-key")
-                if val == b"sqlite-survives":
-                    break
-            except Exception:
-                pass
-            time.sleep(0.5)
+        val = poll_until(lambda: rt.kv_op("get", "durable-key"),
+                         timeout=30, interval=0.5,
+                         desc="durable KV after sqlite GCS restart")
         assert val == b"sqlite-survives"
         # named actor record survived: resolvable by name again
         deadline = time.monotonic() + 60
@@ -1351,16 +1321,9 @@ def test_gcs_sqlite_external_store_fault_tolerance(tmp_path):
                 time.sleep(0.5)
         assert got == 2, got
         # pg record survived the restart (read back from the GCS)
-        deadline = time.monotonic() + 30
-        pgs = None
-        while time.monotonic() < deadline:
-            try:
-                pgs = rt.cluster.gcs.call("pg_list", timeout=10)
-                if pgs:
-                    break
-            except Exception:
-                pass
-            time.sleep(0.5)
+        pgs = poll_until(lambda: rt.cluster.gcs.call("pg_list", timeout=10),
+                         timeout=30, interval=0.5,
+                         desc="pg records after sqlite GCS restart")
         assert pgs, "placement group records lost after GCS restart"
     finally:
         ray_tpu.shutdown()
@@ -1558,3 +1521,138 @@ def test_profile_merges_nodes_and_pids_with_components(cluster):
         profiling._reset_for_tests()
         import os as _os
         _os.environ.pop("RTPU_PROFILING", None)
+
+
+# ---------------------------------------------------------------------------
+# event plane (ISSUE 18): death events with postmortems at the head,
+# cluster-wide log federation
+# ---------------------------------------------------------------------------
+
+def test_worker_sigkill_one_death_event_at_head(cluster):
+    """A worker SIGKILLed on a PEER node produces exactly ONE
+    worker_death event at the head — correct cause class, non-empty
+    postmortem with the worker's stderr tail — shipped over the daemon
+    heartbeat with the acked-cursor dedup contract."""
+    from ray_tpu.util import state
+
+    cluster.add_node(num_cpus=2, resources={"die": 1})
+    cluster.add_node(num_cpus=2)
+    _init(cluster)
+    _wait_nodes(3)
+
+    @ray_tpu.remote(resources={"die": 1}, max_retries=0)
+    def victim():
+        import os as _os
+        import signal as _signal
+        import sys as _sys
+
+        _sys.stderr.write("OSError: cross-node death marker\n")
+        _sys.stderr.flush()
+        _os.kill(_os.getpid(), _signal.SIGKILL)
+
+    from ray_tpu.core.exceptions import WorkerCrashedError
+
+    with pytest.raises(WorkerCrashedError) as ei:
+        ray_tpu.get(victim.remote(), timeout=120)
+    assert ei.value.error_type == "worker_died:signal:SIGKILL"
+    assert "cross-node death marker" in str(ei.value)
+
+    deaths = poll_until(
+        lambda: [e for e in state.list_events(limit=100000)
+                 if e["name"] == "worker_death"
+                 and e.get("task") == "victim"],
+        timeout=60, interval=0.5, desc="worker_death event at head")
+    # several heartbeats have passed by now: the cursor contract must
+    # have deduped re-ships down to exactly one record
+    time.sleep(2.0)
+    deaths = [e for e in state.list_events(limit=100000)
+              if e["name"] == "worker_death" and e.get("task") == "victim"]
+    assert len(deaths) == 1, deaths
+    ev = deaths[0]
+    assert ev["cause"] == "signal:SIGKILL"
+    assert ev["severity"] == "error"
+    assert ev["component"] == "raylet"  # reaped by the peer's daemon
+    pm = ev["postmortem"]
+    assert pm["cause"] == "signal:SIGKILL"
+    assert "cross-node death marker" in pm.get("stderr_tail", "")
+    # node_register events from the GCS's own table rode along too
+    assert sum(1 for e in state.list_events(limit=100000)
+               if e["name"] == "node_register") >= 3
+
+
+def test_daemon_kill_one_node_death_event(cluster):
+    """SIGKILL a node daemon: after the heartbeat timeout the GCS emits
+    exactly ONE node_death event whose postmortem records the blast
+    radius (there is no process left to read a stderr tail from)."""
+    from ray_tpu.util import state
+
+    victim = cluster.add_node(num_cpus=2, resources={"doomed": 1})
+    cluster.add_node(num_cpus=2)
+    _init(cluster)
+    _wait_nodes(3)
+
+    # learn the victim's node id before killing it
+    daemons = [n for n in cluster.list_nodes() if not n["is_head"]]
+    victim_ids = {n["node_id"].hex()[:8] for n in daemons}
+    cluster.kill_node(victim)
+
+    deaths = poll_until(
+        lambda: [e for e in state.list_events(limit=100000)
+                 if e["name"] == "node_death"],
+        timeout=60, interval=0.5,
+        desc="node_death event after heartbeat timeout")
+    assert len(deaths) == 1, deaths
+    ev = deaths[0]
+    assert ev["node_id"] in victim_ids
+    assert ev["component"] == "gcs"
+    assert ev["severity"] == "error"
+    # SIGKILL closes the daemon's GCS conn (usually "connection lost");
+    # a blip-less box may only notice at the heartbeat timeout
+    assert ev["cause"] in ("connection lost", "heartbeat timeout")
+    pm = ev["postmortem"]
+    assert pm["cause"] == ev["cause"]
+    assert {"lost_objects", "dead_actors",
+            "lost_pg_bundles"} <= set(pm)
+
+
+def test_fetch_logs_cross_node_by_task_id(cluster):
+    """Log federation: a task id resolves (via its death event) to the
+    worker that ran it on a PEER node; the fetch rendezvous brings back
+    that node's log tail with the error lines extracted — the
+    `rtpu logs --task` backend."""
+    from ray_tpu.util import state
+
+    cluster.add_node(num_cpus=2, resources={"faraway": 1})
+    _init(cluster)
+    _wait_nodes(2)
+
+    @ray_tpu.remote(resources={"faraway": 1}, max_retries=0)
+    def remote_crash():
+        import os as _os
+        import signal as _signal
+        import sys as _sys
+
+        _sys.stderr.write("KeyError: federated log marker 456\n")
+        _sys.stderr.flush()
+        _os.kill(_os.getpid(), _signal.SIGKILL)
+
+    with pytest.raises(Exception):
+        ray_tpu.get(remote_crash.remote(), timeout=120)
+
+    ev = poll_until(
+        lambda: next((e for e in state.list_events(limit=100000)
+                      if e["name"] == "worker_death"
+                      and e.get("task") == "remote_crash"), None),
+        timeout=60, interval=0.5, desc="remote death event at head")
+    assert ev.get("task_id") and ev.get("worker_id")
+
+    def _fetch():
+        rows = state.fetch_logs({"task_id": ev["task_id"]}, timeout=10.0)
+        return rows or None
+
+    rows = poll_until(_fetch, timeout=60, interval=1.0,
+                      desc="cross-node log fetch by task id")
+    head_node = state._gcs().node_id.hex()[:8]
+    assert rows[0]["node_id"] != head_node  # came from the peer
+    assert "federated log marker 456" in rows[0]["tail"]
+    assert any("KeyError" in ln for ln in rows[0]["error_lines"])
