@@ -387,6 +387,25 @@ class ClusterAdapter:
                         from ray_tpu.util import events as _events
 
                         _events.note_push()
+                # device plane rides the ~2s beats: this node's compiled-
+                # program registries (this process + its workers' pushed
+                # snapshots), shipped like metrics as an idempotent
+                # per-node payload the GCS replaces — registry rows are
+                # mutable state, so a dropped beat self-heals
+                if beat % 4 == 1:
+                    from ray_tpu.util import device_plane as _dp
+
+                    if _dp.device_plane_enabled():
+                        dents = _dp.node_processes(
+                            self.rt,
+                            component=("driver" if self.is_scheduler
+                                       else "raylet"))
+                        if dents:
+                            nid = self.node_id.hex()[:8]
+                            for ent in dents:
+                                ent.setdefault("node_id", nid)
+                            self.gcs.call("device_report", self.node_id,
+                                          dents, timeout=5)
             except Exception:
                 pass
 

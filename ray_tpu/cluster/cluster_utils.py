@@ -150,14 +150,21 @@ class Cluster:
             self.wait_for_nodes(want)
         return node_idx
 
-    def wait_for_nodes(self, n_daemons: int, timeout: float = 30.0):
-        """Wait until ``n_daemons`` non-head nodes are alive in the GCS."""
+    def wait_for_nodes(self, n_daemons: int, timeout: float = 60.0):
+        """Wait until ``n_daemons`` non-head nodes are alive in the GCS.
+
+        The 60s default is an under-load margin, not an expectation: on
+        this 2-vCPU box a daemon boot races pytest + watcher probes for
+        CPU and the r19 flake log shows registration occasionally taking
+        >30s while always completing; the poll also retries OSError —
+        a daemon mid-boot can RST the probe connection, which surfaces
+        as plain OSError, not its ConnectionError subclass."""
         deadline = time.monotonic() + timeout
         alive = []
         while time.monotonic() < deadline:
             try:
                 nodes = self._client.call("node_list", timeout=5)
-            except (ConnectionError, TimeoutError):
+            except (OSError, TimeoutError):
                 # transient GCS connection drop under load: the client
                 # reconnects; a poll must retry, not abort the wait
                 time.sleep(0.3)

@@ -141,6 +141,10 @@ class GcsService:
         # reference metrics-agent -> head pipeline role). Head /metrics
         # pulls via rpc_metrics_get at scrape time.
         self._node_metrics: Dict[bytes, list] = {}
+        # device plane: latest process-entry list per node (compiled-
+        # program registries + HBM census), replaced on each heartbeat
+        # ride like _node_metrics — idempotent, self-healing
+        self._node_devices: Dict[bytes, list] = {}
         self.kv: Dict[str, Dict[str, bytes]] = {}
         self.functions: Dict[str, bytes] = {}
         # named/global actor registry: actor_id -> record dict
@@ -316,6 +320,7 @@ class GcsService:
             # reconnecting node reships a full snapshot on its next
             # carrying heartbeat, so nothing is lost on a blip)
             self._node_metrics.pop(node_id, None)
+            self._node_devices.pop(node_id, None)
             # _task_ev_seq is deliberately NOT popped here: a node marked
             # dead by a connection blip keeps its node_id, reconnects, and
             # reships history from seq 0 — the high-water mark is what
@@ -779,6 +784,28 @@ class GcsService:
                 out.append(({"component": "gcs"}, recs))
         except Exception:
             pass
+        return out
+
+    def rpc_device_report(self, ctx, node_id: bytes, entries) -> bool:
+        """Replace a node's device-plane process entries (compiled-
+        program registries + HBM census) — the metrics-payload pattern,
+        not the acked-cursor one: registry rows are mutable state, so
+        the latest snapshot is the whole truth for that node."""
+        with self.lock:
+            self._node_devices[node_id] = list(entries or ())
+        return True
+
+    def rpc_device_report_get(self, ctx,
+                              exclude_node: Optional[bytes] = None):
+        """Flattened process entries across nodes for the head's
+        state.device_report(). ``exclude_node``: the caller's own node —
+        its entries live in-process (local registry + DeviceStore)."""
+        out = []
+        with self.lock:
+            for nid, entries in self._node_devices.items():
+                if nid == exclude_node:
+                    continue
+                out.extend(entries)
         return out
 
     def rpc_obj_info(self, ctx, oids):

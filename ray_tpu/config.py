@@ -263,6 +263,10 @@ _knob("event_store_max", int, 16384,
 _knob("gcs_max_lifecycle_events", int, 16384,
       "cluster-wide lifecycle-event buffer size in the GCS (event twin "
       "of gcs_max_trace_events)", "cluster/gcs_server.py")
+_knob("device_push_interval_s", float, 2.0,
+      "min seconds between a worker's compiled-program-registry "
+      "snapshot pushes over the control pipe (version-gated: nothing "
+      "ships unless a compile bumped the registry)", "core/worker.py")
 _knob("alerts_interval_s", float, 5.0,
       "watchdog evaluation period for the declarative alert rules at "
       "the head (RTPU_ALERTS=0 kills the watchdog outright)",

@@ -48,7 +48,7 @@ PIPE_DRIVER_MSGS = frozenset({
 PIPE_CASTS = frozenset({
     "put", "submit", "actor_call", "fn_put", "blocked", "unblocked",
     "kill_actor", "cancel", "stream_consumed", "refpins", "metrics",
-    "spans", "prof", "stacks", "free", "events",
+    "spans", "prof", "stacks", "free", "events", "device",
 })
 
 #: request/reply worker->driver ops: ``("req", req_id, op, args)``
@@ -74,7 +74,7 @@ GCS_RPC = frozenset({
     "profile_events", "profile_events_get", "stack_request",
     "stack_reply", "stack_collect", "metrics_get",
     "lifecycle_events", "lifecycle_events_get", "log_request",
-    "log_reply", "log_collect",
+    "log_reply", "log_collect", "device_report", "device_report_get",
     # kv + function store
     "kv_put", "kv_get", "kv_del", "kv_keys", "fn_put", "fn_get",
     # actors

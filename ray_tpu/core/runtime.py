@@ -524,6 +524,14 @@ class DriverRuntime:
 
         self.event_store = EventStore()
         self._event_push = None
+        # device plane (receiver side): workers' compiled-program
+        # registry snapshots land here (replace-by-origin, like the
+        # metric FederationStore — registry rows are mutable state, not
+        # an append log); state.device_report() merges this with the
+        # driver's own registry and remote nodes' GCS payloads
+        from ray_tpu.util.device_plane import DeviceStore
+
+        self.device_store = DeviceStore()
         # alerting watchdog (head-side): declarative rules over the
         # metric view, RTPU_ALERTS=0 kills it. Started here (the driver
         # IS the head in local mode and the head node's driver in
@@ -1750,6 +1758,18 @@ class DriverRuntime:
                     {"worker_id": ws.worker_id.hex()[:8],
                      "node_id": self.node_id.hex()[:8],
                      "component": "worker"})
+            except Exception:
+                pass
+        elif op == "device":
+            # device plane: version-gated program-registry snapshot from
+            # the worker — replace semantics keyed by worker origin
+            try:
+                self.device_store.ingest(
+                    ws.worker_id.hex()[:8],
+                    {"worker_id": ws.worker_id.hex()[:8],
+                     "node_id": self.node_id.hex()[:8],
+                     "component": "worker"},
+                    args[0])
             except Exception:
                 pass
         elif op == "stacks":

@@ -155,6 +155,12 @@ class WorkerRuntime:
         # the interval only bounds the batching delay)
         self._event_last_push = 0.0
         self._event_interval: Optional[float] = None
+        # device plane (sender side): compiled-program registry snapshots
+        # ride the pipe as casts, version-gated — nothing ships unless a
+        # compile/retrace bumped the registry since the last push
+        self._device_last_push = 0.0
+        self._device_interval: Optional[float] = None
+        self._device_version_shipped = 0
         try:
             from ray_tpu import config as _cfg
 
@@ -1316,6 +1322,37 @@ class WorkerRuntime:
         except Exception:
             pass
 
+    def _maybe_push_device(self) -> None:
+        """Ship this process's compiled-program registry snapshot to the
+        driver, rate-limited AND version-gated: zygote workers that never
+        import jax keep an empty registry at version 0 and never ship
+        anything (the ``"jax" in sys.modules`` guard inside snapshot()
+        also keeps the census from importing jax here)."""
+        from ray_tpu.util import device_plane
+
+        if not device_plane.device_plane_enabled():
+            return
+        now = time.monotonic()
+        if self._device_interval is None:
+            try:
+                from ray_tpu import config as _cfg
+
+                self._device_interval = float(
+                    _cfg.get("device_push_interval_s"))
+            except Exception:
+                self._device_interval = 2.0
+        if now - self._device_last_push < self._device_interval:
+            return
+        self._device_last_push = now
+        try:
+            snap = device_plane.snapshot(
+                min_version=self._device_version_shipped)
+            if snap is not None:
+                self._device_version_shipped = snap["version"]
+                self.cast("device", snap)
+        except Exception:
+            pass
+
     def push_telemetry(self) -> None:
         """Rate-limited metric/span/profile/event pushes, callable from
         ANY thread: the main loop's idle ticks, and compiled-DAG exec
@@ -1327,6 +1364,7 @@ class WorkerRuntime:
             self._maybe_push_spans()
             self._maybe_push_profile()
             self._maybe_push_events()
+            self._maybe_push_device()
 
     def main_loop(self):
         self._start_receiver()

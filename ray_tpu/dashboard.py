@@ -104,6 +104,9 @@ class _Handler(BaseHTTPRequestHandler):
                 timeout=float(_p("timeout", 5.0))),
             # alerting watchdog: currently-raised alerts
             "/api/alerts": st.list_alerts,
+            # device plane: compiled-program registry + HBM census,
+            # merged cluster-wide
+            "/api/devices": st.device_report,
             # job submission REST (list; per-job routes handled below)
             "/api/jobs": _jobs_list,
             # serve REST (reference dashboard/modules/serve role)

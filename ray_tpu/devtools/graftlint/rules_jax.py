@@ -210,6 +210,64 @@ class JaxPlatformsLeak(Rule):
                         break
 
 
+#: ML-tier trees whose jit/pmap sites must go through the device-plane
+#: registry (util/device_plane.registered_jit) so every compiled program
+#: gets a name, a signature history, and cost analysis
+_REGISTRY_SCOPES = ("ray_tpu/models/", "ray_tpu/train/", "ray_tpu/serve/",
+                    "ray_tpu/rllib/")
+
+#: introspection calls fenced to util/device_plane.py — each costs a
+#: lowering/compile or a full live-array walk, and scattering them
+#: defeats the single bounded registry
+_FENCED_INTROSPECTION = {"cost_analysis", "memory_analysis", "live_arrays"}
+
+_PLANE_FILE = "ray_tpu/util/device_plane.py"
+
+
+@register
+class JitRegistryDiscipline(Rule):
+    name = "jit-registry-discipline"
+    family = FAMILY_JAX
+    summary = ("under models//train//serve//rllib, jax.jit/jax.pmap goes "
+               "through util.device_plane.registered_jit (named program, "
+               "retrace detection, cost analysis); cost_analysis/"
+               "memory_analysis/live_arrays are fenced to "
+               "util/device_plane.py")
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for mod in project.modules:
+            is_plane = mod.scope_rel == _PLANE_FILE
+            in_scope = mod.scope_rel.startswith(_REGISTRY_SCOPES)
+            for cs in mod.calls:
+                if in_scope and cs.fq in ("jax.jit", "jax.pmap"):
+                    tail = cs.fq.rpartition(".")[2]
+                    yield self.finding(
+                        mod, cs.line,
+                        f"raw jax.{tail}() in an ML-tier module — the "
+                        f"compiled program is invisible to the device "
+                        f"plane (no name, no retrace detection, no cost "
+                        f"analysis); wrap it with "
+                        f"ray_tpu.util.device_plane.registered_jit")
+                if is_plane:
+                    continue
+                tail = None
+                if cs.fq:
+                    t = cs.fq.rpartition(".")[2]
+                    if t in _FENCED_INTROSPECTION:
+                        tail = t
+                if tail is None and cs.parts \
+                        and cs.parts[-1] in _FENCED_INTROSPECTION:
+                    tail = cs.parts[-1]
+                if tail is not None:
+                    yield self.finding(
+                        mod, cs.line,
+                        f"{tail}() outside util/device_plane.py — XLA "
+                        f"introspection costs a lowering (or a live-"
+                        f"array walk) per call; the registry already "
+                        f"holds it, read device_plane.registry() / "
+                        f"state.device_report() instead")
+
+
 @register
 class JaxImportInCore(Rule):
     name = "jax-import-in-core"

@@ -626,5 +626,21 @@ def flash_attention(
             return naive_attention(q, k, v, causal=causal, window=window,
                                    softcap=softcap)
     scale = d ** -0.5
+    from ray_tpu.util import device_plane as _dp
+
+    if _dp.device_plane_enabled() and not isinstance(q, jax.core.Tracer):
+        # EAGER entry point (bench numerics, tests, preflights): the
+        # blockwise/Pallas internals compile implicitly here — register
+        # novel signatures as compiles of "ops::flash_attention" so the
+        # device plane sees them too. Inside an enclosing jit (tracers)
+        # the CALLER's registered program owns the compile.
+        return _dp.tracked_call(
+            "ops::flash_attention", "ops",
+            lambda: _mha(q, k, v, causal, scale, q_block, kv_block,
+                         impl == "pallas", window, softcap),
+            (q, k, v),
+            statics={"impl": impl, "causal": causal, "q_block": q_block,
+                     "kv_block": kv_block, "window": window,
+                     "softcap": softcap})
     return _mha(q, k, v, causal, scale, q_block, kv_block,
                 impl == "pallas", window, softcap)

@@ -69,7 +69,12 @@ class AnakinPPO:
             ep_len=jnp.zeros((num_envs,), jnp.int32),
             last_return=jnp.zeros((num_envs,)),
         )
-        self._step_fn = jax.jit(self._train_iteration, donate_argnums=(0,))
+        from ray_tpu.util.device_plane import registered_jit
+
+        self._step_fn = registered_jit(self._train_iteration,
+                                       name="rllib::anakin_iteration",
+                                       component="rllib",
+                                       donate_argnums=(0,))
 
     # -- the single fused program ----------------------------------------
 

@@ -105,7 +105,11 @@ class DreamerV3Learner:
         # slow critic (return targets) + return-scale EMA state
         self.slow_critic = jax.tree.map(lambda a: a, self.params["critic"])
         self.retnorm = np.array([0.0, 1.0], np.float32)  # [lo, hi] EMA
-        self._update_fn = jax.jit(self._update)
+        from ray_tpu.util.device_plane import registered_jit
+
+        self._update_fn = registered_jit(self._update,
+                                         name="rllib::dreamer_update",
+                                         component="rllib")
         self._rng = jax.random.PRNGKey(seed + 1)
 
     # -- params -----------------------------------------------------------
@@ -471,8 +475,12 @@ class DreamerV3Learner:
         import jax
 
         if not hasattr(self, "_act_fn"):
-            self._act_fn = jax.jit(self._act_jit,
-                                   static_argnames=("greedy",))
+            from ray_tpu.util.device_plane import registered_jit
+
+            self._act_fn = registered_jit(self._act_jit,
+                                          name="rllib::dreamer_act",
+                                          component="rllib",
+                                          static_argnames=("greedy",))
         hstate, z, key = state
         if rng_seed is not None:
             key = jax.random.PRNGKey(rng_seed)

@@ -49,7 +49,11 @@ class SingleAgentEnvRunner:
             self.module = self.spec.build()
         self.params = self.module.init(jax.random.PRNGKey(seed))
         self._rng = jax.random.PRNGKey(seed + 1)
-        self._explore_fn = jax.jit(self.module.forward_exploration)
+        from ray_tpu.util.device_plane import registered_jit
+
+        self._explore_fn = registered_jit(self.module.forward_exploration,
+                                          name="rllib::forward_exploration",
+                                          component="rllib")
         self._obs, _ = self.env.reset(seed=seed)
         self._episode_returns = np.zeros(num_envs)
         self._episode_lens = np.zeros(num_envs, dtype=np.int64)

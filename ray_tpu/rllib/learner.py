@@ -21,6 +21,7 @@ gradient sync to pjit-sharded JAX learners"):
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
@@ -112,14 +113,24 @@ class JaxLearner:
         )
         self.opt_state = jax.device_put(self.optimizer.init(self.params),
                                         self._replicated)
-        self._update_fn = jax.jit(self._update_step)
+        from ray_tpu.util.device_plane import registered_jit
+
+        self._update_fn = registered_jit(self._update_step,
+                                         name="rllib::update",
+                                         component="rllib")
         # scanned multi-step program. NOT donated: a transient axon
         # UNAVAILABLE mid-execute must leave self.params usable for the
         # retry (donation would invalidate the old buffers at dispatch),
         # and RL modules are small enough that double-buffering is free.
-        self._update_steps_fn = jax.jit(self._update_steps)
-        self._grad_fn = jax.jit(self._grad_step)
-        self._apply_fn = jax.jit(self._apply_step)
+        self._update_steps_fn = registered_jit(self._update_steps,
+                                               name="rllib::update_steps",
+                                               component="rllib")
+        self._grad_fn = registered_jit(self._grad_step,
+                                       name="rllib::grad",
+                                       component="rllib")
+        self._apply_fn = registered_jit(self._apply_step,
+                                        name="rllib::apply_grads",
+                                        component="rllib")
 
     # -- override points --------------------------------------------------
 
@@ -259,13 +270,44 @@ class JaxLearner:
         # are never referenced (plan indices are all < n)
         placed = self._place_batch(self._pad_to_devices(batch))
         placed.pop("loss_mask", None)  # per-STEP masks ride the scan
+        t0 = time.perf_counter()
         with jax.set_mesh(self.mesh):
             plan_d = jax.device_put(plan, self._replicated)
             masks_d = jax.device_put(masks, self._replicated)
             self.params, self.opt_state, metrics = self._update_steps_fn(
                 self.params, self.opt_state, placed, plan_d, masks_d)
         got = jax.device_get(metrics)  # single transfer spanning all steps
+        self._note_device_update(time.perf_counter() - t0, len(plan))
         return {k: float(np.asarray(v)[-1]) for k, v in got.items()}
+
+    def _note_device_update(self, dt: float, n_steps: int) -> None:
+        """Cost-model attribution for the scanned update: achieved
+        FLOP/s from the registered program's static cost analysis and
+        the wall time of dispatch→``device_get`` (the get spans every
+        scanned step, so the window is sound even on the tunneled
+        backend). The scan length is per-call (the epoch×minibatch
+        plan), so per-step flops are derived here, not in the row."""
+        try:
+            from ray_tpu.util import device_plane
+
+            flops = device_plane.program_flops_per_step(
+                "rllib::update_steps")
+            if flops and dt > 0:
+                from ray_tpu.util import metric_defs as md
+
+                md.get("rtpu_device_achieved_flops_per_s").set(
+                    flops / dt, tags={"program": "rllib::update_steps"})
+            from ray_tpu.util import tracing
+
+            if tracing.tracing_enabled():
+                end = time.time_ns()
+                tracing.record_span(
+                    "rllib::update", end - int(dt * 1e9), end,
+                    {"program": "rllib::update_steps",
+                     "steps": int(n_steps),
+                     **({"flops": flops} if flops else {})})
+        except Exception:
+            pass
 
     # -- gradient-sync API (multi-learner DDP semantics) -------------------
 

@@ -184,8 +184,12 @@ class MultiAgentEnvRunner:
         self.module = self.ma_spec.build()
         self.params = self.module.init(jax.random.PRNGKey(seed))
         self._rng = jax.random.PRNGKey(seed + 1)
+        from ray_tpu.util.device_plane import registered_jit
+
         self._explore = {
-            mid: jax.jit(m.forward_exploration)
+            mid: registered_jit(m.forward_exploration,
+                                name=f"rllib::forward_exploration[{mid}]",
+                                component="rllib")
             for mid, m in self.module.modules.items()}
         self._obs, _ = self.env.reset(seed=seed)
         self._ep_return = 0.0

@@ -139,7 +139,11 @@ class SACLearner:
         self.opt_state = self.optimizer.init(self.params)
         self.alpha_state = self.alpha_opt.init(self.log_alpha)
         self._rng = jax.random.PRNGKey(seed + 1)
-        self._update_fn = jax.jit(self._update_step)
+        from ray_tpu.util.device_plane import registered_jit
+
+        self._update_fn = registered_jit(self._update_step,
+                                         name="rllib::sac_update",
+                                         component="rllib")
 
     def _update_step(self, params, target_params, log_alpha, opt_state,
                      alpha_state, batch, rng):

@@ -67,6 +67,58 @@ def _cmd_events(args) -> int:
     return 0
 
 
+def _cmd_devices(args) -> int:
+    """``rtpu devices --url http://head:8265`` — the device plane:
+    every process's compiled-program registry (compiles/retraces/cost),
+    HBM watermarks, and live-buffer census, merged cluster-wide. The
+    first thing to read when steps are slow: a climbing retrace count
+    on one program is a recompile storm."""
+    rep = _fetch_api(args.url, "/api/devices") or {}
+    tot = rep.get("totals") or {}
+    line = (f"{tot.get('processes', 0)} process(es), "
+            f"{tot.get('programs', 0)} program row(s), "
+            f"{tot.get('compiles', 0)} compile(s), "
+            f"{tot.get('retraces', 0)} retrace(s)")
+    hbm = tot.get("hbm")
+    if hbm:
+        line += (f", hbm {hbm.get('bytes_in_use', 0) / 2**30:.2f}"
+                 f"/{hbm.get('bytes_limit', 0) / 2**30:.2f} GiB")
+    print(line)
+    rows = rep.get("programs") or []
+    if not rows:
+        print("(no compiled programs registered yet)")
+        return 0
+    print(f"{'program':<34} {'where':<24} {'compiles':>8} "
+          f"{'retraces':>8} {'calls':>8} {'compile_s':>9} "
+          f"{'gflop/step':>10}")
+    for r in rows[:args.limit]:
+        where = (f"{r.get('node_id', '?')}/"
+                 f"{r.get('worker_id') or r.get('component', '?')}")
+        cost = r.get("cost") or {}
+        flops = cost.get("flops")
+        gf = (f"{flops / max(1, int(r.get('steps', 1))) / 1e9:.2f}"
+              if flops else "-")
+        print(f"{r.get('program', '?'):<34} {where:<24} "
+              f"{r.get('compiles', 0):>8} {r.get('retraces', 0):>8} "
+              f"{r.get('calls', 0):>8} "
+              f"{r.get('compile_s_total', 0.0):>9.2f} {gf:>10}")
+    if args.census:
+        for proc in rep.get("processes") or ():
+            lb = proc.get("live_buffers")
+            if not lb:
+                continue
+            where = (f"{proc.get('node_id', '?')}/"
+                     f"{proc.get('worker_id') or proc.get('component')}"
+                     f" pid={proc.get('pid', '?')}")
+            print(f"-- live buffers @ {where}: {lb.get('buffers', 0)} "
+                  f"({lb.get('bytes', 0) / 2**20:.1f} MiB)")
+            for g in (lb.get("groups") or ())[:10]:
+                shape = "x".join(str(d) for d in g.get("shape", ()))
+                print(f"     {g['dtype']:<10} [{shape:<20}] "
+                      f"x{g['count']:<5} {g['bytes'] / 2**20:>8.1f} MiB")
+    return 0
+
+
 def _cmd_logs(args) -> int:
     """``rtpu logs --task <id> --url http://head:8265`` — cluster-wide
     log federation: resolve a task/actor/worker/node id to its log
@@ -547,6 +599,17 @@ def main(argv=None) -> int:
     ev.add_argument("--name", default=None,
                     help="only this event name (e.g. worker_death)")
 
+    dv = sub.add_parser("devices", help="device plane: compiled-program "
+                                        "registry + HBM census, "
+                                        "cluster-wide")
+    dv.add_argument("--url", default="http://127.0.0.1:8265",
+                    help="running head's dashboard (http://host:8265)")
+    dv.add_argument("--limit", type=int, default=50,
+                    help="max program rows printed")
+    dv.add_argument("--census", action="store_true",
+                    help="also print each process's live-buffer census "
+                         "grouped by shape/dtype")
+
     lg = sub.add_parser("logs", help="cluster-wide log fetch by task/"
                                      "actor/worker/node id")
     lg.add_argument("--url", default="http://127.0.0.1:8265",
@@ -679,6 +742,8 @@ def main(argv=None) -> int:
         return _cmd_stack(args)
     if args.cmd == "events":
         return _cmd_events(args)
+    if args.cmd == "devices":
+        return _cmd_devices(args)
     if args.cmd == "logs":
         return _cmd_logs(args)
     if args.cmd == "profile":

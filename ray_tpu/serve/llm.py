@@ -157,16 +157,27 @@ class LLMEngine:
             # immediately), doubling transient HBM for the KV pool —
             # fatal at real pool sizes on a 16 GB v5e. CPU ignores
             # donation (a one-time warning), so tests are unaffected.
-            self._step_fn = jax.jit(self._raw_step_paged,
-                                    donate_argnums=(1,))
-            self._copy_fn = jax.jit(self._raw_copy, donate_argnums=(0,))
+            from ray_tpu.util.device_plane import registered_jit
+
+            self._step_fn = registered_jit(self._raw_step_paged,
+                                           name="serve::decode_step_paged",
+                                           component="serve",
+                                           donate_argnums=(1,))
+            self._copy_fn = registered_jit(self._raw_copy,
+                                           name="serve::copy_kv_block",
+                                           component="serve",
+                                           donate_argnums=(0,))
             # disaggregation (ISSUE 13): gather exports a request's
             # blocks (no donation — the pool stays live), scatter adopts
             # a shipped batch (donated — the old pool is dead on write).
             # Distinct block counts retrace; table widths bound the set.
-            self._gather_fn = jax.jit(self._raw_gather)
-            self._scatter_fn = jax.jit(self._raw_scatter,
-                                       donate_argnums=(0,))
+            self._gather_fn = registered_jit(self._raw_gather,
+                                             name="serve::gather_kv_blocks",
+                                             component="serve")
+            self._scatter_fn = registered_jit(self._raw_scatter,
+                                              name="serve::scatter_kv_blocks",
+                                              component="serve",
+                                              donate_argnums=(0,))
             # warm the COW copy's compile NOW, not in the middle of the
             # first prefix-sharing request's admission (block 0 onto
             # itself over an all-zero cache is a no-op; src/dst trace as
@@ -177,7 +188,11 @@ class LLMEngine:
             self.prefix = None
             self.prefill_chunk = 1
             self._cache = models.init_cache_multi(config, max_slots, max_len)
-            self._step_fn = jax.jit(self._raw_step)
+            from ray_tpu.util.device_plane import registered_jit
+
+            self._step_fn = registered_jit(self._raw_step,
+                                           name="serve::decode_step",
+                                           component="serve")
         self.admission = AdmissionController(slo)
         self._rng = np.random.default_rng(seed)
         self._lock = threading.Lock()
@@ -209,6 +224,8 @@ class LLMEngine:
                 "pool_queued": md.get("rtpu_serve_pool_queued"),
                 "pool_kv_used_frac":
                     md.get("rtpu_serve_pool_kv_used_fraction"),
+                "achieved_flops":
+                    md.get("rtpu_device_achieved_flops_per_s"),
             }
         except Exception:  # metrics plane unavailable (bare unit tests)
             return None
@@ -698,12 +715,14 @@ class LLMEngine:
             logits_h, nvalid = self._advance_paged(jax, jnp)
         else:
             logits_h, nvalid = self._advance_dense(jax, jnp)
+        step_dt = time.perf_counter() - t0
         if self.stats["steps"] > 0:
             # skip the FIRST step: it includes the jit trace+compile
             # (seconds), and seeding the EWMA with it would make a
             # freshly booted SLO-armed replica shed the very burst that
             # scaled it up
-            self.admission.observe_step(time.perf_counter() - t0)
+            self.admission.observe_step(step_dt)
+            self._note_device_step(step_dt)
 
         now = time.monotonic()
         for i, req in enumerate(self._slots):
@@ -733,6 +752,36 @@ class LLMEngine:
         self.stats["steps"] += 1
         self._sample_gauges()
         return True
+
+    def _note_device_step(self, dt: float) -> None:
+        """Cost-model step attribution: achieved FLOP/s for this
+        engine's registered step program, from its static cost analysis
+        and the measured step wall time (already bounded by the
+        logits ``device_get`` in ``_advance_*`` — never
+        ``block_until_ready``). The step also lands as a trace span so
+        decode cadence joins the Perfetto device track."""
+        program = ("serve::decode_step_paged" if self.paged
+                   else "serve::decode_step")
+        try:
+            from ray_tpu.util import device_plane
+
+            flops = device_plane.program_flops_per_step(program)
+            if flops and dt > 0:
+                fps = flops / dt
+                self.stats["flops_per_s"] = round(fps, 1)
+                if self._metrics is not None:
+                    self._metrics["achieved_flops"].set(
+                        fps, tags={"program": program})
+            from ray_tpu.util import tracing
+
+            if tracing.tracing_enabled():
+                end = time.time_ns()
+                tracing.record_span(
+                    "serve::step", end - int(dt * 1e9), end,
+                    {"program": program,
+                     **({"flops": flops} if flops else {})})
+        except Exception:
+            pass
 
     def _emit_prefill_export(self, i: int, req: _Request, tok: int,
                              jax, jnp) -> None:

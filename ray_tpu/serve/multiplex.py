@@ -408,7 +408,11 @@ class _ModelDraft:
             return decode_step_paged(params, cache, tokens, tables, pos,
                                      nvalid, cfg, active=active)
 
-        self._step = jax.jit(raw, donate_argnums=(1,))
+        from ray_tpu.util.device_plane import registered_jit
+
+        self._step = registered_jit(raw, name="serve::mux_decode_step",
+                                    component="serve",
+                                    donate_argnums=(1,))
         self._bound: List[Optional[_Request]] = [None] * self.S
         self._fed = [0] * self.S
         self._ready = True
@@ -515,8 +519,12 @@ class SpeculativeLLMEngine(LLMEngine):
 
         import jax
 
-        self._verify_fn = jax.jit(self._raw_verify_paged,
-                                  donate_argnums=(1,))
+        from ray_tpu.util.device_plane import registered_jit
+
+        self._verify_fn = registered_jit(self._raw_verify_paged,
+                                         name="serve::verify_step_paged",
+                                         component="serve",
+                                         donate_argnums=(1,))
         if drafter == "ngram":
             self._draft = _NgramDraft(n=ngram)
         elif drafter == "model":

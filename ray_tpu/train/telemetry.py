@@ -43,6 +43,9 @@ class StepTelemetry:
         self._last: Dict[str, Any] = {}
         self._steps = 0
         self._last_report_t: Optional[float] = None
+        #: spec-sheet peak override (FLOP/s across attached devices) —
+        #: lets MFU attribution run off-TPU (parity tests, CPU rehearsal)
+        self.peak_flops: Optional[float] = None
 
     def _metrics(self) -> Dict[str, Any]:
         if self._m is None:
@@ -66,14 +69,23 @@ class StepTelemetry:
     def record_step(self, step_time_s: float, *, tokens: Optional[float] = None,
                     flops: Optional[float] = None,
                     mfu: Optional[float] = None,
-                    loss: Optional[float] = None, steps: int = 1) -> None:
+                    loss: Optional[float] = None, steps: int = 1,
+                    program: Optional[str] = None) -> None:
         """Record ``steps`` optimizer steps that took ``step_time_s`` each.
 
         ``tokens``: tokens consumed per step (tokens/s is derived).
-        ``mfu``: measured utilization; when absent but ``flops`` (model
-        FLOPs per step) is given and a TPU is attached, it is computed
-        against the chip's spec-sheet peak."""
+        ``mfu``: measured utilization; when absent but ``flops`` (FLOPs
+        per step) is given and a TPU is attached (or ``peak_flops`` is
+        set), it is computed against the chip's spec-sheet peak.
+        ``program``: a device-plane registry name — when given and
+        ``flops`` is absent, per-step FLOPs come from the registered
+        program's static cost analysis (cost-model-driven attribution;
+        util/device_plane.py) instead of a hand-maintained formula."""
         try:
+            if flops is None and program is not None:
+                from ray_tpu.util import device_plane
+
+                flops = device_plane.program_flops_per_step(program)
             m = self._metrics()
             with self._lock:
                 for _ in range(max(1, int(steps))):
@@ -85,6 +97,8 @@ class StepTelemetry:
                     tps = tokens / step_time_s
                     m["tokens_per_s"].set(tps)
                     self._last["tokens_per_s"] = round(tps, 1)
+                if flops is not None and step_time_s > 0:
+                    self._set_achieved_flops(flops / step_time_s, program)
                 if mfu is None and flops is not None and step_time_s > 0:
                     mfu = self._mfu_from_flops(flops, step_time_s)
                 if mfu is not None:
@@ -114,20 +128,34 @@ class StepTelemetry:
         except Exception:
             pass  # telemetry must never fail a train step
 
-    @staticmethod
-    def _mfu_from_flops(flops: float, step_time_s: float) -> Optional[float]:
+    def _mfu_from_flops(self, flops: float,
+                        step_time_s: float) -> Optional[float]:
         try:
-            import jax
+            peak = self.peak_flops
+            if peak is None:
+                import jax
 
-            from ray_tpu.util.tpu_info import (is_tpu_backend,
-                                               peak_flops_per_chip)
+                from ray_tpu.util.tpu_info import (is_tpu_backend,
+                                                   peak_flops_per_chip)
 
-            if not is_tpu_backend():
-                return None
-            peak = peak_flops_per_chip() * jax.device_count()
+                if not is_tpu_backend():
+                    return None
+                peak = peak_flops_per_chip() * jax.device_count()
             return flops / (step_time_s * peak) if peak else None
         except Exception:
             return None
+
+    def _set_achieved_flops(self, flops_per_s: float,
+                            program: Optional[str]) -> None:
+        try:
+            from ray_tpu.util import metric_defs as md
+
+            md.get("rtpu_device_achieved_flops_per_s").set(
+                flops_per_s,
+                tags={"program": program or self.component})
+            self._last["flops_per_s"] = round(flops_per_s, 1)
+        except Exception:
+            pass
 
     def record_compile(self, seconds: float) -> None:
         try:

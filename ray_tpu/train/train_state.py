@@ -105,8 +105,11 @@ def make_train_step(
         }
         return new_state, metrics
 
-    return jax.jit(step, donate_argnums=(0,) if donate else (),
-                   compiler_options=_compiler_options())
+    from ray_tpu.util.device_plane import registered_jit
+
+    return registered_jit(step, name="train::step", component="train",
+                          donate_argnums=(0,) if donate else (),
+                          compiler_options=_compiler_options())
 
 
 def _compiler_options() -> Optional[Dict[str, str]]:
@@ -188,7 +191,12 @@ class TrainLoopHelper:
                 is_leaf=lambda x: isinstance(x, tuple) and all(
                     a is None or isinstance(a, str) for a in x),
             )
-            params = jax.jit(init_params_fn, out_shardings=p_sh)()
+            from ray_tpu.util.device_plane import registered_jit
+
+            params = registered_jit(init_params_fn,
+                                    name="train::init_params",
+                                    component="train",
+                                    out_shardings=p_sh)()
             state = create_train_state(params, optimizer)
             st_sh = state_shardings(state, param_axes, mesh, rules)
             state = jax.tree.map(
@@ -284,8 +292,11 @@ class TrainLoopHelper:
                 state, ms = jax.lax.scan(body, state, None, length=n)
                 return state, jax.tree.map(lambda a: a[-1], ms)
 
-            self._multi_step_cache[n] = jax.jit(
-                multi, donate_argnums=(0,),
+            from ray_tpu.util.device_plane import registered_jit
+
+            self._multi_step_cache[n] = registered_jit(
+                multi, name="train::run_steps", component="train",
+                steps=n, donate_argnums=(0,),
                 compiler_options=_compiler_options())
         self._check_batch(batch)
         bs = self.batch_sharding()

@@ -22,6 +22,9 @@ Rule kinds (each a plain dict — the whole rule table is data):
 ``stall``           ``metric`` (a depth gauge) sits at/above
                     ``min_depth`` while ``flow`` (a counter) made no
                     progress across the window
+``delta_above``     the summed counter ``metric`` grew by more than
+                    ``threshold`` within one tick window (a RATE rule
+                    over cumulative counters — the compile-storm shape)
 
 The default table covers the failure modes this box actually produces:
 heartbeat-gap stretch, worker-spawn stalls (zygote queueing), serve KV
@@ -79,6 +82,20 @@ DEFAULT_RULES: List[Dict[str, Any]] = [
      "threshold": 0.9, "severity": "warning",
      "description": "shm arena >90% full: spills (and their disk-rate "
                     "ceiling) imminent"},
+    {"name": "jit_compile_storm", "kind": "delta_above",
+     "metric": "rtpu_jit_retraces_total", "threshold": 2.0,
+     "severity": "warning",
+     "description": "3+ jit retraces within one watchdog window: a "
+                    "registered program is recompiling in a loop "
+                    "(shape/dtype churn) — read the jit_recompile "
+                    "events' signature diffs for the offending arg"},
+    {"name": "hbm_occupancy", "kind": "ratio_above",
+     "metric": "rtpu_tpu_hbm_used_bytes",
+     "denominator": "rtpu_tpu_hbm_limit_bytes",
+     "threshold": 0.92, "severity": "warning",
+     "description": "device HBM >92% full: the next retrace or batch "
+                    "bump OOMs — read /api/devices' live-buffer census "
+                    "for what is resident"},
 ]
 
 _lock = threading.Lock()
@@ -253,6 +270,14 @@ class Watchdog:
                 return None, 0.0
             val = _quantile(win, wtotal, bounds, rule["q"])
             return val > rule["threshold"], val
+        if kind == "delta_above":
+            total = sum(float(v) for _k, v in rows)
+            prev = self._prev.get(rule["name"])
+            self._prev[rule["name"]] = total
+            if prev is None:
+                return None, 0.0  # first tick: no window yet
+            delta = max(0.0, total - prev)
+            return delta > rule["threshold"], delta
         if kind == "stall":
             flow_rows = view.get(rule["flow"]) or []
             depth = max((float(v) for _k, v in rows), default=0.0)

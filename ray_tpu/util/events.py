@@ -46,6 +46,9 @@ sites bidirectionally in sync)::
     checkpoint_resume     training resumed from a persisted checkpoint
     alert_raised          the watchdog raised an alert (util/alerts.py)
     alert_cleared         a raised alert condition went away
+    jit_recompile         a registered program recompiled past its first
+                          trace; payload carries the signature diff
+                          (util/device_plane.py)
 """
 
 from __future__ import annotations
@@ -73,6 +76,7 @@ _SEVERITY = {
     "gcs_restart": "warning",
     "alert_raised": "warning",
     "alert_cleared": "info",
+    "jit_recompile": "warning",
 }
 
 _lock = threading.Lock()

@@ -457,6 +457,40 @@ _def("rtpu_tpu_hbm_used_bytes", "gauge",
 _def("rtpu_tpu_hbm_limit_bytes", "gauge",
      "HBM capacity (local devices)", component="train")
 
+# ---------------------------------------------------------------------------
+# device plane (util/device_plane.py — the compiled-program registry)
+# ---------------------------------------------------------------------------
+
+_def("rtpu_jit_compiles_total", "counter",
+     "XLA compiles of registered programs (a fresh abstract signature "
+     "or a fresh jit instance); the jit_compile_storm alert watches "
+     "retraces, not this warmup-inclusive count", tag_keys=("program",),
+     component="device")
+_def("rtpu_jit_retraces_total", "counter",
+     "recompiles past a program's FIRST signature (each also emits one "
+     "jit_recompile lifecycle event carrying the signature diff)",
+     tag_keys=("program",), component="device")
+_def("rtpu_jit_compile_seconds", "histogram",
+     "wall time of registered-program compile calls (dispatch + first "
+     "execution, the record_compile convention)",
+     tag_keys=("program",),
+     boundaries=(0.01, 0.1, 1, 5, 10, 30, 60, 300, 1200),
+     component="device")
+_def("rtpu_device_programs", "gauge",
+     "registered compiled programs in this process's registry "
+     "(sampled per device-plane snapshot)", component="device")
+_def("rtpu_device_live_buffers", "gauge",
+     "live device arrays in this process (jax.live_arrays census, "
+     "sampled per snapshot)", component="device")
+_def("rtpu_device_live_buffer_bytes", "gauge",
+     "bytes held by live device arrays in this process (census "
+     "sample)", component="device")
+_def("rtpu_device_achieved_flops_per_s", "gauge",
+     "achieved FLOP/s attributed from registry cost-analysis flops "
+     "and caller-measured step time (cost-model flops count every "
+     "executed flop, remat recompute included)",
+     tag_keys=("program",), component="device")
+
 
 # ---------------------------------------------------------------------------
 # LLM serving tier (serve/llm.py — recorded in each replica's process,

@@ -216,6 +216,34 @@ def list_events(limit: int = 1000,
     return _apply_filters(rt.event_store.snapshot(int(limit)), filters)
 
 
+def device_report() -> Dict[str, Any]:
+    """Cluster-wide device plane: every process's compiled-program
+    registry (compiles, retraces, signatures, cost/memory analysis),
+    HBM watermarks, and live-buffer census, merged across nodes. Local
+    entries come from this process's registry plus its workers' pushed
+    snapshots (DeviceStore); remote nodes' entries ride heartbeats into
+    the GCS as idempotent per-node payloads. On by default;
+    ``RTPU_DEVICE_PLANE=0`` empties the plane."""
+    from ray_tpu.util import device_plane
+
+    rt = _gcs()
+    comp = "driver"
+    if rt.cluster is not None and not rt.cluster.is_scheduler:
+        comp = "raylet"
+    entries = device_plane.node_processes(rt, component=comp)
+    me = rt.node_id.hex()[:8]
+    for ent in entries:
+        ent.setdefault("node_id", me)
+    if rt.cluster is not None:
+        try:
+            remote = rt.cluster.gcs.call("device_report_get", rt.node_id,
+                                         timeout=10)
+            entries.extend(remote or ())
+        except Exception:
+            pass
+    return device_plane.merge_report(entries)
+
+
 def _resolve_log_target(rt, target: Dict[str, Any]) -> Dict[str, Any]:
     """Map a task/actor id onto the worker that ran it so the log fetch
     can rendezvous on worker_id: death events carry both ids (the usual

@@ -424,6 +424,15 @@ def watch(interval: float, log_path: str, cache_path: str,
             if bench.get("ok") and _bench_is_real_tpu(bench["result"]):
                 payload = {"ts": round(time.time(), 1), "iso": _now_iso(),
                            "bench": bench["result"], "numerics": numerics}
+                # lift the device-plane section (compiled-program
+                # registry: compile times, cost-analysis flops, HBM
+                # watermarks from the real chip) to a top-level key so
+                # the cached compile/cost table survives even if the
+                # bench detail is ever trimmed
+                dp = (bench["result"].get("detail")
+                      or {}).get("device_plane")
+                if dp:
+                    payload["device_plane"] = dp
                 tmp = cache_path + ".tmp"
                 with open(tmp, "w") as f:
                     json.dump(payload, f, indent=1)

@@ -278,13 +278,21 @@ def _origin_pid_tid(s: Dict[str, Any], pids: Dict[str, int],
     if pid is None:
         pid = pids[node] = len(pids) + 1
         names[pid] = f"node:{node}"
+    attrs = s.get("attributes") or {}
     wid = s.get("worker_id")
     if wid:
         tid = f"worker:{wid}"
     else:
-        ppid = (s.get("attributes") or {}).get("process.pid")
+        ppid = attrs.get("process.pid")
         comp = s.get("component") or "proc"
         tid = f"{comp}:{ppid}" if ppid else comp
+    if "program" in attrs:
+        # device-plane slices (device::compile, serve::step,
+        # rllib::update carry a ``program`` attribute): their own track
+        # under the owning process row, so compile/step slices read as
+        # one device timeline instead of interleaving with control-
+        # plane spans
+        tid = f"device[{tid}]"
     return pid, tid
 
 

@@ -51,6 +51,9 @@ rule keeps this catalog and the call sites bidirectionally in sync —
     data.exchange::reduce   streaming-exchange reducer block ingest
     train::step             one optimizer step (manual span)
     train::compile          one XLA compile event (manual span)
+    device::compile         one registered-program XLA compile/retrace
+    serve::step             one serve engine decode step (manual span)
+    rllib::update           one learner update dispatch (manual span)
     lock::<name>            contended lock wait >= 1 ms (manual span)
 """
 

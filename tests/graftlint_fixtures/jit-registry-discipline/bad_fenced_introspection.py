@@ -1,0 +1,10 @@
+# graftlint: path=ray_tpu/util/fake_probe.py
+"""Offender: XLA introspection outside util/device_plane.py — each
+cost_analysis() costs a lowering, each live_arrays() a full walk; the
+registry already holds both."""
+import jax
+
+
+def probe(compiled):
+    stats = compiled.cost_analysis()
+    return stats, jax.live_arrays()

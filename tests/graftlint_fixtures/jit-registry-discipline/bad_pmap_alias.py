@@ -1,0 +1,8 @@
+# graftlint: path=ray_tpu/rllib/fake_learner.py
+"""Offender: an aliased ``from jax import pmap`` still resolves — rules
+match symbols, not spellings."""
+from jax import pmap as parallel_map
+
+
+def make_update(fn):
+    return parallel_map(fn, axis_name="dp")

@@ -1,0 +1,8 @@
+# graftlint: path=ray_tpu/train/fake_step.py
+"""Offender: a raw jax.jit in an ML-tier module — the compiled program
+is invisible to the device plane (no name, no retrace detection)."""
+import jax
+
+
+def make_step(fn):
+    return jax.jit(fn, donate_argnums=(0,))

@@ -1656,3 +1656,109 @@ def test_fetch_logs_cross_node_by_task_id(cluster):
     assert rows[0]["node_id"] != head_node  # came from the peer
     assert "federated log marker 456" in rows[0]["tail"]
     assert any("KeyError" in ln for ln in rows[0]["error_lines"])
+
+
+def test_device_report_federates_across_nodes(cluster, monkeypatch,
+                                              capsys):
+    """ISSUE 19 acceptance: ``state.device_report()`` on the head merges
+    compiled-program registries from >= 2 nodes and >= 3 processes with
+    component labels, and both surfaces (``/api/devices`` + ``rtpu
+    devices``) render it. Pipeline: worker registries cast version-gated
+    "device" snapshots over the control pipe; node stores ride the GCS
+    heartbeat as idempotent per-node payloads; the head merges local +
+    peers at read time."""
+    import json
+    import urllib.request
+
+    monkeypatch.setenv("RTPU_DEVICE_PUSH_INTERVAL_S", "0.2")
+    cluster.add_node(num_cpus=2, resources={"peer": 2})
+    _init(cluster)
+    _wait_nodes(2)
+
+    # the driver registers a program of its own (process #1)
+    import jax.numpy as jnp
+
+    from ray_tpu.util import device_plane
+
+    drv = device_plane.registered_jit(lambda x: x * 3.0,
+                                      name="probe::driver",
+                                      component="test")
+    drv(jnp.ones((8,)))
+
+    def _probe_body(name):
+        import os as _os
+
+        import jax as _jax
+
+        _jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as _jnp
+
+        from ray_tpu.util import device_plane as _dp
+
+        f = _dp.registered_jit(lambda x: x * 2.0, name=name,
+                               component="test")
+        _jax.block_until_ready(f(_jnp.ones((8,))))
+        return _os.getpid()
+
+    @ray_tpu.remote(resources={"peer": 1})
+    def remote_probe():
+        return _probe_body("probe::remote")
+
+    @ray_tpu.remote(num_cpus=1)
+    def local_probe():
+        return _probe_body("probe::local")
+
+    pids = ray_tpu.get([remote_probe.remote(), local_probe.remote()],
+                       timeout=120)
+    assert len(set(pids)) == 2  # a worker process on each node
+
+    from ray_tpu.util import state
+
+    def _report():  # worker push (0.2s) -> heartbeat (~2s) -> GCS -> head
+        rep = state.device_report()
+        names = {r.get("program") for r in rep["programs"]}
+        if not {"probe::driver", "probe::remote",
+                "probe::local"} <= names:
+            return None
+        nids = {r.get("node_id") for r in rep["programs"]}
+        procs = {(p.get("node_id"), p.get("pid"))
+                 for p in rep["processes"]}
+        comps = {p.get("component") for p in rep["processes"]}
+        ok = (len(nids) >= 2 and len(procs) >= 3
+              and {"driver", "worker"} <= comps)
+        return rep if ok else None
+
+    rep = poll_until(_report, timeout=60, interval=0.5,
+                     desc="device report merges 2 nodes / 3 pids")
+    assert rep["totals"]["processes"] >= 3
+    assert rep["totals"]["compiles"] >= 3
+    by_name = {r["program"]: r for r in rep["programs"]}
+    assert by_name["probe::remote"]["component"] == "worker"
+    head_node = state._gcs().node_id.hex()[:8]
+    assert by_name["probe::remote"]["node_id"] != head_node
+    assert by_name["probe::driver"]["node_id"] == head_node
+
+    # both render surfaces over a live dashboard
+    from ray_tpu.dashboard import start_dashboard, stop_dashboard
+
+    dash = start_dashboard(port=0)
+    url = f"http://127.0.0.1:{dash.port}"
+    try:
+        api = json.loads(urllib.request.urlopen(
+            url + "/api/devices", timeout=10).read().decode())["result"]
+        assert api["totals"]["processes"] >= 3
+        assert {r["program"] for r in api["programs"]} >= {
+            "probe::driver", "probe::remote", "probe::local"}
+
+        import argparse
+
+        from ray_tpu.scripts import _cmd_devices
+
+        rc = _cmd_devices(argparse.Namespace(url=url, limit=50,
+                                             census=True))
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "probe::remote" in out and "probe::driver" in out
+        assert "process(es)" in out
+    finally:
+        stop_dashboard()
