@@ -183,6 +183,12 @@ class ClusterAdapter:
         # oid -> fetch flag (True: pull the value; False: state-only)
         self._watched: Dict[bytes, bool] = {}
         self._watch_lock = threading.Lock()
+        # node lifecycle fan-out (elastic training, r20): registered
+        # callbacks get every "nodes" up/down pubsub payload, invoked on
+        # the io pool AFTER the adapter's own failure handling so a
+        # subscriber probing the node view sees the dead peer removed.
+        self._node_event_subs: List[Any] = []
+        self._node_event_lock = threading.Lock()
         self._fetching: Set[bytes] = set()
         # forwarded work for failure handling: node_id -> {task_id: spec}
         self._forwarded: Dict[bytes, Dict[bytes, dict]] = {}
@@ -730,10 +736,15 @@ class ClusterAdapter:
                         break
                 return
             if payload.get("event") == "down":
-                self._io.submit(self._node_down, payload)
+                # notify subscribers on the SAME io task, after the
+                # adapter's own failure handling: a subscriber probing
+                # the node view / resubmitting work must see the dead
+                # peer's tasks failed and its pg bundles released first
+                self._io.submit(self._node_down_and_notify, payload)
             elif payload.get("event") == "up":
                 # a fresh node may make pending pg bundles placeable
                 self._io.submit(self._pg_reschedule_pending)
+                self._io.submit(self._notify_node_event, dict(payload))
             self._node_view_ts = 0.0  # invalidate the scheduler view
         elif channel == "pgs":
             self._io.submit(self._on_pg_event, payload)
@@ -2099,6 +2110,43 @@ class ClusterAdapter:
              "stats": dict(n.get("stats") or {})}
             for n in self._nodes()
         ]
+
+    # ------------------------------------------------------------------
+    # node lifecycle fan-out (elastic membership, r20)
+    # ------------------------------------------------------------------
+
+    def subscribe_node_events(self, cb) -> None:
+        """Register ``cb(payload)`` for node up/down pubsub payloads
+        (``{"event": "down"|"up", "node_id": ..., "cause": ..., ...}``).
+        Callbacks run on the adapter io pool; down-events are delivered
+        AFTER the adapter's own cleanup for the dead node. Subscribers
+        must be quick and exception-safe — the elastic BackendExecutor
+        just records the payload and pokes an event."""
+        with self._node_event_lock:
+            if cb not in self._node_event_subs:
+                self._node_event_subs.append(cb)
+
+    def unsubscribe_node_events(self, cb) -> None:
+        with self._node_event_lock:
+            try:
+                self._node_event_subs.remove(cb)
+            except ValueError:
+                pass
+
+    def _notify_node_event(self, payload: dict) -> None:
+        with self._node_event_lock:
+            subs = list(self._node_event_subs)
+        for cb in subs:
+            try:
+                cb(payload)
+            except Exception:
+                logger.exception("node-event subscriber failed")
+
+    def _node_down_and_notify(self, payload: dict) -> None:
+        try:
+            self._node_down(payload)
+        finally:
+            self._notify_node_event(dict(payload))
 
     # ------------------------------------------------------------------
     # failure handling

@@ -100,6 +100,7 @@ def _load_rule_modules() -> None:
         rules_profiling,
         rules_protocol,
         rules_tracing,
+        rules_train,
     )
 
 

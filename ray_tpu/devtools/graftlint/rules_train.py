@@ -1,0 +1,163 @@
+"""Elastic-training discipline rules (family ``invariants``).
+
+Elastic membership (ISSUE 20) makes ``world_size``/``world_rank`` a
+per-session fact: a preemption fences the gang and re-forms it at a new
+size, renumbering every rank. Code that freezes a world-size/rank read
+into state that outlives the session — module globals, class attributes,
+def-time default arguments, or a closure that a later session re-enters
+— computes with the OLD membership after a re-form (wrong LR/batch
+rescale, wrong shard arithmetic: the classic elastic-training bug). The
+contract is to re-read from :class:`~ray_tpu.train.session.TrainContext`
+at use time, every session.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional
+
+from ray_tpu.devtools.graftlint.engine import Project
+from ray_tpu.devtools.graftlint.model import (
+    FAMILY_INVARIANTS,
+    Finding,
+    Rule,
+    register,
+)
+
+#: TrainContext membership attributes that change across re-forms
+_ATTRS = {"world_size", "world_rank", "local_rank", "local_world_size"}
+#: ... and their accessor twins
+_GETTERS = {"get_world_size", "get_world_rank", "get_local_rank",
+            "get_local_world_size"}
+#: the definition site itself (TrainContext stores these fields; the
+#: executor stamps them per session)
+_EXEMPT = ("ray_tpu/train/session.py",)
+
+_FN_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _reads_membership(node: ast.AST) -> Optional[int]:
+    """Line of the first world-size/rank read inside ``node``, else
+    None. A read is an ``.world_size``-style attribute access or a
+    ``get_world_size()``-style accessor call."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in _ATTRS \
+                and isinstance(sub.ctx, ast.Load):
+            return sub.lineno
+        if isinstance(sub, ast.Call):
+            f = sub.func
+            if isinstance(f, ast.Attribute) and f.attr in _GETTERS:
+                return sub.lineno
+            if isinstance(f, ast.Name) and f.id in _GETTERS:
+                return sub.lineno
+    return None
+
+
+@register
+class StaleWorldSize(Rule):
+    name = "stale-world-size"
+    family = FAMILY_INVARIANTS
+    summary = ("world_size/rank is re-read from TrainContext at use "
+               "time — never frozen into module/class state, function "
+               "defaults, or closures (elastic re-forms renumber ranks "
+               "and resize the world between sessions)")
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for mod in project.modules:
+            if mod.scope_rel in _EXEMPT:
+                continue
+            yield from self._module_and_class_state(mod)
+            yield from self._def_time_defaults(mod)
+            yield from self._closure_captures(mod)
+
+    # -- module / class state ----------------------------------------------
+
+    def _module_and_class_state(self, mod) -> Iterator[Finding]:
+        scopes = [("module", mod.tree.body)]
+        scopes += [("class", node.body) for node in ast.walk(mod.tree)
+                   if isinstance(node, ast.ClassDef)]
+        for kind, body in scopes:
+            for stmt in body:
+                value = None
+                if isinstance(stmt, (ast.Assign, ast.AugAssign)):
+                    value = stmt.value
+                elif isinstance(stmt, ast.AnnAssign):
+                    value = stmt.value
+                if value is None:
+                    continue
+                ln = _reads_membership(value)
+                if ln is not None:
+                    yield self.finding(
+                        mod, stmt.lineno,
+                        f"world_size/rank captured into {kind} state — "
+                        "it outlives the training session, and an "
+                        "elastic re-form changes both; read it from "
+                        "TrainContext at use time instead")
+
+    # -- def-time default arguments ----------------------------------------
+
+    def _def_time_defaults(self, mod) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, _FN_DEFS):
+                continue
+            defaults = list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None]
+            for d in defaults:
+                ln = _reads_membership(d)
+                if ln is not None:
+                    yield self.finding(
+                        mod, ln,
+                        "world_size/rank read in a default argument — "
+                        "defaults evaluate ONCE at def time, so every "
+                        "call after an elastic re-form sees the old "
+                        "membership; read it inside the function body")
+
+    # -- closure captures ---------------------------------------------------
+
+    def _closure_captures(self, mod) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._scan_function(mod, node)
+
+    def _scan_function(self, mod, fn) -> Iterator[Finding]:
+        """Flag ``ws = ctx.world_size`` bindings that a NESTED function
+        then reads: the closure cell freezes the value, and closures are
+        exactly what outlives a session (callbacks, jitted step fns,
+        generators handed to the loop)."""
+        nested: List[ast.AST] = []
+        captured: Dict[str, int] = {}
+
+        def visit(node: ast.AST) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, _FN_DEFS):
+                    nested.append(child)
+                    continue
+                if isinstance(child, ast.Assign) \
+                        and _reads_membership(child.value) is not None:
+                    for tgt in child.targets:
+                        if isinstance(tgt, ast.Name):
+                            captured.setdefault(tgt.id, child.lineno)
+                elif (isinstance(child, ast.AnnAssign)
+                        and child.value is not None
+                        and _reads_membership(child.value) is not None
+                        and isinstance(child.target, ast.Name)):
+                    captured.setdefault(child.target.id, child.lineno)
+                visit(child)
+
+        visit(fn)
+        if not captured or not nested:
+            return
+        loaded = set()
+        for nd in nested:
+            for sub in ast.walk(nd):
+                if isinstance(sub, ast.Name) \
+                        and isinstance(sub.ctx, ast.Load):
+                    loaded.add(sub.id)
+        for name, ln in sorted(captured.items(), key=lambda kv: kv[1]):
+            if name in loaded:
+                yield self.finding(
+                    mod, ln,
+                    f"'{name}' binds a world_size/rank read and is "
+                    "captured by a nested function — the closure cell "
+                    "freezes pre-re-form membership; pass it as an "
+                    "argument or re-read from TrainContext inside")

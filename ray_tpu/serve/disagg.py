@@ -280,6 +280,7 @@ class DisaggHandle:
         dec_span = tracing.manual_span(
             "serve.disagg::decode", {"req": req_id}, parent=parent)
         n = 0
+        migrate = None
         try:
             it = iter(decode_rep.handle_request.options(
                 num_returns="streaming").remote(
@@ -299,8 +300,33 @@ class DisaggHandle:
                 # stream_batch > 1 replicas deliver token CHUNKS (lists)
                 # — flatten so callers always consume per-token
                 for t in (tok if isinstance(tok, list) else (tok,)):
+                    if isinstance(t, dict) and "__migrate__" in t:
+                        # replica drain (r20): the stream ends here; the
+                        # session's KV already shipped to the named
+                        # destination — splice the continuation below
+                        # instead of aborting a half-consumed stream
+                        migrate = t["__migrate__"]
+                        continue
                     n += 1
                     yield t
+            # drain splice: resume on the migration destination. Each
+            # continuation re-emits the handoff token (adoption re-emits
+            # ``first_token``, already delivered pre-drain) — drop it.
+            # A continuation can itself be drained, so chase markers
+            # until a stream ends without one (double preemption).
+            while migrate is not None:
+                mig, migrate = migrate, None
+                dup_pending = True
+                for tok in self._migrated_stream(mig, deadline_s):
+                    for t in (tok if isinstance(tok, list) else (tok,)):
+                        if isinstance(t, dict) and "__migrate__" in t:
+                            migrate = t["__migrate__"]
+                            continue
+                        if dup_pending:
+                            dup_pending = False
+                            continue
+                        n += 1
+                        yield t
         except _RetryableDeath:
             if dec_span is not None:
                 dec_span.finish(error="decode replica died")
@@ -312,12 +338,105 @@ class DisaggHandle:
         if dec_span is not None:
             dec_span.finish({"tokens": n})
 
+    def _migrated_stream(self, mig: Dict[str, Any],
+                         deadline_s: Optional[float]):
+        """Open the continuation stream on a drain's migration
+        destination: the replica adopts the shipped KV (the descriptor
+        in ``mig``) against the fed-token transcript and keeps decoding
+        — no re-prefill. The destination is addressed by actor id (the
+        drain already chose it); routing policy does not re-pick."""
+        import ray_tpu
+
+        dst = mig["dst"]
+
+        def find():
+            return next((r for r in self.decode._replicas
+                         if r._actor_id.binary().hex() == dst), None)
+
+        self._refresh_safe(self.decode)
+        rep = find()
+        if rep is None:
+            self.decode._refresh(force=True)
+            rep = find()
+        if rep is None:
+            raise RuntimeError(
+                f"session migrated to decode replica {dst[:8]} but it "
+                "is not in the routing table")
+        it = iter(rep.handle_request.options(
+            num_returns="streaming").remote(
+            "adopt_stream",
+            (mig["prompt_tokens"], mig["desc"], mig["max_new_tokens"],
+             mig["eos"], deadline_s), {}))
+        while True:
+            try:
+                yield ray_tpu.get(next(it))
+            except StopIteration:
+                return
+
     @staticmethod
     def _report_death(handle: DeploymentHandle, replica) -> None:
         try:
             handle._replica_died(replica)
         except Exception:
             pass
+
+    # -- elastic drain (r20) -----------------------------------------------
+
+    def drain_decode_replica(self, actor_id_hex: Optional[str] = None,
+                             *, node_id: Optional[str] = None,
+                             timeout_s: float = 30.0) -> Dict[str, Any]:
+        """Drain live sessions off a decode replica ahead of preemption:
+        every in-flight decode ships its KV blocks to a surviving peer
+        (round-robin over the rest of the pool) and its stream splices
+        the continuation there — no re-prefill. This is the serving
+        half of the elastic churn story: call it when the preemption
+        notice lands, BEFORE the node-drain RPC
+        (``rpc_node_drain`` → GCS "drained" death) kills the replica.
+
+        Pick the victim by ``actor_id_hex``, or by ``node_id`` (drains
+        every decode replica reported on that node — the shape a
+        node-level preemption notice arrives in). Returns the merged
+        drain report ``{sessions, migrated, failed, finished}``."""
+        import ray_tpu
+
+        self.decode._refresh(force=True)
+        reps = self.decode._replicas
+        loads = self._fresh(self._pool_loads(self.decode))
+
+        def rec(r):
+            return loads.get(r._actor_id.binary()) or {}
+
+        if actor_id_hex is not None:
+            victims = [r for r in reps
+                       if r._actor_id.binary().hex() == actor_id_hex]
+            if not victims:
+                raise ValueError(
+                    f"decode replica {actor_id_hex[:8]} not found")
+        elif node_id is not None:
+            victims = [r for r in reps if rec(r).get("node") == node_id]
+            if not victims:
+                return {"sessions": 0, "migrated": 0, "failed": 0,
+                        "finished": 0}
+        else:
+            raise ValueError("pass actor_id_hex or node_id")
+        victim_ids = {v._actor_id.binary() for v in victims}
+        survivors = [r for r in reps
+                     if r._actor_id.binary() not in victim_ids]
+        if not survivors:
+            raise RuntimeError(
+                "no surviving decode replica to migrate sessions to")
+        dests = [{"dst": r._actor_id.binary().hex(),
+                  "dst_node": rec(r).get("node")} for r in survivors]
+        total = {"sessions": 0, "migrated": 0, "failed": 0,
+                 "finished": 0}
+        for v in victims:
+            rep_out = ray_tpu.get(
+                v.handle_request.remote("drain_sessions",
+                                        (dests, timeout_s), {}),
+                timeout=timeout_s + 60.0)
+            for k in total:
+                total[k] += rep_out.get(k, 0)
+        return total
 
     # -- introspection / lifecycle -----------------------------------------
 

@@ -81,6 +81,13 @@ class _Request:        # elementwise-compare the prompt arrays and raise
     #                                    emit a KVExport instead of it
     adopt_kv: Optional[Dict[str, np.ndarray]] = None  # shipped prompt KV
     #                                    to scatter into claimed blocks
+    # every sampled token, in order (elastic migration, r20): a live
+    # session's continuation prompt on another replica is
+    # prompt + gen_tokens[:-1] — the fed-token transcript the cached KV
+    # positions actually correspond to. The trie insert on release keys
+    # only the true prompt prefix, so this list is what keeps a migrated
+    # session's adoption honest about token VALUES, not just counts.
+    gen_tokens: List[int] = field(default_factory=list)
 
 
 @dataclass(eq=False)
@@ -198,10 +205,15 @@ class LLMEngine:
         self._lock = threading.Lock()
         self._pending: List[_Request] = []
         self._slots: List[Optional[_Request]] = [None] * max_slots
+        # live-session migration intake (elastic serving, r20): the
+        # drain thread marks sessions here; the loop thread exports them
+        # at the top of the next step (the cache is donation-aliased, so
+        # only the step thread may gather from it)
+        self._migrations: List[tuple] = []
         self.stats = {"steps": 0, "tokens_generated": 0,
                       "max_concurrent": 0, "requests": 0,
                       "prefix_hit_tokens": 0, "deadline_drops": 0,
-                      "exported": 0, "adopted": 0}
+                      "exported": 0, "adopted": 0, "migrated_out": 0}
         self._metrics = self._init_metrics()
 
     @staticmethod
@@ -407,6 +419,7 @@ class LLMEngine:
         req.adopt_kv = {"k": np.ascontiguousarray(kv["k"]),
                         "v": np.ascontiguousarray(kv["v"])}
         req.last_token = int(first_token)
+        req.gen_tokens.append(int(first_token))
         with self._lock:
             self._pending.append(req)
             self.stats["requests"] += 1
@@ -704,6 +717,7 @@ class LLMEngine:
         import jax
         import jax.numpy as jnp
 
+        self._process_migrations(jax, jnp)
         active_now, have_pending = self._sweep_and_admit()
         if active_now == 0:
             self._sample_gauges()
@@ -735,6 +749,7 @@ class LLMEngine:
             tok = self._sample(logits_h[i])
             req.last_token = tok
             req.generated += 1
+            req.gen_tokens.append(tok)
             self._observe_emit(req, now)
             if req.prefill_only:
                 self._emit_prefill_export(i, req, tok, jax, jnp)
@@ -810,6 +825,94 @@ class LLMEngine:
             self._release_blocks(req, insert=True)
         req.emit(None)
         self._slots[i] = None
+
+    # -- live-session migration (elastic serving, r20) ---------------------
+
+    def begin_migration(self) -> List[tuple]:
+        """Mark every live DECODING session for export off this engine.
+        Returns ``[(request, reply_queue)]``; the loop thread services
+        each entry at the top of its next step, putting either the
+        export payload dict, ``None`` (the session finished on its own
+        before the export ran — nothing left to migrate), or the
+        exception that killed the export. Thread-safe; called by the
+        deployment's drain path, NOT the loop thread.
+
+        Only sessions past prefill with at least one sampled token
+        qualify: a still-prefilling request has no consumer-visible
+        progress worth shipping — re-prefilling it on another replica
+        via the ordinary retry path costs the same compute as resuming
+        a partial prefill would."""
+        if not self.paged:
+            raise ValueError("session migration requires a paged engine "
+                             "(KV export is block-granular)")
+        out: List[tuple] = []
+        with self._lock:
+            for r in self._slots:
+                if (r is None or r.cancelled or r.prefill_only
+                        or r.consumed < len(r.prompt)
+                        or not r.gen_tokens):
+                    continue
+                reply: "queue.Queue[Any]" = queue.Queue()
+                self._migrations.append((r, reply))
+                out.append((r, reply))
+        return out
+
+    def _process_migrations(self, jax, jnp) -> None:
+        """Service pending session exports on the loop thread (top of
+        step, BEFORE the advance — the migrating slot must not decode a
+        token its export would then miss)."""
+        with self._lock:
+            if not self._migrations:
+                return
+            batch, self._migrations = self._migrations, []
+        for req, reply in batch:
+            # the session may have finished/cancelled between the drain
+            # thread's mark and this step (its blocks are already
+            # released): nothing to migrate, consumer already got the
+            # full stream
+            with self._lock:
+                gone = req.cancelled or req not in self._slots
+            if gone:
+                reply.put(None)
+                continue
+            try:
+                reply.put(self._export_session(req, jax, jnp))
+            except BaseException as e:  # noqa: BLE001 - ships to drain
+                reply.put(e)
+
+    def _export_session(self, req: _Request, jax, jnp) -> Dict[str, Any]:
+        """Gather a live decoding session's cached KV ([L, nb, bs, kvh,
+        hd] per tensor, positions 0..pos-1) and retire the slot. The
+        cache covers exactly the FED tokens — prompt plus every sampled
+        token except the newest (``last_token`` is sampled but not yet
+        fed) — so the destination adopts with prompt=fed transcript,
+        first_token=last_token, and decoding continues token-exact.
+        Same power-of-two id bucketing as :meth:`_emit_prefill_export`
+        (a mid-stream retrace would stall surviving decodes)."""
+        nb = self.pool.blocks_for_tokens(req.pos)
+        bucket = min(_next_pow2(nb), self._tbl_width)
+        ids = req.table[:nb] + [req.table[nb - 1]] * (bucket - nb)
+        kv_dev = self._gather_fn(
+            self._cache, jnp.asarray(np.asarray(ids, np.int32)))
+        kv_host = jax.device_get(kv_dev)
+        fed = list(map(int, req.prompt)) + req.gen_tokens[:-1]
+        with self._lock:
+            self._release_blocks(req, insert=True)
+            for i, r in enumerate(self._slots):
+                if r is req:
+                    self._slots[i] = None
+        self.stats["migrated_out"] += 1
+        return {
+            "kv": {"k": np.asarray(kv_host["k"])[:, :nb],
+                   "v": np.asarray(kv_host["v"])[:, :nb]},
+            "fed_tokens": fed,
+            "last_token": int(req.last_token),
+            "pos": int(req.pos),
+            "generated": int(req.generated),
+            "max_new_tokens": int(req.max_new_tokens),
+            "eos": req.eos,
+            "block_size": self.pool.block_size,
+        }
 
     def _advance_dense(self, jax, jnp):
         """Dense per-slot cache: every active slot advances exactly one
@@ -1296,6 +1399,96 @@ class LLMDeployment:
 
         return self._token_stream(q, submit, len(prompt_tokens),
                                   max_new_tokens, deadline_s)
+
+    # -- elastic drain: migrate live sessions instead of re-prefilling -----
+
+    def drain_sessions(self, destinations: List[Dict[str, Any]],
+                       timeout_s: float = 30.0) -> Dict[str, Any]:
+        """Preemption drain (r20): ship every live decode session's KV
+        blocks to a surviving replica over the ISSUE-13 transfer plane,
+        then hand each session's stream a migration marker so the caller
+        splices the continuation — no re-prefill, token-exact under
+        greedy sampling. ``destinations`` is a round-robin candidate
+        list of ``{"dst": actor_id_hex, "dst_node": node_id|None}``.
+
+        The marker rides the ordinary token stream (a dict is not a
+        token): :class:`~ray_tpu.serve.disagg.DisaggHandle` intercepts
+        it, reconstructs the fed-token prompt from what it already
+        yielded, and calls ``adopt_stream`` on the destination. The
+        re-emitted handoff token (adoption re-emits ``first_token``) is
+        deduped handle-side."""
+        from ray_tpu.serve.kv_transfer import KVSender
+        from ray_tpu.util import events
+
+        if not destinations:
+            raise ValueError("drain needs at least one destination "
+                             "replica")
+        pending = self.engine.begin_migration()
+        self._wake.set()
+        me = self.identity()["actor"] or ""
+        try:
+            events.emit("serve_drain", replica=me,
+                        role=self.engine.role, sessions=len(pending),
+                        destinations=len(destinations))
+        except Exception:
+            pass
+        migrated, failed, finished = 0, 0, 0
+        if pending:
+            with self._xfer_lock:
+                if self._kv_sender is None:
+                    import uuid
+
+                    src = me or uuid.uuid4().hex[:12]
+                    self._kv_sender = KVSender(
+                        src, max_payload_bytes=self._max_payload_bytes())
+        for n, (req, reply) in enumerate(pending):
+            dst = destinations[n % len(destinations)]
+            try:
+                payload = reply.get(timeout=timeout_s)
+                if payload is None:
+                    finished += 1   # completed on its own pre-export
+                    continue
+                if isinstance(payload, BaseException):
+                    raise payload
+                import uuid
+
+                req_id = uuid.uuid4().hex
+                same_host = bool(dst.get("dst_node")) and \
+                    dst["dst_node"] == self.identity()["node"]
+                desc = self._kv_sender.ship(
+                    KVExport(token=payload["last_token"],
+                             prompt_len=payload["pos"],
+                             block_size=payload["block_size"],
+                             kv=payload["kv"]),
+                    req_id=req_id, dst_id=dst["dst"],
+                    same_host=same_host)
+                # budget: adoption re-emits the handoff token (deduped
+                # by the handle), so the destination owes remaining+1
+                req.emit({"__migrate__": {
+                    "desc": desc, "dst": dst["dst"],
+                    "prompt_tokens": payload["fed_tokens"],
+                    "first_token": payload["last_token"],
+                    "max_new_tokens": (payload["max_new_tokens"]
+                                       - payload["generated"] + 1),
+                    "eos": payload["eos"],
+                }})
+                req.emit(None)
+                migrated += 1
+                try:
+                    events.emit("serve_session_migrated", replica=me,
+                                dst=dst["dst"], req=req_id,
+                                kv_tokens=payload["pos"],
+                                generated=payload["generated"])
+                except Exception:
+                    pass
+            except BaseException as e:  # noqa: BLE001 - per-session
+                failed += 1
+                try:
+                    req.emit(e)
+                except Exception:
+                    pass
+        return {"sessions": len(pending), "migrated": migrated,
+                "failed": failed, "finished": finished}
 
     def stats(self) -> Dict[str, Any]:
         out = dict(self.engine.stats)

@@ -19,6 +19,12 @@ from ray_tpu.train.config import (
     ScalingConfig,
 )
 from ray_tpu.train.backend import Backend, BackendConfig, JaxConfig
+from ray_tpu.train.backend_executor import (
+    ElasticWorldSizeError,
+    TrainingProtocolError,
+    TrainingWorkerError,
+    WorkerDeathError,
+)
 from ray_tpu.train.session import (
     TrainContext,
     get_checkpoint,
@@ -59,6 +65,10 @@ __all__ = [
     "Backend",
     "BackendConfig",
     "JaxConfig",
+    "TrainingWorkerError",
+    "TrainingProtocolError",
+    "WorkerDeathError",
+    "ElasticWorldSizeError",
     "TrainContext",
     "get_checkpoint",
     "get_context",

@@ -81,9 +81,29 @@ class Checkpoint:
 # Pytree save/restore
 # ---------------------------------------------------------------------------
 
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
 def save_pytree(tree: Any, path: str, *, name: str = "state") -> None:
     """Save a pytree of arrays under ``path``. Device arrays are pulled to
-    host as numpy; structure goes to a pickle next to the flat arrays."""
+    host as numpy; structure goes to a pickle next to the flat arrays.
+
+    Crash-atomic: payloads are written as ``.tmp-*`` siblings in the
+    target dir (same filesystem, so ``os.replace`` is atomic), fsynced,
+    renamed into place, and only then is the ``.metadata.json``
+    completeness marker landed (merge-updating any user-set metadata) and
+    the directory fsynced. A worker killed mid-save leaves either temp
+    litter or the previous files — never a readable-but-torn save that
+    ``trainer._latest_checkpoint`` could resume from.
+    """
     import jax
 
     os.makedirs(path, exist_ok=True)
@@ -93,10 +113,36 @@ def save_pytree(tree: Any, path: str, *, name: str = "state") -> None:
         if hasattr(leaf, "addressable_data"):   # jax.Array (maybe sharded)
             leaf = jax.device_get(leaf)
         host.append(np.asarray(leaf))
-    np.savez(os.path.join(path, f"{name}_{_TREE_FILE}"),
-             **{str(i): a for i, a in enumerate(host)})
-    with open(os.path.join(path, f"{name}_{_STRUCT_FILE}"), "wb") as f:
+    final_tree = os.path.join(path, f"{name}_{_TREE_FILE}")
+    final_struct = os.path.join(path, f"{name}_{_STRUCT_FILE}")
+    tmp_tree = os.path.join(path, f".tmp-{name}_{_TREE_FILE}")
+    tmp_struct = os.path.join(path, f".tmp-{name}_{_STRUCT_FILE}")
+    with open(tmp_tree, "wb") as f:
+        np.savez(f, **{str(i): a for i, a in enumerate(host)})
+        f.flush()
+        os.fsync(f.fileno())
+    with open(tmp_struct, "wb") as f:
         pickle.dump(treedef, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp_tree, final_tree)
+    os.replace(tmp_struct, final_struct)
+    meta_path = os.path.join(path, _METADATA_FILE)
+    meta: Dict[str, Any] = {}
+    if os.path.exists(meta_path):
+        try:
+            with open(meta_path) as f:
+                meta = json.load(f)
+        except (OSError, ValueError):
+            meta = {}
+    meta.setdefault("pytrees", {})[name] = {"leaves": len(host)}
+    tmp_meta = os.path.join(path, f".tmp-{_METADATA_FILE.lstrip('.')}")
+    with open(tmp_meta, "w") as f:
+        json.dump(meta, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp_meta, meta_path)
+    _fsync_dir(path)
 
 
 class AsyncSave:

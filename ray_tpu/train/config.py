@@ -35,6 +35,25 @@ class ScalingConfig:
     placement_strategy: str = "PACK"
     topology: Optional[str] = None        # e.g. "v5e-256" (informational)
     mesh: MeshConfig = field(default_factory=MeshConfig)
+    # Elastic membership (r20): ``min_workers`` turns preemption tolerance
+    # on — on worker/node loss the BackendExecutor re-forms the gang at
+    # the largest placeable world size in [min_workers, num_workers]
+    # instead of failing the run, and re-expands toward ``num_workers``
+    # at checkpoint boundaries when capacity returns. None (default)
+    # keeps the fixed-size gang: any loss is a group restart that burns a
+    # FailureConfig.max_failures attempt. Elastic mode requires the user
+    # loop to honor the rescale contract (read get_world_size() fresh
+    # every session; see TrainContext.world_epoch).
+    min_workers: Optional[int] = None
+
+    @property
+    def elastic(self) -> bool:
+        return self.min_workers is not None
+
+    def resolved_min_workers(self) -> int:
+        if self.min_workers is None:
+            return self.num_workers
+        return max(1, min(int(self.min_workers), self.num_workers))
 
     def worker_resources(self) -> Dict[str, float]:
         res = dict(self.resources_per_worker)
@@ -71,12 +90,31 @@ class ScalingConfig:
 
 @dataclass
 class FailureConfig:
-    """Restart-the-whole-group semantics (reference
-    ``backend_executor.py:708 _restart``): on a TPU slice one lost host
-    kills the ICI collective, so recovery is group restart from the last
-    checkpoint, not per-task lineage."""
+    """Failure-recovery budget for a training run.
+
+    Group restart (reference ``backend_executor.py:708 _restart``) is now
+    the FALLBACK, not the only recovery: with
+    ``ScalingConfig.min_workers`` set, a worker/node loss first goes
+    through the elastic path — fence the survivors, re-form the gang at
+    the largest placeable world size, and resume from the last
+    all-ranks-ok checkpoint — WITHOUT consuming a ``max_failures``
+    attempt (preemption is weather, not a failure of the job).
+    ``max_failures`` attempts are spent only when recovery has to fall
+    back to a same-size group restart: elasticity disabled
+    (``min_workers=None`` — on a TPU slice one lost host kills the ICI
+    collective, so fixed-topology runs must restart the whole gang), the
+    surviving capacity below ``min_workers``, or the re-form loop itself
+    failing ``elastic_reform_attempts`` times (double preemption burning
+    every candidate world size).
+    """
 
     max_failures: int = 0
+    # bound on consecutive fence->re-form->resume attempts per membership
+    # change: each attempt re-probes placeable capacity, so a second
+    # preemption DURING re-form just shrinks the next attempt's target
+    # (convergence), and the bound turns a pathological churn loop into
+    # an ordinary group-restart fallback instead of a livelock.
+    elastic_reform_attempts: int = 8
 
 
 @dataclass

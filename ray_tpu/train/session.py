@@ -35,12 +35,28 @@ class TrainContext:
     trial_id: str = "0"
     loop_config: Dict[str, Any] = field(default_factory=dict)
     dataset_shards: Dict[str, Any] = field(default_factory=dict)
+    # elastic membership (r20): the epoch bumps every time the gang is
+    # re-formed at a new world size (preemption shrink / capacity-restore
+    # expand); ``resumed_from`` names the checkpoint this session resumed
+    # from, or None on a cold start. The LR/batch rescale contract: the
+    # user loop reads get_world_size() EVERY session (never caches it
+    # across restarts — graftlint ``stale-world-size``) and rescales its
+    # per-host batch / learning rate from it, so global batch semantics
+    # survive world-size changes.
+    world_epoch: int = 0
+    resumed_from: Optional[str] = None
 
     def get_world_rank(self) -> int:
         return self.world_rank
 
     def get_world_size(self) -> int:
         return self.world_size
+
+    def get_world_epoch(self) -> int:
+        return self.world_epoch
+
+    def get_resumed_from(self) -> Optional[str]:
+        return self.resumed_from
 
     def get_local_rank(self) -> int:
         return self.local_rank
@@ -135,6 +151,17 @@ class _Session:
                 ckpt_path, f".rank_{self.context.world_rank}.ok")
             with open(marker, "w"):
                 pass
+            # world-size stamp: elastic resume must know how many rank
+            # markers make this checkpoint complete — the CURRENT gang's
+            # size is no longer a valid guess once world size can change
+            # between checkpoints. Every rank writes the same value
+            # (idempotent); written after the rank dir like the marker.
+            ws_path = os.path.join(ckpt_path, ".world_size")
+            if not os.path.exists(ws_path):
+                tmp = ws_path + f".tmp.{self.context.world_rank}"
+                with open(tmp, "w") as f:
+                    f.write(str(self.context.world_size))
+                os.replace(tmp, ws_path)
         # step telemetry: each report is one user-loop step — inter-report
         # wall time + well-known keys land in the metrics registry (and
         # federate to the head /metrics); never fails the report

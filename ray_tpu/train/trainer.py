@@ -23,7 +23,10 @@ import uuid
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ray_tpu.train.backend import BackendConfig, JaxConfig
-from ray_tpu.train.backend_executor import BackendExecutor
+from ray_tpu.train.backend_executor import (
+    BackendExecutor,
+    WorkerDeathError,
+)
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.config import (
     CheckpointConfig,
@@ -158,9 +161,28 @@ class DataParallelTrainer(BaseTrainer):
         progress_path = os.path.join(trial_dir, "progress.jsonl")
         last_metrics: Dict[str, Any] = {}
         checkpoints: List[Tuple[Dict[str, Any], str]] = []
+        elastic = self.scaling_config.elastic
+        reform_attempts = \
+            self.run_config.failure_config.elastic_reform_attempts
         with open(progress_path, "a") as progress:
             while True:
-                results = executor.get_next_results()
+                try:
+                    results = executor.get_next_results()
+                except WorkerDeathError as e:
+                    if not elastic:
+                        raise
+                    # elastic shrink: preemption is weather, not a
+                    # failure — fence, re-form at the largest placeable
+                    # size, resume from the last all-ranks-ok checkpoint
+                    # WITHOUT burning a max_failures attempt. If the
+                    # floor can't hold (ElasticWorldSizeError) this
+                    # raises into fit()'s group-restart fallback.
+                    latest = _latest_checkpoint(
+                        trial_dir, self.scaling_config.num_workers) \
+                        or start_ckpt
+                    executor.reform(latest, reason="shrink",
+                                    attempts=reform_attempts)
+                    continue
                 if results is None:
                     break
                 rank0_metrics, _ = results[0]
@@ -172,6 +194,12 @@ class DataParallelTrainer(BaseTrainer):
                 if ckpt_dir:
                     checkpoints.append((last_metrics, ckpt_dir))
                     self._prune_checkpoints(checkpoints)
+                    if elastic:
+                        # scale-back-up at the epoch boundary: an
+                        # all-ranks-ok checkpoint just landed, so this is
+                        # the exact point a bigger gang can resume from
+                        executor.maybe_expand(ckpt_dir,
+                                              attempts=reform_attempts)
         executor.finish_training()
         best = checkpoints[-1][1] if checkpoints else None
         return Result(
@@ -235,6 +263,23 @@ class JaxTrainer(DataParallelTrainer):
                          backend_config=backend, **kwargs)
 
 
+def _is_torn_save_dir(path: str) -> bool:
+    """A rank dir holding pytree payload files without their
+    ``.metadata.json`` completeness marker (or crash-atomic ``.tmp-``
+    litter) was killed mid-save — resuming from it would load a torn
+    state. Non-pytree checkpoints (user-managed files) carry no marker
+    contract and are accepted as-is."""
+    try:
+        entries = os.listdir(path)
+    except OSError:
+        return True
+    if any(e.startswith(".tmp-") for e in entries):
+        return True
+    has_pytree = any(e.endswith("_pytree.npz")
+                     or e.endswith("_pytree_struct.pkl") for e in entries)
+    return has_pytree and ".metadata.json" not in entries
+
+
 def _latest_checkpoint(trial_dir: str,
                        world_size: int = 1) -> Optional[str]:
     """Newest checkpoint that every rank finished persisting. A gang that
@@ -243,7 +288,14 @@ def _latest_checkpoint(trial_dir: str,
     but unverifiable — so resume accepts ONLY checkpoints carrying every
     rank's ``.rank_R.ok`` marker (written by session.report after the
     copy). Rank-dir presence alone proves nothing: the kill can land
-    after the copies and before the first marker."""
+    after the copies and before the first marker.
+
+    Elastic runs change world size between checkpoints, so completeness
+    is judged against the ``.world_size`` stamp each checkpoint carries
+    (falling back to the caller's ``world_size`` for pre-elastic dirs).
+    Rank dirs that LOOK complete but were killed mid ``save_pytree``
+    (payload files without the ``.metadata.json`` marker, or temp
+    litter) are skipped too — see :func:`_is_torn_save_dir`."""
     if not os.path.isdir(trial_dir):
         return None
     for name in sorted((d for d in os.listdir(trial_dir)
@@ -253,7 +305,18 @@ def _latest_checkpoint(trial_dir: str,
             entries = os.listdir(path)
         except OSError:
             continue
-        if all(f".rank_{r}.ok" in entries
-               for r in range(max(world_size, 1))):
-            return path
+        ws = max(world_size, 1)
+        if ".world_size" in entries:
+            try:
+                with open(os.path.join(path, ".world_size")) as f:
+                    ws = max(int(f.read().strip()), 1)
+            except (OSError, ValueError):
+                continue  # unreadable stamp: do not trust the dir
+        if not all(f".rank_{r}.ok" in entries for r in range(ws)):
+            continue
+        if any(_is_torn_save_dir(os.path.join(path, d))
+               for d in entries if d.startswith("rank_")
+               and os.path.isdir(os.path.join(path, d))):
+            continue
+        return path
     return None

@@ -43,7 +43,14 @@ sites bidirectionally in sync)::
     object_restore        a spilled object was promoted back into shm
     serve_replica_death   a serve replica died and was dropped
     serve_reroute         serve handles were told to refresh routing
+    serve_drain           a serve replica is draining: live sessions
+                          migrate to surviving replicas before the stop
+    serve_session_migrated  a live decode session's KV blocks shipped to
+                          a surviving replica (no re-prefill)
     checkpoint_resume     training resumed from a persisted checkpoint
+    train_world_epoch     elastic membership change: the train gang
+                          re-formed at a new world size (shrink on
+                          preemption / expand on restored capacity)
     alert_raised          the watchdog raised an alert (util/alerts.py)
     alert_cleared         a raised alert condition went away
     jit_recompile         a registered program recompiled past its first
@@ -74,6 +81,8 @@ _SEVERITY = {
     "serve_replica_death": "error",
     "actor_restart": "warning",
     "gcs_restart": "warning",
+    "serve_drain": "warning",
+    "train_world_epoch": "warning",
     "alert_raised": "warning",
     "alert_cleared": "info",
     "jit_recompile": "warning",

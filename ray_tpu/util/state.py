@@ -216,6 +216,28 @@ def list_events(limit: int = 1000,
     return _apply_filters(rt.event_store.snapshot(int(limit)), filters)
 
 
+def subscribe_node_events(callback) -> bool:
+    """Register ``callback(payload)`` for node lifecycle pubsub events
+    (``{"event": "down"|"up", "node_id": ..., "cause": ...}``),
+    delivered AFTER the cluster adapter's own failure handling has run
+    for the node. Returns False off-cluster (single-node runtimes have
+    no membership to watch). This is the public seam the train layer's
+    elastic membership machinery (r20) subscribes through — callbacks
+    run on the adapter's io pool, so keep them non-blocking."""
+    rt = _gcs()
+    if rt.cluster is None:
+        return False
+    rt.cluster.subscribe_node_events(callback)
+    return True
+
+
+def unsubscribe_node_events(callback) -> None:
+    """Remove a :func:`subscribe_node_events` callback (idempotent)."""
+    rt = _gcs()
+    if rt.cluster is not None:
+        rt.cluster.unsubscribe_node_events(callback)
+
+
 def device_report() -> Dict[str, Any]:
     """Cluster-wide device plane: every process's compiled-program
     registry (compiles, retraces, signatures, cost/memory analysis),

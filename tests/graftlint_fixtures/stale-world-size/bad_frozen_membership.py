@@ -1,0 +1,22 @@
+"""Positive fixture: world_size/rank frozen into state that outlives
+the training session — module globals, class attributes, def-time
+defaults, and a closure cell."""
+from ray_tpu.train import get_context
+
+WORLD_SIZE = get_context().world_size          # module state
+
+
+class LRSchedule:
+    ranks = get_context().get_world_size()     # class state
+
+    def scale(self, lr, ws=get_context().world_size):  # def-time default
+        return lr * ws
+
+
+def make_step(ctx):
+    rank = ctx.get_world_rank()                # frozen into a closure
+
+    def step(batch):
+        return batch[rank]
+
+    return step

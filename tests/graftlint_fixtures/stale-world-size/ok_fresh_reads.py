@@ -1,0 +1,23 @@
+"""Negative fixture: membership is re-read from the TrainContext at use
+time; the CONTEXT object may be captured (its fields are re-stamped per
+session), and reads passed as plain call arguments are fine."""
+from ray_tpu.train import get_context
+
+
+def train_loop(config):
+    ctx = get_context()
+    for _ in range(config["epochs"]):
+        ws = ctx.get_world_size()              # fresh read each epoch
+        do_step(config["lr"] * ws, ctx.world_rank)
+
+
+def make_step(ctx):
+    def step(batch):
+        # re-read inside the closure: always the current membership
+        return batch[ctx.get_world_rank()]
+
+    return step
+
+
+def do_step(lr, rank):
+    return lr, rank

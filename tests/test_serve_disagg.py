@@ -664,3 +664,125 @@ def test_multinode_proxy_routing_and_pool_reports():
     finally:
         ray_tpu.shutdown()
         c.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# elastic drain (r20): live-session migration, no re-prefill
+# ---------------------------------------------------------------------------
+
+def test_disagg_drain_migrates_live_session_token_exact(rt_serve):
+    """Preemption drain: with a live decode stream in flight,
+    drain_decode_replica ships the session's KV blocks to the surviving
+    decode replica and the handle splices the continuation — the caller
+    sees the EXACT token sequence of an undisturbed run, the prefill
+    pool never re-prefills, and the drain/migration land on the event
+    plane (acceptance criterion (c) of the elasticity issue)."""
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.util import state
+
+    h = serve.deploy_disagg(
+        "llama-debug", name="ddrain", prefill_replicas=1,
+        decode_replicas=2, max_slots=4, max_len=2048, block_size=8,
+        prefill_chunk=8, seed=0)
+    try:
+        from conftest import poll_until
+
+        prompt = np.random.default_rng(7).integers(0, 256, 20).tolist()
+        # reference: undisturbed 40-token stream, consumed to completion
+        # so its session retires (greedy sampling makes the drained
+        # run's first 40 tokens comparable)
+        ref = list(h.stream(prompt, 40))
+        assert len(ref) == 40
+
+        # drained run: a deliberately huge budget keeps the session
+        # in flight for the whole drain dance
+        g = h.stream(prompt, 1200)
+        got = [next(g) for _ in range(5)]        # stream provably live
+
+        # the live session sits on exactly one decode replica (the
+        # reference session has retired): that replica is the victim
+        h.decode._refresh(force=True)
+        reps = list(h.decode._replicas)
+        assert len(reps) == 2
+        by_hex = {r._actor_id.binary().hex(): r for r in reps}
+
+        def one_victim():
+            stats = {hx: ray_tpu.get(
+                r.handle_request.remote("stats", (), {}), timeout=60)
+                for hx, r in by_hex.items()}
+            v = [hx for hx, s in stats.items() if s["inflight"] >= 1]
+            return v if len(v) == 1 else None
+
+        victim = poll_until(one_victim, timeout=30,
+                            desc="exactly one live decode session")[0]
+
+        report = h.drain_decode_replica(victim, timeout_s=60.0)
+        assert report["sessions"] == 1, report
+        assert report["migrated"] == 1 and report["failed"] == 0, report
+
+        # token-exact continuation across the splice — the destination
+        # adopted the shipped KV against the fed-token transcript; any
+        # re-prefill drift or handoff-token duplication breaks this
+        while len(got) < 40:
+            got.append(next(g))
+        assert got == ref, (got, ref)
+        g.close()
+
+        # the victim exported the live session; the survivor adopted it
+        vstats = ray_tpu.get(
+            by_hex[victim].handle_request.remote("stats", (), {}),
+            timeout=60)
+        assert vstats["migrated_out"] == 1
+        # no re-prefill: the prefill pool served exactly the two
+        # original streams
+        h.prefill._refresh(force=True)
+        pstats = ray_tpu.get(
+            h.prefill._replicas[0].handle_request.remote(
+                "stats", (), {}), timeout=60)
+        assert pstats["exported"] == 2, pstats
+
+        # event-plane records: one drain, one migrated session bound for
+        # a SURVIVING replica with real KV cargo (replica rings ship to
+        # the head asynchronously: poll)
+        def drain_events():
+            evs = state.list_events(limit=100000)
+            drains = [e for e in evs if e.get("name") == "serve_drain"]
+            migs = [e for e in evs
+                    if e.get("name") == "serve_session_migrated"]
+            return (drains, migs) if drains and migs else None
+
+        drains, migs = poll_until(drain_events, timeout=30,
+                                  desc="drain events reach the head")
+        assert int(drains[-1]["sessions"]) >= 1
+        assert len(migs) == 1
+        assert migs[0]["dst"] != victim
+        assert int(migs[0]["kv_tokens"]) >= len(prompt)
+    finally:
+        h.shutdown()
+
+
+def test_drain_decode_replica_argument_errors(rt_serve):
+    """Victim addressing: unknown actor id is a loud error; an unknown
+    node id is a no-op report (the shape a stale preemption notice
+    arrives in); draining needs a surviving peer."""
+    import pytest as _pytest
+
+    from ray_tpu import serve
+
+    h = serve.deploy_disagg(
+        "llama-debug", name="ddrain2", prefill_replicas=1,
+        decode_replicas=1, max_slots=2, max_len=64, block_size=8,
+        prefill_chunk=8, seed=0)
+    try:
+        with _pytest.raises(ValueError):
+            h.drain_decode_replica("feedfacefeedface")
+        assert h.drain_decode_replica(node_id="no-such-node") == {
+            "sessions": 0, "migrated": 0, "failed": 0, "finished": 0}
+        # sole decode replica: no surviving peer to migrate to
+        h.decode._refresh(force=True)
+        only = h.decode._replicas[0]._actor_id.binary().hex()
+        with _pytest.raises(RuntimeError):
+            h.drain_decode_replica(only)
+    finally:
+        h.shutdown()

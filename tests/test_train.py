@@ -306,3 +306,303 @@ def test_xla_compiler_options_knob(monkeypatch):
              "opt_state": optax.sgd(0.1).init({"w": jnp.ones((4,))})}
     out, _ = step(state, {"x": jnp.asarray(np.ones(4, np.float32))})
     assert int(out["step"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# Elastic membership (r20): crash-atomic checkpoints, reform convergence,
+# enriched death errors, end-to-end elastic resume
+# ---------------------------------------------------------------------------
+
+def test_save_pytree_crash_atomic_markers(tmp_path):
+    """A completed save leaves no ``.tmp-`` litter and carries the
+    ``.metadata.json`` completeness marker; a dir missing the marker or
+    holding temp litter reads as torn (resume must skip it)."""
+    from ray_tpu.train.trainer import _is_torn_save_dir
+
+    d = tmp_path / "rank_0"
+    save_pytree({"w": np.ones((3,), np.float32)}, str(d))
+    entries = os.listdir(d)
+    assert not any(e.startswith(".tmp-") for e in entries)
+    assert ".metadata.json" in entries
+    assert not _is_torn_save_dir(str(d))
+    # user-set metadata survives the save's marker merge
+    from ray_tpu.train.checkpoint import Checkpoint as Ckpt
+
+    Ckpt(str(d)).update_metadata({"step": 7})
+    save_pytree({"w": np.zeros((3,), np.float32)}, str(d))
+    assert Ckpt(str(d)).get_metadata()["step"] == 7
+    # kill-before-marker shape: payloads present, marker missing
+    os.remove(d / ".metadata.json")
+    assert _is_torn_save_dir(str(d))
+    # kill-mid-rename shape: temp litter next to a marker
+    save_pytree({"w": np.ones((3,), np.float32)}, str(d))
+    (d / ".tmp-state_pytree.npz").write_bytes(b"partial")
+    assert _is_torn_save_dir(str(d))
+    # non-pytree checkpoints (user-managed files) carry no contract
+    u = tmp_path / "user"
+    u.mkdir()
+    (u / "model.pkl").write_bytes(b"x")
+    assert not _is_torn_save_dir(str(u))
+
+
+def test_latest_checkpoint_world_size_stamp_and_torn_dirs(tmp_path):
+    """Resume-point selection: all-ranks-ok judged against each
+    checkpoint's own ``.world_size`` stamp (elastic runs change size
+    between checkpoints), torn rank dirs and unreadable stamps skipped."""
+    from ray_tpu.train.trainer import _latest_checkpoint
+
+    def mk(name, ws=None, oks=(), ranks=(), torn_rank=None):
+        d = tmp_path / name
+        d.mkdir()
+        if ws is not None:
+            (d / ".world_size").write_text(str(ws))
+        for r in oks:
+            (d / f".rank_{r}.ok").write_text("")
+        for r in ranks:
+            (d / f"rank_{r}").mkdir()
+        if torn_rank is not None:
+            rd = d / f"rank_{torn_rank}"
+            np.savez(rd / "state_pytree.npz")  # payload, no marker
+        return str(d)
+
+    assert _latest_checkpoint(str(tmp_path), 2) is None
+    # complete at the stamped (shrunken) world size 1 — even though the
+    # caller's requested size is 2
+    c0 = mk("checkpoint_000000", ws=2, oks=(0, 1), ranks=(0, 1))
+    c1 = mk("checkpoint_000001", ws=1, oks=(0,), ranks=(0,))
+    assert _latest_checkpoint(str(tmp_path), 2) == c1
+    # missing a rank marker for its stamp: skipped, falls back to c1
+    mk("checkpoint_000002", ws=2, oks=(0,), ranks=(0, 1))
+    assert _latest_checkpoint(str(tmp_path), 2) == c1
+    # newest is complete -> wins
+    c3 = mk("checkpoint_000003", ws=2, oks=(0, 1), ranks=(0, 1))
+    assert _latest_checkpoint(str(tmp_path), 2) == c3
+    # a torn rank dir (killed mid save_pytree) disqualifies the dir
+    mk("checkpoint_000004", ws=1, oks=(0,), ranks=(0,), torn_rank=0)
+    assert _latest_checkpoint(str(tmp_path), 2) == c3
+    # unreadable stamp: do not trust the dir
+    c5 = mk("checkpoint_000005", oks=(0, 1), ranks=(0, 1))
+    (tmp_path / "checkpoint_000005" / ".world_size").write_text("junk")
+    assert _latest_checkpoint(str(tmp_path), 2) == c3
+    # pre-elastic dirs (no stamp) judged against the caller's size
+    os.remove(tmp_path / "checkpoint_000005" / ".world_size")
+    assert _latest_checkpoint(str(tmp_path), 2) == c5
+    assert c0  # silence unused warning
+
+
+def _stub_executor(monkeypatch, probes, fail_first_starts=0):
+    """BackendExecutor with placement/spawn stubbed: ``probes`` feeds
+    successive _placeable_world_size() answers; the first
+    ``fail_first_starts`` start() calls die (double preemption: a node
+    lost while the NEW group places)."""
+    from ray_tpu.train.backend import BackendConfig
+    from ray_tpu.train.backend_executor import BackendExecutor
+
+    ex = BackendExecutor(BackendConfig(),
+                         ScalingConfig(num_workers=4, min_workers=1))
+    ex._spec = {"train_fn": lambda: None, "loop_config": {},
+                "trial_dir": "/tmp/x", "experiment_name": "x",
+                "datasets": {}}
+    calls = {"starts": [], "launches": [], "shutdowns": 0}
+    it = iter(probes)
+    monkeypatch.setattr(ex, "_placeable_world_size", lambda: next(it))
+    monkeypatch.setattr(ex, "shutdown",
+                        lambda: calls.__setitem__(
+                            "shutdowns", calls["shutdowns"] + 1))
+
+    def fake_start(num_workers=None):
+        calls["starts"].append(num_workers)
+        if len(calls["starts"]) <= fail_first_starts:
+            raise ConnectionError("node lost during placement")
+        ex._world_size = num_workers
+
+    monkeypatch.setattr(ex, "start", fake_start)
+    monkeypatch.setattr(ex, "_launch_sessions",
+                        lambda ckpt: calls["launches"].append(ckpt))
+    return ex, calls
+
+
+def test_reform_double_preemption_converges(monkeypatch):
+    """A second preemption DURING re-form fails that attempt; the next
+    attempt re-probes (shrunken) capacity and lands — no livelock, and
+    the world epoch reflects every fencing attempt."""
+    ex, calls = _stub_executor(monkeypatch, probes=[3, 2],
+                               fail_first_starts=1)
+    assert ex.reform("/ckpt/5", reason="shrink") == 2
+    assert calls["starts"] == [3, 2]          # re-probe, not retry-at-3
+    assert calls["launches"] == ["/ckpt/5"]   # sessions resume from ckpt
+    assert ex.world_epoch == 2                # one bump per fence
+    assert ex.world_size == 2
+
+
+def test_reform_floor_and_attempt_bound(monkeypatch):
+    """Capacity below min_workers raises ElasticWorldSizeError (the
+    group-restart fallback owns it); persistent churn exhausts the
+    attempt bound instead of livelocking."""
+    from ray_tpu.train.backend_executor import (
+        ElasticWorldSizeError, TrainingWorkerError)
+
+    ex, _ = _stub_executor(monkeypatch, probes=[0])
+    with pytest.raises(ElasticWorldSizeError):
+        ex.reform(None)
+    ex2, calls2 = _stub_executor(monkeypatch, probes=[3, 3, 3],
+                                 fail_first_starts=3)
+    with pytest.raises(TrainingWorkerError) as ei:
+        ex2.reform(None, attempts=3)
+    assert not isinstance(ei.value, ElasticWorldSizeError)
+    assert len(calls2["starts"]) == 3
+    # reform before start_training is a caller bug, not a retry case
+    from ray_tpu.train.backend import BackendConfig
+    from ray_tpu.train.backend_executor import BackendExecutor
+
+    with pytest.raises(TrainingWorkerError):
+        BackendExecutor(BackendConfig(),
+                        ScalingConfig(num_workers=2)).reform(None)
+
+
+def test_maybe_expand_only_when_capacity_returns(monkeypatch):
+    ex, calls = _stub_executor(monkeypatch, probes=[2, 4])
+    ex._world_size = 2
+    assert ex.maybe_expand("/ckpt/1") is None      # probe says 2: no-op
+    assert calls["starts"] == []
+    assert ex.maybe_expand("/ckpt/2") == 4         # capacity returned
+    assert calls["starts"] == [4]
+    assert calls["launches"] == ["/ckpt/2"]
+    ex._world_size = 4
+    assert ex.maybe_expand("/ckpt/3") is None      # at requested size
+
+
+class _FakeWorkers:
+    """worker_group stand-in: each worker's next_result.remote() hands
+    back a sentinel the monkeypatched ray_tpu.get resolves."""
+
+    class _W:
+        def __init__(self, outcome):
+            class _M:
+                def __init__(self, outcome):
+                    self._o = outcome
+
+                def remote(self, timeout):
+                    return self._o
+
+            self.next_result = _M(outcome)
+
+    def __init__(self, outcomes):
+        self.workers = [self._W(o) for o in outcomes]
+
+
+def _fake_get(monkeypatch):
+    def get(ref, **kw):
+        if isinstance(ref, BaseException):
+            raise ref
+        return ref
+
+    monkeypatch.setattr(ray_tpu, "get", get)
+
+
+def test_get_next_results_names_dead_ranks_and_node_events(monkeypatch):
+    """A dead rank surfaces as WorkerDeathError carrying WHICH ranks
+    died and the node events recorded since the last drain — not a bare
+    'inconsistent worker states'."""
+    from ray_tpu.core.exceptions import ActorDiedError
+    from ray_tpu.train.backend import BackendConfig
+    from ray_tpu.train.backend_executor import (
+        BackendExecutor, WorkerDeathError)
+
+    ex = BackendExecutor(BackendConfig(), ScalingConfig(num_workers=2))
+    ex.worker_group = _FakeWorkers([
+        ("result", {"step": 1}, None),
+        ActorDiedError("actor's node died"),
+    ])
+    ex._node_events.append({"event": "down", "node_id": "deadbeef",
+                            "cause": "heartbeat_timeout"})
+    _fake_get(monkeypatch)
+    with pytest.raises(WorkerDeathError) as ei:
+        ex.get_next_results()
+    e = ei.value
+    assert sorted(e.dead_ranks) == [1]
+    assert isinstance(e.dead_ranks[1], ActorDiedError)
+    assert e.node_events and e.node_events[0]["event"] == "down"
+    msg = str(e)
+    assert "rank(s) [1]" in msg and "heartbeat_timeout" in msg
+    # the drain is a drain: a second failure reports only fresh events
+    assert ex.drain_node_events() == []
+
+
+def test_get_next_results_lockstep_protocol_error(monkeypatch):
+    """Some ranks done while others still report() is a training-loop
+    bug (mismatched per-rank report counts) — raised as
+    TrainingProtocolError, never retried as a death."""
+    from ray_tpu.train.backend import BackendConfig
+    from ray_tpu.train.backend_executor import (
+        BackendExecutor, TrainingProtocolError, WorkerDeathError)
+
+    ex = BackendExecutor(BackendConfig(), ScalingConfig(num_workers=2))
+    ex.worker_group = _FakeWorkers([
+        ("done", None, None),
+        ("result", {"step": 3}, None),
+    ])
+    _fake_get(monkeypatch)
+    with pytest.raises(TrainingProtocolError) as ei:
+        ex.get_next_results()
+    assert not isinstance(ei.value, WorkerDeathError)
+    assert "rank(s) [0]" in str(ei.value)
+    # a user exception propagates UNCHANGED (group-restart budget owns it)
+    ex.worker_group = _FakeWorkers([ValueError("loop bug"),
+                                    ("result", {}, None)])
+    with pytest.raises(ValueError, match="loop bug"):
+        ex.get_next_results()
+
+
+def test_jax_trainer_elastic_rank_death_resumes_without_burning_budget(
+        rt_train):
+    """End-to-end elastic path on the local runtime: rank 0 SIGKILLs its
+    own process mid-run. With min_workers set the trainer fences,
+    re-forms, and resumes from the last all-ranks-ok checkpoint WITHOUT
+    consuming a max_failures attempt (max_failures=0 here, so any
+    group-restart would have failed the run), bumping world_epoch and
+    emitting train_world_epoch."""
+    marker = os.path.join(rt_train, "killed_once")
+
+    def loop(config):
+        import pickle, signal, tempfile
+
+        import ray_tpu.train as train
+
+        ctx = train.get_context()
+        start = 0
+        ckpt = train.get_checkpoint()
+        if ckpt is not None:
+            with open(os.path.join(ckpt.path, "rank_0", "state.pkl"),
+                      "rb") as f:
+                start = pickle.load(f)["step"] + 1
+        for step in range(start, 4):
+            d = tempfile.mkdtemp()
+            with open(os.path.join(d, "state.pkl"), "wb") as f:
+                pickle.dump({"step": step}, f)
+            train.report({"step": step, "epoch": ctx.world_epoch,
+                          "resumed": ctx.resumed_from or ""},
+                         checkpoint=Checkpoint(d))
+            if (step == 1 and ctx.world_rank == 0
+                    and not os.path.exists(config["marker"])):
+                open(config["marker"], "w").close()
+                os.kill(os.getpid(), signal.SIGKILL)
+
+    trainer = JaxTrainer(
+        loop,
+        train_loop_config={"marker": marker},
+        scaling_config=ScalingConfig(num_workers=2, min_workers=1),
+        run_config=RunConfig(storage_path=rt_train,
+                             failure_config=FailureConfig(max_failures=0)),
+    )
+    result = trainer.fit()
+    assert result.metrics["step"] == 3
+    assert result.metrics["epoch"] >= 1          # post-reform session
+    assert result.metrics["resumed"]             # resumed from a ckpt
+    from ray_tpu.util import state
+
+    evs = [e for e in state.list_events(limit=10000)
+           if e.get("name") == "train_world_epoch"]
+    assert evs, "reform must emit train_world_epoch"
+    assert evs[-1].get("reason") == "shrink"
+    assert int(evs[-1].get("epoch", 0)) >= 1
